@@ -1,0 +1,362 @@
+"""Backend conformance tests: hand-written change JSON in -> exact patch out.
+
+Direct port of the reference suite `/root/reference/test/backend_test.js`.
+These cases pin the wire protocol (change/patch JSON) of the backend.
+"""
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.uuid import uuid
+
+
+class TestIncrementalDiffs:
+    def test_assign_key_in_map(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [], 'type': 'map',
+                       'key': 'bird', 'value': 'magpie'}]
+        }
+
+    def test_conflict_on_same_key(self):
+        change1 = {'actor': 'actor1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': 'actor2', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}
+        ]}
+        s0 = Backend.init('actor1')
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {'actor1': 1, 'actor2': 1}, 'deps': {'actor1': 1, 'actor2': 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [], 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird',
+                       'conflicts': [{'actor': 'actor1', 'value': 'magpie'}]}]
+        }
+
+    def test_delete_key_from_map(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': ROOT_ID, 'key': 'bird'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'remove', 'obj': ROOT_ID, 'path': [], 'type': 'map',
+                       'key': 'bird'}]
+        }
+
+    def test_create_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        s0 = Backend.init(actor)
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'map'},
+                {'action': 'set', 'obj': birds, 'type': 'map', 'path': None,
+                 'key': 'wrens', 'value': 3},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'path': [],
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_assign_in_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': birds, 'key': 'sparrows', 'value': 15}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': birds, 'type': 'map', 'path': ['birds'],
+                       'key': 'sparrows', 'value': 15}]
+        }
+
+    def test_create_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        s0 = Backend.init(actor)
+        s1, patch1 = Backend.apply_changes(s0, [change1])
+        assert patch1 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'path': None,
+                 'index': 0, 'value': 'chaffinch', 'elemId': f'{actor}:1'},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'path': [],
+                 'key': 'birds', 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_apply_updates_inside_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'greenfinch'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': birds, 'type': 'list', 'path': ['birds'],
+                       'index': 0, 'value': 'greenfinch'}]
+        }
+
+    def test_delete_list_elements(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': f'{actor}:1'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change2])
+        assert patch2 == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'remove', 'obj': birds, 'type': 'list', 'path': ['birds'],
+                       'index': 0}]
+        }
+
+
+class TestApplyLocalChange:
+    def test_apply_change_requests(self):
+        actor = uuid()
+        change1 = {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, patch1 = Backend.apply_local_change(s0, change1)
+        assert patch1 == {
+            'actor': actor, 'seq': 1, 'canUndo': True, 'canRedo': False,
+            'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'path': [], 'type': 'map',
+                       'key': 'bird', 'value': 'magpie'}]
+        }
+
+    def test_throws_on_duplicate_requests(self):
+        actor = uuid()
+        change1 = {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'requestType': 'change', 'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'jay'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_local_change(s0, change1)
+        s2, _ = Backend.apply_local_change(s1, change2)
+        with pytest.raises(ValueError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, change1)
+        with pytest.raises(ValueError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, change2)
+
+
+class TestGetPatch:
+    def test_most_recent_value_for_key(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird'}]
+        }
+
+    def test_conflicting_values_for_key(self):
+        change1 = {'actor': 'actor1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': 'actor2', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'blackbird'}
+        ]}
+        s0 = Backend.init('actor1')
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False,
+            'clock': {'actor1': 1, 'actor2': 1}, 'deps': {'actor1': 1, 'actor2': 1},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'bird', 'value': 'blackbird',
+                       'conflicts': [{'actor': 'actor1', 'value': 'magpie'}]}]
+        }
+
+    def test_create_nested_maps(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': birds},
+            {'action': 'set', 'obj': birds, 'key': 'wrens', 'value': 3},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': 'wrens'},
+            {'action': 'set', 'obj': birds, 'key': 'sparrows', 'value': 15}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'map'},
+                {'action': 'set', 'obj': birds, 'type': 'map', 'key': 'sparrows', 'value': 15},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'key': 'birds',
+                 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_create_lists(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'chaffinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 1}, 'deps': {actor: 1},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
+                 'value': 'chaffinch', 'elemId': f'{actor}:1'},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'key': 'birds',
+                 'value': birds, 'link': True}
+            ]
+        }
+
+    def test_latest_state_of_list(self):
+        birds, actor = uuid(), uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': birds},
+            {'action': 'ins', 'obj': birds, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:1', 'value': 'chaffinch'},
+            {'action': 'ins', 'obj': birds, 'key': f'{actor}:1', 'elem': 2},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:2', 'value': 'goldfinch'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'birds', 'value': birds}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'del', 'obj': birds, 'key': f'{actor}:1'},
+            {'action': 'ins', 'obj': birds, 'key': f'{actor}:1', 'elem': 3},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:3', 'value': 'greenfinch'},
+            {'action': 'set', 'obj': birds, 'key': f'{actor}:2', 'value': 'goldfinches!!'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
+            'diffs': [
+                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
+                 'value': 'greenfinch', 'elemId': f'{actor}:3'},
+                {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 1,
+                 'value': 'goldfinches!!', 'elemId': f'{actor}:2'},
+                {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'key': 'birds',
+                 'value': birds, 'link': True}
+            ]
+        }
+
+
+class TestCausalOrdering:
+    def test_buffers_out_of_order_changes(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'jay'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, patch1 = Backend.apply_changes(s0, [change2])
+        assert patch1['diffs'] == []
+        assert Backend.get_missing_deps(s1) == {actor: 1}
+        s2, patch2 = Backend.apply_changes(s1, [change1])
+        # Both changes are applied once the dependency arrives
+        assert s2.op_set.clock == {actor: 2}
+        assert [d['value'] for d in patch2['diffs']] == ['magpie', 'jay']
+
+    def test_duplicate_changes_are_idempotent(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch2 = Backend.apply_changes(s1, [change1])
+        assert patch2['diffs'] == []
+        assert s2.op_set.clock == {actor: 1}
+
+    def test_inconsistent_seq_reuse_raises(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change1b = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'jay'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        with pytest.raises(ValueError, match='Inconsistent reuse of sequence number'):
+            Backend.apply_changes(s1, [change1b])
+
+    def test_old_states_remain_valid(self):
+        actor = uuid()
+        change1 = {'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'magpie'}
+        ]}
+        change2 = {'actor': actor, 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'bird', 'value': 'jay'}
+        ]}
+        s0 = Backend.init(actor)
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, _ = Backend.apply_changes(s1, [change2])
+        # s1 must still see its own version of the world
+        assert s1.op_set.clock == {actor: 1}
+        patch1 = Backend.get_patch(s1)
+        assert patch1['diffs'][0]['value'] == 'magpie'
+        assert [c['seq'] for c in Backend.get_changes(s1, s2)] == [2]
