@@ -1,0 +1,215 @@
+"""automerge_tpu — a TPU-native CRDT framework for collaborative documents.
+
+Same capabilities as the reference Automerge library (v0.9.2,
+`/root/reference/src/automerge.js`): every peer holds a full copy of a JSON
+document, edits it locally/offline, and merging any two copies converges
+automatically. The frontend/session/sync semantics match the reference; the
+backend CRDT engine additionally has a batched device path
+(:mod:`automerge_tpu.device`) that resolves causal graphs for thousands of
+documents at once on TPU via JAX/XLA, with documents sharded over a device
+mesh (:mod:`automerge_tpu.parallel`).
+
+Public API parity (src/automerge.js:122-134): ``init, change, empty_change,
+undo, redo, load, save, merge, diff, get_changes, apply_changes,
+get_missing_deps, equals, inspect, get_history, uuid, Frontend, Backend,
+DocSet, WatchableDoc, Connection, Text`` plus the frontend re-exports
+``can_undo, can_redo, get_actor_id, set_actor_id, get_conflicts``.
+camelCase aliases are provided for users coming from the reference.
+"""
+
+import json as _json
+
+from . import frontend as Frontend
+from . import backend as Backend
+from .common import ROOT_ID, is_object
+from .text import Text
+from .uuid import uuid
+
+__version__ = '0.9.2'
+
+
+def doc_from_changes(actor_id, changes):
+    """Construct a frontend document reflecting `changes`
+    (src/automerge.js:10-17)."""
+    if not actor_id:
+        raise ValueError('actor_id is required in doc_from_changes')
+    doc = Frontend.init({'actorId': actor_id, 'backend': Backend})
+    state, _ = Backend.apply_changes(Backend.init(actor_id), changes)
+    patch = Backend.get_patch(state)
+    patch['state'] = state
+    return Frontend.apply_patch(doc, patch)
+
+
+def init(actor_id=None):
+    """A new empty document with an immediate in-process backend
+    (src/automerge.js:21-23)."""
+    return Frontend.init({'actorId': actor_id, 'backend': Backend})
+
+
+def change(doc, message=None, callback=None):
+    """Edit `doc` via a mutable proxy in `callback`; returns the new document
+    (src/automerge.js:25-28)."""
+    new_doc, _ = Frontend.change(doc, message, callback)
+    return new_doc
+
+
+def empty_change(doc, message=None):
+    new_doc, _ = Frontend.empty_change(doc, message)
+    return new_doc
+
+
+def undo(doc, message=None):
+    new_doc, _ = Frontend.undo(doc, message)
+    return new_doc
+
+
+def redo(doc, message=None):
+    new_doc, _ = Frontend.redo(doc, message)
+    return new_doc
+
+
+def load(data, actor_id=None):
+    """Deserialize a document saved with :func:`save` (src/automerge.js:45-47).
+
+    The reference serializes with transit-immutable-js; this framework uses a
+    plain-JSON envelope of the change history (the wire format of changes is
+    identical, so histories interoperate at the change level).
+    """
+    payload = _json.loads(data)
+    if isinstance(payload, dict):
+        changes = payload['changes']
+    else:
+        changes = payload
+    return doc_from_changes(actor_id or uuid(), changes)
+
+
+def save(doc):
+    """Serialize the full change history (src/automerge.js:49-52)."""
+    state = Frontend.get_backend_state(doc)
+    history = state.op_set.get_history()
+    return _json.dumps({'format': 'automerge-tpu@1', 'changes': history})
+
+
+def merge(local_doc, remote_doc):
+    """Apply changes from `remote_doc` missing in `local_doc`
+    (src/automerge.js:54-64)."""
+    if Frontend.get_actor_id(local_doc) == Frontend.get_actor_id(remote_doc):
+        raise ValueError('Cannot merge an actor with itself')
+    local_state = Frontend.get_backend_state(local_doc)
+    remote_state = Frontend.get_backend_state(remote_doc)
+    state, patch = Backend.merge(local_state, remote_state)
+    if not patch['diffs']:
+        return local_doc
+    patch['state'] = state
+    return Frontend.apply_patch(local_doc, patch)
+
+
+def diff(old_doc, new_doc):
+    """Diffs that transform `old_doc`'s tree into `new_doc`'s
+    (src/automerge.js:66-72)."""
+    old_state = Frontend.get_backend_state(old_doc)
+    new_state = Frontend.get_backend_state(new_doc)
+    changes = Backend.get_changes(old_state, new_state)
+    _, patch = Backend.apply_changes(old_state, changes)
+    return patch['diffs']
+
+
+def get_changes(old_doc, new_doc):
+    old_state = Frontend.get_backend_state(old_doc)
+    new_state = Frontend.get_backend_state(new_doc)
+    return Backend.get_changes(old_state, new_state)
+
+
+def apply_changes(doc, changes):
+    old_state = Frontend.get_backend_state(doc)
+    new_state, patch = Backend.apply_changes(old_state, changes)
+    patch['state'] = new_state
+    return Frontend.apply_patch(doc, patch)
+
+
+def get_missing_deps(doc):
+    return Backend.get_missing_deps(Frontend.get_backend_state(doc))
+
+
+def equals(val1, val2):
+    """Deep equality on document values, ignoring CRDT metadata
+    (src/automerge.js:91-100)."""
+    if isinstance(val1, Text) or isinstance(val2, Text):
+        return isinstance(val1, Text) and isinstance(val2, Text) and list(val1) == list(val2)
+    if isinstance(val1, dict) and isinstance(val2, dict):
+        if sorted(val1.keys()) != sorted(val2.keys()):
+            return False
+        return all(equals(val1[k], val2[k]) for k in val1)
+    if isinstance(val1, list) and isinstance(val2, list):
+        return len(val1) == len(val2) and all(equals(a, b) for a, b in zip(val1, val2))
+    return val1 == val2
+
+
+def inspect(doc):
+    """Plain JSON-like copy of the document without CRDT metadata
+    (src/automerge.js:102-104)."""
+    def clean(value):
+        if isinstance(value, Text):
+            return ''.join(str(v) for v in value)
+        if isinstance(value, dict):
+            return {k: clean(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [clean(v) for v in value]
+        return value
+    return clean(doc)
+
+
+class _HistoryEntry:
+    """One change in the history, with a lazily-built document snapshot
+    (src/automerge.js:106-120)."""
+
+    __slots__ = ('_actor', '_history', '_index')
+
+    def __init__(self, actor, history, index):
+        self._actor = actor
+        self._history = history
+        self._index = index
+
+    @property
+    def change(self):
+        return self._history[self._index]
+
+    @property
+    def snapshot(self):
+        return doc_from_changes(self._actor, self._history[:self._index + 1])
+
+
+def get_history(doc):
+    state = Frontend.get_backend_state(doc)
+    actor = Frontend.get_actor_id(doc)
+    history = state.op_set.get_history()
+    return [_HistoryEntry(actor, history, i) for i in range(len(history))]
+
+
+# Frontend re-exports (src/automerge.js:137-139)
+can_undo = Frontend.can_undo
+can_redo = Frontend.can_redo
+get_actor_id = Frontend.get_actor_id
+set_actor_id = Frontend.set_actor_id
+get_conflicts = Frontend.get_conflicts
+get_object_id = Frontend.get_object_id
+get_element_ids = Frontend.get_element_ids
+
+from .sync.doc_set import DocSet            # noqa: E402
+from .sync.watchable_doc import WatchableDoc  # noqa: E402
+from .sync.connection import Connection     # noqa: E402
+
+# camelCase aliases (reference API parity)
+emptyChange = empty_change
+getChanges = get_changes
+applyChanges = apply_changes
+getMissingDeps = get_missing_deps
+getHistory = get_history
+docFromChanges = doc_from_changes
+canUndo = can_undo
+canRedo = can_redo
+getActorId = get_actor_id
+setActorId = set_actor_id
+getConflicts = get_conflicts
+getObjectId = get_object_id
+getElementIds = get_element_ids

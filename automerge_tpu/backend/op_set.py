@@ -244,19 +244,22 @@ def get_parent(op_set, object_id, key):
 
 
 def insertions_after(op_set, object_id, parent_id, child_id=None):
-    """Children of parent_id in Lamport-descending order (op_set.js:379-390)."""
+    """Children of parent_id in Lamport-descending order (op_set.js:379-390).
+
+    lamport_compare orders by (elem, actor), which is exactly Python tuple
+    comparison, so a key-based sort suffices (no cmp_to_key in this hot path).
+    """
     child_key = None
     if child_id:
         match = _ELEMID_RE.match(child_id)
         if match:
-            child_key = {'actor': match.group(1), 'elem': int(match.group(2))}
+            child_key = (int(match.group(2)), match.group(1))
 
-    import functools
     ops = [op for op in op_set.by_object[object_id].following.get(parent_id, [])
            if op['action'] == 'ins']
     if child_key is not None:
-        ops = [op for op in ops if lamport_compare(op, child_key) < 0]
-    ops.sort(key=functools.cmp_to_key(lamport_compare), reverse=True)
+        ops = [op for op in ops if (op['elem'], op['actor']) < child_key]
+    ops.sort(key=lambda op: (op['elem'], op['actor']), reverse=True)
     return [f"{op['actor']}:{op['elem']}" for op in ops]
 
 

@@ -1,0 +1,14 @@
+"""Sync/session layer: DocSet, WatchableDoc, Connection.
+
+The replication protocol is network-agnostic (parity with reference
+src/connection.js): a Connection exchanges vector-clock advertisements and
+missing changes per docId over a user-supplied send callback. The batched
+TPU path for whole-DocSet merges lives in
+:mod:`automerge_tpu.parallel.docset_engine`.
+"""
+
+from .doc_set import DocSet
+from .watchable_doc import WatchableDoc
+from .connection import Connection
+
+__all__ = ['DocSet', 'WatchableDoc', 'Connection']

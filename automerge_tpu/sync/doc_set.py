@@ -1,0 +1,59 @@
+"""DocSet: a keyed collection of documents with change handlers.
+
+Parity with `/root/reference/src/doc_set.js`. This is also the unit of
+batching for the TPU engine: all documents in a DocSet can be merged in one
+device call (see :mod:`automerge_tpu.parallel.docset_engine`), which is the
+vmap'd equivalent of calling :meth:`apply_changes` per document.
+"""
+
+from .. import frontend as Frontend
+from .. import backend as Backend
+
+
+class DocSet:
+    def __init__(self):
+        self.docs = {}
+        self.handlers = []
+
+    @property
+    def doc_ids(self):
+        return list(self.docs.keys())
+
+    docIds = doc_ids
+
+    def get_doc(self, doc_id):
+        return self.docs.get(doc_id)
+
+    getDoc = get_doc
+
+    def set_doc(self, doc_id, doc):
+        self.docs = dict(self.docs)
+        self.docs[doc_id] = doc
+        for handler in list(self.handlers):
+            handler(doc_id, doc)
+
+    setDoc = set_doc
+
+    def apply_changes(self, doc_id, changes):
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            doc = Frontend.init({'backend': Backend})
+        old_state = Frontend.get_backend_state(doc)
+        new_state, patch = Backend.apply_changes(old_state, changes)
+        patch['state'] = new_state
+        doc = Frontend.apply_patch(doc, patch)
+        self.set_doc(doc_id, doc)
+        return doc
+
+    applyChanges = apply_changes
+
+    def register_handler(self, handler):
+        if handler not in self.handlers:
+            self.handlers = self.handlers + [handler]
+
+    registerHandler = register_handler
+
+    def unregister_handler(self, handler):
+        self.handlers = [h for h in self.handlers if h != handler]
+
+    unregisterHandler = unregister_handler

@@ -13,6 +13,20 @@ class Text:
         self._max_elem = max_elem
         self._frozen = False
 
+    def _freeze(self):
+        # Same contract as AmMap/AmList: materialized objects are immutable.
+        # The elems sequence becomes a tuple so out-of-change mutation fails
+        # loudly instead of silently diverging replicas.
+        object.__setattr__(self, '_frozen', True)
+        object.__setattr__(self, 'elems', tuple(self.elems))
+
+    def __setattr__(self, name, value):
+        if getattr(self, '_frozen', False):
+            from .frontend.datatypes import FrozenError
+            raise FrozenError(
+                'This object is frozen; use change() to modify an Automerge document')
+        object.__setattr__(self, name, value)
+
     def __len__(self):
         return len(self.elems)
 

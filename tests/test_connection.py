@@ -1,0 +1,244 @@
+"""Connection sync-protocol tests with the message-exchange mini-DSL.
+
+Port of /root/reference/test/connection_test.js: N DocSets stand in for
+network nodes; a recording send callback on each directed link; a test
+script is a list of steps that assert each expected message and optionally
+deliver it to the peer or drop it — enabling tests for duplicate delivery
+tolerance, dropped messages, concurrent exchange, and multi-hop forwarding.
+"""
+import pytest
+
+import automerge_tpu as Automerge
+from automerge_tpu import Connection, DocSet
+
+
+class Spy:
+    """Recording send callback (the sinon.spy() equivalent)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, msg):
+        self.calls.append(msg)
+
+    @property
+    def call_count(self):
+        return len(self.calls)
+
+
+class Harness:
+    def __init__(self, nodes, links):
+        self.nodes = nodes
+        self.links = links
+        self.count = {}
+        self.spies = {}
+        self.conns = {}
+        for n1, n2 in links:
+            for a, b in ((n1, n2), (n2, n1)):
+                self.count[(a, b)] = 0
+                self.spies[(a, b)] = Spy()
+                self.conns[(a, b)] = Connection(nodes[a], self.spies[(a, b)])
+        for conn in self.conns.values():
+            conn.open()
+
+    def expect(self, frm, to, deliver=False, drop=False, match=None):
+        spy = self.spies[(frm, to)]
+        if spy.call_count <= self.count[(frm, to)]:
+            raise AssertionError(f'Expected message was not sent: {frm}->{to}')
+        msg = spy.calls[self.count[(frm, to)]]
+        if match:
+            match(msg)
+        if deliver:
+            self.count[(frm, to)] += 1
+            self.conns[(to, frm)].receive_msg(msg)
+        elif drop:
+            self.count[(frm, to)] += 1
+        return msg
+
+    def check_no_unexpected_messages(self):
+        for n1, n2 in self.links:
+            for a, b in ((n1, n2), (n2, n1)):
+                assert self.spies[(a, b)].call_count == self.count[(a, b)], \
+                    (f'Expected {self.count[(a, b)]} messages from {a} to {b}, '
+                     f'saw {self.spies[(a, b)].call_count}')
+
+
+@pytest.fixture
+def doc1():
+    return Automerge.change(Automerge.init(),
+                            lambda doc: doc.__setattr__('doc1', 'doc1'))
+
+
+@pytest.fixture
+def nodes():
+    return [DocSet() for _ in range(5)]
+
+
+class TestConnection:
+    def test_no_messages_if_no_documents(self, nodes):
+        h = Harness(nodes, [(1, 2)])
+        h.check_no_unexpected_messages()
+
+    def test_advertises_local_documents(self, doc1, nodes):
+        nodes[1].set_doc('doc1', doc1)
+        h = Harness(nodes, [(1, 2)])
+        h.expect(1, 2, drop=True, match=lambda msg: (
+            self_assert(msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}})))
+        h.check_no_unexpected_messages()
+
+    def test_sends_document_missing_remotely(self, doc1, nodes):
+        nodes[1].set_doc('doc1', doc1)
+        h = Harness(nodes, [(1, 2)])
+        # Node 1 advertises; node 2 requests; node 1 sends data; node 2 acks.
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {}}))
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg['docId'] == 'doc1' and len(msg['changes']) == 1))
+        assert nodes[2].get_doc('doc1')['doc1'] == 'doc1'
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.check_no_unexpected_messages()
+
+    def test_concurrent_exchange_of_missing_documents(self, doc1, nodes):
+        doc2 = Automerge.change(Automerge.init(),
+                                lambda doc: doc.__setattr__('doc2', 'doc2'))
+        nodes[1].set_doc('doc1', doc1)
+        nodes[2].set_doc('doc2', doc2)
+        h = Harness(nodes, [(1, 2)])
+        h.expect(1, 2, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.expect(2, 1, match=lambda msg: self_assert(
+            msg == {'docId': 'doc2', 'clock': {doc2._actor_id: 1}}))
+        h.expect(1, 2, deliver=True)
+        h.expect(2, 1, deliver=True)
+        # Requests for missing documents cross over
+        h.expect(1, 2, match=lambda msg: self_assert(
+            msg == {'docId': 'doc2', 'clock': {}}))
+        h.expect(2, 1, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {}}))
+        h.expect(1, 2, deliver=True)
+        h.expect(2, 1, deliver=True)
+        # Document data responses
+        h.expect(1, 2, match=lambda msg: self_assert(
+            msg['docId'] == 'doc1' and len(msg['changes']) == 1))
+        h.expect(2, 1, match=lambda msg: self_assert(
+            msg['docId'] == 'doc2' and len(msg['changes']) == 1))
+        h.expect(1, 2, deliver=True)
+        h.expect(2, 1, deliver=True)
+        # Acknowledgements
+        h.expect(1, 2, deliver=True)
+        h.expect(2, 1, deliver=True)
+        h.check_no_unexpected_messages()
+        assert nodes[1].get_doc('doc2')['doc2'] == 'doc2'
+        assert nodes[2].get_doc('doc1')['doc1'] == 'doc1'
+
+    def test_brings_older_copy_up_to_date(self, doc1, nodes):
+        doc2 = Automerge.merge(Automerge.init(), doc1)
+        doc2 = Automerge.change(doc2, lambda doc: doc.__setattr__('doc1', 'doc1++'))
+        nodes[1].set_doc('doc1', doc1)
+        nodes[2].set_doc('doc1', doc2)
+        h = Harness(nodes, [(1, 2)])
+        h.expect(1, 2, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.expect(2, 1, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1',
+                    'clock': {doc1._actor_id: 1, doc2._actor_id: 1}}))
+        h.expect(1, 2, deliver=True)
+        h.expect(2, 1, deliver=True)
+        # Node 2 sends missing changes to node 1
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg['docId'] == 'doc1' and len(msg['changes']) == 1))
+        # Node 1 acknowledges
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1',
+                    'clock': {doc1._actor_id: 1, doc2._actor_id: 1}}))
+        h.check_no_unexpected_messages()
+        assert nodes[1].get_doc('doc1')['doc1'] == 'doc1++'
+
+    def test_bidirectional_merge_of_divergent_copies(self, doc1, nodes):
+        doc2 = Automerge.merge(Automerge.init(), doc1)
+        doc2 = Automerge.change(doc2, lambda doc: doc.__setattr__('two', 'two'))
+        doc1 = Automerge.change(doc1, lambda doc: doc.__setattr__('one', 'one'))
+        nodes[1].set_doc('doc1', doc1)
+        nodes[2].set_doc('doc1', doc2)
+        h = Harness(nodes, [(1, 2)])
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 2}}))
+        h.expect(2, 1, drop=True)
+        # Node 2 sends the change node 1 is missing
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 1, doc2._actor_id: 1}
+            and len(msg['changes']) == 1))
+        # Node 1 acks and sends the change node 2 is missing
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2, doc2._actor_id: 1}
+            and len(msg['changes']) == 1))
+        # Node 2 acknowledges
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2, doc2._actor_id: 1}))
+        h.check_no_unexpected_messages()
+        assert Automerge.inspect(nodes[1].get_doc('doc1')) == \
+            {'doc1': 'doc1', 'one': 'one', 'two': 'two'}
+        assert Automerge.inspect(nodes[2].get_doc('doc1')) == \
+            {'doc1': 'doc1', 'one': 'one', 'two': 'two'}
+
+    def test_forwards_incoming_changes_to_other_connections(self, doc1, nodes):
+        nodes[2].set_doc('doc1', doc1)
+        h = Harness(nodes, [(1, 2), (1, 3)])
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.expect(1, 2, deliver=True)   # node 1 requests from node 2
+        h.expect(2, 1, deliver=True)   # node 2 sends the document
+        assert nodes[1].get_doc('doc1')['doc1'] == 'doc1'
+        h.expect(1, 2, deliver=True)   # ack to node 2
+        h.expect(1, 3, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 1}}))
+        h.expect(3, 1, deliver=True)   # node 3 requests
+        h.expect(1, 3, deliver=True)   # node 1 sends the document
+        assert nodes[3].get_doc('doc1')['doc1'] == 'doc1'
+        h.expect(3, 1, deliver=True)   # ack
+        h.check_no_unexpected_messages()
+
+    def test_tolerates_duplicate_deliveries(self, nodes):
+        doc1 = Automerge.change(Automerge.init(),
+                                lambda doc: doc.__setattr__('list', []))
+        nodes[1].set_doc('doc1', doc1)
+        nodes[2].set_doc('doc1', doc1)
+        nodes[3].set_doc('doc1', doc1)
+        h = Harness(nodes, [(1, 2), (1, 3), (2, 3)])
+        h.expect(1, 2, deliver=True)
+        h.expect(1, 3, deliver=True)
+        h.expect(2, 1, deliver=True)
+        h.expect(2, 3, deliver=True)
+        h.expect(3, 1, deliver=True)
+        h.expect(3, 2, deliver=True)
+
+        # Change on node 1, propagated to nodes 2 and 3
+        doc1 = Automerge.change(doc1, lambda doc: doc.list.push('hello'))
+        nodes[1].set_doc('doc1', doc1)
+        h.expect(1, 2, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2} and len(msg['changes']) == 1))
+        h.expect(1, 3, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2} and len(msg['changes']) == 1))
+        # Node 2 acks to node 1, forwards to node 3
+        h.expect(2, 1, deliver=True, match=lambda msg: self_assert(
+            msg == {'docId': 'doc1', 'clock': {doc1._actor_id: 2}}))
+        h.expect(2, 3, match=lambda msg: self_assert(len(msg['changes']) == 1))
+        # Node 3 receives the change from BOTH node 1 and node 2
+        h.expect(1, 3, deliver=True)
+        h.expect(2, 3, deliver=True)
+        # Acknowledgements from node 3
+        h.expect(3, 1, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2}))
+        h.expect(3, 2, deliver=True, match=lambda msg: self_assert(
+            msg['clock'] == {doc1._actor_id: 2}))
+        h.check_no_unexpected_messages()
+        for n in (1, 2, 3):
+            assert Automerge.inspect(nodes[n].get_doc('doc1')) == {'list': ['hello']}
+
+
+def self_assert(condition):
+    assert condition
+    return True

@@ -1,0 +1,219 @@
+"""Frontend tests: request emission and split-mode backend concurrency.
+
+Port of /root/reference/test/frontend_test.js — exercises the frontend in
+isolation (no immediate backend): change-request emission, the pending
+request queue, and the operational transform that reconciles queued local
+requests against remote patches.
+"""
+import pytest
+
+from automerge_tpu import frontend as Frontend
+from automerge_tpu import backend as Backend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.uuid import uuid
+
+
+def get_requests(doc):
+    out = []
+    for req in doc._state['requests']:
+        req = {k: v for k, v in req.items() if k not in ('before', 'diffs')}
+        out.append(req)
+    return out
+
+
+class TestFrontendChanges:
+    def test_empty_by_default(self):
+        doc = Frontend.init()
+        assert dict(doc) == {}
+        assert Frontend.get_actor_id(doc)
+
+    def test_defer_actor_id(self):
+        doc0 = Frontend.init({'deferActorId': True})
+        assert Frontend.get_actor_id(doc0) is None
+        with pytest.raises(ValueError, match='Actor ID must be initialized'):
+            Frontend.change(doc0, lambda doc: doc.__setattr__('foo', 'bar'))
+        doc1 = Frontend.set_actor_id(doc0, uuid())
+        doc2, req = Frontend.change(doc1, lambda doc: doc.__setattr__('foo', 'bar'))
+        assert dict(doc2) == {'foo': 'bar'}
+
+    def test_unmodified_doc_if_nothing_changed(self):
+        doc0 = Frontend.init()
+        doc1, req = Frontend.change(doc0, lambda doc: None)
+        assert doc1 is doc0
+
+    def test_set_root_properties_request(self):
+        actor = uuid()
+        doc, req = Frontend.change(Frontend.init(actor),
+                                   lambda doc: doc.__setattr__('bird', 'magpie'))
+        assert dict(doc) == {'bird': 'magpie'}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {},
+                       'ops': [{'obj': ROOT_ID, 'action': 'set', 'key': 'bird',
+                                'value': 'magpie'}]}
+
+    def test_create_nested_maps_request(self):
+        doc, req = Frontend.change(Frontend.init(),
+                                   lambda doc: doc.__setattr__('birds', {'wrens': 3}))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert dict(doc) == {'birds': {'wrens': 3}}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {},
+                       'ops': [
+                           {'obj': birds, 'action': 'makeMap'},
+                           {'obj': birds, 'action': 'set', 'key': 'wrens', 'value': 3},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds', 'value': birds},
+                       ]}
+
+    def test_create_lists_request(self):
+        doc, req = Frontend.change(Frontend.init(),
+                                   lambda doc: doc.__setattr__('birds', ['chaffinch']))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1, 'deps': {},
+                       'ops': [
+                           {'obj': birds, 'action': 'makeList'},
+                           {'obj': birds, 'action': 'ins', 'key': '_head', 'elem': 1},
+                           {'obj': birds, 'action': 'set', 'key': f'{actor}:1',
+                            'value': 'chaffinch'},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds', 'value': birds},
+                       ]}
+
+    def test_delete_list_elements_request(self):
+        doc1, req1 = Frontend.change(
+            Frontend.init(), lambda doc: doc.__setattr__('birds', ['chaffinch', 'goldfinch']))
+        doc2, req2 = Frontend.change(doc1, lambda doc: doc.birds.delete_at(0))
+        actor = Frontend.get_actor_id(doc2)
+        birds = Frontend.get_object_id(doc2['birds'])
+        assert list(doc2['birds']) == ['goldfinch']
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2, 'deps': {},
+                        'ops': [{'obj': birds, 'action': 'del', 'key': f'{actor}:1'}]}
+
+
+class TestBackendConcurrency:
+    """Simulated backend lag: patches with old seq/clock interleaved with
+    local changes exercise the request queue + OT
+    (frontend_test.js:108-228)."""
+
+    def test_uses_deps_and_seq_from_backend(self):
+        local, remote1, remote2 = uuid(), uuid(), uuid()
+        patch1 = {
+            'clock': {local: 4, remote1: 11, remote2: 41},
+            'deps': {local: 4, remote2: 41},
+            'canUndo': False, 'canRedo': False,
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'blackbirds', 'value': 24}],
+        }
+        doc1 = Frontend.apply_patch(Frontend.init(local), patch1)
+        doc2, req = Frontend.change(doc1, lambda doc: doc.__setattr__('partridges', 1))
+        assert get_requests(doc2) == [
+            {'requestType': 'change', 'actor': local, 'seq': 5, 'deps': {remote2: 41},
+             'ops': [{'obj': ROOT_ID, 'action': 'set', 'key': 'partridges', 'value': 1}]}
+        ]
+
+    def test_removes_pending_requests_once_handled(self):
+        actor = uuid()
+        doc1, change1 = Frontend.change(Frontend.init(actor),
+                                        lambda doc: doc.__setattr__('blackbirds', 24))
+        doc2, change2 = Frontend.change(doc1, lambda doc: doc.__setattr__('partridges', 1))
+        assert [r['seq'] for r in get_requests(doc2)] == [1, 2]
+
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'blackbirds', 'value': 24}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 1, 'diffs': diffs1,
+                                           'clock': {actor: 1}, 'deps': {actor: 1},
+                                           'canUndo': True, 'canRedo': False})
+        assert dict(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert [r['seq'] for r in get_requests(doc2)] == [2]
+
+        diffs2 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'partridges', 'value': 1}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2, 'diffs': diffs2,
+                                           'clock': {actor: 2}, 'deps': {actor: 2},
+                                           'canUndo': True, 'canRedo': False})
+        assert dict(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert get_requests(doc2) == []
+
+    def test_remote_patches_leave_queue_unchanged(self):
+        actor, other = uuid(), uuid()
+        doc, req = Frontend.change(Frontend.init(actor),
+                                   lambda doc: doc.__setattr__('blackbirds', 24))
+        assert [r['seq'] for r in get_requests(doc)] == [1]
+
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'pheasants', 'value': 2}]
+        doc = Frontend.apply_patch(doc, {'actor': other, 'seq': 1, 'diffs': diffs1,
+                                         'clock': {other: 1}, 'deps': {other: 1},
+                                         'canUndo': True, 'canRedo': False})
+        assert dict(doc) == {'blackbirds': 24, 'pheasants': 2}
+        assert [r['seq'] for r in get_requests(doc)] == [1]
+
+    def test_rejects_out_of_order_request_patches(self):
+        doc1, req1 = Frontend.change(Frontend.init(),
+                                     lambda doc: doc.__setattr__('blackbirds', 24))
+        doc2, req2 = Frontend.change(doc1, lambda doc: doc.__setattr__('partridges', 1))
+        actor = Frontend.get_actor_id(doc2)
+        diffs = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                  'key': 'partridges', 'value': 1}]
+        with pytest.raises(ValueError, match='Mismatched sequence number'):
+            Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2, 'diffs': diffs,
+                                        'clock': {actor: 2}, 'deps': {actor: 2},
+                                        'canUndo': True, 'canRedo': False})
+
+    def test_transform_concurrent_insertions(self):
+        doc1, req1 = Frontend.change(Frontend.init(),
+                                     lambda doc: doc.__setattr__('birds', ['goldfinch']))
+        birds = Frontend.get_object_id(doc1['birds'])
+        actor = Frontend.get_actor_id(doc1)
+        diffs1 = [
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'goldfinch', 'elemId': f'{actor}:1'},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+        ]
+        doc1 = Frontend.apply_patch(doc1, {'actor': actor, 'seq': 1, 'diffs': diffs1,
+                                           'clock': {actor: 1}, 'deps': {actor: 1},
+                                           'canUndo': True, 'canRedo': False})
+        assert list(doc1['birds']) == ['goldfinch']
+        assert get_requests(doc1) == []
+
+        def cb(doc):
+            doc.birds.insert_at(0, 'chaffinch')
+            doc.birds.insert_at(2, 'greenfinch')
+        doc2, req2 = Frontend.change(doc1, cb)
+        assert list(doc2['birds']) == ['chaffinch', 'goldfinch', 'greenfinch']
+
+        remote = uuid()
+        diffs3 = [{'obj': birds, 'type': 'list', 'action': 'insert', 'index': 1,
+                   'value': 'bullfinch', 'elemId': f'{remote}:2'}]
+        doc3 = Frontend.apply_patch(doc2, {'actor': remote, 'seq': 1, 'diffs': diffs3,
+                                           'clock': {actor: 1, remote: 1},
+                                           'deps': {actor: 1, remote: 1},
+                                           'canUndo': True, 'canRedo': False})
+        assert list(doc3['birds']) == ['chaffinch', 'goldfinch', 'bullfinch', 'greenfinch']
+
+        diffs4 = [
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'chaffinch', 'elemId': f'{actor}:2'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 2,
+             'value': 'greenfinch', 'elemId': f'{actor}:3'},
+        ]
+        doc4 = Frontend.apply_patch(doc3, {'actor': actor, 'seq': 2, 'diffs': diffs4,
+                                           'clock': {actor: 2, remote: 1},
+                                           'deps': {actor: 2, remote: 1},
+                                           'canUndo': True, 'canRedo': False})
+        assert list(doc4['birds']) == ['chaffinch', 'goldfinch', 'greenfinch', 'bullfinch']
+        assert get_requests(doc4) == []
+
+    def test_interleaving_of_patches_and_changes(self):
+        actor = uuid()
+        doc1, req1 = Frontend.change(Frontend.init(actor),
+                                     lambda doc: doc.__setattr__('number', 1))
+        doc2, req2 = Frontend.change(doc1, lambda doc: doc.__setattr__('number', 2))
+        assert req1['seq'] == 1 and req2['seq'] == 2
+        state0 = Backend.init(actor)
+        state1, patch1 = Backend.apply_local_change(state0, req1)
+        doc2a = Frontend.apply_patch(doc2, patch1)
+        doc3, req3 = Frontend.change(doc2a, lambda doc: doc.__setattr__('number', 3))
+        assert req3 == {'requestType': 'change', 'actor': actor, 'seq': 3, 'deps': {},
+                        'ops': [{'obj': ROOT_ID, 'action': 'set', 'key': 'number',
+                                 'value': 3}]}

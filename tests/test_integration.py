@@ -1,0 +1,579 @@
+"""Integration tests driving only the public API.
+
+Port of the reference integration suite `/root/reference/test/test.js`
+(sections: sequential use, nested maps, lists, concurrent use, undo, redo,
+save/load, history, diff, changes API). Two in-process actor instances
+stand in for two devices, exactly as the reference does
+(INTERNALS.md:46-48).
+"""
+import pytest
+
+import automerge_tpu as Automerge
+from automerge_tpu import Text
+from automerge_tpu.uuid import uuid
+
+
+def equals_one_of(value, *candidates):
+    """helpers.js:5-15 — the CRDT legitimately permits either outcome."""
+    assert any(Automerge.equals(value, c) for c in candidates), \
+        f'{value!r} not one of {candidates!r}'
+
+
+class TestSequentialUse:
+    def test_initial_empty_doc(self):
+        s1 = Automerge.init()
+        assert dict(s1) == {}
+        assert Automerge.inspect(s1) == {}
+
+    def test_set_root_properties(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, 'set foo', lambda doc: doc.__setattr__('foo', 'bar'))
+        assert s1['foo'] == 'bar'
+        assert s1.foo == 'bar'
+        assert dict(s1) == {'foo': 'bar'}
+
+    def test_change_returns_same_doc_if_noop(self):
+        s1 = Automerge.init()
+        s2 = Automerge.change(s1, lambda doc: None)
+        assert s2 is s1
+
+    def test_change_is_not_destructive(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('foo', 'bar'))
+        s2 = Automerge.change(s1, lambda doc: doc.__setattr__('foo', 'baz'))
+        assert s1['foo'] == 'bar'
+        assert s2['foo'] == 'baz'
+
+    def test_root_object_is_frozen(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('foo', 'bar'))
+        with pytest.raises(TypeError):
+            s1['foo'] = 'changed'
+        with pytest.raises(TypeError):
+            del s1['foo']
+
+    def test_reads_see_writes_in_same_callback(self):
+        s1 = Automerge.init()
+        def cb(doc):
+            doc.value = 'a'
+            assert doc.value == 'a'
+            doc.value = 'b'
+            assert doc.value == 'b'
+        s1 = Automerge.change(s1, cb)
+        assert s1['value'] == 'b'
+
+    def test_sequential_changes_with_types(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('counter', 1))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('flag', True))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('pi', 3.14))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('missing', None))
+        assert dict(s1) == {'counter': 1, 'flag': True, 'pi': 3.14, 'missing': None}
+
+    def test_delete_key(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('foo', 'bar'))
+        s1 = Automerge.change(s1, lambda doc: doc.__delattr__('foo'))
+        assert dict(s1) == {}
+
+    def test_rejects_invalid_keys(self):
+        s1 = Automerge.init()
+        with pytest.raises(ValueError):
+            Automerge.change(s1, lambda doc: doc.__setitem__('', 'x'))
+        with pytest.raises(ValueError):
+            Automerge.change(s1, lambda doc: doc.__setitem__('_foo', 'x'))
+
+    def test_rejects_unsupported_values(self):
+        s1 = Automerge.init()
+        with pytest.raises(TypeError):
+            Automerge.change(s1, lambda doc: doc.__setitem__('x', {1, 2, 3}))
+
+
+class TestNestedMaps:
+    def test_assign_nested_map(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__(
+            'nested', {'key': 'value'}))
+        assert Automerge.inspect(s1) == {'nested': {'key': 'value'}}
+        assert s1['nested']['key'] == 'value'
+        assert Automerge.get_object_id(s1['nested']) is not None
+
+    def test_deeply_nested(self):
+        s1 = Automerge.init()
+        def cb(doc):
+            doc.a = {'b': {'c': {'d': 1}}}
+        s1 = Automerge.change(s1, cb)
+        assert Automerge.inspect(s1) == {'a': {'b': {'c': {'d': 1}}}}
+
+    def test_mutate_nested_after_creation(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('outer', {}))
+        def cb(doc):
+            doc.outer['inner'] = 42
+        s1 = Automerge.change(s1, cb)
+        assert Automerge.inspect(s1) == {'outer': {'inner': 42}}
+
+    def test_delete_nested_key(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('m', {'a': 1, 'b': 2}))
+        def cb(doc):
+            del doc.m['a']
+        s1 = Automerge.change(s1, cb)
+        assert Automerge.inspect(s1) == {'m': {'b': 2}}
+
+    def test_structure_sharing(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('a', {'x': 1}))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('b', {'y': 2}))
+        a_before = s1['a']
+        def cb(doc):
+            doc.b['y'] = 3
+        s2 = Automerge.change(s1, cb)
+        assert s2['a'] is a_before  # untouched subtree is aliased
+
+
+class TestLists:
+    def test_create_and_append(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('noodles', []))
+        def cb(doc):
+            doc.noodles.append('udon')
+            doc.noodles.append('soba')
+            doc.noodles.insert(1, 'ramen')
+        s1 = Automerge.change(s1, cb)
+        assert list(s1['noodles']) == ['udon', 'ramen', 'soba']
+        assert len(s1['noodles']) == 3
+
+    def test_set_by_index(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', ['a', 'b', 'c']))
+        def cb(doc):
+            doc.xs[1] = 'B'
+        s1 = Automerge.change(s1, cb)
+        assert list(s1['xs']) == ['a', 'B', 'c']
+
+    def test_delete_by_index(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', ['a', 'b', 'c']))
+        def cb(doc):
+            del doc.xs[1]
+        s1 = Automerge.change(s1, cb)
+        assert list(s1['xs']) == ['a', 'c']
+
+    def test_splice(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', [1, 2, 3, 4]))
+        def cb(doc):
+            deleted = doc.xs.splice(1, 2, 'a', 'b', 'c')
+            assert deleted == [2, 3]
+        s1 = Automerge.change(s1, cb)
+        assert list(s1['xs']) == [1, 'a', 'b', 'c', 4]
+
+    def test_push_pop_shift_unshift(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', []))
+        def cb(doc):
+            doc.xs.push(1, 2, 3)
+            assert doc.xs.pop() == 3
+            doc.xs.unshift(0)
+            assert doc.xs.shift() == 0
+        s1 = Automerge.change(s1, cb)
+        assert list(s1['xs']) == [1, 2]
+
+    def test_nested_objects_in_lists(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__(
+            'books', [{'title': 'DDIA', 'authors': ['Kleppmann']}]))
+        assert Automerge.inspect(s1) == {
+            'books': [{'title': 'DDIA', 'authors': ['Kleppmann']}]}
+        def cb(doc):
+            doc.books[0]['authors'].append('et al')
+        s1 = Automerge.change(s1, cb)
+        assert Automerge.inspect(s1['books'][0]) == {
+            'title': 'DDIA', 'authors': ['Kleppmann', 'et al']}
+
+    def test_out_of_bounds_raises(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', ['a']))
+        with pytest.raises(IndexError):
+            Automerge.change(s1, lambda doc: doc.xs.insert(5, 'x'))
+
+    def test_element_ids(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', ['a', 'b']))
+        actor = Automerge.get_actor_id(s1)
+        assert Automerge.get_element_ids(s1['xs']) == [f'{actor}:1', f'{actor}:2']
+
+
+class TestConcurrentUse:
+    def setup_method(self):
+        self.s1 = Automerge.init()
+        self.s2 = Automerge.init()
+        self.s3 = Automerge.init()
+
+    def test_merge_updates_of_different_properties(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('foo', 'bar'))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__('hello', 'world'))
+        s3 = Automerge.merge(s1, s2)
+        assert s3['foo'] == 'bar'
+        assert s3['hello'] == 'world'
+        assert dict(s3) == {'foo': 'bar', 'hello': 'world'}
+        assert s3._conflicts == {}
+
+    def test_concurrent_updates_of_same_field(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('field', 'one'))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__('field', 'two'))
+        s3 = Automerge.merge(s1, s2)
+        if s1._actor_id > s2._actor_id:
+            assert dict(s3) == {'field': 'one'}
+            assert s3._conflicts == {'field': {s2._actor_id: 'two'}}
+        else:
+            assert dict(s3) == {'field': 'two'}
+            assert s3._conflicts == {'field': {s1._actor_id: 'one'}}
+
+    def test_concurrent_updates_of_same_list_element(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('birds', ['finch']))
+        s2 = Automerge.merge(self.s2, s1)
+        def set1(doc): doc.birds[0] = 'greenfinch'
+        def set2(doc): doc.birds[0] = 'goldfinch'
+        s1 = Automerge.change(s1, set1)
+        s2 = Automerge.change(s2, set2)
+        s3 = Automerge.merge(s1, s2)
+        if s1._actor_id > s2._actor_id:
+            assert list(s3['birds']) == ['greenfinch']
+            assert s3['birds']._conflicts == [{s2._actor_id: 'goldfinch'}]
+        else:
+            assert list(s3['birds']) == ['goldfinch']
+            assert s3['birds']._conflicts == [{s1._actor_id: 'greenfinch'}]
+
+    def test_assignment_conflicts_of_different_types(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('field', 'string'))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__('field', ['list']))
+        s3 = Automerge.change(self.s3, lambda doc: doc.__setattr__('field', {'thing': 'map'}))
+        s1 = Automerge.merge(Automerge.merge(s1, s2), s3)
+        equals_one_of(s1['field'], 'string', ['list'], {'thing': 'map'})
+
+    def test_changes_within_conflicting_map_field(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('field', 'string'))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__('field', {}))
+        def cb(doc):
+            doc.field['innerKey'] = 42
+        s2 = Automerge.change(s2, cb)
+        s3 = Automerge.merge(s1, s2)
+        equals_one_of(s3['field'], 'string', {'innerKey': 42})
+
+    def test_concurrently_assigned_nested_maps_not_merged(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__(
+            'config', {'background': 'blue'}))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__(
+            'config', {'logo_url': 'logo.png'}))
+        s3 = Automerge.merge(s1, s2)
+        equals_one_of(s3['config'], {'background': 'blue'}, {'logo_url': 'logo.png'})
+
+    def test_clear_conflicts_after_assigning_new_value(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('field', 'one'))
+        s2 = Automerge.change(self.s2, lambda doc: doc.__setattr__('field', 'two'))
+        s3 = Automerge.merge(s1, s2)
+        s3 = Automerge.change(s3, lambda doc: doc.__setattr__('field', 'three'))
+        assert dict(s3) == {'field': 'three'}
+        assert s3._conflicts == {}
+        s2 = Automerge.merge(s2, s3)
+        assert dict(s2) == {'field': 'three'}
+        assert s2._conflicts == {}
+
+    def test_concurrent_insertions_at_different_positions(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('list', ['one', 'three']))
+        s2 = Automerge.merge(self.s2, s1)
+        s1 = Automerge.change(s1, lambda doc: doc.list.splice(1, 0, 'two'))
+        s2 = Automerge.change(s2, lambda doc: doc.list.push('four'))
+        s3 = Automerge.merge(s1, s2)
+        assert Automerge.inspect(s3) == {'list': ['one', 'two', 'three', 'four']}
+        assert s3._conflicts == {}
+
+    def test_concurrent_insertions_at_same_position(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('birds', ['parakeet']))
+        s2 = Automerge.merge(self.s2, s1)
+        s1 = Automerge.change(s1, lambda doc: doc.birds.push('starling'))
+        s2 = Automerge.change(s2, lambda doc: doc.birds.push('chaffinch'))
+        s3 = Automerge.merge(s1, s2)
+        equals_one_of(list(s3['birds']),
+                      ['parakeet', 'starling', 'chaffinch'],
+                      ['parakeet', 'chaffinch', 'starling'])
+        s2 = Automerge.merge(s2, s3)
+        assert Automerge.equals(s2, s3)
+
+    def test_concurrent_assignment_and_deletion_of_map_entry(self):
+        # Add-wins semantics
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('bestBird', 'robin'))
+        s2 = Automerge.merge(self.s2, s1)
+        s1 = Automerge.change(s1, lambda doc: doc.__delitem__('bestBird'))
+        s2 = Automerge.change(s2, lambda doc: doc.__setattr__('bestBird', 'magpie'))
+        s3 = Automerge.merge(s1, s2)
+        assert dict(s1) == {}
+        assert dict(s2) == {'bestBird': 'magpie'}
+        assert dict(s3) == {'bestBird': 'magpie'}
+        assert s3._conflicts == {}
+
+    def test_concurrent_assignment_and_deletion_of_list_element(self):
+        # Concurrent assignment resurrects a deleted list element (add-wins)
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__(
+            'birds', ['blackbird', 'thrush', 'goldfinch']))
+        s2 = Automerge.merge(self.s2, s1)
+        def set1(doc): doc.birds[1] = 'starling'
+        s1 = Automerge.change(s1, set1)
+        s2 = Automerge.change(s2, lambda doc: doc.birds.splice(1, 1))
+        s3 = Automerge.merge(s1, s2)
+        assert list(s1['birds']) == ['blackbird', 'starling', 'goldfinch']
+        assert list(s2['birds']) == ['blackbird', 'goldfinch']
+        assert list(s3['birds']) == ['blackbird', 'starling', 'goldfinch']
+
+    def test_concurrent_updates_at_different_tree_levels(self):
+        # A delete higher up in the tree overrides an update in a subtree
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('animals', {
+            'birds': {'pink': 'flamingo', 'black': 'starling'}, 'mammals': ['badger']}))
+        s2 = Automerge.merge(self.s2, s1)
+        def cb1(doc):
+            doc.animals['birds']['brown'] = 'sparrow'
+        s1 = Automerge.change(s1, cb1)
+        def cb2(doc):
+            del doc.animals['birds']
+        s2 = Automerge.change(s2, cb2)
+        s3 = Automerge.merge(s1, s2)
+        assert Automerge.inspect(s1['animals']) == {
+            'birds': {'pink': 'flamingo', 'brown': 'sparrow', 'black': 'starling'},
+            'mammals': ['badger']}
+        assert Automerge.inspect(s2['animals']) == {'mammals': ['badger']}
+        assert Automerge.inspect(s3['animals']) == {'mammals': ['badger']}
+
+    def test_no_interleaving_of_insertion_runs(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('wisdom', []))
+        s2 = Automerge.merge(self.s2, s1)
+        s1 = Automerge.change(s1, lambda doc: doc.wisdom.push('to', 'be', 'is', 'to', 'do'))
+        s2 = Automerge.change(s2, lambda doc: doc.wisdom.push('to', 'do', 'is', 'to', 'be'))
+        s3 = Automerge.merge(s1, s2)
+        equals_one_of(list(s3['wisdom']),
+                      ['to', 'be', 'is', 'to', 'do', 'to', 'do', 'is', 'to', 'be'],
+                      ['to', 'do', 'is', 'to', 'be', 'to', 'be', 'is', 'to', 'do'])
+
+    def test_insertion_by_greater_actor_id(self):
+        s1 = Automerge.init('A')
+        s2 = Automerge.init('B')
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('list', ['two']))
+        s2 = Automerge.merge(s2, s1)
+        s2 = Automerge.change(s2, lambda doc: doc.list.splice(0, 0, 'one'))
+        assert list(s2['list']) == ['one', 'two']
+
+    def test_insertion_by_lesser_actor_id(self):
+        s1 = Automerge.init('B')
+        s2 = Automerge.init('A')
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('list', ['two']))
+        s2 = Automerge.merge(s2, s1)
+        s2 = Automerge.change(s2, lambda doc: doc.list.splice(0, 0, 'one'))
+        assert list(s2['list']) == ['one', 'two']
+
+    def test_insertion_order_consistent_with_causality(self):
+        s1 = Automerge.change(self.s1, lambda doc: doc.__setattr__('list', ['four']))
+        s2 = Automerge.merge(self.s2, s1)
+        s2 = Automerge.change(s2, lambda doc: doc.list.unshift('three'))
+        s1 = Automerge.merge(s1, s2)
+        s1 = Automerge.change(s1, lambda doc: doc.list.unshift('two'))
+        s2 = Automerge.merge(s2, s1)
+        s2 = Automerge.change(s2, lambda doc: doc.list.unshift('one'))
+        assert list(s2['list']) == ['one', 'two', 'three', 'four']
+
+    def test_merge_same_actor_raises(self):
+        s1 = Automerge.init('A')
+        s2 = Automerge.init('A')
+        with pytest.raises(ValueError, match='Cannot merge an actor with itself'):
+            Automerge.merge(s1, s2)
+
+
+class TestUndoRedo:
+    def test_allow_undo_after_local_changes(self):
+        s1 = Automerge.init()
+        assert Automerge.can_undo(s1) is False
+        with pytest.raises(ValueError, match='there is nothing to be undone'):
+            Automerge.undo(s1)
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('hello', 'world'))
+        assert Automerge.can_undo(s1) is True
+
+    def test_undo_field_assignment(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('counter', 1))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('counter', 2))
+        assert dict(s1) == {'counter': 2}
+        s1 = Automerge.undo(s1)
+        assert dict(s1) == {'counter': 1}
+        s1 = Automerge.undo(s1)
+        assert dict(s1) == {}
+
+    def test_undo_deletion(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('bird', 'robin'))
+        s1 = Automerge.change(s1, lambda doc: doc.__delitem__('bird'))
+        assert dict(s1) == {}
+        s1 = Automerge.undo(s1)
+        assert dict(s1) == {'bird': 'robin'}
+
+    def test_undos_grow_the_history(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 2))
+        s1 = Automerge.undo(s1)
+        assert len(Automerge.get_history(s1)) == 3
+
+    def test_undo_list_insertion(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('xs', ['a']))
+        s1 = Automerge.change(s1, lambda doc: doc.xs.push('b'))
+        s1 = Automerge.undo(s1)
+        assert list(s1['xs']) == ['a']
+
+    def test_redo_after_undo(self):
+        s1 = Automerge.init()
+        assert Automerge.can_redo(s1) is False
+        with pytest.raises(ValueError, match='there is no prior undo'):
+            Automerge.redo(s1)
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('v', 1))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('v', 2))
+        s1 = Automerge.undo(s1)
+        assert dict(s1) == {'v': 1}
+        assert Automerge.can_redo(s1) is True
+        s1 = Automerge.redo(s1)
+        assert dict(s1) == {'v': 2}
+
+    def test_undo_redo_undo_redo_chain(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('s', 'a'))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('s', 'b'))
+        s1 = Automerge.undo(s1)
+        s1 = Automerge.redo(s1)
+        s1 = Automerge.undo(s1)
+        assert dict(s1) == {'s': 'a'}
+        s1 = Automerge.redo(s1)
+        assert dict(s1) == {'s': 'b'}
+
+    def test_local_change_clears_redo_stack(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('s', 'a'))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('s', 'b'))
+        s1 = Automerge.undo(s1)
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('s', 'c'))
+        assert Automerge.can_redo(s1) is False
+
+
+class TestSaveLoad:
+    def test_round_trip(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__(
+            'todos', [{'title': 'water plants', 'done': False}]))
+        data = Automerge.save(s1)
+        s2 = Automerge.load(data)
+        assert Automerge.equals(s1, s2)
+        assert Automerge.inspect(s2) == {
+            'todos': [{'title': 'water plants', 'done': False}]}
+
+    def test_load_preserves_history(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, 'first', lambda doc: doc.__setattr__('a', 1))
+        s1 = Automerge.change(s1, 'second', lambda doc: doc.__setattr__('b', 2))
+        s2 = Automerge.load(Automerge.save(s1))
+        assert [h.change['message'] for h in Automerge.get_history(s2)] == \
+            ['first', 'second']
+
+    def test_loaded_doc_can_be_edited_and_merged(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s2 = Automerge.load(Automerge.save(s1))
+        s2 = Automerge.change(s2, lambda doc: doc.__setattr__('y', 2))
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('z', 3))
+        s3 = Automerge.merge(s1, s2)
+        assert dict(s3) == {'x': 1, 'y': 2, 'z': 3}
+
+
+class TestHistory:
+    def test_history_with_messages_and_snapshots(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, 'make list', lambda doc: doc.__setattr__('xs', []))
+        s1 = Automerge.change(s1, 'add elem', lambda doc: doc.xs.push('a'))
+        history = Automerge.get_history(s1)
+        assert len(history) == 2
+        assert history[0].change['message'] == 'make list'
+        assert Automerge.inspect(history[0].snapshot) == {'xs': []}
+        assert Automerge.inspect(history[1].snapshot) == {'xs': ['a']}
+
+    def test_merged_history_interleaves_actors(self):
+        s1 = Automerge.init()
+        s2 = Automerge.init()
+        s1 = Automerge.change(s1, 'a1', lambda doc: doc.__setattr__('a', 1))
+        s2 = Automerge.change(s2, 'b1', lambda doc: doc.__setattr__('b', 1))
+        s3 = Automerge.merge(s1, s2)
+        msgs = [h.change.get('message') for h in Automerge.get_history(s3)]
+        assert sorted(msgs) == ['a1', 'b1']
+
+
+class TestDiff:
+    def test_diff_between_versions(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('bird', 'magpie'))
+        s2 = Automerge.change(s1, lambda doc: doc.__setattr__('bird', 'jay'))
+        diffs = Automerge.diff(s1, s2)
+        assert len(diffs) == 1
+        assert diffs[0]['action'] == 'set'
+        assert diffs[0]['key'] == 'bird'
+        assert diffs[0]['value'] == 'jay'
+
+    def test_diff_of_identical_docs_is_empty(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('bird', 'magpie'))
+        assert Automerge.diff(s1, s1) == []
+
+    def test_diff_diverged_raises(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s2 = Automerge.change(s1, lambda doc: doc.__setattr__('y', 2))
+        s3 = Automerge.change(s1, lambda doc: doc.__setattr__('z', 3))
+        # s2 and s3 share a prefix but then diverge... same actor, so the
+        # second change simply has a higher seq; construct true divergence
+        # with two actors instead:
+        a = Automerge.init('A')
+        a = Automerge.change(a, lambda doc: doc.__setattr__('x', 1))
+        b = Automerge.merge(Automerge.init('B'), a)
+        a = Automerge.change(a, lambda doc: doc.__setattr__('y', 2))
+        b = Automerge.change(b, lambda doc: doc.__setattr__('z', 3))
+        with pytest.raises(ValueError, match='diverged'):
+            Automerge.diff(a, b)
+
+
+class TestChangesAPI:
+    def test_get_and_apply_changes(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s2 = Automerge.change(s1, lambda doc: doc.__setattr__('y', 2))
+        changes = Automerge.get_changes(s1, s2)
+        assert len(changes) == 1
+        replica = Automerge.apply_changes(
+            Automerge.apply_changes(Automerge.init(), Automerge.get_changes(Automerge.init(), s1)),
+            changes)
+        assert dict(replica) == {'x': 1, 'y': 2}
+
+    def test_out_of_order_delivery_buffers(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s2 = Automerge.change(s1, lambda doc: doc.__setattr__('y', 2))
+        c1 = Automerge.get_changes(Automerge.init(), s1)
+        c2 = Automerge.get_changes(s1, s2)
+        replica = Automerge.apply_changes(Automerge.init(), c2)
+        assert dict(replica) == {}
+        assert Automerge.get_missing_deps(replica) != {}
+        replica = Automerge.apply_changes(replica, c1)
+        assert dict(replica) == {'x': 1, 'y': 2}
+        assert Automerge.get_missing_deps(replica) == {}
+
+    def test_empty_change_incorporates_deps(self):
+        s1 = Automerge.init()
+        s1 = Automerge.change(s1, lambda doc: doc.__setattr__('x', 1))
+        s2 = Automerge.empty_change(s1)
+        history = Automerge.get_history(s2)
+        assert len(history) == 2
+        assert history[1].change['ops'] == []

@@ -1,0 +1,67 @@
+"""Text CRDT tests (port of /root/reference/test/text_test.js)."""
+import automerge_tpu as Automerge
+from automerge_tpu import Text
+
+from test_integration import equals_one_of
+
+
+def _setup():
+    def make_text(doc):
+        doc.text = Text()
+    s1 = Automerge.change(Automerge.init(), make_text)
+    s2 = Automerge.merge(Automerge.init(), s1)
+    return s1, s2
+
+
+class TestText:
+    def test_insertion(self):
+        s1, _ = _setup()
+        s1 = Automerge.change(s1, lambda doc: doc.text.insert_at(0, 'a'))
+        assert len(s1['text']) == 1
+        assert s1['text'].get(0) == 'a'
+
+    def test_deletion(self):
+        s1, _ = _setup()
+        s1 = Automerge.change(s1, lambda doc: doc.text.insert_at(0, 'a', 'b', 'c'))
+        s1 = Automerge.change(s1, lambda doc: doc.text.delete_at(1, 1))
+        assert len(s1['text']) == 2
+        assert s1['text'].get(0) == 'a'
+        assert s1['text'].get(1) == 'c'
+
+    def test_concurrent_insertion(self):
+        s1, s2 = _setup()
+        s1 = Automerge.change(s1, lambda doc: doc.text.insert_at(0, 'a', 'b', 'c'))
+        s2 = Automerge.change(s2, lambda doc: doc.text.insert_at(0, 'x', 'y', 'z'))
+        s1 = Automerge.merge(s1, s2)
+        assert len(s1['text']) == 6
+        equals_one_of(s1['text'].join(''), 'abcxyz', 'xyzabc')
+
+    def test_text_and_other_ops_in_same_change(self):
+        s1, _ = _setup()
+        def cb(doc):
+            doc.foo = 'bar'
+            doc.text.insert_at(0, 'a')
+        s1 = Automerge.change(s1, cb)
+        assert s1['foo'] == 'bar'
+        assert s1['text'].join('') == 'a'
+
+    def test_save_load_round_trip(self):
+        s1, _ = _setup()
+        s1 = Automerge.change(s1, lambda doc: doc.text.insert_at(0, *'hello'))
+        s2 = Automerge.load(Automerge.save(s1))
+        assert s2['text'].join('') == 'hello'
+
+    def test_three_way_concurrent_merge(self):
+        s1, s2 = _setup()
+        s3 = Automerge.merge(Automerge.init(), s1)
+        s1 = Automerge.change(s1, lambda doc: doc.text.insert_at(0, *'aa'))
+        s2 = Automerge.change(s2, lambda doc: doc.text.insert_at(0, *'bb'))
+        s3 = Automerge.change(s3, lambda doc: doc.text.insert_at(0, *'cc'))
+        merged = Automerge.merge(Automerge.merge(s1, s2), s3)
+        assert len(merged['text']) == 6
+        text = merged['text'].join('')
+        # runs are not interleaved
+        assert 'aa' in text and 'bb' in text and 'cc' in text
+        # all replicas converge
+        s2 = Automerge.merge(s2, merged)
+        assert s2['text'].join('') == text
