@@ -1,0 +1,30 @@
+"""TPU device engine: batched CRDT resolution kernels.
+
+This package is the TPU-native replacement for the reference's hot core —
+the per-op JavaScript loops of `backend/op_set.js` and the pointer-chasing
+`backend/skip_list.js`. State is struct-of-arrays in device memory:
+interned integer actor/object/key IDs, ops as fixed-width int32 columns,
+clocks as dense ``[n_actors]`` vectors, sequences as tombstoned arrays with
+scan-built index maps.
+
+Kernels (all pure, jittable, static-shaped; designed for the MXU/VPU and
+XLA fusion rather than per-element control flow):
+
+* :mod:`.clock`    — dense vector-clock ops (readiness, union, compare)
+* :mod:`.merge`    — batched map-field conflict resolution
+  (segment-reductions replace the reference's `applyAssign` loop,
+  op_set.js:180-219)
+* :mod:`.sequence` — RGA insertion-tree ordering via sort + pointer
+  doubling (replaces `insertionsAfter`/`getNext` tree walks,
+  op_set.js:379-425, and the SkipList order-statistic index)
+* :mod:`.packing`  — host-side interning and struct-of-arrays packing
+* :mod:`.engine`   — the batched document-store engine driving the kernels
+
+Batching model: one program, N documents — ``vmap`` over the leading doc
+axis; sharding over a device mesh is layered on top in
+:mod:`automerge_tpu.parallel`.
+"""
+
+from .engine import DocStore, batch_merge_docs
+
+__all__ = ['DocStore', 'batch_merge_docs']

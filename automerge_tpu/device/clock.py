@@ -1,0 +1,45 @@
+"""Dense vector-clock kernels.
+
+Host clocks are ``dict[actor, seq]``; on device a clock is a dense
+``int32[n_actors]`` vector (index = interned actor rank). These are the
+batched equivalents of `src/common.js:14-18` (lessOrEqual),
+`op_set.js:20-27` (causallyReady) and `src/connection.js:9-12`
+(clockUnion), vectorized over documents/changes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def less_or_equal(clock1, clock2):
+    """Elementwise vector-clock partial order; broadcasts over leading axes."""
+    return jnp.all(clock1 <= clock2, axis=-1)
+
+
+def union(clock1, clock2):
+    """Pointwise max (clock merge)."""
+    return jnp.maximum(clock1, clock2)
+
+
+def causally_ready(doc_clock, change_deps, change_actor, change_seq):
+    """Readiness of a batch of changes against a document clock.
+
+    doc_clock:    int32[A]         current applied clock
+    change_deps:  int32[C, A]      each change's declared deps (dense)
+    change_actor: int32[C]         originating actor rank
+    change_seq:   int32[C]
+
+    A change is ready when every dep is satisfied and its own predecessor
+    (seq-1 from the same actor) has been applied (op_set.js:20-27).
+    """
+    deps_ok = jnp.all(change_deps <= doc_clock[None, :], axis=-1)
+    own_ok = doc_clock[change_actor] >= change_seq - 1
+    return deps_ok & own_ok
+
+
+def advance(doc_clock, change_actor, change_seq, ready):
+    """New document clock after applying the ready subset of changes."""
+    seqs = jnp.where(ready, change_seq, 0)
+    applied = jax.ops.segment_max(seqs, change_actor,
+                                  num_segments=doc_clock.shape[0])
+    return jnp.maximum(doc_clock, applied)

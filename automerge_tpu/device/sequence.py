@@ -1,0 +1,138 @@
+"""RGA sequence ordering kernel: sort + pointer doubling.
+
+TPU-native replacement for BOTH the reference's insertion-tree walk
+(`insertionsAfter`/`getNext`/`getPrevious`, op_set.js:379-425 — sequential
+pointer chasing per element) and its SkipList order-statistic index
+(backend/skip_list.js — O(log n) per lookup, but inherently serial).
+
+The document order of list/text elements is the depth-first traversal of
+the insertion tree where each node's children sort Lamport-descending by
+(elem, actor) (op_set.js:371-390). This kernel computes the positions of
+ALL n elements at once in O(log n) parallel rounds:
+
+1. **Sort** nodes by (parent, elem desc, actor desc) — children end up
+   grouped per parent in priority order (one ``lexsort``).
+2. **Thread the tree**: first-child and next-sibling links fall out of the
+   sorted order; the DFS successor is ``first_child`` if present, else the
+   next sibling of the nearest ancestor that has one. That ancestor is
+   found with pointer doubling over parent links (log n gathers).
+3. **List-rank** the successor chain with pointer doubling (log n gathers)
+   to turn links into integer positions — the parallel prefix-sum
+   replacement for the skip list's order statistics.
+4. **Visibility scan**: a cumulative sum over tombstone flags maps tree
+   positions to user-visible list indexes.
+
+Everything is gathers/scatters/sorts/cumsums on static shapes — no
+data-dependent control flow, so XLA compiles one fused program and the
+same code vmaps across documents.
+
+Node 0 is the virtual ``'_head'`` element; padding slots carry
+``valid=False`` and sort to the end.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _ceil_log2(n):
+    bits = 0
+    while (1 << bits) < n:
+        bits += 1
+    return max(bits, 1)
+
+
+def _rga_order(parent, elem, actor, visible, valid):
+    n = parent.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rounds = _ceil_log2(n) + 1
+
+    # --- 1. sort children into (parent asc, elem desc, actor desc) ---------
+    # The head (node 0) is nobody's child: bucket it with the padding so it
+    # never receives sibling links of its own.
+    parent_adj = jnp.where(valid & (idx != 0), parent, n)
+    order = jnp.lexsort((-actor, -elem, parent_adj))  # [n] node id per sorted pos
+    p_sorted = parent_adj[order]
+
+    # --- 2. thread the tree -------------------------------------------------
+    pos = jnp.arange(n, dtype=jnp.int32)
+    is_seg_start = jnp.concatenate([
+        jnp.array([True]), p_sorted[1:] != p_sorted[:-1]])
+    # first_child[p] = first sorted node whose parent is p (-1 if none)
+    first_child = jnp.full((n + 1,), -1, dtype=jnp.int32)
+    first_child = first_child.at[jnp.where(is_seg_start, p_sorted, n)].set(
+        jnp.where(is_seg_start, order, -1), mode='drop')
+    first_child = first_child[:n]
+    # next_sibling[node] = next sorted node under the same parent (-1 if none)
+    same_parent_next = jnp.concatenate([
+        p_sorted[1:] == p_sorted[:-1], jnp.array([False])])
+    nxt_in_sort = jnp.concatenate([order[1:], jnp.array([-1], dtype=jnp.int32)])
+    next_sibling = jnp.full((n,), -1, dtype=jnp.int32)
+    next_sibling = next_sibling.at[order].set(
+        jnp.where(same_parent_next, nxt_in_sort, -1))
+    # Head and padding share a sort bucket; sever any accidental link so the
+    # chain of the last list element terminates instead of entering padding.
+    next_sibling = next_sibling.at[0].set(-1)
+
+    # nearest ancestor-or-self with a next sibling (head terminates the climb)
+    has_sib = next_sibling >= 0
+    is_head = idx == 0
+    climb = jnp.where(has_sib | is_head, idx, parent)
+    for _ in range(rounds):
+        climb = climb[climb]
+    up = jnp.where(has_sib[climb], next_sibling[climb], -1)
+
+    succ = jnp.where(first_child[idx] >= 0, first_child[idx], up)
+    succ = jnp.where(valid, succ, -1)
+
+    # --- 3. list-rank the successor chain (pointer doubling) ---------------
+    # Work in an (n+1)-slot space where slot n is the chain terminator.
+    nxt = jnp.where(succ >= 0, succ, n)
+    nxt = jnp.concatenate([nxt, jnp.array([n], dtype=jnp.int32)])
+    dist = jnp.where(jnp.arange(n + 1) == n, 0, 1)
+    for _ in range(rounds):
+        dist = dist + dist[nxt]
+        nxt = nxt[nxt]
+    dist = dist[:n]                       # steps from node to end of chain
+    tree_pos = dist[0] - dist              # head = 0, then 1..chain_len
+
+    # --- 4. visibility scan -------------------------------------------------
+    on_chain = valid & (tree_pos > 0)      # head and padding excluded
+    node_at_pos = jnp.full((n,), n - 1, dtype=jnp.int32)
+    node_at_pos = node_at_pos.at[jnp.where(on_chain, tree_pos, 0)].set(
+        jnp.where(on_chain, idx, 0), mode='drop')
+    vis_ordered = jnp.where(on_chain[node_at_pos], visible[node_at_pos], False)
+    vis_rank_ordered = jnp.cumsum(vis_ordered) - vis_ordered  # index among visible
+    vis_index = vis_rank_ordered[tree_pos]
+    vis_index = jnp.where(visible & on_chain, vis_index, -1)
+
+    return {'tree_pos': tree_pos, 'vis_index': vis_index,
+            'node_at_pos': node_at_pos,
+            'length': jnp.sum(jnp.where(on_chain, visible, False))}
+
+
+@jax.jit
+def rga_order(parent, elem, actor, visible, valid):
+    """Total document order of an insertion tree.
+
+    Args:
+      parent:  int32[n] parent node index per node (node 0 = '_head')
+      elem:    int32[n] Lamport counter per node
+      actor:   int32[n] actor rank per node
+      visible: bool[n]  node currently has a value (not a tombstone)
+      valid:   bool[n]  padding mask (node 0 must be valid)
+
+    Returns dict of:
+      tree_pos:    int32[n] DFS position (head = 0, elements 1..)
+      vis_index:   int32[n] index among visible elements (-1 if hidden)
+      node_at_pos: int32[n] inverse permutation (node id at each position)
+      length:      int32    number of visible elements
+    """
+    return _rga_order(parent, elem, actor, visible, valid)
+
+
+@jax.jit
+def rga_order_batch(parent, elem, actor, visible, valid):
+    """vmap over a leading document axis."""
+    return jax.vmap(_rga_order)(parent, elem, actor, visible, valid)

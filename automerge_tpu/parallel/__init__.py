@@ -1,0 +1,22 @@
+"""Distributed layer: document sharding over a device mesh.
+
+The reference scales by replicating documents over a network of peers
+(`src/connection.js`); within a TPU pod this framework instead shards the
+*document axis* of a DocSet over the mesh and lets XLA collectives ride
+the ICI:
+
+* **dp (documents)** — independent docs partitioned across devices; each
+  device resolves its shard with the same program (`shard_map` over the
+  leading axis), global statistics via ``psum``.
+* **sp (sequence)** — very long Text documents shard their node axis; the
+  pointer-doubling rounds become sharded gathers (XLA inserts the
+  all-gathers automatically from the sharding annotations).
+* **DCN** — between hosts/pods the Connection wire protocol is unchanged:
+  vector-clock advertisement + change shipping, with the host feeding
+  device batches.
+"""
+
+from .mesh import make_mesh, shard_docs
+from .docset_engine import sharded_merge_step, ShardedDocSetEngine
+
+__all__ = ['make_mesh', 'shard_docs', 'sharded_merge_step', 'ShardedDocSetEngine']

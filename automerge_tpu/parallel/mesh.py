@@ -1,0 +1,29 @@
+"""Mesh construction and document-axis sharding helpers."""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DOC_AXIS = 'docs'
+
+
+def make_mesh(n_devices=None, axis=DOC_AXIS, devices=None):
+    """A 1-D mesh over the available devices.
+
+    Documents are embarrassingly parallel (independent CRDT replicas), so a
+    single mesh axis suffices for the doc dimension; collectives are only
+    needed for global statistics and cross-doc rebalancing.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_docs(mesh, *arrays, axis=DOC_AXIS):
+    """Place arrays with their leading (document) axis split over the mesh."""
+    sharding = NamedSharding(mesh, P(axis))
+    placed = tuple(jax.device_put(a, sharding) for a in arrays)
+    return placed if len(placed) != 1 else placed[0]

@@ -1,0 +1,125 @@
+"""Benchmark: batched CRDT merge throughput on one chip.
+
+Driver metric (BASELINE.md): ops merged/sec across a DocSet. The headline
+config is BASELINE config 5 — a 10k-document DocSet each receiving ~100
+concurrent map ops, merged in one batched device call (the reference
+resolves these one op at a time through `applyAssign`,
+op_set.js:180-219). North star: 1M ops across 10k docs in <100ms on one
+v5e chip => 1e7 ops/sec; `vs_baseline` is measured throughput over that
+target.
+
+Prints exactly ONE JSON line on stdout; auxiliary configs go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32, seed=0):
+    """Synthetic DocSet batch: per doc, n_ops concurrent 'set' ops from
+    n_actors actors spread over n_keys root fields (each actor's ops are
+    sequential for itself, concurrent across actors)."""
+    rng = np.random.default_rng(seed)
+    seg_id = rng.integers(0, n_keys, size=(n_docs, n_ops)).astype(np.int32)
+    actor = rng.integers(0, n_actors, size=(n_docs, n_ops)).astype(np.int32)
+    # seq numbers: per (doc, actor) running count in op order
+    seq = np.ones((n_docs, n_ops), dtype=np.int32)
+    for a in range(n_actors):
+        mask = actor == a
+        running = np.cumsum(mask, axis=1)
+        seq[mask] = running[mask]
+    # each op's clock: covers its own previous ops only (fully concurrent
+    # across actors — the worst case for conflict resolution)
+    clock = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
+    d_idx, o_idx = np.indices((n_docs, n_ops))
+    clock[d_idx, o_idx, actor] = seq - 1
+    is_del = rng.random((n_docs, n_ops)) < 0.05
+    valid = np.ones((n_docs, n_ops), dtype=bool)
+    return seg_id, actor, seq, clock, is_del, valid
+
+
+def bench_docset_merge(jnp, resolve_batch, n_docs=10240, n_ops=128, iters=20):
+    seg_id, actor, seq, clock, is_del, valid = gen_docset_workload(
+        n_docs=n_docs, n_ops=n_ops)
+    args = tuple(jnp.asarray(a) for a in (seg_id, actor, seq, clock, is_del, valid))
+
+    import jax
+    # compile + warmup
+    out = resolve_batch(*args, num_segments=n_ops)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = resolve_batch(*args, num_segments=n_ops)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    total_ops = n_docs * n_ops
+    t_med = float(np.median(times))
+    t_p99 = float(np.quantile(times, 0.99))
+    return total_ops, t_med, t_p99
+
+
+def bench_text_merge(jnp, rga_order, n_nodes=1 << 18, iters=10):
+    """Config 2/4 analogue: one huge Text insertion tree ordered on device
+    (the parallel replacement of the skip-list path)."""
+    rng = np.random.default_rng(1)
+    parent = np.zeros(n_nodes, dtype=np.int32)
+    parent[1:] = (rng.random(n_nodes - 1) * np.arange(1, n_nodes)).astype(np.int32)
+    elem = np.arange(n_nodes, dtype=np.int32)
+    actor = rng.integers(1, 4, size=n_nodes).astype(np.int32)
+    actor[0] = 0
+    visible = rng.random(n_nodes) < 0.9
+    visible[0] = False
+    valid = np.ones(n_nodes, dtype=bool)
+    args = tuple(jnp.asarray(a) for a in (parent, elem, actor, visible, valid))
+
+    import jax
+    out = rga_order(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = rga_order(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return n_nodes, float(np.median(times))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from automerge_tpu.device.merge import resolve_assignments_batch
+    from automerge_tpu.device.sequence import rga_order
+
+    log(f'devices: {jax.devices()}')
+
+    # Headline: config 5 — 10k-doc DocSet batched merge
+    total_ops, t_med, t_p99 = bench_docset_merge(jnp, resolve_assignments_batch)
+    ops_per_sec = total_ops / t_med
+    log(f'docset-merge: {total_ops} ops in {t_med * 1e3:.2f} ms '
+        f'(p99 {t_p99 * 1e3:.2f} ms) -> {ops_per_sec / 1e6:.1f}M ops/s')
+
+    # Secondary: long-text RGA ordering
+    n_nodes, t_text = bench_text_merge(jnp, rga_order)
+    log(f'text-order: {n_nodes} elems in {t_text * 1e3:.2f} ms '
+        f'-> {n_nodes / t_text / 1e6:.1f}M elems/s')
+
+    north_star = 1e7  # 1M ops / 100ms (BASELINE.json)
+    print(json.dumps({
+        'metric': 'docset_merge_ops_per_sec',
+        'value': round(ops_per_sec, 1),
+        'unit': 'ops/s',
+        'vs_baseline': round(ops_per_sec / north_star, 2),
+    }), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,15 +1,27 @@
 """Test configuration.
 
-Device-sharding tests run on a virtual 8-device CPU mesh so multi-chip
-layouts are exercised without TPU pod hardware (the driver separately
-dry-runs the multichip path). Must be set before jax is imported anywhere.
+Unit tests run JAX on CPU with 8 virtual devices so multi-chip sharding is
+exercised without TPU pod hardware (the driver separately dry-runs the
+multichip path on its own virtual mesh, and bench.py uses the real chip).
+
+The interpreter's site hooks may import jax and register a TPU-tunnel
+plugin before pytest starts, so env vars are too late here — the platform
+must be forced through jax.config. This also keeps the suite off the
+tunnel entirely: unit tests must never contend with a benchmark (or a
+stuck tunnel) for the real chip.
 """
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+assert jax.devices()[0].platform == 'cpu'
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,365 @@
+"""Differential tests: device kernels vs the host oracle.
+
+The TPU kernels must produce byte-identical results to the oracle engine
+(`automerge_tpu.backend.op_set`) — the same JSON-in/JSON-out contract the
+reference test suite pins. Random op traces are replayed through both.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu as Automerge
+from automerge_tpu import backend as Backend
+from automerge_tpu.common import ROOT_ID
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from automerge_tpu.device import sequence as seq_kernel  # noqa: E402
+from automerge_tpu.device import merge as merge_kernel   # noqa: E402
+from automerge_tpu.device import clock as clock_kernel   # noqa: E402
+
+
+LIST_ID = 'f1111111-1111-1111-1111-111111111111'
+
+
+def oracle_list_state(ins_ops_by_actor, del_elems):
+    """Replay an insertion/deletion trace through the oracle backend and
+    return the visible elemIds in document order.
+
+    Each insertion becomes its own change whose deps cover the change that
+    created the parent element (causal delivery requires an actor to have
+    seen an element before inserting after it — INTERNALS.md:85-98).
+    """
+    state = Backend.init()
+    make = {'actor': 'setup', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeList', 'obj': LIST_ID},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'list', 'value': LIST_ID},
+    ]}
+    state, _ = Backend.apply_changes(state, [make])
+    creator = {'_head': ('setup', 1)}   # elemId -> (actor, seq) that made it
+    seqs = {}
+    changes = []
+    # Replay insertions in creation order (elem is a global counter in the
+    # generator) so each parent's creator is known when referenced.
+    flat = [(op['elem'], actor, op) for actor, ops in ins_ops_by_actor.items()
+            for op in ops]
+    for _, actor, op in sorted(flat):
+        seqs[actor] = seqs.get(actor, 0) + 1
+        dep_actor, dep_seq = creator[op['parent']]
+        deps = {'setup': 1, dep_actor: dep_seq}
+        deps.pop(actor, None)
+        changes.append({'actor': actor, 'seq': seqs[actor], 'deps': deps,
+                        'ops': [
+                            {'action': 'ins', 'obj': LIST_ID,
+                             'key': op['parent'], 'elem': op['elem']},
+                            {'action': 'set', 'obj': LIST_ID,
+                             'key': f"{actor}:{op['elem']}",
+                             'value': op['value']},
+                        ]})
+        creator[f"{actor}:{op['elem']}"] = (actor, seqs[actor])
+    random.shuffle(changes)
+    state, _ = Backend.apply_changes(state, changes)
+    assert not state.op_set.queue, 'trace was not causally deliverable'
+    if del_elems:
+        del_change = {'actor': 'zzz-deleter', 'seq': 1,
+                      'deps': {a: s for a, s in state.op_set.clock.items()},
+                      'ops': [{'action': 'del', 'obj': LIST_ID, 'key': e}
+                              for e in del_elems]}
+        state, _ = Backend.apply_changes(state, [del_change])
+    return state.op_set.by_object[LIST_ID].elem_ids
+
+
+def kernel_list_state(ins_ops_by_actor, del_elems, pad_to=None):
+    """Pack the same trace into device arrays and run the RGA kernel."""
+    actors = sorted(ins_ops_by_actor.keys())
+    actor_rank = {a: i + 1 for i, a in enumerate(actors)}  # 0 = head
+
+    nodes = [('_head', 0, 0, '_head')]  # (elem_id, elem, actor_rank, parent)
+    for actor, ops in ins_ops_by_actor.items():
+        for op in ops:
+            nodes.append((f"{actor}:{op['elem']}", op['elem'],
+                          actor_rank[actor], op['parent']))
+    node_idx = {eid: i for i, (eid, _, _, _) in enumerate(nodes)}
+
+    if pad_to is None:
+        pad_to = 1
+        while pad_to < len(nodes):
+            pad_to *= 2  # shared jit cache across trace sizes
+    n = pad_to
+    parent = np.zeros(n, dtype=np.int32)
+    elem = np.zeros(n, dtype=np.int32)
+    actor = np.zeros(n, dtype=np.int32)
+    visible = np.zeros(n, dtype=bool)
+    valid = np.zeros(n, dtype=bool)
+    deleted = set(del_elems)
+    for i, (eid, e, a, par) in enumerate(nodes):
+        parent[i] = node_idx[par]
+        elem[i] = e
+        actor[i] = a
+        valid[i] = True
+        visible[i] = (i != 0) and (eid not in deleted)
+
+    out = seq_kernel.rga_order(jnp.array(parent), jnp.array(elem),
+                               jnp.array(actor), jnp.array(visible),
+                               jnp.array(valid))
+    vis_index = np.asarray(out['vis_index'])
+    length = int(out['length'])
+    ordered = [None] * length
+    for i, (eid, _, _, _) in enumerate(nodes):
+        if vis_index[i] >= 0:
+            ordered[vis_index[i]] = eid
+    return ordered
+
+
+def random_trace(rng, n_actors=3, n_ops=40, delete_frac=0.2):
+    actors = [f'actor{chr(ord("a") + i)}' for i in range(n_actors)]
+    ops_by_actor = {a: [] for a in actors}
+    all_elems = ['_head']
+    next_elem = {a: 0 for a in actors}
+    max_elem = 0
+    for _ in range(n_ops):
+        a = rng.choice(actors)
+        max_elem += 1
+        next_elem[a] = max_elem
+        parent = rng.choice(all_elems)
+        eid = f'{a}:{max_elem}'
+        ops_by_actor[a].append({'parent': parent, 'elem': max_elem,
+                                'value': eid})
+        all_elems.append(eid)
+    dels = [e for e in all_elems[1:] if rng.random() < delete_frac]
+    return ops_by_actor, dels
+
+
+class TestSequenceKernel:
+    def test_simple_appends(self):
+        ops = {'actorb': [{'parent': '_head', 'elem': 1, 'value': 'x'},
+                          {'parent': 'actorb:1', 'elem': 2, 'value': 'y'},
+                          {'parent': 'actorb:2', 'elem': 3, 'value': 'z'}]}
+        assert kernel_list_state(ops, []) == oracle_list_state(ops, []) \
+            == ['actorb:1', 'actorb:2', 'actorb:3']
+
+    def test_concurrent_inserts_at_head(self):
+        ops = {'actora': [{'parent': '_head', 'elem': 1, 'value': 'a'}],
+               'actorb': [{'parent': '_head', 'elem': 2, 'value': 'b'}],
+               'actorc': [{'parent': '_head', 'elem': 2, 'value': 'c'}]}
+        assert kernel_list_state(ops, []) == oracle_list_state(ops, [])
+
+    def test_with_tombstones(self):
+        ops = {'actora': [{'parent': '_head', 'elem': 1, 'value': 'a'},
+                          {'parent': 'actora:1', 'elem': 2, 'value': 'b'},
+                          {'parent': 'actora:2', 'elem': 3, 'value': 'c'}]}
+        assert kernel_list_state(ops, ['actora:2']) == \
+            oracle_list_state(ops, ['actora:2'])
+
+    def test_with_padding(self):
+        ops = {'actora': [{'parent': '_head', 'elem': 1, 'value': 'a'}],
+               'actorb': [{'parent': 'actora:1', 'elem': 2, 'value': 'b'}]}
+        assert kernel_list_state(ops, [], pad_to=16) == oracle_list_state(ops, [])
+
+    @pytest.mark.parametrize('seed', range(8))
+    def test_random_traces_match_oracle(self, seed):
+        rng = random.Random(seed)
+        ops, dels = random_trace(rng, n_actors=2 + seed % 3,
+                                 n_ops=20 + seed * 7)
+        assert kernel_list_state(ops, dels) == oracle_list_state(ops, dels)
+
+    def test_batch_matches_single(self):
+        rng = random.Random(99)
+        traces = [random_trace(rng, n_ops=15) for _ in range(4)]
+        singles = [kernel_list_state(ops, dels, pad_to=64)
+                   for ops, dels in traces]
+        assert all(singles[i] == oracle_list_state(*traces[i])
+                   for i in range(4))
+
+
+class TestMergeKernel:
+    def _pack_field_ops(self, ops_per_key, actor_names):
+        """ops_per_key: {key: [(actor, seq, clock_dict, is_del)]}"""
+        rank = {a: i for i, a in enumerate(sorted(actor_names))}
+        keys = sorted(ops_per_key.keys())
+        seg_of = {k: i for i, k in enumerate(keys)}
+        rows = []
+        for k, ops in ops_per_key.items():
+            for (actor, seq, clock, is_del) in ops:
+                crow = [clock.get(a, 0) for a in sorted(actor_names)]
+                rows.append((seg_of[k], rank[actor], seq, crow, is_del))
+        n = len(rows)
+        seg = jnp.array([r[0] for r in rows], dtype=jnp.int32)
+        act = jnp.array([r[1] for r in rows], dtype=jnp.int32)
+        seq = jnp.array([r[2] for r in rows], dtype=jnp.int32)
+        clk = jnp.array([r[3] for r in rows], dtype=jnp.int32)
+        isd = jnp.array([r[4] for r in rows])
+        val = jnp.ones(n, dtype=bool)
+        return keys, rank, (seg, act, seq, clk, isd, val)
+
+    def test_concurrent_writes_highest_actor_wins(self):
+        ops = {'bird': [('actor1', 1, {}, False), ('actor2', 1, {}, False)]}
+        keys, rank, packed = self._pack_field_ops(ops, ['actor1', 'actor2'])
+        out = merge_kernel.resolve_assignments(*packed, num_segments=1)
+        assert np.asarray(out['surviving']).tolist() == [True, True]
+        assert int(out['winner'][0]) == 1  # actor2's op
+        assert int(out['seg_max_actor'][0]) == rank['actor2']
+
+    def test_causally_later_write_supersedes(self):
+        # actor1 seq1 writes; actor2 (having seen it) overwrites
+        ops = {'bird': [('actor1', 1, {}, False),
+                        ('actor2', 1, {'actor1': 1}, False)]}
+        keys, rank, packed = self._pack_field_ops(ops, ['actor1', 'actor2'])
+        out = merge_kernel.resolve_assignments(*packed, num_segments=1)
+        assert np.asarray(out['surviving']).tolist() == [False, True]
+
+    def test_delete_removes_value(self):
+        ops = {'bird': [('actor1', 1, {}, False),
+                        ('actor1', 2, {'actor1': 1}, True)]}
+        keys, rank, packed = self._pack_field_ops(ops, ['actor1'])
+        out = merge_kernel.resolve_assignments(*packed, num_segments=1)
+        assert np.asarray(out['surviving']).tolist() == [False, False]
+        assert int(out['winner'][0]) == -1
+
+    def test_concurrent_delete_loses_to_assignment(self):
+        # Add-wins: concurrent set survives a delete (test.js:697-708)
+        ops = {'bird': [('actor1', 1, {}, False),
+                        ('actor1', 2, {'actor1': 1}, True),
+                        ('actor2', 1, {'actor1': 1}, False)]}
+        keys, rank, packed = self._pack_field_ops(ops, ['actor1', 'actor2'])
+        out = merge_kernel.resolve_assignments(*packed, num_segments=1)
+        assert np.asarray(out['surviving']).tolist() == [False, False, True]
+
+    def test_multiple_segments_and_padding(self):
+        ops = {'a': [('actor1', 1, {}, False)],
+               'b': [('actor1', 2, {'actor1': 1}, False),
+                     ('actor2', 1, {}, False)]}
+        keys, rank, packed = self._pack_field_ops(ops, ['actor1', 'actor2'])
+        seg, act, seq, clk, isd, val = packed
+        # pad with junk ops that must not affect the result
+        pad = 3
+        seg = jnp.concatenate([seg, jnp.zeros(pad, jnp.int32)])
+        act = jnp.concatenate([act, jnp.zeros(pad, jnp.int32)])
+        seq = jnp.concatenate([seq, jnp.full((pad,), 99, jnp.int32)])
+        clk = jnp.concatenate([clk, jnp.full((pad, clk.shape[1]), 99, jnp.int32)])
+        isd = jnp.concatenate([isd, jnp.zeros(pad, bool)])
+        val = jnp.concatenate([val, jnp.zeros(pad, bool)])
+        out = merge_kernel.resolve_assignments(seg, act, seq, clk, isd, val,
+                                               num_segments=2)
+        assert np.asarray(out['surviving'])[:3].tolist() == [True, True, True]
+        assert not np.asarray(out['surviving'])[3:].any()
+        assert int(out['winner'][0]) == 0
+        # both actors' ops on 'b' survive (concurrent); actor2 wins
+        assert int(out['seg_max_actor'][1]) == rank['actor2']
+
+    def test_batch_axis(self):
+        ops = {'k': [('actor1', 1, {}, False), ('actor2', 1, {}, False)]}
+        _, _, packed = self._pack_field_ops(ops, ['actor1', 'actor2'])
+        batched = tuple(jnp.stack([x, x]) for x in packed)
+        out = merge_kernel.resolve_assignments_batch(*batched, num_segments=1)
+        assert out['surviving'].shape == (2, 2)
+        assert np.asarray(out['winner']).tolist() == [[1], [1]]
+
+
+class TestClockKernel:
+    def test_readiness(self):
+        doc_clock = jnp.array([2, 1, 0], dtype=jnp.int32)
+        deps = jnp.array([[2, 0, 0], [0, 0, 1], [0, 1, 0]], dtype=jnp.int32)
+        actor = jnp.array([1, 0, 2], dtype=jnp.int32)
+        seq = jnp.array([2, 3, 1], dtype=jnp.int32)
+        ready = clock_kernel.causally_ready(doc_clock, deps, actor, seq)
+        assert np.asarray(ready).tolist() == [True, False, True]
+
+    def test_advance(self):
+        doc_clock = jnp.array([2, 1, 0], dtype=jnp.int32)
+        actor = jnp.array([1, 0, 2], dtype=jnp.int32)
+        seq = jnp.array([2, 3, 1], dtype=jnp.int32)
+        ready = jnp.array([True, False, True])
+        new_clock = clock_kernel.advance(doc_clock, actor, seq, ready)
+        assert np.asarray(new_clock).tolist() == [2, 2, 1]
+
+    def test_less_or_equal(self):
+        a = jnp.array([[1, 2], [3, 1]], dtype=jnp.int32)
+        b = jnp.array([1, 2], dtype=jnp.int32)
+        assert np.asarray(clock_kernel.less_or_equal(a, b)).tolist() == [True, False]
+
+
+class TestEngine:
+    """Engine-level differential tests: the pack -> kernel -> unpack
+    pipeline must agree with the oracle backend on the same change JSON."""
+
+    def _oracle_fields(self, changes):
+        state, _ = Backend.apply_changes(Backend.init(), changes)
+        out = {}
+        rec = state.op_set.by_object[ROOT_ID]
+        for key, ops in rec.fields.items():
+            if not ops:
+                out[(ROOT_ID, key)] = {'action': 'remove', 'value': None,
+                                       'conflicts': None}
+                continue
+            conflicts = None
+            if len(ops) > 1:
+                conflicts = {op['actor']: op.get('value') for op in ops[1:]}
+            out[(ROOT_ID, key)] = {'action': 'set', 'value': ops[0].get('value'),
+                                   'conflicts': conflicts,
+                                   'link': ops[0]['action'] == 'link'}
+        return out
+
+    def _random_doc_changes(self, rng, n_actors=3, n_changes=6, n_keys=4):
+        actors = sorted(f'actor-{rng.randrange(1000):03d}-{i}' for i in range(n_actors))
+        seqs = {a: 0 for a in actors}
+        clock_seen = {a: {} for a in actors}   # each actor's local view
+        changes = []
+        for _ in range(n_changes):
+            a = rng.choice(actors)
+            seqs[a] += 1
+            deps = dict(clock_seen[a])
+            deps.pop(a, None)
+            ops = []
+            for _ in range(rng.randrange(1, 4)):
+                key = f'k{rng.randrange(n_keys)}'
+                if rng.random() < 0.2:
+                    ops.append({'action': 'del', 'obj': ROOT_ID, 'key': key})
+                else:
+                    ops.append({'action': 'set', 'obj': ROOT_ID, 'key': key,
+                                'value': f'{a}:{seqs[a]}:{key}'})
+            changes.append({'actor': a, 'seq': seqs[a], 'deps': deps, 'ops': ops})
+            clock_seen[a][a] = seqs[a]
+            # sometimes sync this actor with another's state (creates
+            # happened-before edges; otherwise everything is concurrent)
+            if rng.random() < 0.5:
+                b = rng.choice(actors)
+                for actor_k, s in clock_seen[b].items():
+                    clock_seen[a][actor_k] = max(clock_seen[a].get(actor_k, 0), s)
+        return changes
+
+    @pytest.mark.parametrize('seed', range(6))
+    def test_batch_merge_matches_oracle(self, seed):
+        from automerge_tpu.device.engine import batch_merge_docs
+        rng = random.Random(seed)
+        docs = [self._random_doc_changes(rng) for _ in range(5)]
+        resolved = batch_merge_docs(docs)
+        for i, changes in enumerate(docs):
+            assert resolved[i] == self._oracle_fields(changes), f'doc {i}'
+
+    def test_sharded_engine_matches_single_chip(self):
+        from automerge_tpu.device.engine import batch_merge_docs
+        from automerge_tpu.parallel import make_mesh
+        from automerge_tpu.parallel.docset_engine import ShardedDocSetEngine
+        if len(jax.devices()) < 8:
+            pytest.skip('needs 8 virtual devices')
+        rng = random.Random(123)
+        docs = [self._random_doc_changes(rng) for _ in range(11)]
+        single = batch_merge_docs(docs)
+        sharded, stats = ShardedDocSetEngine(make_mesh(8)).apply_changes_batch(docs)
+        assert sharded == single
+        assert stats['ops_applied'] > 0
+
+    def test_docstore_materialize(self):
+        from automerge_tpu.device.engine import DocStore
+        changes = [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 1},
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'y', 'value': 2}]},
+            {'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'del', 'obj': ROOT_ID, 'key': 'y'}]},
+        ]
+        store = DocStore.from_changes([changes])
+        assert store.materialize(0, ROOT_ID) == {'x': 1}

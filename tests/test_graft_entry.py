@@ -1,0 +1,32 @@
+"""The driver's entry points must compile and run on a virtual mesh."""
+import importlib.util
+import os
+import sys
+
+import jax
+import pytest
+
+
+def _load_graft():
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        '__graft_entry__.py')
+    spec = importlib.util.spec_from_file_location('graft_entry', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_entry_compiles_and_runs():
+    graft = _load_graft()
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out['surviving'].shape == args[0].shape
+    assert out['vis_index'].shape == args[6].shape
+
+
+def test_dryrun_multichip_8():
+    if len(jax.devices()) < 8:
+        pytest.skip('needs 8 virtual devices')
+    graft = _load_graft()
+    graft.dryrun_multichip(8)
