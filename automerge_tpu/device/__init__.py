@@ -17,6 +17,8 @@ XLA fusion rather than per-element control flow):
 * :mod:`.sequence` — RGA insertion-tree ordering via sort + pointer
   doubling (replaces `insertionsAfter`/`getNext` tree walks,
   op_set.js:379-425, and the SkipList order-statistic index)
+* :mod:`.pallas_merge` — hand-scheduled Pallas/Mosaic variant of the merge
+  kernel (one-hot MXU clock gather + VPU masked maxes, VMEM-resident)
 * :mod:`.packing`  — host-side interning and struct-of-arrays packing
 * :mod:`.engine`   — the batched document-store engine driving the kernels
 
@@ -25,6 +27,6 @@ axis; sharding over a device mesh is layered on top in
 :mod:`automerge_tpu.parallel`.
 """
 
-from .engine import DocStore, batch_merge_docs
+from .engine import DocStore, batch_merge_docs, pick_resolve_kernel
 
-__all__ = ['DocStore', 'batch_merge_docs']
+__all__ = ['DocStore', 'batch_merge_docs', 'pick_resolve_kernel']

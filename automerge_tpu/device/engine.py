@@ -12,10 +12,27 @@ lanes, which is what makes million-op merges per chip feasible.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from . import merge as merge_kernel
 from . import packing
+
+
+def pick_resolve_kernel(kernel='auto'):
+    """Select the field-resolution kernel implementation.
+
+    'xla'    — segment-reduction path (merge.py), runs everywhere.
+    'pallas' — hand-scheduled VMEM-resident kernel (pallas_merge.py);
+               requires a TPU backend (Mosaic).
+    'auto'   — pallas on TPU, xla otherwise.
+    """
+    if kernel == 'auto':
+        kernel = 'pallas' if jax.default_backend() == 'tpu' else 'xla'
+    if kernel == 'pallas':
+        from . import pallas_merge
+        return pallas_merge.resolve_assignments_batch_pallas
+    return merge_kernel.resolve_assignments_batch
 
 
 class DocStore:
@@ -75,7 +92,7 @@ def unpack_resolved(packed, surviving_row, winner_row):
     return doc_fields
 
 
-def batch_merge_docs(docs_changes, return_timing=False):
+def batch_merge_docs(docs_changes, return_timing=False, kernel='auto'):
     """Merge a batch of change lists, one per document, on device.
 
     Args:
@@ -93,7 +110,8 @@ def batch_merge_docs(docs_changes, return_timing=False):
     seg_id, actor, seq, clock, is_del, valid, n_pad = packing.pad_and_stack(packed)
     t1 = time.perf_counter()
 
-    out = merge_kernel.resolve_assignments_batch(
+    resolve = pick_resolve_kernel(kernel)
+    out = resolve(
         jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
         jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),
         num_segments=n_pad)

@@ -1,0 +1,44 @@
+"""Synthetic device-kernel workload generators (shared by bench + tests).
+
+The merge kernels assume causal delivery, which the generator encodes as
+an invariant: each op's clock row covers exactly its own actor's prior ops
+(``clock[i, actor_i] = seq_i - 1``), optionally plus a causally-consistent
+prefix of other actors' ops. Keeping the construction in one place keeps
+bench.py and the Pallas differential tests on the same op distribution.
+"""
+
+import numpy as np
+
+
+def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
+                        seed=0, del_p=0.05, invalid_p=0.0, cross_clock=False):
+    """A DocSet batch: per doc, ``n_ops`` concurrent 'set' ops from
+    ``n_actors`` actors spread over ``n_keys`` root fields.
+
+    Each actor's ops are sequential for itself and (by default) fully
+    concurrent across actors — the worst case for conflict resolution.
+    With ``cross_clock`` some ops additionally cover a prefix of other
+    actors' ops, exercising supersession.
+
+    Returns (seg_id, actor, seq, clock, is_del, valid) numpy arrays with
+    shapes [D,N] / [D,N,A].
+    """
+    rng = np.random.default_rng(seed)
+    seg_id = rng.integers(0, n_keys, size=(n_docs, n_ops)).astype(np.int32)
+    actor = rng.integers(0, n_actors, size=(n_docs, n_ops)).astype(np.int32)
+    # seq numbers: per (doc, actor) running count in op order
+    seq = np.ones((n_docs, n_ops), dtype=np.int32)
+    for a in range(n_actors):
+        mask = actor == a
+        running = np.cumsum(mask, axis=1)
+        seq[mask] = running[mask]
+    clock = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
+    d_idx, o_idx = np.indices((n_docs, n_ops))
+    clock[d_idx, o_idx, actor] = seq - 1
+    if cross_clock:
+        extra = rng.integers(0, 2, size=(n_docs, n_ops, n_actors))
+        clock = np.maximum(clock, np.minimum(extra.astype(np.int32),
+                                             seq[:, :, None] - 1))
+    is_del = rng.random((n_docs, n_ops)) < del_p
+    valid = rng.random((n_docs, n_ops)) >= invalid_p
+    return seg_id, actor, seq, clock, is_del, valid

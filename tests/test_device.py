@@ -71,8 +71,8 @@ def oracle_list_state(ins_ops_by_actor, del_elems):
     return state.op_set.by_object[LIST_ID].elem_ids
 
 
-def kernel_list_state(ins_ops_by_actor, del_elems, pad_to=None):
-    """Pack the same trace into device arrays and run the RGA kernel."""
+def pack_trace(ins_ops_by_actor, del_elems, pad_to=None):
+    """Pack a trace into device arrays; returns (arrays, node elem_ids)."""
     actors = sorted(ins_ops_by_actor.keys())
     actor_rank = {a: i + 1 for i, a in enumerate(actors)}  # 0 = head
 
@@ -100,17 +100,24 @@ def kernel_list_state(ins_ops_by_actor, del_elems, pad_to=None):
         actor[i] = a
         valid[i] = True
         visible[i] = (i != 0) and (eid not in deleted)
+    arrays = (parent, elem, actor, visible, valid)
+    return arrays, [eid for eid, _, _, _ in nodes]
 
-    out = seq_kernel.rga_order(jnp.array(parent), jnp.array(elem),
-                               jnp.array(actor), jnp.array(visible),
-                               jnp.array(valid))
-    vis_index = np.asarray(out['vis_index'])
-    length = int(out['length'])
-    ordered = [None] * length
-    for i, (eid, _, _, _) in enumerate(nodes):
+
+def _ordered_elem_ids(out_row, elem_ids):
+    vis_index = np.asarray(out_row['vis_index'])
+    ordered = [None] * int(out_row['length'])
+    for i, eid in enumerate(elem_ids):
         if vis_index[i] >= 0:
             ordered[vis_index[i]] = eid
     return ordered
+
+
+def kernel_list_state(ins_ops_by_actor, del_elems, pad_to=None):
+    """Pack the same trace into device arrays and run the RGA kernel."""
+    arrays, elem_ids = pack_trace(ins_ops_by_actor, del_elems, pad_to)
+    out = seq_kernel.rga_order(*(jnp.array(a) for a in arrays))
+    return _ordered_elem_ids(out, elem_ids)
 
 
 def random_trace(rng, n_actors=3, n_ops=40, delete_frac=0.2):
@@ -166,12 +173,19 @@ class TestSequenceKernel:
         assert kernel_list_state(ops, dels) == oracle_list_state(ops, dels)
 
     def test_batch_matches_single(self):
+        # The vmap'd batch kernel must agree row-by-row with both the
+        # single-doc kernel and the oracle.
         rng = random.Random(99)
         traces = [random_trace(rng, n_ops=15) for _ in range(4)]
-        singles = [kernel_list_state(ops, dels, pad_to=64)
-                   for ops, dels in traces]
-        assert all(singles[i] == oracle_list_state(*traces[i])
-                   for i in range(4))
+        packed = [pack_trace(ops, dels, pad_to=64) for ops, dels in traces]
+        stacked = tuple(jnp.array(np.stack([p[0][k] for p in packed]))
+                        for k in range(5))
+        batch_out = seq_kernel.rga_order_batch(*stacked)
+        for i, (ops, dels) in enumerate(traces):
+            row = {k: np.asarray(v)[i] for k, v in batch_out.items()}
+            got = _ordered_elem_ids(row, packed[i][1])
+            assert got == kernel_list_state(ops, dels, pad_to=64)
+            assert got == oracle_list_state(ops, dels)
 
 
 class TestMergeKernel:
