@@ -34,6 +34,7 @@ Key semantic anchors (reference citations):
 import re
 
 from ..common import ROOT_ID
+from ..native import make_seq_index, clone_index
 
 _ELEMID_RE = re.compile(r'^(.*):(\d+)$')
 
@@ -71,7 +72,12 @@ class ObjectRecord:
         self.following = {}                     # parent elemId/'_head' -> list of 'ins' ops
         self.insertion = {}                     # elemId -> 'ins' op
         self.max_elem = 0
-        self.elem_ids = []                      # visible elemIds in order (sequence index)
+        # Visible elemIds in document order. For sequences this is the
+        # order-statistic index — natively a C++ skip list with O(1) COW
+        # snapshots (native.py), matching the role of skip_list.js; plain
+        # list fallback when the native library is unavailable.
+        self.elem_ids = (make_seq_index() if init_action in ('makeList', 'makeText')
+                         else [])
 
     def clone(self):
         rec = ObjectRecord(self.init_action)
@@ -80,7 +86,7 @@ class ObjectRecord:
         rec.following = dict(self.following)
         rec.insertion = dict(self.insertion)
         rec.max_elem = self.max_elem
-        rec.elem_ids = list(self.elem_ids)
+        rec.elem_ids = clone_index(self.elem_ids)
         return rec
 
     def is_sequence(self):

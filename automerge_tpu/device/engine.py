@@ -19,16 +19,45 @@ from . import merge as merge_kernel
 from . import packing
 
 
+def _pallas_fits(n_ops, n_actors):
+    """Whether the Pallas kernel's per-block working set fits VMEM.
+
+    The kernel keeps one DOC_BLOCK of every operand + output resident
+    (~DOC_BLOCK * n_pad * (7 + n_actors) * 4 bytes) and unrolls
+    ~3 * n_tiles^2 tile-pair bodies; past these bounds Mosaic either
+    fails allocation or compiles pathologically, while the XLA path
+    handles the same shapes fine.
+    """
+    from . import pallas_merge as pm
+    n_pad = pm._round_up(max(n_ops, pm.OPS_TILE), pm.OPS_TILE)
+    vmem_bytes = pm.DOC_BLOCK * n_pad * (7 + n_actors) * 4
+    n_tiles = n_pad // pm.OPS_TILE
+    return vmem_bytes <= 8 * 1024 * 1024 and n_tiles <= 8
+
+
 def pick_resolve_kernel(kernel='auto'):
     """Select the field-resolution kernel implementation.
 
     'xla'    — segment-reduction path (merge.py), runs everywhere.
     'pallas' — hand-scheduled VMEM-resident kernel (pallas_merge.py);
                requires a TPU backend (Mosaic).
-    'auto'   — pallas on TPU, xla otherwise.
+    'auto'   — on TPU, pallas when the block working set fits VMEM
+               (checked per call against the input shapes), xla
+               otherwise and on non-TPU backends.
     """
     if kernel == 'auto':
-        kernel = 'pallas' if jax.default_backend() == 'tpu' else 'xla'
+        if jax.default_backend() != 'tpu':
+            return merge_kernel.resolve_assignments_batch
+
+        def dispatch(seg_id, actor, seq, clock, is_del, valid, *, num_segments):
+            if _pallas_fits(seg_id.shape[1], clock.shape[2]):
+                from . import pallas_merge
+                fn = pallas_merge.resolve_assignments_batch_pallas
+            else:
+                fn = merge_kernel.resolve_assignments_batch
+            return fn(seg_id, actor, seq, clock, is_del, valid,
+                      num_segments=num_segments)
+        return dispatch
     if kernel == 'pallas':
         from . import pallas_merge
         return pallas_merge.resolve_assignments_batch_pallas

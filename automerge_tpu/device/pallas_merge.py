@@ -41,7 +41,7 @@ def _round_up(n, m):
 
 def _make_kernel(n_tiles, n_actors):
     def kernel(seg_ref, actor_ref, seq_ref, clock_ref, is_del_ref, valid_ref,
-               seen_ref, surv_ref, wactor_ref, widx_ref, surv_scratch):
+               surv_ref, wactor_ref, widx_ref, surv_scratch):
         neg = jnp.int32(-1)
 
         def tile(ref, d, t):
@@ -79,7 +79,6 @@ def _make_kernel(n_tiles, n_actors):
                 valid_i = tile(valid_ref, d, ti)
                 is_del_i = tile(is_del_ref, d, ti)
                 surv_i = (valid_i != 0) & ~(seen_i >= seq_i) & (is_del_i == 0)
-                seen_ref[d, pl.ds(ti * OPS_TILE, OPS_TILE)] = seen_i
                 surv_scratch[d, pl.ds(ti * OPS_TILE, OPS_TILE)] = \
                     surv_i.astype(jnp.int32)
 
@@ -130,16 +129,16 @@ def _resolve_pallas_padded(seg_id, actor, seq, clock, is_del, valid,
     spec2 = pl.BlockSpec((DOC_BLOCK, n_pad, n_actors), lambda d: (d, 0, 0),
                          memory_space=pltpu.VMEM)
 
-    seen, surv, wactor, widx = pl.pallas_call(
+    surv, wactor, widx = pl.pallas_call(
         _make_kernel(n_tiles, n_actors),
         grid=(n_docs // DOC_BLOCK,),
         in_specs=[spec1, spec1, spec1, spec2, spec1, spec1],
-        out_specs=[spec1, spec1, spec1, spec1],
-        out_shape=[jax.ShapeDtypeStruct((n_docs, n_pad), jnp.int32)] * 4,
+        out_specs=[spec1, spec1, spec1],
+        out_shape=[jax.ShapeDtypeStruct((n_docs, n_pad), jnp.int32)] * 3,
         scratch_shapes=[pltpu.VMEM((DOC_BLOCK, n_pad), jnp.int32)],
         interpret=interpret,
     )(seg_id, actor, seq, clock, is_del, valid)
-    return {'seen': seen, 'surviving': surv != 0,
+    return {'surviving': surv != 0,
             'winner_actor_per_op': wactor, 'winner_per_op': widx}
 
 

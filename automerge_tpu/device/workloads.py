@@ -34,11 +34,27 @@ def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
         seq[mask] = running[mask]
     clock = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
     d_idx, o_idx = np.indices((n_docs, n_ops))
-    clock[d_idx, o_idx, actor] = seq - 1
     if cross_clock:
-        extra = rng.integers(0, 2, size=(n_docs, n_ops, n_actors))
-        clock = np.maximum(clock, np.minimum(extra.astype(np.int32),
-                                             seq[:, :, None] - 1))
+        # Causally valid cross-actor coverage via knowledge frontiers: op i
+        # (column o) covers every op in columns < f_i, with f_i drawn in
+        # [f_prev_own, o] (monotone per actor). Monotonicity makes the
+        # clocks transitively closed — if i covers j then f_i > o_j >= f_j,
+        # so i covers everything j covers — and counts are capped by each
+        # actor's real op tally, so no phantom dependencies exist.
+        onehot = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
+        onehot[d_idx, o_idx, actor] = 1
+        # counts[d, o, b] = number of b-ops in columns < o
+        counts = np.zeros((n_docs, n_ops + 1, n_actors), dtype=np.int32)
+        counts[:, 1:] = np.cumsum(onehot, axis=1)
+        f_prev = np.zeros((n_docs, n_actors), dtype=np.int64)
+        docs = np.arange(n_docs)
+        for o in range(n_ops):
+            a = actor[:, o]
+            lo = f_prev[docs, a]
+            f = lo + (rng.random(n_docs) * (o - lo + 1)).astype(np.int64)
+            f_prev[docs, a] = f
+            clock[:, o, :] = counts[docs, f, :]
+    clock[d_idx, o_idx, actor] = seq - 1
     is_del = rng.random((n_docs, n_ops)) < del_p
     valid = rng.random((n_docs, n_ops)) >= invalid_p
     return seg_id, actor, seq, clock, is_del, valid

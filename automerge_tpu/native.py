@@ -1,0 +1,220 @@
+"""Native host runtime: C++ order-statistic sequence index with COW handles.
+
+The reference's L1 native-performance role is played by `SkipList`
+(backend/skip_list.js:114-334) — an immutable order-statistic index giving
+elemId<->index in O(log n), introduced "for performance" (CHANGELOG.md:140)
+to replace an O(n) design. Here that component is a C++ indexable skip
+list (`native/seq_index.cpp`) behind refcount-based copy-on-write handles:
+
+* :class:`SeqIndex` quacks like the ``list`` of elemId strings the oracle
+  backend otherwise keeps (``index/insert/__delitem__/__getitem__/len``),
+  so every call site works with either representation.
+* ``clone()`` is O(1): snapshots share one C++ structure. The structure is
+  physically copied only when a *shared* snapshot is mutated. In the common
+  replay loop (``state = apply(state, change)``) the old snapshot is
+  garbage-collected before the next mutation, so edits stay in-place
+  O(log n) — the persistence of the reference's immutable skip list at
+  mutable-structure speed.
+* elemId strings are interned process-wide to int64 keys; only ints cross
+  the C boundary.
+
+The C library is compiled on demand with g++ (no pip deps); if a compiler
+or the .so is unavailable, callers fall back to plain Python lists.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_LIB = None
+_LOAD_ATTEMPTED = False
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, '_native', 'libamtpu.so')
+_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), 'native', 'seq_index.cpp')
+
+
+def _bind(lib):
+    lib.amsl_new.argtypes = [ctypes.c_uint64]
+    lib.amsl_new.restype = ctypes.c_void_p
+    lib.amsl_copy.argtypes = [ctypes.c_void_p]
+    lib.amsl_copy.restype = ctypes.c_void_p
+    lib.amsl_free.argtypes = [ctypes.c_void_p]
+    lib.amsl_free.restype = None
+    lib.amsl_len.argtypes = [ctypes.c_void_p]
+    lib.amsl_len.restype = ctypes.c_int64
+    lib.amsl_insert.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.amsl_insert.restype = ctypes.c_int
+    lib.amsl_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amsl_remove.restype = ctypes.c_int64
+    lib.amsl_index_of.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amsl_index_of.restype = ctypes.c_int64
+    lib.amsl_key_at.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.amsl_key_at.restype = ctypes.c_int64
+    lib.amsl_fill_keys.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64)]
+    lib.amsl_fill_keys.restype = None
+    return lib
+
+
+def _compile():
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix='.so', dir=os.path.dirname(_SO_PATH))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
+             _SRC_PATH, '-o', tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)  # atomic: concurrent builders both succeed
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _LIB, _LOAD_ATTEMPTED
+    if _LOAD_ATTEMPTED:
+        return _LIB
+    _LOAD_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE', '1') == '0':
+        return None
+    if not os.path.exists(_SO_PATH):
+        if not os.path.exists(_SRC_PATH) or not _compile():
+            return None
+    try:
+        _LIB = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+# Process-wide elemId interner. elemIds ("actor:counter" strings) are
+# append-only over a process lifetime; the table is shared by all indexes.
+_INTERN = {}
+_STRS = []
+
+
+def _intern(key):
+    i = _INTERN.get(key)
+    if i is None:
+        i = len(_STRS)
+        _INTERN[key] = i
+        _STRS.append(key)
+    return i
+
+
+_seed_counter = [0]
+
+
+class SeqIndex:
+    """COW handle over one C++ skip list; list-compatible surface."""
+
+    __slots__ = ('_lib', '_h', '_rc')
+
+    def __init__(self, _h=None, _rc=None, _lib=None):
+        self._lib = _lib or _load()
+        if _h is not None:
+            self._h = _h
+            self._rc = _rc
+        else:
+            _seed_counter[0] += 1
+            self._h = self._lib.amsl_new(_seed_counter[0])
+            self._rc = [1]
+
+    def clone(self):
+        """O(1) snapshot: share the structure, bump the refcount."""
+        self._rc[0] += 1
+        return SeqIndex(_h=self._h, _rc=self._rc, _lib=self._lib)
+
+    def _own(self):
+        """Ensure exclusive ownership before a mutation (copy if shared)."""
+        if self._rc[0] > 1:
+            h = self._lib.amsl_copy(self._h)
+            if not h:
+                raise MemoryError('seq index copy failed')
+            self._rc[0] -= 1
+            self._h = h
+            self._rc = [1]
+
+    def __del__(self):
+        rc = getattr(self, '_rc', None)
+        if rc is None:
+            return
+        rc[0] -= 1
+        if rc[0] == 0 and self._h:
+            self._lib.amsl_free(self._h)
+        self._h = None
+        self._rc = None
+
+    def __len__(self):
+        return self._lib.amsl_len(self._h)
+
+    def __getitem__(self, index):
+        n = len(self)
+        if index < 0:
+            index += n
+        k = self._lib.amsl_key_at(self._h, index)
+        if k < 0:
+            raise IndexError('seq index out of range')
+        return _STRS[k]
+
+    def index(self, key):
+        i = self._lib.amsl_index_of(self._h, _INTERN.get(key, -1))
+        if i < 0:
+            raise ValueError(f'{key!r} is not in seq index')
+        return i
+
+    def insert(self, index, key):
+        self._own()
+        n = len(self)
+        if index < 0:
+            index = max(n + index, 0)
+        if index > n:
+            index = n
+        if self._lib.amsl_insert(self._h, index, _intern(key)) != 0:
+            raise ValueError(f'duplicate elemId {key!r}')
+
+    def __delitem__(self, index):
+        self._own()
+        if index < 0:
+            index += len(self)
+        if self._lib.amsl_remove(self._h, index) < 0:
+            raise IndexError('seq index out of range')
+
+    def __iter__(self):
+        n = len(self)
+        buf = (ctypes.c_int64 * n)()
+        self._lib.amsl_fill_keys(self._h, buf)
+        return iter([_STRS[k] for k in buf])
+
+    def __eq__(self, other):
+        if isinstance(other, (list, SeqIndex)):
+            return list(self) == list(other)
+        return NotImplemented
+
+    def __repr__(self):
+        return f'SeqIndex({list(self)!r})'
+
+
+def make_seq_index():
+    """A fresh sequence index: native if available, else a plain list."""
+    if _load() is not None:
+        return SeqIndex()
+    return []
+
+
+def clone_index(idx):
+    """Snapshot an index produced by :func:`make_seq_index`."""
+    if isinstance(idx, SeqIndex):
+        return idx.clone()
+    return list(idx)

@@ -82,18 +82,17 @@ def main():
 
     log(f'devices: {jax.devices()}')
 
-    # Headline: config 5 — 10k-doc DocSet batched merge. Kernel auto-select
-    # (Pallas on TPU, XLA segment-reduce elsewhere); both are reported.
+    # Headline: config 5 — 10k-doc DocSet batched merge, measured on the
+    # kernel the auto path actually selects (what default-API users get).
+    # The alternate kernel is logged to stderr as a diagnostic only.
     total_ops, t_med, t_p99 = bench_docset_merge(jnp, pick_resolve_kernel())
     ops_per_sec = total_ops / t_med
     log(f'docset-merge[auto]: {total_ops} ops in {t_med * 1e3:.2f} ms '
         f'(p99 {t_p99 * 1e3:.2f} ms) -> {ops_per_sec / 1e6:.1f}M ops/s')
     if jax.default_backend() == 'tpu':
         _, t_xla, _ = bench_docset_merge(jnp, resolve_assignments_batch)
-        log(f'docset-merge[xla]: {t_xla * 1e3:.2f} ms '
+        log(f'docset-merge[xla diagnostic]: {t_xla * 1e3:.2f} ms '
             f'-> {total_ops / t_xla / 1e6:.1f}M ops/s')
-        if total_ops / t_xla > ops_per_sec:  # keep the better path honest
-            ops_per_sec = total_ops / t_xla
 
     # Secondary: long-text RGA ordering
     n_nodes, t_text = bench_text_merge(jnp, rga_order)

@@ -1,0 +1,239 @@
+// Order-statistic sequence index: the native-runtime equivalent of the
+// reference's SkipList (backend/skip_list.js:114-334), which maps list/text
+// element IDs <-> document indexes in O(log n) both ways. The reference's
+// structure is an immutable JS skip list; this is a mutable, doubly-linked
+// indexable skip list in C++ whose persistence is provided one level up by
+// refcount-based copy-on-write handles (automerge_tpu/native.py): OpSet
+// snapshots share one structure until a shared snapshot is mutated, at
+// which point the structure is copied once.
+//
+// Keys are int64 handles (elemId strings are interned host-side). Widths on
+// every forward link give key_at(i); prev links walked top-level-first give
+// index_of(key) in expected O(log n), mirroring skip_list.js:261-287.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <unordered_map>
+
+namespace {
+
+constexpr int kMaxLevel = 32;
+
+struct Node {
+    int64_t key;
+    int level;               // number of links (1..kMaxLevel)
+    Node** next;             // next[l], l in [0, level)
+    int64_t* nwidth;         // level-0 distance to next[l] (0 if next is null)
+    Node** prev;             // prev[l]
+    int64_t* pwidth;         // level-0 distance from prev[l] to this node
+};
+
+Node* node_new(int64_t key, int level) {
+    Node* n = static_cast<Node*>(std::malloc(sizeof(Node)));
+    n->key = key;
+    n->level = level;
+    n->next = static_cast<Node**>(std::calloc(level, sizeof(Node*)));
+    n->nwidth = static_cast<int64_t*>(std::calloc(level, sizeof(int64_t)));
+    n->prev = static_cast<Node**>(std::calloc(level, sizeof(Node*)));
+    n->pwidth = static_cast<int64_t*>(std::calloc(level, sizeof(int64_t)));
+    return n;
+}
+
+void node_free(Node* n) {
+    std::free(n->next);
+    std::free(n->nwidth);
+    std::free(n->prev);
+    std::free(n->pwidth);
+    std::free(n);
+}
+
+struct SeqIndex {
+    Node* head;                                  // sentinel, level kMaxLevel
+    int64_t size;
+    uint64_t rng;                                // xorshift64 state
+    std::unordered_map<int64_t, Node*> by_key;
+
+    explicit SeqIndex(uint64_t seed) : size(0), rng(seed ? seed : 0x9e3779b97f4a7c15ULL) {
+        head = node_new(-1, kMaxLevel);
+    }
+
+    ~SeqIndex() {
+        Node* n = head;
+        while (n) {
+            Node* nx = n->next[0];
+            node_free(n);
+            n = nx;
+        }
+    }
+
+    // Geometric level distribution, promotion probability 1/4 (same family
+    // as skip_list.js randomLevel's p — expected O(log n) search).
+    int random_level() {
+        rng ^= rng << 13;
+        rng ^= rng >> 7;
+        rng ^= rng << 17;
+        uint64_t r = rng;
+        int level = 1;
+        while (level < kMaxLevel && (r & 3) == 3) {
+            level++;
+            r >>= 2;
+        }
+        return level;
+    }
+
+    // Insert `key` so it lands at position `index` (0-based). Returns 0,
+    // or -1 on out-of-range index / duplicate key.
+    int insert(int64_t index, int64_t key) {
+        if (index < 0 || index > size || by_key.count(key)) return -1;
+        Node* update[kMaxLevel];
+        int64_t rank[kMaxLevel];  // # nodes strictly before update[l] chain, incl itself
+        Node* x = head;
+        int64_t pos = 0;          // nodes passed (head counts as 0)
+        for (int l = kMaxLevel - 1; l >= 0; l--) {
+            while (x->next[l] && pos + x->nwidth[l] <= index) {
+                pos += x->nwidth[l];
+                x = x->next[l];
+            }
+            update[l] = x;
+            rank[l] = pos;
+        }
+        int level = random_level();
+        Node* n = node_new(key, level);
+        for (int l = 0; l < level; l++) {
+            Node* u = update[l];
+            n->next[l] = u->next[l];
+            n->nwidth[l] = u->next[l] ? (rank[l] + u->nwidth[l] - index) : 0;
+            n->prev[l] = u;
+            n->pwidth[l] = index - rank[l] + 1;
+            if (u->next[l]) {
+                u->next[l]->prev[l] = n;
+                u->next[l]->pwidth[l] = n->nwidth[l];
+            }
+            u->next[l] = n;
+            u->nwidth[l] = n->pwidth[l];
+        }
+        for (int l = level; l < kMaxLevel; l++) {
+            Node* u = update[l];
+            if (u->next[l]) {
+                u->nwidth[l] += 1;
+                u->next[l]->pwidth[l] = u->nwidth[l];
+            }
+        }
+        by_key[key] = n;
+        size++;
+        return 0;
+    }
+
+    // Remove the node at `index`; returns its key or -1 if out of range.
+    int64_t remove_at(int64_t index) {
+        if (index < 0 || index >= size) return -1;
+        Node* update[kMaxLevel];
+        Node* x = head;
+        int64_t pos = 0;
+        for (int l = kMaxLevel - 1; l >= 0; l--) {
+            while (x->next[l] && pos + x->nwidth[l] <= index) {
+                pos += x->nwidth[l];
+                x = x->next[l];
+            }
+            update[l] = x;
+        }
+        Node* n = x->next[0];  // pos == index position of predecessor chain
+        for (int l = 0; l < kMaxLevel; l++) {
+            Node* u = update[l];
+            if (l < n->level) {
+                u->next[l] = n->next[l];
+                u->nwidth[l] = n->next[l] ? u->nwidth[l] + n->nwidth[l] - 1 : 0;
+                if (n->next[l]) {
+                    n->next[l]->prev[l] = u;
+                    n->next[l]->pwidth[l] = u->nwidth[l];
+                }
+            } else if (u->next[l]) {
+                u->nwidth[l] -= 1;
+                u->next[l]->pwidth[l] = u->nwidth[l];
+            }
+        }
+        int64_t key = n->key;
+        by_key.erase(key);
+        node_free(n);
+        size--;
+        return key;
+    }
+
+    // Position of `key`, or -1. Walks prev links top-level-first, summing
+    // widths — the skip_list.js:261-270 algorithm.
+    int64_t index_of(int64_t key) const {
+        auto it = by_key.find(key);
+        if (it == by_key.end()) return -1;
+        const Node* n = it->second;
+        int64_t pos = 0;
+        while (n != head) {
+            int l = n->level - 1;
+            pos += n->pwidth[l];
+            n = n->prev[l];
+        }
+        return pos - 1;
+    }
+
+    int64_t key_at(int64_t index) const {
+        if (index < 0 || index >= size) return -1;
+        const Node* x = head;
+        int64_t pos = 0;
+        for (int l = kMaxLevel - 1; l >= 0; l--) {
+            while (x->next[l] && pos + x->nwidth[l] <= index + 1) {
+                pos += x->nwidth[l];
+                x = x->next[l];
+            }
+        }
+        return x->key;
+    }
+
+    void fill_keys(int64_t* out) const {
+        const Node* n = head->next[0];
+        for (int64_t i = 0; n; n = n->next[0], i++) out[i] = n->key;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* amsl_new(uint64_t seed) { return new (std::nothrow) SeqIndex(seed); }
+
+void* amsl_copy(void* h) {
+    SeqIndex* src = static_cast<SeqIndex*>(h);
+    SeqIndex* dst = new (std::nothrow) SeqIndex(src->rng * 6364136223846793005ULL + 1);
+    if (!dst) return nullptr;
+    int64_t i = 0;
+    for (Node* n = src->head->next[0]; n; n = n->next[0], i++) {
+        dst->insert(i, n->key);
+    }
+    return dst;
+}
+
+void amsl_free(void* h) { delete static_cast<SeqIndex*>(h); }
+
+int64_t amsl_len(void* h) { return static_cast<SeqIndex*>(h)->size; }
+
+int amsl_insert(void* h, int64_t index, int64_t key) {
+    return static_cast<SeqIndex*>(h)->insert(index, key);
+}
+
+int64_t amsl_remove(void* h, int64_t index) {
+    return static_cast<SeqIndex*>(h)->remove_at(index);
+}
+
+int64_t amsl_index_of(void* h, int64_t key) {
+    return static_cast<SeqIndex*>(h)->index_of(key);
+}
+
+int64_t amsl_key_at(void* h, int64_t index) {
+    return static_cast<SeqIndex*>(h)->key_at(index);
+}
+
+void amsl_fill_keys(void* h, int64_t* out) {
+    static_cast<SeqIndex*>(h)->fill_keys(out);
+}
+
+}  // extern "C"

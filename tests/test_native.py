@@ -1,0 +1,177 @@
+"""Native C++ sequence index: differential + COW-persistence tests.
+
+Port of the reference's skip-list strategy (test/skip_list_test.js): a
+black-box API suite plus a property-based differential test driving random
+insert/remove programs against a shadow Python list (skip_list_test.js:
+171-223). The COW tests cover what the reference gets from immutability:
+old snapshots must be unaffected by later mutations.
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native library unavailable')
+
+
+def make():
+    return native.SeqIndex()
+
+
+class TestBlackBox:
+    def test_empty(self):
+        s = make()
+        assert len(s) == 0
+        assert list(s) == []
+        with pytest.raises(IndexError):
+            s[0]
+        with pytest.raises(ValueError):
+            s.index('missing')
+
+    def test_insert_and_lookup(self):
+        s = make()
+        s.insert(0, 'a:1')
+        s.insert(1, 'a:2')
+        s.insert(1, 'b:1')
+        assert list(s) == ['a:1', 'b:1', 'a:2']
+        assert len(s) == 3
+        assert [s[i] for i in range(3)] == ['a:1', 'b:1', 'a:2']
+        assert s.index('a:1') == 0
+        assert s.index('b:1') == 1
+        assert s.index('a:2') == 2
+        assert s[-1] == 'a:2'
+
+    def test_remove(self):
+        s = make()
+        for i, k in enumerate(['a:1', 'a:2', 'a:3', 'a:4']):
+            s.insert(i, k)
+        del s[1]
+        assert list(s) == ['a:1', 'a:3', 'a:4']
+        assert s.index('a:4') == 2
+        with pytest.raises(ValueError):
+            s.index('a:2')
+        del s[2]
+        assert list(s) == ['a:1', 'a:3']
+        with pytest.raises(IndexError):
+            del s[5]
+
+    def test_duplicate_key_rejected(self):
+        s = make()
+        s.insert(0, 'a:1')
+        with pytest.raises(ValueError):
+            s.insert(1, 'a:1')
+
+    def test_reinsert_after_remove(self):
+        s = make()
+        s.insert(0, 'a:1')
+        del s[0]
+        s.insert(0, 'a:1')
+        assert s.index('a:1') == 0
+
+    def test_equality_with_list(self):
+        s = make()
+        s.insert(0, 'x:1')
+        assert s == ['x:1']
+        assert not (s == ['x:2'])
+
+
+class TestPropertyDifferential:
+    """Random programs vs a shadow list (skip_list_test.js:171-223)."""
+
+    @pytest.mark.parametrize('seed', range(8))
+    def test_random_program(self, seed):
+        rng = random.Random(seed)
+        s, shadow = make(), []
+        next_key = 0
+        for step in range(400):
+            if shadow and rng.random() < 0.35:
+                i = rng.randrange(len(shadow))
+                del s[i]
+                del shadow[i]
+            else:
+                i = rng.randint(0, len(shadow))
+                key = f'actor:{next_key}'
+                next_key += 1
+                s.insert(i, key)
+                shadow.insert(i, key)
+            if step % 50 == 0 or step == 399:
+                assert list(s) == shadow
+                assert len(s) == len(shadow)
+                for j in rng.sample(range(len(shadow)), min(10, len(shadow))):
+                    assert s[j] == shadow[j]
+                    assert s.index(shadow[j]) == j
+
+    def test_large_sequential_append(self):
+        s, shadow = make(), []
+        for i in range(3000):
+            s.insert(i, f'a:{i}')
+            shadow.append(f'a:{i}')
+        assert list(s) == shadow
+        assert s.index('a:1500') == 1500
+        assert s[2999] == 'a:2999'
+
+
+class TestCopyOnWrite:
+    def test_clone_is_snapshot(self):
+        s = make()
+        for i in range(10):
+            s.insert(i, f'a:{i}')
+        snap = s.clone()
+        s.insert(10, 'a:10')
+        del s[0]
+        assert len(snap) == 10
+        assert list(snap) == [f'a:{i}' for i in range(10)]
+        assert len(s) == 10
+        assert list(s) == [f'a:{i}' for i in range(1, 11)]
+
+    def test_mutating_clone_preserves_original(self):
+        s = make()
+        s.insert(0, 'a:1')
+        snap = s.clone()
+        snap.insert(1, 'b:1')
+        assert list(s) == ['a:1']
+        assert list(snap) == ['a:1', 'b:1']
+
+    def test_chained_clones(self):
+        s = make()
+        s.insert(0, 'a:1')
+        c1 = s.clone()
+        c2 = c1.clone()
+        c2.insert(1, 'c:1')
+        c1.insert(0, 'b:1')
+        assert list(s) == ['a:1']
+        assert list(c1) == ['b:1', 'a:1']
+        assert list(c2) == ['a:1', 'c:1']
+
+    def test_dropping_snapshot_allows_inplace(self):
+        # No assertion on *where* the mutation happens — just that results
+        # stay correct when snapshots are created and discarded repeatedly,
+        # the replay-loop pattern the COW scheme optimizes.
+        s = make()
+        for i in range(200):
+            snap = s.clone()
+            del snap
+            s.insert(i, f'a:{i}')
+        assert len(s) == 200
+        assert s.index('a:199') == 199
+
+
+class TestBackendIntegration:
+    def test_opset_uses_native_index(self):
+        from automerge_tpu.backend import op_set as O
+        s = O.init()
+        change = {'actor': 'actor1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': 'list1'},
+            {'action': 'ins', 'obj': 'list1', 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': 'list1', 'key': 'actor1:1', 'value': 'x'},
+            {'action': 'link', 'obj': '00000000-0000-0000-0000-000000000000',
+             'key': 'items', 'value': 'list1'},
+        ]}
+        O.add_change(s, change, False)
+        rec = s.by_object['list1']
+        assert isinstance(rec.elem_ids, native.SeqIndex)
+        assert list(rec.elem_ids) == ['actor1:1']
