@@ -65,6 +65,8 @@ class ObjectRecord:
     __slots__ = ('init_action', 'inbound', 'fields', 'following',
                  'insertion', 'max_elem', 'elem_ids')
 
+    SEQUENCE_ACTIONS = ('makeList', 'makeText')
+
     def __init__(self, init_action=None):
         self.init_action = init_action          # 'makeMap'/'makeList'/'makeText'/None(root)
         self.inbound = []                       # list of link ops referencing this object
@@ -76,11 +78,12 @@ class ObjectRecord:
         # order-statistic index — natively a C++ skip list with O(1) COW
         # snapshots (native.py), matching the role of skip_list.js; plain
         # list fallback when the native library is unavailable.
-        self.elem_ids = (make_seq_index() if init_action in ('makeList', 'makeText')
-                         else [])
+        self.elem_ids = (make_seq_index()
+                         if init_action in self.SEQUENCE_ACTIONS else [])
 
     def clone(self):
-        rec = ObjectRecord(self.init_action)
+        rec = ObjectRecord.__new__(ObjectRecord)  # skip __init__: elem_ids
+        rec.init_action = self.init_action        # comes from the snapshot
         rec.inbound = list(self.inbound)
         rec.fields = dict(self.fields)          # op lists are shared (immutable by convention)
         rec.following = dict(self.following)
@@ -90,7 +93,7 @@ class ObjectRecord:
         return rec
 
     def is_sequence(self):
-        return self.init_action in ('makeList', 'makeText')
+        return self.init_action in self.SEQUENCE_ACTIONS
 
 
 class OpSet:

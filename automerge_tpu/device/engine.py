@@ -22,15 +22,16 @@ from . import packing
 def _pallas_fits(n_ops, n_actors):
     """Whether the Pallas kernel's per-block working set fits VMEM.
 
-    The kernel keeps one DOC_BLOCK of every operand + output resident
-    (~DOC_BLOCK * n_pad * (7 + n_actors) * 4 bytes) and unrolls
+    The kernel keeps one DOC_BLOCK of every plane resident — 5 int32
+    inputs + 3 outputs + 1 scratch + the [.., n_actors] clock, i.e.
+    DOC_BLOCK * n_pad * (9 + n_actors) * 4 bytes — and unrolls
     ~3 * n_tiles^2 tile-pair bodies; past these bounds Mosaic either
     fails allocation or compiles pathologically, while the XLA path
     handles the same shapes fine.
     """
     from . import pallas_merge as pm
     n_pad = pm._round_up(max(n_ops, pm.OPS_TILE), pm.OPS_TILE)
-    vmem_bytes = pm.DOC_BLOCK * n_pad * (7 + n_actors) * 4
+    vmem_bytes = pm.DOC_BLOCK * n_pad * (9 + n_actors) * 4
     n_tiles = n_pad // pm.OPS_TILE
     return vmem_bytes <= 8 * 1024 * 1024 and n_tiles <= 8
 

@@ -10,11 +10,13 @@ list (`native/seq_index.cpp`) behind refcount-based copy-on-write handles:
   backend otherwise keeps (``index/insert/__delitem__/__getitem__/len``),
   so every call site works with either representation.
 * ``clone()`` is O(1): snapshots share one C++ structure. The structure is
-  physically copied only when a *shared* snapshot is mutated. In the common
-  replay loop (``state = apply(state, change)``) the old snapshot is
-  garbage-collected before the next mutation, so edits stay in-place
-  O(log n) — the persistence of the reference's immutable skip list at
-  mutable-structure speed.
+  physically copied only when a *shared* snapshot is mutated, via a
+  linear-time structural copy in C++. Within one batched apply session
+  (the fast path: ``apply_changes(state, many_changes)``) at most one copy
+  happens and every subsequent edit is in-place O(log n). Per-change apply
+  loops pay one O(n) copy per change — the same asymptotics as the plain
+  list fallback's clone, at memcpy-level constants — so batching is where
+  the 20-30x replay speedup comes from.
 * elemId strings are interned process-wide to int64 keys; only ints cross
   the C boundary.
 
@@ -84,9 +86,13 @@ def _load():
     _LOAD_ATTEMPTED = True
     if os.environ.get('AUTOMERGE_TPU_NATIVE', '1') == '0':
         return None
-    if not os.path.exists(_SO_PATH):
-        if not os.path.exists(_SRC_PATH) or not _compile():
-            return None
+    have_src = os.path.exists(_SRC_PATH)
+    stale = (have_src and os.path.exists(_SO_PATH)
+             and os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH))
+    if not os.path.exists(_SO_PATH) or stale:
+        if not have_src or not _compile():
+            if not os.path.exists(_SO_PATH):
+                return None
     try:
         _LIB = _bind(ctypes.CDLL(_SO_PATH))
     except OSError:

@@ -73,6 +73,42 @@ def bench_text_merge(jnp, rga_order, n_nodes=1 << 18, iters=10):
     return n_nodes, float(np.median(times))
 
 
+def bench_trace_replay(n_ops=180000, host_ops=20000):
+    """automerge-perf analogue (BASELINE.md): a ~180k-keystroke editing
+    trace. Device path: the full insertion tree ordered in one RGA-kernel
+    call. Host path: wire changes through the oracle backend in one batched
+    apply session (native C++ sequence index) — measured at a smaller size
+    and reported as changes/s."""
+    import jax
+    from automerge_tpu import traces
+    from automerge_tpu import backend as B
+    from automerge_tpu.device.sequence import rga_order
+
+    trace = traces.gen_editing_trace(n_ops, seed=0)
+    arrays, values = traces.trace_to_device_arrays(
+        trace, pad_to=1 << (int(np.ceil(np.log2(n_ops + 2)))))
+    args = tuple(np.asarray(a) for a in arrays)
+    out = rga_order(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = rga_order(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t_dev = float(np.median(times))
+    log(f'trace-replay[device]: {n_ops} keystrokes ordered in '
+        f'{t_dev * 1e3:.2f} ms -> {n_ops / t_dev / 1e6:.2f}M ops/s')
+
+    host_trace = trace[:host_ops + 1]
+    state = B.init('bench')
+    t0 = time.perf_counter()
+    state, _ = B.apply_changes(state, host_trace)
+    t_host = time.perf_counter() - t0
+    log(f'trace-replay[host oracle]: {host_ops} changes in {t_host:.2f} s '
+        f'-> {host_ops / t_host:.0f} changes/s')
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -98,6 +134,9 @@ def main():
     n_nodes, t_text = bench_text_merge(jnp, rga_order)
     log(f'text-order: {n_nodes} elems in {t_text * 1e3:.2f} ms '
         f'-> {n_nodes / t_text / 1e6:.1f}M elems/s')
+
+    # Secondary: automerge-perf editing-trace replay (device + host oracle)
+    bench_trace_replay()
 
     north_star = 1e7  # 1M ops / 100ms (BASELINE.json)
     print(json.dumps({

@@ -16,6 +16,7 @@
 #include <cstring>
 #include <new>
 #include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -23,30 +24,43 @@ constexpr int kMaxLevel = 32;
 
 struct Node {
     int64_t key;
-    int level;               // number of links (1..kMaxLevel)
+    int32_t level;           // number of links (1..kMaxLevel)
+    int32_t pooled;          // 1 = lives in a copy arena, not malloc'd
     Node** next;             // next[l], l in [0, level)
     int64_t* nwidth;         // level-0 distance to next[l] (0 if next is null)
     Node** prev;             // prev[l]
     int64_t* pwidth;         // level-0 distance from prev[l] to this node
 };
 
-Node* node_new(int64_t key, int level) {
-    Node* n = static_cast<Node*>(std::malloc(sizeof(Node)));
+constexpr size_t node_bytes(int level) {
+    return sizeof(Node) + static_cast<size_t>(level) * (2 * sizeof(Node*) +
+                                                        2 * sizeof(int64_t));
+}
+
+// Lay the four per-level arrays out right after the Node struct.
+Node* node_init(void* mem, int64_t key, int level, int pooled) {
+    Node* n = static_cast<Node*>(mem);
     n->key = key;
     n->level = level;
-    n->next = static_cast<Node**>(std::calloc(level, sizeof(Node*)));
-    n->nwidth = static_cast<int64_t*>(std::calloc(level, sizeof(int64_t)));
-    n->prev = static_cast<Node**>(std::calloc(level, sizeof(Node*)));
-    n->pwidth = static_cast<int64_t*>(std::calloc(level, sizeof(int64_t)));
+    n->pooled = pooled;
+    char* p = static_cast<char*>(mem) + sizeof(Node);
+    n->next = reinterpret_cast<Node**>(p);
+    p += level * sizeof(Node*);
+    n->prev = reinterpret_cast<Node**>(p);
+    p += level * sizeof(Node*);
+    n->nwidth = reinterpret_cast<int64_t*>(p);
+    p += level * sizeof(int64_t);
+    n->pwidth = reinterpret_cast<int64_t*>(p);
+    std::memset(n->next, 0, level * (2 * sizeof(Node*) + 2 * sizeof(int64_t)));
     return n;
 }
 
+Node* node_new(int64_t key, int level) {
+    return node_init(std::malloc(node_bytes(level)), key, level, 0);
+}
+
 void node_free(Node* n) {
-    std::free(n->next);
-    std::free(n->nwidth);
-    std::free(n->prev);
-    std::free(n->pwidth);
-    std::free(n);
+    if (!n->pooled) std::free(n);
 }
 
 struct SeqIndex {
@@ -54,6 +68,7 @@ struct SeqIndex {
     int64_t size;
     uint64_t rng;                                // xorshift64 state
     std::unordered_map<int64_t, Node*> by_key;
+    std::vector<void*> arenas;                   // bulk-copy node storage
 
     explicit SeqIndex(uint64_t seed) : size(0), rng(seed ? seed : 0x9e3779b97f4a7c15ULL) {
         head = node_new(-1, kMaxLevel);
@@ -66,6 +81,7 @@ struct SeqIndex {
             node_free(n);
             n = nx;
         }
+        for (void* a : arenas) std::free(a);
     }
 
     // Geometric level distribution, promotion probability 1/4 (same family
@@ -201,14 +217,49 @@ extern "C" {
 
 void* amsl_new(uint64_t seed) { return new (std::nothrow) SeqIndex(seed); }
 
+// Linear-time structural copy: preserves every node's tower level, linking
+// each level's chain in one pass with widths derived from positions. All
+// copied nodes live in one arena allocation (freed with the list), so a
+// copy is a single malloc + one sweep instead of n allocations.
 void* amsl_copy(void* h) {
     SeqIndex* src = static_cast<SeqIndex*>(h);
     SeqIndex* dst = new (std::nothrow) SeqIndex(src->rng * 6364136223846793005ULL + 1);
     if (!dst) return nullptr;
-    int64_t i = 0;
-    for (Node* n = src->head->next[0]; n; n = n->next[0], i++) {
-        dst->insert(i, n->key);
+    size_t total = 0;
+    for (Node* s = src->head->next[0]; s; s = s->next[0]) {
+        total += node_bytes(s->level);
     }
+    char* arena = nullptr;
+    if (total) {
+        arena = static_cast<char*>(std::malloc(total));
+        if (!arena) {
+            delete dst;
+            return nullptr;
+        }
+        dst->arenas.push_back(arena);
+    }
+    Node* last[kMaxLevel];
+    int64_t last_pos[kMaxLevel];
+    for (int l = 0; l < kMaxLevel; l++) {
+        last[l] = dst->head;
+        last_pos[l] = -1;
+    }
+    dst->by_key.reserve(src->by_key.size());
+    int64_t pos = 0;
+    for (Node* s = src->head->next[0]; s; s = s->next[0], pos++) {
+        Node* n = node_init(arena, s->key, s->level, 1);
+        arena += node_bytes(s->level);
+        for (int l = 0; l < s->level; l++) {
+            last[l]->next[l] = n;
+            last[l]->nwidth[l] = pos - last_pos[l];
+            n->prev[l] = last[l];
+            n->pwidth[l] = pos - last_pos[l];
+            last[l] = n;
+            last_pos[l] = pos;
+        }
+        dst->by_key[s->key] = n;
+    }
+    dst->size = src->size;
     return dst;
 }
 
