@@ -11,6 +11,10 @@ the ICI:
 * **sp (sequence)** — very long Text documents shard their node axis; the
   pointer-doubling rounds become sharded gathers (XLA inserts the
   all-gathers automatically from the sharding annotations).
+* **peer sync over ICI** — mesh replicas of one document converge by
+  collective (`ici_sync`): clock advertisement = ``pmax``, change
+  shipping = ``all_gather`` (or ``ppermute`` ring gossip), convergent
+  apply = the merge kernel on the union.
 * **DCN** — between hosts/pods the Connection wire protocol is unchanged:
   vector-clock advertisement + change shipping, with the host feeding
   device batches.
@@ -18,5 +22,9 @@ the ICI:
 
 from .mesh import make_mesh, shard_docs
 from .docset_engine import sharded_merge_step, ShardedDocSetEngine
+from .ici_sync import (make_peer_mesh, shard_peers, sync_step,
+                       ring_sync_step)
 
-__all__ = ['make_mesh', 'shard_docs', 'sharded_merge_step', 'ShardedDocSetEngine']
+__all__ = ['make_mesh', 'shard_docs', 'sharded_merge_step',
+           'ShardedDocSetEngine', 'make_peer_mesh', 'shard_peers',
+           'sync_step', 'ring_sync_step']

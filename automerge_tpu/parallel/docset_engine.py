@@ -15,7 +15,7 @@ This composes the parallelism axes of the framework:
   (:mod:`automerge_tpu.device.sequence` under sharded inputs)
 """
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 import jax
@@ -48,21 +48,27 @@ def _merge_step(seg_id, actor, seq, clock, is_del, valid, num_segments):
     return out, stats
 
 
-def sharded_merge_step(mesh, seg_id, actor, seq, clock, is_del, valid, *,
-                       num_segments):
-    """Run one batched merge step with the doc axis sharded over `mesh`.
-
-    Returns (kernel outputs with doc-sharded leading axis, replicated stats).
-    """
+@lru_cache(maxsize=64)
+def _merge_step_fn(mesh, num_segments):
     spec = P(DOC_AXIS)
-    fn = shard_map(
+    return jax.jit(shard_map(
         partial(_merge_step, num_segments=num_segments),
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=({'surviving': spec, 'winner': spec, 'seg_max_actor': spec},
                    {'ops_applied': P(), 'ops_surviving': P(), 'conflicts': P()}),
-    )
-    return jax.jit(fn)(seg_id, actor, seq, clock, is_del, valid)
+    ))
+
+
+def sharded_merge_step(mesh, seg_id, actor, seq, clock, is_del, valid, *,
+                       num_segments):
+    """Run one batched merge step with the doc axis sharded over `mesh`.
+
+    Returns (kernel outputs with doc-sharded leading axis, replicated
+    stats). The compiled step is cached per (mesh, num_segments).
+    """
+    return _merge_step_fn(mesh, num_segments)(
+        seg_id, actor, seq, clock, is_del, valid)
 
 
 class ShardedDocSetEngine:
