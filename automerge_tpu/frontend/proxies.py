@@ -93,6 +93,20 @@ class MapProxy:
     def __setattr__(self, name, value):
         self._context.set_map_key(self._obj_id, name, _unproxy(value))
 
+    def update(self, other=(), /, **kwargs):
+        """Bulk assignment (the reference's Object.assign support,
+        proxies_test.js:68-73).
+
+        Like every method name on this proxy (``get``/``keys``/...), a
+        document field literally named ``update`` must be read with item
+        access (``doc['update']``) — attribute access resolves the method.
+        """
+        items = other.items() if hasattr(other, 'items') else other
+        for k, v in items:
+            self[k] = v
+        for k, v in kwargs.items():
+            self[k] = v
+
     def __delitem__(self, key):
         self._context.delete_map_key(self._obj_id, key)
 

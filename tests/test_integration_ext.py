@@ -1,0 +1,346 @@
+"""Integration coverage, part 2: the rest of the reference's test.js suite.
+
+Ports the behaviors of test/test.js not already covered by
+test_integration.py: forking, conflict-resolving no-op writes, object
+identity/UUIDs, primitive<->object type changes, multiple references to
+one object, undo/redo interaction with remote actors, diff detail
+(indexes, object creation, paths), and incremental changes API.
+"""
+
+import pytest
+
+import automerge_tpu as A
+
+
+def equals_one_of(actual, *candidates):
+    """test/helpers.js:5-15 — the CRDT legitimately permits any of these."""
+    assert any(actual == c for c in candidates), \
+        f'{actual!r} not in {candidates!r}'
+
+
+class TestChanges:
+    def test_group_several_changes(self):
+        s1 = A.init('a1')
+        s1 = A.change(s1, lambda d: (
+            d.__setitem__('first', 'one'),
+            d.__setitem__('second', 'two')))
+        assert A.inspect(s1) == {'first': 'one', 'second': 'two'}
+        assert len(A.get_history(s1)) == 1
+
+    def test_no_conflict_on_repeated_assignment(self):
+        s1 = A.init('a1')
+        s1 = A.change(s1, lambda d: d.__setitem__('k', 'one'))
+        s1 = A.change(s1, lambda d: d.__setitem__('k', 'two'))
+        assert s1['k'] == 'two'
+        assert A.get_conflicts(s1) == {}
+
+    def test_no_conflict_writing_field_twice_in_one_change(self):
+        s1 = A.change(A.init('a1'), lambda d: (
+            d.__setitem__('k', 'one'), d.__setitem__('k', 'two')))
+        assert s1['k'] == 'two'
+        assert A.get_conflicts(s1) == {}
+
+    def test_forking_does_not_interfere(self):
+        base = A.change(A.init('base'), lambda d: d.__setitem__('x', 0))
+        f1 = A.change(A.merge(A.init('f1'), base),
+                      lambda d: d.__setitem__('x', 1))
+        f2 = A.change(A.merge(A.init('f2'), base),
+                      lambda d: d.__setitem__('y', 2))
+        assert f1['x'] == 1 and 'y' not in f1
+        assert f2['x'] == 0 and f2['y'] == 2
+        merged = A.merge(A.merge(A.init('m'), f1), f2)
+        assert merged['x'] == 1 and merged['y'] == 2
+
+    def test_non_string_message_rejected(self):
+        with pytest.raises(TypeError):
+            A.change(A.init('a1'), {'not': 'a string'},
+                     lambda d: d.__setitem__('k', 1))
+
+    def test_empty_change_references_dependencies(self):
+        s1 = A.change(A.init('actor1'), lambda d: d.__setitem__('k', 1))
+        s2 = A.merge(A.init('actor2'), s1)
+        s2 = A.empty_change(s2, 'empty')
+        history = A.get_history(s2)
+        assert history[-1].change['message'] == 'empty'
+        assert history[-1].change['deps'] == {'actor1': 1}
+
+
+class TestRootObject:
+    def test_delete_missing_key_is_noop(self):
+        # JS `delete` semantics: deleting an absent key succeeds silently
+        s1 = A.change(A.init('a1'), lambda d: d.__delitem__('nothing'))
+        assert A.inspect(s1) == {}
+
+    def test_change_type_of_property(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('prop', 123))
+        s1 = A.change(s1, lambda d: d.__setitem__('prop', '123'))
+        assert s1['prop'] == '123'
+        s1 = A.change(s1, lambda d: d.__setitem__('prop', [1, 2]))
+        assert list(s1['prop']) == [1, 2]
+        s1 = A.change(s1, lambda d: d.__setitem__('prop', {'a': 1}))
+        assert A.inspect(s1)['prop'] == {'a': 1}
+
+
+class TestNestedMaps:
+    def test_nested_maps_get_object_ids(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'pos', {'x': 1, 'y': 2}))
+        oid = A.get_object_id(s1['pos'])
+        assert oid and oid != A.ROOT_ID
+        s2 = A.change(s1, lambda d: d.pos.__setitem__('x', 9))
+        assert A.get_object_id(s2['pos']) == oid  # same object, new version
+
+    def test_replace_old_object_with_new(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'city', {'name': 'aa'}))
+        old_id = A.get_object_id(s1['city'])
+        s2 = A.change(s1, lambda d: d.__setitem__('city', {'name': 'bb'}))
+        assert A.get_object_id(s2['city']) != old_id
+        assert A.inspect(s2) == {'city': {'name': 'bb'}}
+
+    def test_field_changes_between_primitive_and_map(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('v', 42))
+        s1 = A.change(s1, lambda d: d.__setitem__('v', {'nested': True}))
+        assert A.inspect(s1) == {'v': {'nested': True}}
+        s1 = A.change(s1, lambda d: d.__setitem__('v', 44))
+        assert A.inspect(s1) == {'v': 44}
+
+    def test_several_references_to_same_map(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'position', {'x': 1}))
+        s1 = A.change(s1, lambda d: d.__setitem__('size', d.position))
+        assert A.get_object_id(s1['position']) == A.get_object_id(s1['size'])
+        s2 = A.change(s1, lambda d: d.position.__setitem__('x', 7))
+        assert s2['size']['x'] == 7  # both names see the update
+
+    def test_delete_reference_keeps_other_reference(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('a', {'v': 1}))
+        s1 = A.change(s1, lambda d: d.__setitem__('b', d.a))
+        s1 = A.change(s1, lambda d: d.__delitem__('a'))
+        assert 'a' not in s1
+        assert s1['b']['v'] == 1
+
+
+class TestLists:
+    def test_out_by_one_assignment_is_insertion(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('list', ['a']))
+        s1 = A.change(s1, lambda d: d.list.__setitem__(1, 'b'))
+        assert list(s1['list']) == ['a', 'b']
+
+    def test_out_of_range_assignment_raises(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('list', ['a']))
+        with pytest.raises((IndexError, ValueError)):
+            A.change(s1, lambda d: d.list.__setitem__(5, 'x'))
+
+    def test_nested_lists(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'matrix', [[1, 2], [3, 4]]))
+        assert A.inspect(s1) == {'matrix': [[1, 2], [3, 4]]}
+        s2 = A.change(s1, lambda d: d.matrix[1].__setitem__(0, 99))
+        assert A.inspect(s2) == {'matrix': [[1, 2], [99, 4]]}
+
+    def test_replace_entire_list(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('l', [1, 2]))
+        s2 = A.change(s1, lambda d: d.__setitem__('l', ['x']))
+        assert list(s2['l']) == ['x']
+        assert A.get_object_id(s2['l']) != A.get_object_id(s1['l'])
+
+    def test_change_type_of_list_element(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('l', [1, 2]))
+        s2 = A.change(s1, lambda d: d.l.__setitem__(0, {'m': True}))
+        assert A.inspect(s2) == {'l': [{'m': True}, 2]}
+
+    def test_arbitrary_depth_nesting(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'a', {'b': [{'c': {'d': [1]}}]}))
+        s2 = A.change(s1, lambda d: d.a['b'][0]['c']['d'].append(2))
+        assert A.inspect(s2) == {'a': {'b': [{'c': {'d': [1, 2]}}]}}
+
+    def test_several_references_to_same_list(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('a', [1]))
+        s1 = A.change(s1, lambda d: d.__setitem__('b', d.a))
+        s2 = A.change(s1, lambda d: d.a.append(2))
+        assert list(s2['b']) == [1, 2]
+
+
+class TestConcurrent:
+    def test_changes_within_conflicting_list_element(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('l', ['hello']))
+        s2 = A.merge(A.init('bbbb'), s1)
+        s1 = A.change(s1, lambda d: d.l.__setitem__(0, {'map1': True}))
+        s1 = A.change(s1, lambda d: d.l[0].__setitem__('k', 1))
+        s2 = A.change(s2, lambda d: d.l.__setitem__(0, {'map2': True}))
+        s2 = A.change(s2, lambda d: d.l[0].__setitem__('k', 2))
+        s3 = A.merge(s1, s2)
+        # bbbb > aaaa: map2 wins; the conflict preserves map1
+        assert A.inspect(s3)['l'][0] == {'map2': True, 'k': 2}
+
+    def test_insertion_regardless_of_actor_id(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('l', ['mid']))
+        s2 = A.merge(A.init('bbbb'), s1)
+        s1 = A.change(s1, lambda d: d.l.insert_at(0, 'from-a'))
+        s2 = A.change(s2, lambda d: d.l.insert_at(0, 'from-b'))
+        s3 = A.merge(s1, s2)
+        equals_one_of(list(s3['l']),
+                      ['from-a', 'from-b', 'mid'],
+                      ['from-b', 'from-a', 'mid'])
+
+
+class TestUndoRemote:
+    def test_undo_only_local_changes(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('s1', 'old'))
+        s1 = A.change(s1, lambda d: d.__setitem__('s1', 'new'))
+        s2 = A.merge(A.init('bbbb'), s1)
+        s2 = A.change(s2, lambda d: d.__setitem__('s2', 'remote'))
+        s1 = A.merge(s1, s2)
+        s1 = A.undo(s1)     # undoes s1's own last change, not s2's
+        assert A.inspect(s1) == {'s1': 'old', 's2': 'remote'}
+
+    def test_ignore_other_actors_updates_to_reverted_field(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('v', 1))
+        s1 = A.change(s1, lambda d: d.__setitem__('v', 2))
+        s2 = A.merge(A.init('bbbb'), s1)
+        s2 = A.change(s2, lambda d: d.__setitem__('v', 3))
+        s1 = A.merge(s1, s2)
+        assert s1['v'] == 3
+        s1 = A.undo(s1)     # reverts s1's assignment: v goes back to 1
+        assert s1['v'] == 1
+
+    def test_undo_object_creation_removes_link(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('k', 'v'))
+        s1 = A.change(s1, lambda d: d.__setitem__('obj', {'x': 1}))
+        s1 = A.undo(s1)
+        assert A.inspect(s1) == {'k': 'v'}
+
+    def test_undo_link_deletion_restores_object(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'fish', ['trout', 'bass']))
+        s1 = A.change(s1, lambda d: d.__delitem__('fish'))
+        assert A.inspect(s1) == {}
+        s1 = A.undo(s1)
+        assert A.inspect(s1) == {'fish': ['trout', 'bass']}
+
+    def test_undo_list_element_deletion(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'l', ['A', 'B', 'C']))
+        s1 = A.change(s1, lambda d: d.l.__delitem__(1))
+        assert list(s1['l']) == ['A', 'C']
+        s1 = A.undo(s1)
+        assert list(s1['l']) == ['A', 'B', 'C']
+
+
+class TestRedoRemote:
+    def test_wind_history_backwards_and_forwards(self):
+        s = A.init('a1')
+        for i in range(1, 4):
+            s = A.change(s, lambda d, i=i: d.__setitem__('v', i))
+        for expected in (2, 1):
+            s = A.undo(s)
+            assert s['v'] == expected
+        s = A.undo(s)
+        assert 'v' not in s
+        for expected in (1, 2, 3):
+            s = A.redo(s)
+            assert s['v'] == expected
+        # and wind back again
+        s = A.undo(s)
+        assert s['v'] == 2
+
+    def test_redo_with_concurrent_changes_to_other_fields(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('trout', 2))
+        s1 = A.change(s1, lambda d: d.__setitem__('trout', 3))
+        s1 = A.undo(s1)
+        s2 = A.merge(A.init('bbbb'), s1)
+        s2 = A.change(s2, lambda d: d.__setitem__('salmon', 1))
+        s1 = A.merge(s1, s2)
+        s1 = A.redo(s1)
+        assert A.inspect(s1) == {'trout': 3, 'salmon': 1}
+
+    def test_overwrite_other_actors_assignment_after_undo(self):
+        s1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('v', 1))
+        s1 = A.change(s1, lambda d: d.__setitem__('v', 2))
+        s1 = A.undo(s1)
+        s2 = A.merge(A.init('bbbb'), s1)
+        s2 = A.change(s2, lambda d: d.__setitem__('v', 3))
+        s1 = A.merge(s1, s2)
+        s1 = A.redo(s1)     # redo reasserts v=2 after bbbb's v=3
+        assert s1['v'] == 2
+
+
+class TestSaveLoadExtra:
+    def test_load_generates_new_actor_id(self):
+        s1 = A.init()
+        s2 = A.load(A.save(s1))
+        assert A.get_actor_id(s2) and A.get_actor_id(s2) != A.get_actor_id(s1)
+
+    def test_conflicts_reconstituted(self):
+        s1 = A.change(A.init('actor1'), lambda d: d.__setitem__('x', 3))
+        s2 = A.change(A.init('actor2'), lambda d: d.__setitem__('x', 5))
+        s1 = A.merge(s1, s2)
+        s3 = A.load(A.save(s1), 'actor3')
+        assert s3['x'] == 5
+        assert A.get_conflicts(s3) == {'x': {'actor1': 3}}
+
+
+class TestHistoryExtra:
+    def test_empty_history_for_empty_document(self):
+        assert A.get_history(A.init('a1')) == []
+
+
+class TestDiffExtra:
+    def test_list_insertions_by_index(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('birds', []))
+        s2 = A.change(s1, lambda d: d.birds.append('Robin'))
+        diffs = A.diff(s1, s2)
+        inserts = [d for d in diffs if d['action'] == 'insert']
+        assert inserts and inserts[0]['index'] == 0
+        assert inserts[0]['value'] == 'Robin'
+
+    def test_list_deletions_by_index(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'birds', ['Robin', 'Wagtail']))
+        s2 = A.change(s1, lambda d: d.birds.__delitem__(0))
+        diffs = A.diff(s1, s2)
+        removes = [d for d in diffs if d['action'] == 'remove']
+        assert removes and removes[0]['index'] == 0
+
+    def test_object_creation_information(self):
+        s1 = A.init('a1')
+        s2 = A.change(s1, lambda d: d.__setitem__('bird', {'n': 'jay'}))
+        diffs = A.diff(s1, s2)
+        creates = [d for d in diffs if d['action'] == 'create']
+        assert creates, f'no create diff in {diffs}'
+
+    def test_path_to_modified_object(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__(
+            'birds', [{'name': 'Chaffinch', 'habitat': ['woodland']}]))
+        s2 = A.change(s1, lambda d: d.birds[0]['habitat'].append('gardens'))
+        diffs = A.diff(s1, s2)
+        paths = [d.get('path') for d in diffs if d.get('path') is not None]
+        assert ['birds', 0, 'habitat'] in paths
+
+
+class TestChangesAPIExtra:
+    def test_empty_document_changes(self):
+        assert A.get_changes(A.init('a1'), A.init('a1')) == []
+
+    def test_nothing_changed(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('k', 1))
+        assert A.get_changes(s1, s1) == []
+
+    def test_apply_empty_change_list(self):
+        s1 = A.change(A.init('a1'), lambda d: d.__setitem__('k', 1))
+        s2 = A.apply_changes(s1, [])
+        assert A.inspect(s2) == A.inspect(s1)
+
+    def test_incremental_changes(self):
+        s1 = A.change(A.init('actor1'), lambda d: d.__setitem__('b', ['one']))
+        s2 = A.change(s1, lambda d: d.b.append('two'))
+        empty = A.init('actor9')
+        changes1 = A.get_changes(empty, s1)
+        changes2 = A.get_changes(s1, s2)
+        assert len(changes1) == 1 and len(changes2) == 1
+        s3 = A.apply_changes(A.init('actor3'), changes1)
+        assert list(s3['b']) == ['one']
+        s3 = A.apply_changes(s3, changes2)
+        assert list(s3['b']) == ['one', 'two']

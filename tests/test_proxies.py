@@ -1,0 +1,302 @@
+"""Proxy API surface: the Python-idiomatic port of proxies_test.js.
+
+The reference pins the full JS Array/Object behavioral surface of the
+proxies handed to change() callbacks (test/proxies_test.js, 58 cases).
+The equivalents here are the Python container protocols: item/attribute
+access, ``in``, ``len``, iteration, slicing, and the list mutation
+surface (both Python idioms and the reference's camelCase array methods).
+"""
+
+import json
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.frontend.datatypes import FrozenError
+
+
+def change(doc, cb):
+    return A.change(doc, cb)
+
+
+@pytest.fixture
+def list_doc():
+    return change(A.init('actor1'), lambda d: (
+        d.__setitem__('list', [1, 2, 3]),
+        d.__setitem__('empty', [])))
+
+
+class TestRootObject:
+    def test_fixed_object_id(self):
+        def cb(doc):
+            assert doc._object_id == ROOT_ID
+        change(A.init(), cb)
+
+    def test_knows_actor_id(self):
+        def cb(doc):
+            assert doc._change.actor_id == 'customActorId'
+        change(A.init('customActorId'), cb)
+
+    def test_keys_as_properties(self):
+        def cb(doc):
+            doc.key1 = 'value1'
+            assert doc.key1 == 'value1'
+            assert doc['key1'] == 'value1'
+        change(A.init(), cb)
+
+    def test_unknown_properties_are_none(self):
+        def cb(doc):
+            assert doc.someProperty is None
+            assert doc['someProperty'] is None
+        change(A.init(), cb)
+
+    def test_in_operator(self):
+        def cb(doc):
+            doc.key1 = 'value1'
+            assert 'key1' in doc
+            assert 'key2' not in doc
+        change(A.init(), cb)
+
+    def test_keys_method(self):
+        def cb(doc):
+            assert doc.keys() == []
+            doc.key1 = 'v1'
+            doc.key2 = 'v2'
+            assert sorted(doc.keys()) == ['key1', 'key2']
+        change(A.init(), cb)
+
+    def test_values_and_items(self):
+        def cb(doc):
+            doc.update({'a': 1, 'b': 2})
+            assert sorted(doc.items()) == [('a', 1), ('b', 2)]
+            assert sorted(doc.values()) == [1, 2]
+        change(A.init(), cb)
+
+    def test_bulk_assignment(self):
+        doc = change(A.init(), lambda d: d.update({'key1': 'v1', 'key2': 'v2'},
+                                                  key3='v3'))
+        assert A.inspect(doc) == {'key1': 'v1', 'key2': 'v2', 'key3': 'v3'}
+
+    def test_get_with_default(self):
+        def cb(doc):
+            doc.key1 = 'v'
+            assert doc.get('key1') == 'v'
+            assert doc.get('nope', 'fallback') == 'fallback'
+        change(A.init(), cb)
+
+    def test_json_round_trip(self):
+        doc = change(A.init(), lambda d: d.update(
+            {'key1': 'value1', 'nested': {'key2': 'value2'}}))
+        assert json.loads(json.dumps(A.inspect(doc))) == {
+            'key1': 'value1', 'nested': {'key2': 'value2'}}
+
+    def test_len(self):
+        def cb(doc):
+            assert len(doc) == 0
+            doc.a = 1
+            assert len(doc) == 1
+        change(A.init(), cb)
+
+    def test_delete_via_attr_and_item(self):
+        doc = change(A.init(), lambda d: d.update({'a': 1, 'b': 2}))
+        doc = change(doc, lambda d: d.__delitem__('a'))
+        assert 'a' not in doc and doc['b'] == 2
+        doc = change(doc, lambda d: d.__delattr__('b'))
+        assert A.inspect(doc) == {}
+
+
+class TestListObject:
+    def test_looks_like_a_list(self, list_doc):
+        def cb(doc):
+            lst = doc.list
+            assert lst._type == 'list'
+            assert list(lst) == [1, 2, 3]
+            assert len(lst) == 3
+            assert lst.length == 3
+            assert len(doc.empty) == 0
+        change(list_doc, cb)
+
+    def test_fetch_by_index(self, list_doc):
+        def cb(doc):
+            assert doc.list[0] == 1
+            assert doc.list[2] == 3
+            assert doc.list[-1] == 3
+            assert doc.list['1'] == 2        # string index (reference :158)
+            with pytest.raises(TypeError):
+                doc.list['someProperty']
+        change(list_doc, cb)
+
+    def test_in_operator(self, list_doc):
+        def cb(doc):
+            assert 2 in doc.list
+            assert 99 not in doc.list
+        change(list_doc, cb)
+
+    def test_iteration_and_enumerate(self, list_doc):
+        def cb(doc):
+            assert [v for v in doc.list] == [1, 2, 3]
+            assert list(enumerate(doc.list)) == [(0, 1), (1, 2), (2, 3)]
+        change(list_doc, cb)
+
+    def test_slices(self, list_doc):
+        def cb(doc):
+            assert doc.list[:] == [1, 2, 3]
+            assert doc.list[1:] == [2, 3]
+            assert doc.list[:2] == [1, 2]
+            assert doc.list[::-1] == [3, 2, 1]
+        change(list_doc, cb)
+
+    def test_json_round_trip(self, list_doc):
+        assert json.loads(json.dumps(A.inspect(list_doc))) == {
+            'list': [1, 2, 3], 'empty': []}
+
+    # -- read-only method surface (proxies_test.js:218-396) -----------------
+
+    def test_concat_equivalent(self, list_doc):
+        def cb(doc):
+            assert list(doc.list) + [4, 5] == [1, 2, 3, 4, 5]
+        change(list_doc, cb)
+
+    def test_every_some_equivalent(self, list_doc):
+        def cb(doc):
+            assert all(v > 0 for v in doc.list)
+            assert not all(v > 2 for v in doc.list)
+            assert any(v == 3 for v in doc.list)
+            assert not any(v == 9 for v in doc.list)
+        change(list_doc, cb)
+
+    def test_filter_map_equivalent(self, list_doc):
+        def cb(doc):
+            assert [v for v in doc.list if v % 2] == [1, 3]
+            assert [v * 10 for v in doc.list] == [10, 20, 30]
+        change(list_doc, cb)
+
+    def test_index_and_count(self, list_doc):
+        def cb(doc):
+            assert doc.list.index(2) == 1
+            with pytest.raises(ValueError):
+                doc.list.index(99)
+            assert doc.list.index_of(3) == 2
+            assert doc.list.index_of(99) == -1
+            assert doc.list.count(2) == 1
+        change(list_doc, cb)
+
+    def test_join_equivalent(self, list_doc):
+        def cb(doc):
+            assert ','.join(str(v) for v in doc.list) == '1,2,3'
+        change(list_doc, cb)
+
+    def test_reduce_equivalent(self, list_doc):
+        from functools import reduce
+        def cb(doc):
+            assert reduce(lambda a, b: a + b, doc.list, 0) == 6
+        change(list_doc, cb)
+
+    def test_eq_against_plain_list(self, list_doc):
+        def cb(doc):
+            assert doc.list == [1, 2, 3]
+            assert not (doc.list == [1, 2])
+        change(list_doc, cb)
+
+    # -- mutation surface (proxies_test.js:397-459) -------------------------
+
+    def test_fill(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.fill('a'))
+        assert list(doc['list']) == ['a', 'a', 'a']
+        doc = change(doc, lambda d: d.list.fill('c', 1, 3))
+        assert list(doc['list']) == ['a', 'c', 'c']
+
+    def test_pop(self, list_doc):
+        def cb(doc):
+            assert doc.list.pop() == 3
+            assert doc.list.pop(0) == 1
+            assert list(doc.list) == [2]
+            assert doc.empty.pop() is None
+        doc = change(list_doc, cb)
+        assert list(doc['list']) == [2]
+
+    def test_push(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.push(4, 5))
+        assert list(doc['list']) == [1, 2, 3, 4, 5]
+
+    def test_append_extend(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.append(4))
+        doc = change(doc, lambda d: d.list.extend([5, 6]))
+        assert list(doc['list']) == [1, 2, 3, 4, 5, 6]
+
+    def test_shift_unshift(self, list_doc):
+        def cb(doc):
+            assert doc.list.shift() == 1
+            doc.list.unshift(0)
+            assert doc.empty.shift() is None
+        doc = change(list_doc, cb)
+        assert list(doc['list']) == [0, 2, 3]
+
+    def test_splice(self, list_doc):
+        def cb(doc):
+            assert doc.list.splice(1) == [2, 3]
+            doc.list.splice(0, 0, 'a', 'b')
+        doc = change(list_doc, cb)
+        assert list(doc['list']) == ['a', 'b', 1]
+
+    def test_insert_at_delete_at(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.insert_at(1, 'x'))
+        assert list(doc['list']) == [1, 'x', 2, 3]
+        doc = change(doc, lambda d: d.list.delete_at(0, 2))
+        assert list(doc['list']) == [2, 3]
+
+    def test_camel_case_aliases(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.insertAt(0, 'x'))
+        doc = change(doc, lambda d: d.list.deleteAt(0))
+        assert list(doc['list']) == [1, 2, 3]
+        def cb(d):
+            assert d.list.indexOf(2) == 1
+        change(doc, cb)
+
+    def test_remove(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.remove(2))
+        assert list(doc['list']) == [1, 3]
+
+    def test_set_by_negative_index(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.__setitem__(-1, 'z'))
+        assert list(doc['list']) == [1, 2, 'z']
+
+    def test_del_by_negative_index(self, list_doc):
+        doc = change(list_doc, lambda d: d.list.__delitem__(-2))
+        assert list(doc['list']) == [1, 3]
+
+    def test_nested_objects_created_in_list(self):
+        doc = change(A.init(), lambda d: d.__setitem__(
+            'todos', [{'title': 'one', 'done': False}]))
+        doc = change(doc, lambda d: d.todos[0].__setitem__('done', True))
+        assert doc['todos'][0]['done'] is True
+        doc = change(doc, lambda d: d.todos.append({'title': 'two'}))
+        assert doc['todos'][1]['title'] == 'two'
+
+    def test_reads_reflect_writes_in_callback(self):
+        def cb(doc):
+            doc.list = []
+            doc.list.append(1)
+            doc.list.append(2)
+            assert list(doc.list) == [1, 2]
+            assert doc.list.length == 2
+            doc.list[0] = 99
+            assert doc.list[0] == 99
+        change(A.init(), cb)
+
+
+class TestOutsideChangeCallback:
+    def test_materialized_doc_is_frozen(self, list_doc):
+        with pytest.raises(FrozenError):
+            list_doc['x'] = 1
+        with pytest.raises(FrozenError):
+            list_doc['list'][0] = 99
+        with pytest.raises((FrozenError, AttributeError)):
+            list_doc['list'].append(4)
+
+    def test_proxy_must_not_escape_callback(self):
+        escaped = []
+        doc = change(A.init(), lambda d: escaped.append(d))
+        with pytest.raises(TypeError):
+            A.change(escaped[0], lambda d: None)

@@ -1,0 +1,78 @@
+"""WatchableDoc and uuid-factory suites (watchable_doc_test.js, test_uuid.js)."""
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu.uuid import uuid
+
+
+@pytest.fixture
+def setup():
+    before = A.change(A.init('actor1'), lambda d: d.__setitem__(
+        'document', 'watch me now'))
+    after = A.change(before, lambda d: d.__setitem__(
+        'document', 'i can mash potato'))
+    changes = A.get_changes(before, after)
+    return before, after, changes
+
+
+class TestWatchableDoc:
+    def test_holds_the_document(self, setup):
+        before, _, _ = setup
+        watch = A.WatchableDoc(before)
+        assert watch.get() is before
+
+    def test_requires_a_doc(self):
+        with pytest.raises(ValueError):
+            A.WatchableDoc(None)
+
+    def test_handler_called_via_set(self, setup):
+        before, after, _ = setup
+        watch = A.WatchableDoc(before)
+        calls = []
+        watch.register_handler(calls.append)
+        watch.set(after)
+        assert calls == [after]
+        assert watch.get() is after
+
+    def test_handler_called_via_apply_changes(self, setup):
+        before, after, changes = setup
+        watch = A.WatchableDoc(before)
+        calls = []
+        watch.register_handler(calls.append)
+        watch.apply_changes(changes)
+        assert len(calls) == 1
+        assert A.inspect(watch.get()) == A.inspect(after)
+
+    def test_unregister_handler(self, setup):
+        before, _, changes = setup
+        watch = A.WatchableDoc(before)
+        calls = []
+        watch.register_handler(calls.append)
+        watch.unregister_handler(calls.append)
+        watch.apply_changes(changes)
+        assert calls == []
+
+
+class TestUuid:
+    def teardown_method(self):
+        uuid.reset()
+
+    def test_generates_unique_values(self):
+        assert uuid() != uuid()
+
+    def test_custom_factory(self):
+        counter = [0]
+        def custom():
+            counter[0] += 1
+            return f'custom-uuid-{counter[0] - 1}'
+        uuid.set_factory(custom)
+        assert uuid() == 'custom-uuid-0'
+        assert uuid() == 'custom-uuid-1'
+        uuid.reset()
+        assert 'custom' not in uuid()
+
+    def test_factory_drives_actor_ids(self):
+        uuid.set_factory(lambda: 'deterministic-actor')
+        doc = A.init()
+        assert A.get_actor_id(doc) == 'deterministic-actor'
