@@ -13,6 +13,7 @@ reference's Immutable.js state).
 """
 
 from ..common import ROOT_ID, is_object, less_or_equal
+from ..utils.metrics import metrics
 from . import op_set as OpSet
 
 
@@ -119,9 +120,21 @@ def _normalize_change(change):
 def _apply(state, changes, undoable):
     ops = state.op_set.clone()
     diffs = []
+    n_ops = 0
     for change in changes:
+        n_ops += len(change.get('ops', []))
         diffs.extend(OpSet.add_change(ops, _normalize_change(change), undoable))
     state = BackendState(ops)
+
+    m = metrics
+    m.bump('changes_applied', len(changes))
+    m.bump('ops_applied', n_ops)
+    m.bump('conflicts_detected',
+           sum(1 for d in diffs if d.get('conflicts')))
+    m.set_gauge('queue_depth', len(ops.queue))
+    if m.active:
+        m.emit('apply', changes=len(changes), ops=n_ops, diffs=len(diffs),
+               queued=len(ops.queue), undoable=undoable)
     return state, _make_patch(state, diffs)
 
 

@@ -15,6 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils.metrics import metrics
 from . import merge as merge_kernel
 from . import packing
 
@@ -152,6 +153,16 @@ def batch_merge_docs(docs_changes, return_timing=False, kernel='auto'):
     results = [unpack_resolved(p, surviving[i], winner[i])
                for i, p in enumerate(packed)]
     t3 = time.perf_counter()
+
+    real_ops = int(valid.sum())
+    metrics.bump('device_batches')
+    metrics.bump('device_ops', real_ops)
+    metrics.set_gauge('device_batch_occupancy',
+                      real_ops / max(valid.size, 1))
+    if metrics.active:
+        metrics.emit('device_batch', docs=len(packed), ops=real_ops,
+                     padded_ops=int(valid.size), pack_s=t1 - t0,
+                     device_s=t2 - t1, unpack_s=t3 - t2)
 
     if return_timing:
         return results, {'pack': t1 - t0, 'device': t2 - t1, 'unpack': t3 - t2}

@@ -15,6 +15,7 @@ replicas sharing a mesh sync by collective instead of message
 from .. import frontend as Frontend
 from .. import backend as Backend
 from ..common import less_or_equal
+from ..utils.metrics import metrics
 
 
 def clock_union(clock_map, doc_id, clock):
@@ -48,6 +49,12 @@ class Connection:
         self._our_clock = clock_union(self._our_clock, doc_id, clock)
         if changes is not None:
             msg['changes'] = changes
+        metrics.bump('sync_msgs_sent')
+        if changes is not None:
+            metrics.bump('sync_changes_sent', len(changes))
+        if metrics.active:
+            metrics.emit('sync_send', doc_id=doc_id,
+                         changes=len(changes) if changes else 0)
         self._send_msg(msg)
 
     def maybe_send_changes(self, doc_id):
@@ -79,6 +86,10 @@ class Connection:
 
     def receive_msg(self, msg):
         """(connection.js:91-108)"""
+        metrics.bump('sync_msgs_received')
+        if metrics.active:
+            metrics.emit('sync_receive', doc_id=msg.get('docId'),
+                         changes=len(msg.get('changes') or ()))
         if 'clock' in msg and msg['clock'] is not None:
             self._their_clock = clock_union(self._their_clock, msg['docId'], msg['clock'])
         if 'changes' in msg and msg['changes'] is not None:

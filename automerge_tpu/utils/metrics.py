@@ -1,0 +1,86 @@
+"""Observability: counters, a structured event stream, profiler hooks.
+
+The reference has no tracing/metrics at all (SURVEY.md §5: zero logging
+calls; its only introspection is getHistory/inspect and DocSet handler
+callbacks). This module adds the observability layer the TPU build is
+specified to carry: cheap process-wide counters (ops applied, changes
+applied, conflicts detected, queue depth, device batch occupancy), a
+structured event stream for subscribers, and a context manager bridging
+to the JAX profiler for on-device tracing.
+
+Everything is no-op-cheap when nothing subscribes: counter bumps are one
+dict add; events are only materialized if a subscriber is registered.
+"""
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    """One counter registry + event bus (a process-wide default lives at
+    module level; tests can construct private instances)."""
+
+    def __init__(self):
+        self.counters = defaultdict(int)
+        self._subscribers = []
+
+    # -- counters ----------------------------------------------------------
+
+    def bump(self, name, value=1):
+        self.counters[name] += value
+
+    def set_gauge(self, name, value):
+        self.counters[name] = value
+
+    def snapshot(self):
+        return dict(self.counters)
+
+    def reset(self):
+        self.counters.clear()
+
+    # -- event stream ------------------------------------------------------
+
+    def subscribe(self, handler):
+        """handler(event: dict) — called synchronously on every emit."""
+        if handler not in self._subscribers:
+            self._subscribers.append(handler)
+
+    def unsubscribe(self, handler):
+        self._subscribers = [h for h in self._subscribers if h != handler]
+
+    @property
+    def active(self):
+        return bool(self._subscribers)
+
+    def emit(self, event, **fields):
+        if not self._subscribers:
+            return
+        record = {'event': event, 'ts': time.time(), **fields}
+        for handler in list(self._subscribers):
+            handler(record)
+
+
+metrics = Metrics()
+
+# Module-level conveniences bound to the default registry.
+counters = metrics.snapshot
+reset = metrics.reset
+subscribe = metrics.subscribe
+unsubscribe = metrics.unsubscribe
+emit = metrics.emit
+bump = metrics.bump
+set_gauge = metrics.set_gauge
+
+
+@contextmanager
+def profile_trace(log_dir=None, name='automerge_tpu'):
+    """Bridge to the JAX profiler: wraps a block in a device trace when a
+    log_dir is given, else a cheap named annotation (visible in xprof)."""
+    import jax
+    if log_dir:
+        with jax.profiler.trace(log_dir):
+            yield
+    else:
+        with jax.profiler.TraceAnnotation(name):
+            yield

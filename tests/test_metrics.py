@@ -1,0 +1,125 @@
+"""Observability layer: counters, event stream, and hook integration."""
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as B
+from automerge_tpu.utils import metrics as M
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    M.metrics.reset()
+    yield
+    M.metrics.reset()
+
+
+class TestRegistry:
+    def test_bump_and_snapshot(self):
+        m = M.Metrics()
+        m.bump('x')
+        m.bump('x', 4)
+        m.set_gauge('g', 0.5)
+        assert m.snapshot() == {'x': 5, 'g': 0.5}
+        m.reset()
+        assert m.snapshot() == {}
+
+    def test_events_only_materialize_with_subscribers(self):
+        m = M.Metrics()
+        assert not m.active
+        m.emit('ignored', a=1)       # no subscriber: cheap no-op
+        seen = []
+        m.subscribe(seen.append)
+        m.emit('hello', a=1)
+        assert seen[0]['event'] == 'hello' and seen[0]['a'] == 1
+        assert 'ts' in seen[0]
+        m.unsubscribe(seen.append)
+        m.emit('after', a=2)
+        assert len(seen) == 1
+
+
+class TestBackendIntegration:
+    def test_apply_counts_ops_and_changes(self):
+        s = B.init('a1')
+        ch = {'actor': 'a1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'x', 'value': 1},
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'y', 'value': 2}]}
+        B.apply_changes(s, [ch])
+        snap = M.counters()
+        assert snap['changes_applied'] == 1
+        assert snap['ops_applied'] == 2
+        assert snap['queue_depth'] == 0
+
+    def test_queue_depth_gauge_reflects_buffered_changes(self):
+        s = B.init('a1')
+        ch2 = {'actor': 'a1', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'x', 'value': 1}]}
+        B.apply_changes(s, [ch2])       # missing seq 1: buffered
+        assert M.counters()['queue_depth'] == 1
+
+    def test_conflict_counter(self):
+        d1 = A.change(A.init('aaaa'), lambda d: d.__setitem__('k', 1))
+        d2 = A.change(A.init('bbbb'), lambda d: d.__setitem__('k', 2))
+        M.metrics.reset()
+        A.merge(d1, d2)
+        assert M.counters()['conflicts_detected'] >= 1
+
+    def test_apply_event_stream(self):
+        events = []
+        M.subscribe(events.append)
+        A.change(A.init('a1'), lambda d: d.__setitem__('k', 1))
+        assert any(e['event'] == 'apply' and e['changes'] == 1
+                   for e in events)
+
+
+class TestConnectionIntegration:
+    def test_sync_message_counters(self):
+        ds1, ds2 = A.DocSet(), A.DocSet()
+        queues = {}
+        c1 = A.Connection(ds1, lambda m: queues.setdefault('to2', []).append(m))
+        c2 = A.Connection(ds2, lambda m: queues.setdefault('to1', []).append(m))
+        c1.open()
+        c2.open()
+        doc = A.change(A.init('actor1'), lambda d: d.__setitem__('k', 'v'))
+        ds1.set_doc('doc1', doc)
+        # deliver until quiescent
+        for _ in range(10):
+            moved = False
+            for msg in queues.pop('to2', []):
+                c2.receive_msg(msg)
+                moved = True
+            for msg in queues.pop('to1', []):
+                c1.receive_msg(msg)
+                moved = True
+            if not moved:
+                break
+        assert A.inspect(ds2.get_doc('doc1')) == {'k': 'v'}
+        snap = M.counters()
+        assert snap['sync_msgs_sent'] >= 2
+        assert snap['sync_msgs_received'] >= 2
+        assert snap['sync_changes_sent'] >= 1
+
+
+class TestDeviceIntegration:
+    def test_device_batch_occupancy(self):
+        from automerge_tpu.device.engine import batch_merge_docs
+        changes = [{'actor': 'a1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'x', 'value': 1},
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'y', 'value': 2},
+            {'action': 'set', 'obj': A.ROOT_ID, 'key': 'x', 'value': 3}]}]
+        events = []
+        M.subscribe(events.append)
+        batch_merge_docs([changes, changes])
+        snap = M.counters()
+        assert snap['device_batches'] == 1
+        assert snap['device_ops'] == 6
+        assert 0 < snap['device_batch_occupancy'] <= 1
+        batch_events = [e for e in events if e['event'] == 'device_batch']
+        assert batch_events and batch_events[0]['docs'] == 2
+
+
+class TestProfilerBridge:
+    def test_trace_annotation_runs(self):
+        import jax.numpy as jnp
+        with M.profile_trace(name='test-block'):
+            jnp.zeros(4).sum()
