@@ -25,6 +25,12 @@ class BackendState:
     def __init__(self, op_set):
         self.op_set = op_set
 
+    @property
+    def clock(self):
+        """Uniform clock accessor shared with the device backend state, so
+        protocol layers (Connection) need no per-backend special cases."""
+        return self.op_set.clock
+
 
 class MaterializationContext:
     """Builds the diff list that instantiates a whole document tree

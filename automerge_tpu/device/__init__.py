@@ -21,6 +21,8 @@ XLA fusion rather than per-element control flow):
   kernel (one-hot MXU clock gather + VPU masked maxes, VMEM-resident)
 * :mod:`.packing`  — host-side interning and struct-of-arrays packing
 * :mod:`.engine`   — the batched document-store engine driving the kernels
+* :mod:`.backend`  — the batched device backend speaking the change/patch
+  protocol (wire changes in, reference-format patches out)
 
 Batching model: one program, N documents — ``vmap`` over the leading doc
 axis; sharding over a device mesh is layered on top in

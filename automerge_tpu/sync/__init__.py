@@ -8,7 +8,8 @@ TPU path for whole-DocSet merges lives in
 """
 
 from .doc_set import DocSet
+from .device_doc_set import DeviceDocSet
 from .watchable_doc import WatchableDoc
 from .connection import Connection
 
-__all__ = ['DocSet', 'WatchableDoc', 'Connection']
+__all__ = ['DocSet', 'DeviceDocSet', 'WatchableDoc', 'Connection']

@@ -18,6 +18,12 @@ from ..common import less_or_equal
 from ..utils.metrics import metrics
 
 
+def _backend_of(doc):
+    """The backend module a document was initialized with (oracle or
+    device — both expose the same change/patch protocol surface)."""
+    return doc._options.get('backend') or Backend
+
+
 def clock_union(clock_map, doc_id, clock):
     """Merge `clock` into `clock_map[doc_id]`, taking per-actor maxima
     (connection.js:9-12)."""
@@ -61,10 +67,11 @@ class Connection:
         """(connection.js:58-73)"""
         doc = self._doc_set.get_doc(doc_id)
         state = Frontend.get_backend_state(doc)
-        clock = state.op_set.clock
+        clock = state.clock
 
         if doc_id in self._their_clock:
-            changes = Backend.get_missing_changes(state, self._their_clock[doc_id])
+            changes = _backend_of(doc).get_missing_changes(
+                state, self._their_clock[doc_id])
             if changes:
                 self._their_clock = clock_union(self._their_clock, doc_id, clock)
                 self.send_msg(doc_id, clock, changes)
@@ -79,7 +86,7 @@ class Connection:
         if state is None:
             raise TypeError('This object cannot be used for network sync. '
                             'Are you trying to sync a snapshot from the history?')
-        clock = state.op_set.clock
+        clock = state.clock
         if not less_or_equal(self._our_clock.get(doc_id, {}), clock):
             raise ValueError('Cannot pass an old state object to a connection')
         self.maybe_send_changes(doc_id)

@@ -1,0 +1,113 @@
+"""DeviceDocSet: a DocSet whose apply-changes path runs on the TPU.
+
+The reference's DocSet applies changes one document at a time through the
+host backend (`src/doc_set.js:25-33`). A :class:`DeviceDocSet` keeps the
+same public surface (get_doc/set_doc/apply_changes/handlers — Connection
+works unchanged) and adds :meth:`apply_changes_batch`, which routes the
+wire changes of MANY documents through the batched device backend
+(:mod:`automerge_tpu.device.backend`) in one device call.
+
+Routing. Map-only documents (set/del/link/makeMap ops) live on the device
+path. A document whose incoming changes contain sequence ops
+(ins/makeList/makeText) is transparently migrated to the host oracle by
+replaying its change log — the change/patch protocol makes the two
+backends interchangeable, so callers never see the difference.
+"""
+
+from .. import frontend as Frontend
+from .. import backend as Backend
+from ..device import backend as DeviceBackend
+from .doc_set import DocSet
+
+_MAP_ACTIONS = frozenset(('set', 'del', 'link', 'makeMap'))
+
+
+def _map_only(changes):
+    return all(op['action'] in _MAP_ACTIONS
+               for change in changes for op in change.get('ops', ()))
+
+
+class DeviceDocSet(DocSet):
+    def __init__(self, kernel='auto'):
+        super().__init__()
+        self.kernel = kernel
+        self._oracle_docs = set()   # doc_ids migrated to the host backend
+
+    # -- routing -----------------------------------------------------------
+
+    def _device_state(self, doc_id):
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            return DeviceBackend.init()
+        return Frontend.get_backend_state(doc)
+
+    def _migrate_to_oracle(self, doc_id):
+        """Replay the device change log through the host oracle; the wire
+        protocol guarantees the rebuilt document is identical."""
+        doc = self.docs.get(doc_id)
+        state = Backend.init()
+        changes = []
+        if doc is not None:
+            dev_state = Frontend.get_backend_state(doc)
+            changes = dev_state.get_history() + list(dev_state.queue)
+        new_doc = Frontend.init({'backend': Backend})
+        if changes:
+            state, patch = Backend.apply_changes(state, changes)
+            patch['state'] = state
+            new_doc = Frontend.apply_patch(new_doc, patch)
+        self._oracle_docs.add(doc_id)
+        self.docs = dict(self.docs)
+        self.docs[doc_id] = new_doc
+        return new_doc
+
+    # -- public surface ----------------------------------------------------
+
+    def apply_changes(self, doc_id, changes):
+        return self.apply_changes_batch({doc_id: changes})[doc_id]
+
+    applyChanges = apply_changes
+
+    def apply_changes_batch(self, changes_by_doc):
+        """Apply `{doc_id: [change, ...]}` across documents; every
+        device-routed document resolves in ONE device call. Returns
+        `{doc_id: new_doc}` and fires handlers per document."""
+        device_ids, device_states, device_changes = [], [], []
+        oracle_ids = []
+        for doc_id, changes in changes_by_doc.items():
+            doc = self.docs.get(doc_id)
+            state = Frontend.get_backend_state(doc) if doc is not None else None
+            on_device = state is None or isinstance(
+                state, DeviceBackend.DeviceBackendState)
+            if doc_id in self._oracle_docs or not on_device:
+                # host-backed doc (e.g. added via set_doc) stays on the oracle
+                self._oracle_docs.add(doc_id)
+                oracle_ids.append(doc_id)
+            elif not _map_only(changes):
+                if doc is not None:
+                    self._migrate_to_oracle(doc_id)
+                else:
+                    self._oracle_docs.add(doc_id)
+                oracle_ids.append(doc_id)
+            else:
+                device_ids.append(doc_id)
+                device_states.append(self._device_state(doc_id))
+                device_changes.append(changes)
+
+        out = {}
+        if device_ids:
+            new_states, patches = DeviceBackend.apply_changes_batch(
+                device_states, device_changes, kernel=self.kernel)
+            for doc_id, state, patch in zip(device_ids, new_states, patches):
+                doc = self.docs.get(doc_id)
+                if doc is None:
+                    doc = Frontend.init({'backend': DeviceBackend})
+                patch['state'] = state
+                doc = Frontend.apply_patch(doc, patch)
+                self.set_doc(doc_id, doc)
+                out[doc_id] = doc
+
+        for doc_id in oracle_ids:
+            out[doc_id] = super().apply_changes(doc_id, changes_by_doc[doc_id])
+        return out
+
+    applyChangesBatch = apply_changes_batch

@@ -1,0 +1,311 @@
+"""Differential tests: device backend vs host oracle behind the same
+change/patch protocol.
+
+The acceptance criterion from the build plan: for a batch of documents,
+device-path patches applied through Frontend.apply_patch must produce
+documents identical to the oracle path (same materialized JSON, same
+conflicts), for map documents including nested maps, links, deletes and
+concurrent-assignment conflicts.
+"""
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.sync import DeviceDocSet, DocSet, Connection
+
+
+def _doc_via_oracle(changes):
+    state = Backend.init()
+    doc = Frontend.init({'backend': Backend})
+    state, patch = Backend.apply_changes(state, changes)
+    patch['state'] = state
+    return Frontend.apply_patch(doc, patch), state
+
+
+def _doc_via_device(changes):
+    state = DeviceBackend.init()
+    doc = Frontend.init({'backend': DeviceBackend})
+    state, patch = DeviceBackend.apply_changes(state, changes)
+    patch['state'] = state
+    return Frontend.apply_patch(doc, patch), state
+
+
+def _materialize(doc):
+    """Plain nested dict of a map document, with conflicts."""
+    def conv(obj):
+        if hasattr(obj, '_conflicts'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+def _changes_from_edits(*edit_fns, actor_ids=None):
+    """Run each edit through a real frontend so the wire changes have the
+    exact shape the frontend emits; concurrent actors share no deps."""
+    changes = []
+    for i, fn in enumerate(edit_fns):
+        actor = (actor_ids[i] if actor_ids else f'actor-{i:02d}')
+        doc = Frontend.init({'backend': Backend})
+        doc = Frontend.set_actor_id(doc, actor)
+        doc, _req = Frontend.change(doc, fn)
+        changes.extend(Backend.get_changes_for_actor(
+            Frontend.get_backend_state(doc), actor))
+    return changes
+
+
+def assert_equivalent(changes):
+    oracle_doc, _ = _doc_via_oracle(changes)
+    device_doc, dev_state = _doc_via_device(changes)
+    assert _materialize(device_doc) == _materialize(oracle_doc)
+    assert device_doc._conflicts == oracle_doc._conflicts
+    return device_doc, dev_state
+
+
+class TestMapDifferential:
+    def test_single_actor_flat_map(self):
+        changes = _changes_from_edits(
+            lambda d: d.update({'title': 'hello', 'count': 3}))
+        assert_equivalent(changes)
+
+    def test_concurrent_conflict_highest_actor_wins(self):
+        changes = _changes_from_edits(
+            lambda d: d.__setitem__('x', 'low'),
+            lambda d: d.__setitem__('x', 'high'))
+        doc, _ = assert_equivalent(changes)
+        assert doc['x'] == 'high'
+        assert doc._conflicts['x'] == {'actor-00': 'low'}
+
+    def test_three_way_conflict_ordering(self):
+        changes = _changes_from_edits(
+            lambda d: d.__setitem__('k', 1),
+            lambda d: d.__setitem__('k', 2),
+            lambda d: d.__setitem__('k', 3))
+        doc, _ = assert_equivalent(changes)
+        assert doc['k'] == 3
+        assert doc._conflicts['k'] == {'actor-00': 1, 'actor-01': 2}
+
+    def test_delete_key(self):
+        a = Frontend.init({'backend': Backend})
+        a = Frontend.set_actor_id(a, 'aa')
+        a, _ = Frontend.change(a, lambda d: d.update({'k': 1, 'keep': 2}))
+        a, _ = Frontend.change(a, lambda d: d.__delitem__('k'))
+        changes = Backend.get_changes_for_actor(Frontend.get_backend_state(a), 'aa')
+        doc, _ = assert_equivalent(changes)
+        assert 'k' not in doc and doc['keep'] == 2
+
+    def test_concurrent_set_vs_delete(self):
+        base = _changes_from_edits(lambda d: d.__setitem__('x', 'orig'),
+                                   actor_ids=['base'])
+        # two peers fork from base: one deletes, one overwrites
+        def fork(edit, actor):
+            doc = Frontend.init({'backend': Backend})
+            doc = Frontend.set_actor_id(doc, actor)
+            state, patch = Backend.apply_changes(
+                Frontend.get_backend_state(doc), base)
+            patch['state'] = state
+            doc = Frontend.apply_patch(doc, patch)
+            doc, _ = Frontend.change(doc, edit)
+            return Backend.get_changes_for_actor(
+                Frontend.get_backend_state(doc), actor)
+        changes = base + fork(lambda d: d.__delitem__('x'), 'deleter') \
+                       + fork(lambda d: d.__setitem__('x', 'new'), 'writer')
+        doc, _ = assert_equivalent(changes)
+        assert doc['x'] == 'new'   # concurrent set survives a delete
+
+    def test_nested_maps_and_links(self):
+        changes = _changes_from_edits(
+            lambda d: d.__setitem__('config', {'theme': {'color': 'red'},
+                                              'depth': 2}))
+        doc, _ = assert_equivalent(changes)
+        assert doc['config']['theme']['color'] == 'red'
+
+    def test_causal_chain_across_actors(self):
+        # actor B's change depends on actor A's; delivery order shuffled
+        a = Frontend.init({'backend': Backend})
+        a = Frontend.set_actor_id(a, 'aa')
+        a, _ = Frontend.change(a, lambda d: d.__setitem__('x', 1))
+        b = Frontend.init({'backend': Backend})
+        b = Frontend.set_actor_id(b, 'bb')
+        sa = Frontend.get_backend_state(a)
+        sb, patch = Backend.apply_changes(Frontend.get_backend_state(b),
+                                          Backend.get_missing_changes(sa, {}))
+        patch['state'] = sb
+        b = Frontend.apply_patch(b, patch)
+        b, _ = Frontend.change(b, lambda d: d.__setitem__('x', 2))
+        changes = Backend.get_missing_changes(Frontend.get_backend_state(b), {})
+        assert len(changes) == 2
+        # causal (b depends on a): deliver in both orders
+        for order in (changes, changes[::-1]):
+            doc, _ = assert_equivalent(order)
+            assert doc['x'] == 2          # causally later, not a conflict
+            assert 'x' not in doc._conflicts
+
+    def test_incremental_applies_match_single_shot(self):
+        changes = _changes_from_edits(
+            lambda d: d.update({'a': 1, 'b': 2}),
+            lambda d: d.update({'b': 3, 'c': 4}))
+        one_doc, one_state = _doc_via_device(changes)
+
+        state = DeviceBackend.init()
+        doc = Frontend.init({'backend': DeviceBackend})
+        for ch in changes:
+            state, patch = DeviceBackend.apply_changes(state, [ch])
+            patch['state'] = state
+            doc = Frontend.apply_patch(doc, patch)
+        assert _materialize(doc) == _materialize(one_doc)
+        assert doc._conflicts == one_doc._conflicts
+
+    def test_duplicate_delivery_idempotent(self):
+        changes = _changes_from_edits(lambda d: d.__setitem__('x', 1))
+        state = DeviceBackend.init()
+        state, p1 = DeviceBackend.apply_changes(state, changes)
+        state, p2 = DeviceBackend.apply_changes(state, changes)
+        assert p2['diffs'] == []
+
+    def test_out_of_order_buffering_and_missing_deps(self):
+        a = Frontend.init({'backend': Backend})
+        a = Frontend.set_actor_id(a, 'aa')
+        a, _ = Frontend.change(a, lambda d: d.__setitem__('x', 1))
+        a, _ = Frontend.change(a, lambda d: d.__setitem__('y', 2))
+        c1, c2 = Backend.get_changes_for_actor(
+            Frontend.get_backend_state(a), 'aa')
+
+        state = DeviceBackend.init()
+        state, patch = DeviceBackend.apply_changes(state, [c2])
+        assert patch['diffs'] == []            # buffered, not applied
+        assert DeviceBackend.get_missing_deps(state) == {'aa': 1}
+        state, patch = DeviceBackend.apply_changes(state, [c1])
+        keys = {d.get('key') for d in patch['diffs']}
+        assert keys == {'x', 'y'}              # both apply once ready
+        assert DeviceBackend.get_missing_deps(state) == {}
+
+    def test_get_patch_matches_oracle_materialization(self):
+        changes = _changes_from_edits(
+            lambda d: d.update({'a': {'deep': {'er': 1}}, 'b': 2}),
+            lambda d: d.__setitem__('b', 9))
+        _, oracle_state = _doc_via_oracle(changes)
+        _, dev_state = _doc_via_device(changes)
+        oracle_doc = Frontend.apply_patch(
+            Frontend.init('viewer-1'), Backend.get_patch(oracle_state))
+        device_doc = Frontend.apply_patch(
+            Frontend.init('viewer-1'), DeviceBackend.get_patch(dev_state))
+        assert _materialize(device_doc) == _materialize(oracle_doc)
+
+    def test_random_concurrent_workload(self):
+        rng = np.random.default_rng(7)
+        keys = ['k%d' % i for i in range(6)]
+        edits = []
+        for i in range(8):
+            picks = rng.choice(len(keys), size=3, replace=False)
+            vals = rng.integers(0, 100, size=3)
+            def edit(d, picks=picks, vals=vals):
+                for p, v in zip(picks, vals):
+                    d[keys[p]] = int(v)
+            edits.append(edit)
+        changes = _changes_from_edits(*edits)
+        rng.shuffle(changes)
+        assert_equivalent(changes)
+
+
+class TestDeviceLocalChange:
+    def test_frontend_change_on_device_backend(self):
+        doc = Frontend.init({'backend': DeviceBackend})
+        doc = Frontend.set_actor_id(doc, 'local-1')
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('msg', 'hi'))
+        assert doc['msg'] == 'hi'
+        state = Frontend.get_backend_state(doc)
+        assert state.clock == {'local-1': 1}
+
+    def test_undo_rejected(self):
+        state = DeviceBackend.init()
+        with pytest.raises(NotImplementedError):
+            DeviceBackend.apply_local_change(
+                state, {'requestType': 'undo', 'actor': 'a', 'seq': 1,
+                        'deps': {}})
+
+
+class TestDeviceDocSet:
+    def _make_changes(self, n_docs, n_actors=3):
+        per_doc = []
+        for d in range(n_docs):
+            edits = [
+                (lambda d_, i=i, d2=d: d_.__setitem__('f%d' % (i % 4),
+                                                      'v%d-%d' % (d2, i)))
+                for i in range(n_actors)]
+            per_doc.append(_changes_from_edits(*edits))
+        return per_doc
+
+    def test_batch_matches_oracle_docset(self):
+        per_doc = self._make_changes(6)
+        dds = DeviceDocSet()
+        dds.apply_changes_batch(
+            {'doc%d' % i: chs for i, chs in enumerate(per_doc)})
+        ods = DocSet()
+        for i, chs in enumerate(per_doc):
+            ods.apply_changes('doc%d' % i, chs)
+        for i in range(len(per_doc)):
+            ddoc, odoc = dds.get_doc('doc%d' % i), ods.get_doc('doc%d' % i)
+            assert _materialize(ddoc) == _materialize(odoc)
+            assert ddoc._conflicts == odoc._conflicts
+
+    def test_handlers_fire(self):
+        seen = []
+        dds = DeviceDocSet()
+        dds.register_handler(lambda doc_id, doc: seen.append(doc_id))
+        dds.apply_changes('d1', _changes_from_edits(
+            lambda d: d.__setitem__('x', 1)))
+        assert seen == ['d1']
+
+    def test_sequence_doc_migrates_to_oracle(self):
+        list_changes = _changes_from_edits(
+            lambda d: d.__setitem__('items', ['a', 'b']))
+        dds = DeviceDocSet()
+        # first a map change lands on device...
+        dds.apply_changes('d1', _changes_from_edits(
+            lambda d: d.__setitem__('x', 1), actor_ids=['map-actor']))
+        # ...then a list change migrates the doc to the oracle
+        dds.apply_changes('d1', list_changes)
+        doc = dds.get_doc('d1')
+        assert doc['x'] == 1
+        assert list(doc['items']) == ['a', 'b']
+
+    def test_host_backed_doc_added_via_set_doc_stays_on_oracle(self):
+        """A doc created with the host backend and added via set_doc must
+        route to the oracle, not crash the device path."""
+        doc = am.change(am.init('host-actor'),
+                        lambda d: d.__setitem__('x', 1))
+        dds = DeviceDocSet()
+        dds.set_doc('d1', doc)
+        more = _changes_from_edits(lambda d: d.__setitem__('y', 2),
+                                   actor_ids=['other'])
+        dds.apply_changes('d1', more)
+        out = dds.get_doc('d1')
+        assert out['x'] == 1 and out['y'] == 2
+
+    def test_connection_sync_device_to_oracle(self):
+        """A DeviceDocSet and a plain DocSet converge over Connection."""
+        dds, ods = DeviceDocSet(), DocSet()
+        msgs_a, msgs_b = [], []
+        conn_a = Connection(dds, msgs_a.append)
+        conn_b = Connection(ods, msgs_b.append)
+
+        changes = _changes_from_edits(lambda d: d.__setitem__('shared', 42))
+        dds.apply_changes('doc', changes)
+        conn_a.open()
+        conn_b.open()
+        # pump messages until quiescent
+        for _ in range(10):
+            if not msgs_a and not msgs_b:
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                conn_b.receive_msg(m)
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                conn_a.receive_msg(m)
+        odoc = ods.get_doc('doc')
+        assert odoc is not None and odoc['shared'] == 42
