@@ -96,7 +96,49 @@ class ObjectRecord:
         return self.init_action in self.SEQUENCE_ACTIONS
 
 
-class OpSet:
+class SharedChangeLog:
+    """Append-only shared change log with per-snapshot visible lengths.
+
+    Mixed into both the host :class:`OpSet` and the device backend state
+    (:class:`automerge_tpu.device.backend.DeviceBackendState`): the host
+    class must define ``states``, ``state_lens``, ``history`` and
+    ``history_len``. Old snapshots stay valid after a successor appends —
+    a snapshot sees only its recorded visible length, and a divergent
+    sibling branches a private copy of the log.
+    """
+
+    __slots__ = ()
+
+    def actor_states(self, actor):
+        return self.states.get(actor, []), self.state_lens.get(actor, 0)
+
+    def actor_state(self, actor, index):
+        lst, n = self.actor_states(actor)
+        if index < 0 or index >= n:
+            return None
+        return lst[index]
+
+    def _append_state(self, actor, entry):
+        lst, n = self.actor_states(actor)
+        if len(lst) != n:
+            # A sibling snapshot extended this log differently; branch a copy.
+            lst = lst[:n]
+        if actor not in self.states or lst is not self.states[actor]:
+            self.states[actor] = lst
+        lst.append(entry)
+        self.state_lens[actor] = n + 1
+
+    def _append_history(self, change):
+        if len(self.history) != self.history_len:
+            self.history = self.history[:self.history_len]
+        self.history.append(change)
+        self.history_len += 1
+
+    def get_history(self):
+        return self.history[:self.history_len]
+
+
+class OpSet(SharedChangeLog):
     """One snapshot of the CRDT engine state (reference op_set.js:298-310)."""
 
     __slots__ = ('states', 'state_lens', 'history', 'history_len',
@@ -144,36 +186,6 @@ class OpSet:
             self.by_object[object_id] = self.by_object[object_id].clone()
             self._owned.add(object_id)
         return self.by_object[object_id]
-
-    # -- state-log access (append-only sharing) -----------------------------
-
-    def actor_states(self, actor):
-        return self.states.get(actor, []), self.state_lens.get(actor, 0)
-
-    def actor_state(self, actor, index):
-        lst, n = self.actor_states(actor)
-        if index < 0 or index >= n:
-            return None
-        return lst[index]
-
-    def _append_state(self, actor, entry):
-        lst, n = self.actor_states(actor)
-        if len(lst) != n:
-            # A sibling snapshot extended this log differently; branch a copy.
-            lst = lst[:n]
-        if actor not in self.states or lst is not self.states[actor]:
-            self.states[actor] = lst
-        lst.append(entry)
-        self.state_lens[actor] = n + 1
-
-    def _append_history(self, change):
-        if len(self.history) != self.history_len:
-            self.history = self.history[:self.history_len]
-        self.history.append(change)
-        self.history_len += 1
-
-    def get_history(self):
-        return self.history[:self.history_len]
 
 
 # -- causality helpers ------------------------------------------------------

@@ -29,17 +29,19 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..common import ROOT_ID
+from ..backend.op_set import SharedChangeLog, causally_ready, transitive_deps
 from ..utils.metrics import metrics
 from . import engine as _engine
 from .packing import _next_pow2
 
 
-class DeviceBackendState:
+class DeviceBackendState(SharedChangeLog):
     """Persistent snapshot of one document's device-resident CRDT state.
 
     Mirrors what the oracle keeps in an OpSet (op_set.js:298-310), but with
     field state stored as packable entry tuples instead of op dicts inside
-    an object tree.
+    an object tree. The change-log surface (actor_states/get_history/...)
+    is shared with the oracle via :class:`SharedChangeLog`.
     """
 
     __slots__ = ('objects', 'fields', 'states', 'state_lens', 'clock',
@@ -73,58 +75,16 @@ class DeviceBackendState:
         new.history_len = self.history_len
         return new
 
-    # -- change-log access (append-only sharing, as in the oracle) ---------
-
-    def actor_states(self, actor):
-        return self.states.get(actor, []), self.state_lens.get(actor, 0)
-
-    def actor_state(self, actor, index):
-        lst, n = self.actor_states(actor)
-        return lst[index] if 0 <= index < n else None
-
-    def _append_state(self, actor, entry):
-        lst, n = self.actor_states(actor)
-        if len(lst) != n:
-            lst = lst[:n]
-        if actor not in self.states or lst is not self.states[actor]:
-            self.states[actor] = lst
-        lst.append(entry)
-        self.state_lens[actor] = n + 1
-
-    def _append_history(self, change):
-        if len(self.history) != self.history_len:
-            self.history = self.history[:self.history_len]
-        self.history.append(change)
-        self.history_len += 1
-
-    def get_history(self):
-        return self.history[:self.history_len]
-
 
 def init():
     return DeviceBackendState()
 
 
 # -- host phase 1: causal ordering (op_set.js:267-283) -----------------------
-
-def _causally_ready(state, change):
-    deps = dict(change['deps'])
-    deps[change['actor']] = change['seq'] - 1
-    return all(state.clock.get(a, 0) >= s for a, s in deps.items())
-
-
-def _transitive_deps(state, base_deps):
-    """Transitive closure over the applied-change log (op_set.js:29-37)."""
-    deps = {}
-    for dep_actor, dep_seq in base_deps.items():
-        if dep_seq <= 0:
-            continue
-        entry = state.actor_state(dep_actor, dep_seq - 1)
-        for a, s in (entry['all_deps'] if entry else {}).items():
-            deps[a] = max(deps.get(a, 0), s)
-        deps[dep_actor] = dep_seq
-    return deps
-
+# Readiness and transitive closure are the oracle's own helpers
+# (op_set.causally_ready / transitive_deps) — both backends duck-type the
+# same .clock / .actor_state surface, so causal-delivery semantics can
+# never diverge between them.
 
 def _admit_changes(state, changes):
     """Fixed-point causal delivery: returns [(change, all_deps)] of the
@@ -146,12 +106,12 @@ def _admit_changes(state, changes):
                     raise ValueError(
                         f'Inconsistent reuse of sequence number {seq} by {actor}')
                 continue
-            if not _causally_ready(state, change):
+            if not causally_ready(state, change):
                 remaining.append(change)
                 continue
             base_deps = dict(change['deps'])
             base_deps[actor] = seq - 1
-            all_deps = _transitive_deps(state, base_deps)
+            all_deps = transitive_deps(state, base_deps)
             state._append_state(actor, {'change': change, 'all_deps': all_deps})
             state.clock[actor] = seq
             new_deps = {a: s for a, s in state.deps.items()
@@ -172,14 +132,13 @@ def _admit_changes(state, changes):
 class _DocWork:
     """Per-document staging between the host phases and the device call."""
 
-    __slots__ = ('state', 'create_diffs', 'touched', 'rows', 'errors')
+    __slots__ = ('state', 'create_diffs', 'touched', 'rows')
 
     def __init__(self, state):
         self.state = state
         self.create_diffs = []
         self.touched = []      # (obj, key) in first-touch order
         self.rows = []         # (field, entry_dict, is_del, is_new)
-        self.errors = []
 
 
 def _stage_changes(work, admitted):
@@ -187,7 +146,6 @@ def _stage_changes(work, admitted):
     touched_set = set()
     for change, all_deps in admitted:
         actor, seq = change['actor'], change['seq']
-        new_objects = set()
         for op in change['ops']:
             action = op['action']
             if action == 'makeMap':
@@ -195,7 +153,6 @@ def _stage_changes(work, admitted):
                 if obj in state.objects:
                     raise ValueError('Duplicate creation of object ' + obj)
                 state.objects[obj] = {'type': 'makeMap', 'inbound': []}
-                new_objects.add(obj)
                 work.create_diffs.append(
                     {'action': 'create', 'obj': obj, 'type': 'map'})
             elif action in ('makeList', 'makeText', 'ins'):
@@ -237,7 +194,6 @@ def _pack_docs(works, kernel='auto'):
     is_del = np.zeros((d, n), bool)
     valid = np.zeros((d, n), bool)
 
-    doc_meta = []
     n_actors = 1
     clocks = []
     max_segs = 1
@@ -259,7 +215,6 @@ def _pack_docs(works, kernel='auto'):
             is_del[i, j] = del_flag
             valid[i, j] = True
         clocks.append(crows)
-        doc_meta.append(actor_names)
 
     # pad the actor axis to a power of two as well: all three kernel-input
     # dims stay bucketed, so the jit cache is shared across batches
@@ -273,7 +228,7 @@ def _pack_docs(works, kernel='auto'):
     out = resolve(jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
                   jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),
                   num_segments=n_segs)
-    return np.asarray(out['surviving']), np.asarray(out['winner']), doc_meta
+    return np.asarray(out['surviving'])
 
 
 def _get_path(state, object_id):
@@ -371,7 +326,7 @@ def apply_changes_batch(states, changes_per_doc, kernel='auto'):
 
     total_rows = sum(len(w.rows) for w in works)
     if total_rows:
-        surviving, _winner, _meta = _pack_docs(works, kernel=kernel)
+        surviving = _pack_docs(works, kernel=kernel)
     else:
         surviving = np.zeros((len(works), 1), bool)
 
@@ -418,6 +373,11 @@ def get_patch(state):
     diffs child-first, then field sets, so the frontend can resolve links."""
     diffs = []
     emitted = set()
+    # one pass over the field table, then per-object lookups are O(fields-of)
+    fields_by_obj = {}
+    for (obj, key), entries in state.fields.items():
+        if entries:
+            fields_by_obj.setdefault(obj, []).append((key, entries))
 
     def emit_object(obj_id):
         if obj_id in emitted:
@@ -427,9 +387,8 @@ def get_patch(state):
         obj_diffs = []
         if obj_id != ROOT_ID:
             obj_diffs.append({'action': 'create', 'obj': obj_id, 'type': 'map'})
-        for (obj, key), entries in state.fields.items():
-            if obj != obj_id or not entries:
-                continue
+        for key, entries in fields_by_obj.get(obj_id, ()):
+            obj = obj_id
             winner = entries[0]
             if winner['action'] == 'link':
                 emit_object(winner['value'])
@@ -451,7 +410,7 @@ def get_patch(state):
 
 def get_missing_changes(state, have_deps):
     """Changes a peer with clock `have_deps` lacks (op_set.js:327-334)."""
-    all_deps = _transitive_deps(state, dict(have_deps))
+    all_deps = transitive_deps(state, dict(have_deps))
     changes = []
     for actor in state.states:
         lst, n = state.actor_states(actor)

@@ -26,24 +26,28 @@ def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
     rng = np.random.default_rng(seed)
     seg_id = rng.integers(0, n_keys, size=(n_docs, n_ops)).astype(np.int32)
     actor = rng.integers(0, n_actors, size=(n_docs, n_ops)).astype(np.int32)
-    # seq numbers: per (doc, actor) running count in op order
+    # validity is drawn BEFORE seq/clock construction so that both count
+    # only ops that exist — clocks never reference masked-out (phantom) ops
+    valid = rng.random((n_docs, n_ops)) >= invalid_p
+    # seq numbers: per (doc, actor) running count of VALID ops in op order
     seq = np.ones((n_docs, n_ops), dtype=np.int32)
     for a in range(n_actors):
         mask = actor == a
-        running = np.cumsum(mask, axis=1)
+        running = np.cumsum(mask & valid, axis=1)
         seq[mask] = running[mask]
+    seq = np.maximum(seq, 1)  # invalid ops before an actor's first valid op
     clock = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
     d_idx, o_idx = np.indices((n_docs, n_ops))
     if cross_clock:
         # Causally valid cross-actor coverage via knowledge frontiers: op i
-        # (column o) covers every op in columns < f_i, with f_i drawn in
-        # [f_prev_own, o] (monotone per actor). Monotonicity makes the
+        # (column o) covers every valid op in columns < f_i, with f_i drawn
+        # in [f_prev_own, o] (monotone per actor). Monotonicity makes the
         # clocks transitively closed — if i covers j then f_i > o_j >= f_j,
-        # so i covers everything j covers — and counts are capped by each
-        # actor's real op tally, so no phantom dependencies exist.
+        # so i covers everything j covers — and counts tally only valid
+        # ops, so no phantom dependencies exist even with invalid_p > 0.
         onehot = np.zeros((n_docs, n_ops, n_actors), dtype=np.int32)
-        onehot[d_idx, o_idx, actor] = 1
-        # counts[d, o, b] = number of b-ops in columns < o
+        onehot[d_idx, o_idx, actor] = valid.astype(np.int32)
+        # counts[d, o, b] = number of valid b-ops in columns < o
         counts = np.zeros((n_docs, n_ops + 1, n_actors), dtype=np.int32)
         counts[:, 1:] = np.cumsum(onehot, axis=1)
         f_prev = np.zeros((n_docs, n_actors), dtype=np.int64)
@@ -56,5 +60,4 @@ def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
             clock[:, o, :] = counts[docs, f, :]
     clock[d_idx, o_idx, actor] = seq - 1
     is_del = rng.random((n_docs, n_ops)) < del_p
-    valid = rng.random((n_docs, n_ops)) >= invalid_p
     return seg_id, actor, seq, clock, is_del, valid

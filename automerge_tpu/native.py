@@ -106,6 +106,12 @@ def available():
 
 # Process-wide elemId interner. elemIds ("actor:counter" strings) are
 # append-only over a process lifetime; the table is shared by all indexes.
+#
+# Growth contract: one entry per distinct elemId ever seen, never pruned
+# automatically — integer ids baked into live C++ skip lists must stay
+# valid, so entries can only be dropped when no SeqIndex is alive. A
+# long-lived process churning through many documents should call
+# `reset_intern_table()` at a point where it holds no SeqIndex instances.
 _INTERN = {}
 _STRS = []
 
@@ -117,6 +123,19 @@ def _intern(key):
         _INTERN[key] = i
         _STRS.append(key)
     return i
+
+
+def intern_table_size():
+    """Number of distinct elemIds interned so far (observability hook)."""
+    return len(_STRS)
+
+
+def reset_intern_table():
+    """Drop every interned elemId. ONLY safe when no SeqIndex instances
+    are alive: live indexes hold the old integer ids and would resolve
+    them against the new table."""
+    _INTERN.clear()
+    _STRS.clear()
 
 
 _seed_counter = [0]
@@ -187,7 +206,10 @@ class SeqIndex:
             index = max(n + index, 0)
         if index > n:
             index = n
-        if self._lib.amsl_insert(self._h, index, _intern(key)) != 0:
+        rc = self._lib.amsl_insert(self._h, index, _intern(key))
+        if rc == -2:
+            raise MemoryError('seq index node allocation failed')
+        if rc != 0:
             raise ValueError(f'duplicate elemId {key!r}')
 
     def __delitem__(self, index):

@@ -46,12 +46,12 @@ def make_peer_mesh(n_peers=None, devices=None):
     """A 1-D mesh whose axis enumerates replica peers (one device each)."""
     if devices is None:
         devices = jax.devices()
-        if n_peers is not None:
-            if n_peers > len(devices):
-                raise ValueError(
-                    f'need {n_peers} devices for {n_peers} peers, '
-                    f'have {len(devices)}')
-            devices = devices[:n_peers]
+    if n_peers is not None:
+        if n_peers > len(devices):
+            raise ValueError(
+                f'need {n_peers} devices for {n_peers} peers, '
+                f'have {len(devices)}')
+        devices = devices[:n_peers]
     from jax.sharding import Mesh
     return Mesh(np.asarray(devices), (PEER_AXIS,))
 
@@ -130,6 +130,10 @@ def _ring_body(seg_id, actor, seq, clock, is_del, valid, n_peers,
     Equivalent result to the all-gather round, but per-step ICI traffic is
     1/P of the union — the ring-attention bandwidth shape.
     """
+    # One peer per device (same invariant as _sync_body): a local peer axis
+    # > 1 would gossip whole co-located blocks and produce partial unions.
+    assert seg_id.shape[0] == 1, \
+        f'{seg_id.shape[0]} peers share one device; use one device per peer'
     perm = [(i, (i + 1) % n_peers) for i in range(n_peers)]
 
     def ship(x):

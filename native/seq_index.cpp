@@ -56,7 +56,9 @@ Node* node_init(void* mem, int64_t key, int level, int pooled) {
 }
 
 Node* node_new(int64_t key, int level) {
-    return node_init(std::malloc(node_bytes(level)), key, level, 0);
+    void* mem = std::malloc(node_bytes(level));
+    if (!mem) return nullptr;   // OOM propagates as null, not a segfault
+    return node_init(mem, key, level, 0);
 }
 
 void node_free(Node* n) {
@@ -100,7 +102,7 @@ struct SeqIndex {
     }
 
     // Insert `key` so it lands at position `index` (0-based). Returns 0,
-    // or -1 on out-of-range index / duplicate key.
+    // -1 on out-of-range index / duplicate key, or -2 on allocation failure.
     int insert(int64_t index, int64_t key) {
         if (index < 0 || index > size || by_key.count(key)) return -1;
         Node* update[kMaxLevel];
@@ -117,6 +119,7 @@ struct SeqIndex {
         }
         int level = random_level();
         Node* n = node_new(key, level);
+        if (!n) return -2;
         for (int l = 0; l < level; l++) {
             Node* u = update[l];
             n->next[l] = u->next[l];
@@ -215,7 +218,11 @@ struct SeqIndex {
 
 extern "C" {
 
-void* amsl_new(uint64_t seed) { return new (std::nothrow) SeqIndex(seed); }
+void* amsl_new(uint64_t seed) {
+    SeqIndex* s = new (std::nothrow) SeqIndex(seed);
+    if (s && !s->head) { delete s; return nullptr; }  // head alloc failed
+    return s;
+}
 
 // Linear-time structural copy: preserves every node's tower level, linking
 // each level's chain in one pass with widths derived from positions. All
@@ -225,6 +232,7 @@ void* amsl_copy(void* h) {
     SeqIndex* src = static_cast<SeqIndex*>(h);
     SeqIndex* dst = new (std::nothrow) SeqIndex(src->rng * 6364136223846793005ULL + 1);
     if (!dst) return nullptr;
+    if (!dst->head) { delete dst; return nullptr; }
     size_t total = 0;
     for (Node* s = src->head->next[0]; s; s = s->next[0]) {
         total += node_bytes(s->level);
