@@ -1,0 +1,92 @@
+"""Framework configuration: one small Options dataclass.
+
+The reference keeps configuration minimal — `init(options)` takes
+`actorId`/`deferActorId`/`backend` (frontend/index.js:197-221) and that is
+the whole flag surface. This framework mirrors that restraint: everything
+device-related (mesh shape, batch padding, dtype widths, actor-table
+capacity, kernel choice) lives in ONE dataclass threaded through the
+engines, instead of scattered kwargs.
+
+Padding fields exist because XLA compiles per shape: a fixed `op_pad` /
+`actor_pad` pins the jit cache to one bucket across batches; `None` means
+"next power of two of what the batch needs" (shared cache across batches
+of similar size, no recompilation storm — SURVEY §7 "padding + bucketing").
+"""
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Options:
+    """Device/engine configuration.
+
+    Attributes:
+      kernel: field-resolution kernel — 'auto' (pallas on TPU when the
+        working set fits VMEM, xla otherwise), 'xla', or 'pallas'.
+      n_devices: mesh size for sharded engines (None = every device).
+      op_pad: fixed op-axis padding per document batch (None = next pow2).
+      seg_pad: fixed segment (field) capacity (None = next pow2).
+      actor_pad: actor-table capacity — clocks are dense [actor_pad]
+        vectors on device (None = next pow2 of the batch's actor count).
+      clock_dtype / index_dtype: device array widths. int32 everywhere by
+        default: TPU VPU lanes are 32-bit and none of the CRDT counters
+        (seq numbers, list indexes) approach 2^31.
+    """
+
+    kernel: str = 'auto'
+    n_devices: Optional[int] = None
+    op_pad: Optional[int] = None
+    seg_pad: Optional[int] = None
+    actor_pad: Optional[int] = None
+    clock_dtype: np.dtype = np.dtype(np.int32)
+    index_dtype: np.dtype = np.dtype(np.int32)
+
+    def __post_init__(self):
+        if self.kernel not in ('auto', 'xla', 'pallas'):
+            raise ValueError(f'unknown kernel {self.kernel!r}')
+        for name in ('n_devices', 'op_pad', 'seg_pad', 'actor_pad'):
+            v = getattr(self, name)
+            if v is not None and v < 1:
+                raise ValueError(f'{name} must be >= 1, got {v}')
+
+    def pad_ops(self, n):
+        """Op-axis size for a batch needing `n` rows."""
+        return self._pad(self.op_pad, n, 'op_pad')
+
+    def pad_segments(self, n):
+        return self._pad(self.seg_pad, n, 'seg_pad')
+
+    def pad_actors(self, n):
+        return self._pad(self.actor_pad, n, 'actor_pad')
+
+    @staticmethod
+    def _pad(fixed, n, name):
+        if fixed is not None:
+            if n > fixed:
+                raise ValueError(
+                    f'batch needs {n} but {name} is fixed at {fixed}')
+            return fixed
+        p = 1
+        while p < max(n, 1):
+            p <<= 1
+        return p
+
+    def make_mesh(self):
+        """Document-axis mesh of `n_devices` (parallel.mesh.make_mesh)."""
+        from .parallel.mesh import make_mesh
+        return make_mesh(n_devices=self.n_devices)
+
+    def make_peer_mesh(self):
+        """Peer-axis mesh for ICI replica sync (parallel.ici_sync)."""
+        from .parallel.ici_sync import make_peer_mesh
+        return make_peer_mesh(n_peers=self.n_devices)
+
+    def with_(self, **kw):
+        """Functional update (the dataclass is frozen)."""
+        return replace(self, **kw)
+
+
+DEFAULT = Options()

@@ -32,7 +32,6 @@ from ..common import ROOT_ID
 from ..backend.op_set import SharedChangeLog, causally_ready, transitive_deps
 from ..utils.metrics import metrics
 from . import engine as _engine
-from .packing import _next_pow2
 
 
 class DeviceBackendState(SharedChangeLog):
@@ -183,11 +182,11 @@ def _stage_changes(work, admitted):
 
 # -- device phase: pack, resolve, unpack -------------------------------------
 
-def _pack_docs(works, kernel='auto'):
+def _pack_docs(works, options):
     """Pack every staged row of every doc, run ONE device resolution."""
     d = len(works)
     max_rows = max((len(w.rows) for w in works), default=0)
-    n = _next_pow2(max(max_rows, 1))
+    n = options.pad_ops(max_rows)
     seg_id = np.zeros((d, n), np.int32)
     actor = np.zeros((d, n), np.int32)
     seq = np.zeros((d, n), np.int32)
@@ -218,13 +217,13 @@ def _pack_docs(works, kernel='auto'):
 
     # pad the actor axis to a power of two as well: all three kernel-input
     # dims stay bucketed, so the jit cache is shared across batches
-    n_actors = _next_pow2(n_actors)
+    n_actors = options.pad_actors(n_actors)
     clock = np.zeros((d, n, n_actors), np.int32)
     for i, crows in enumerate(clocks):
         clock[i, :, :crows.shape[1]] = crows
 
-    n_segs = _next_pow2(max_segs)
-    resolve = _engine.pick_resolve_kernel(kernel)
+    n_segs = options.pad_segments(max_segs)
+    resolve = _engine.pick_resolve_kernel(options.kernel)
     out = resolve(jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
                   jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),
                   num_segments=n_segs)
@@ -304,18 +303,21 @@ def _make_patch(state, diffs):
 
 # -- public surface ----------------------------------------------------------
 
-def apply_changes_batch(states, changes_per_doc, kernel='auto'):
+def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
     """Apply wire changes to a batch of documents in one device call.
 
     Args:
       states: list of :class:`DeviceBackendState`, one per document.
       changes_per_doc: list (parallel to `states`) of change lists.
+      options: :class:`~automerge_tpu.config.Options`; `kernel` overrides
+        just the kernel choice.
 
     Returns:
       (new_states, patches) — patches carry reference-format diffs. One
       diff per touched field (the compaction of the oracle's per-op diff
       stream: applying either stream to a frontend yields the same doc).
     """
+    opts = _engine.as_options(options, kernel)
     works = []
     for state, changes in zip(states, changes_per_doc):
         state = state.clone()
@@ -326,7 +328,7 @@ def apply_changes_batch(states, changes_per_doc, kernel='auto'):
 
     total_rows = sum(len(w.rows) for w in works)
     if total_rows:
-        surviving = _pack_docs(works, kernel=kernel)
+        surviving = _pack_docs(works, opts)
     else:
         surviving = np.zeros((len(works), 1), bool)
 
@@ -341,14 +343,15 @@ def apply_changes_batch(states, changes_per_doc, kernel='auto'):
     return new_states, patches
 
 
-def apply_changes(state, changes, kernel='auto'):
+def apply_changes(state, changes, kernel=None, options=None):
     """Single-document facade matching Backend.apply_changes
     (backend/index.js:161-163)."""
-    new_states, patches = apply_changes_batch([state], [changes], kernel=kernel)
+    new_states, patches = apply_changes_batch([state], [changes],
+                                              kernel=kernel, options=options)
     return new_states[0], patches[0]
 
 
-def apply_local_change(state, request, kernel='auto'):
+def apply_local_change(state, request, kernel=None, options=None):
     """Apply one local change request (backend/index.js:173-195).
 
     The device backend does not keep op-level undo history; 'undo'/'redo'
@@ -362,7 +365,8 @@ def apply_local_change(state, request, kernel='auto'):
         raise NotImplementedError(
             'device backend supports requestType "change" only')
     change = {k: v for k, v in request.items() if k != 'requestType'}
-    new_state, patch = apply_changes(state, [change], kernel=kernel)
+    new_state, patch = apply_changes(state, [change], kernel=kernel,
+                                     options=options)
     patch['actor'] = request['actor']
     patch['seq'] = request['seq']
     return new_state, patch
@@ -388,14 +392,13 @@ def get_patch(state):
         if obj_id != ROOT_ID:
             obj_diffs.append({'action': 'create', 'obj': obj_id, 'type': 'map'})
         for key, entries in fields_by_obj.get(obj_id, ()):
-            obj = obj_id
             winner = entries[0]
             if winner['action'] == 'link':
                 emit_object(winner['value'])
             for e in entries[1:]:
                 if e['action'] == 'link':
                     emit_object(e['value'])
-            edit = {'action': 'set', 'type': 'map', 'obj': obj, 'key': key,
+            edit = {'action': 'set', 'type': 'map', 'obj': obj_id, 'key': key,
                     'value': winner['value']}
             if winner['action'] == 'link':
                 edit['link'] = True
@@ -436,11 +439,11 @@ def get_missing_deps(state):
     return missing
 
 
-def merge(local, remote, kernel='auto'):
+def merge(local, remote, kernel=None, options=None):
     """Pull changes present in `remote` but not `local`
     (backend/index.js:240-243)."""
     changes = get_missing_changes(remote, local.clock)
-    return apply_changes(local, changes, kernel=kernel)
+    return apply_changes(local, changes, kernel=kernel, options=options)
 
 
 # camelCase aliases (reference API parity)

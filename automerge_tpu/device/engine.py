@@ -15,9 +15,19 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..config import Options, DEFAULT as DEFAULT_OPTIONS
 from ..utils.metrics import metrics
 from . import merge as merge_kernel
 from . import packing
+
+
+def as_options(options=None, kernel=None):
+    """Normalize (options, legacy kernel kwarg) into one Options."""
+    if options is None:
+        options = DEFAULT_OPTIONS
+    if kernel is not None and kernel != options.kernel:
+        options = options.with_(kernel=kernel)
+    return options
 
 
 def _pallas_fits(n_ops, n_actors):
@@ -46,7 +56,11 @@ def pick_resolve_kernel(kernel='auto'):
     'auto'   — on TPU, pallas when the block working set fits VMEM
                (checked per call against the input shapes), xla
                otherwise and on non-TPU backends.
+
+    Accepts an :class:`~automerge_tpu.config.Options` too.
     """
+    if isinstance(kernel, Options):
+        kernel = kernel.kernel
     if kernel == 'auto':
         if jax.default_backend() != 'tpu':
             return merge_kernel.resolve_assignments_batch
@@ -123,29 +137,35 @@ def unpack_resolved(packed, surviving_row, winner_row):
     return doc_fields
 
 
-def batch_merge_docs(docs_changes, return_timing=False, kernel='auto'):
+def batch_merge_docs(docs_changes, return_timing=False, kernel=None,
+                     options=None):
     """Merge a batch of change lists, one per document, on device.
 
     Args:
       docs_changes: list over documents; each entry is a list of changes
         (causally self-contained per document).
       return_timing: also return a dict of phase timings.
+      options: :class:`~automerge_tpu.config.Options` (kernel choice and
+        padding policy); `kernel` remains as a shorthand override.
 
     Returns:
       per-doc dict {(obj, key): {'action': 'set'|'remove', 'value', 'conflicts'}}
       matching exactly what the oracle's field state would be.
     """
     import time
+    opts = as_options(options, kernel)
     t0 = time.perf_counter()
     packed = [packing.pack_assignments(changes) for changes in docs_changes]
-    seg_id, actor, seq, clock, is_del, valid, n_pad = packing.pad_and_stack(packed)
+    seg_id, actor, seq, clock, is_del, valid, n_pad = packing.pad_and_stack(
+        packed, n_ops=opts.op_pad, n_actors=opts.actor_pad)
+    n_segs = opts.pad_segments(max((p.n_segments for p in packed), default=1))
     t1 = time.perf_counter()
 
-    resolve = pick_resolve_kernel(kernel)
+    resolve = pick_resolve_kernel(opts.kernel)
     out = resolve(
         jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
         jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),
-        num_segments=n_pad)
+        num_segments=n_segs)
     surviving = np.asarray(out['surviving'])
     winner = np.asarray(out['winner'])
     t2 = time.perf_counter()

@@ -137,13 +137,22 @@ def pack_assignments(changes, prior_states=None):
 def pad_and_stack(packed_docs, n_ops=None, n_actors=None):
     """Stack per-doc :class:`PackedAssignments` into padded [D, ...] arrays.
 
-    Pads the op axis to the next power of two (shared jit cache across
-    batches — avoids the recompilation storm of truly dynamic shapes).
+    With `n_ops`/`n_actors` unset, pads to the next power of two (shared
+    jit cache across batches — avoids the recompilation storm of truly
+    dynamic shapes). A caller-fixed size is used EXACTLY (one pinned jit
+    bucket, the Options contract) and overflow is a clear error.
     """
     d = len(packed_docs)
-    n = n_ops or max((p.seg_id.shape[0] for p in packed_docs), default=1)
-    n = max(_next_pow2(n), 1)
-    a = n_actors or max((p.clock.shape[1] for p in packed_docs), default=1)
+    need_n = max((p.seg_id.shape[0] for p in packed_docs), default=1)
+    need_a = max((p.clock.shape[1] for p in packed_docs), default=1)
+    if n_ops is not None and need_n > n_ops:
+        raise ValueError(f'batch needs {need_n} op rows but op_pad is '
+                         f'fixed at {n_ops}')
+    if n_actors is not None and need_a > n_actors:
+        raise ValueError(f'batch needs {need_a} actors but actor_pad is '
+                         f'fixed at {n_actors}')
+    n = n_ops if n_ops is not None else max(_next_pow2(need_n), 1)
+    a = n_actors if n_actors is not None else need_a
 
     seg_id = np.zeros((d, n), np.int32)
     actor = np.zeros((d, n), np.int32)

@@ -154,6 +154,8 @@ class SeqIndex:
         else:
             _seed_counter[0] += 1
             self._h = self._lib.amsl_new(_seed_counter[0])
+            if not self._h:
+                raise MemoryError('seq index allocation failed')
             self._rc = [1]
 
     def clone(self):

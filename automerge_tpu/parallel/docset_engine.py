@@ -78,8 +78,18 @@ class ShardedDocSetEngine:
     axis; padded docs carry valid=False ops and resolve to nothing.
     """
 
-    def __init__(self, mesh=None):
-        self.mesh = mesh if mesh is not None else make_mesh()
+    def __init__(self, mesh=None, options=None):
+        from ..device.engine import as_options
+        self.options = as_options(options)
+        if self.options.kernel == 'pallas':
+            # the shard_map body runs the XLA resolver; failing beats
+            # silently benchmarking the wrong kernel
+            raise ValueError('ShardedDocSetEngine runs the XLA resolve '
+                             'kernel; kernel="pallas" is single-chip only')
+        if mesh is None:
+            mesh = (self.options.make_mesh() if self.options.n_devices
+                    else make_mesh())
+        self.mesh = mesh
 
     def apply_changes_batch(self, docs_changes):
         """docs_changes: list (per doc) of change lists. Returns the same
@@ -90,7 +100,8 @@ class ShardedDocSetEngine:
         packed = [packing.pack_assignments(c) for c in docs_changes]
         d_real = len(packed)
         d_pad = -(-d_real // n_dev) * n_dev
-        arrays = packing.pad_and_stack(packed)
+        arrays = packing.pad_and_stack(packed, n_ops=self.options.op_pad,
+                                       n_actors=self.options.actor_pad)
         seg_id, actor, seq, clock, is_del, valid, n_pad = arrays
         if d_pad != d_real:
             def pad_docs(a):
@@ -100,8 +111,10 @@ class ShardedDocSetEngine:
                 pad_docs, (seg_id, actor, seq, clock, is_del, valid))
 
         arrays = shard_docs(self.mesh, seg_id, actor, seq, clock, is_del, valid)
+        n_segs = self.options.pad_segments(
+            max((p.n_segments for p in packed), default=1))
         out, stats = sharded_merge_step(self.mesh, *arrays,
-                                        num_segments=n_pad)
+                                        num_segments=n_segs)
         surviving = np.asarray(out['surviving'])
         winner = np.asarray(out['winner'])
 

@@ -28,9 +28,10 @@ def _map_only(changes):
 
 
 class DeviceDocSet(DocSet):
-    def __init__(self, kernel='auto'):
+    def __init__(self, kernel=None, options=None):
         super().__init__()
-        self.kernel = kernel
+        from ..device.engine import as_options
+        self.options = as_options(options, kernel)
         self._oracle_docs = set()   # doc_ids migrated to the host backend
 
     # -- routing -----------------------------------------------------------
@@ -96,7 +97,7 @@ class DeviceDocSet(DocSet):
         out = {}
         if device_ids:
             new_states, patches = DeviceBackend.apply_changes_batch(
-                device_states, device_changes, kernel=self.kernel)
+                device_states, device_changes, options=self.options)
             for doc_id, state, patch in zip(device_ids, new_states, patches):
                 doc = self.docs.get(doc_id)
                 if doc is None:

@@ -1,0 +1,88 @@
+"""Options: the one config object threaded through the engines."""
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.config import Options
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.device.engine import as_options, batch_merge_docs
+from automerge_tpu.parallel.docset_engine import ShardedDocSetEngine
+from automerge_tpu.sync import DeviceDocSet
+
+from test_device_backend import _changes_from_edits, assert_equivalent
+
+
+class TestOptions:
+    def test_defaults(self):
+        o = Options()
+        assert o.kernel == 'auto' and o.n_devices is None
+        assert o.clock_dtype == np.int32
+
+    def test_pad_next_pow2_when_unset(self):
+        o = Options()
+        assert o.pad_ops(5) == 8
+        assert o.pad_actors(1) == 1
+        assert o.pad_segments(17) == 32
+
+    def test_fixed_pad_is_respected_and_checked(self):
+        o = Options(op_pad=64, actor_pad=8)
+        assert o.pad_ops(5) == 64
+        with pytest.raises(ValueError):
+            o.pad_ops(65)
+
+    def test_invalid_kernel_rejected(self):
+        with pytest.raises(ValueError):
+            Options(kernel='gpu')
+        with pytest.raises(ValueError):
+            Options(op_pad=0)
+
+    def test_with_functional_update(self):
+        o = Options()
+        o2 = o.with_(kernel='xla', n_devices=4)
+        assert o.kernel == 'auto'
+        assert o2.kernel == 'xla' and o2.n_devices == 4
+
+    def test_as_options_kernel_override(self):
+        o = as_options(Options(op_pad=32), 'xla')
+        assert o.kernel == 'xla' and o.op_pad == 32
+        assert as_options(None, None).kernel == 'auto'
+
+    def test_exported_from_package(self):
+        assert am.Options is Options
+
+
+class TestOptionsThreading:
+    def test_device_backend_fixed_pads_match_default(self):
+        changes = _changes_from_edits(
+            lambda d: d.update({'a': 1, 'b': 2}),
+            lambda d: d.__setitem__('b', 9))
+        base_state, base_patch = DeviceBackend.apply_changes(
+            DeviceBackend.init(), changes)
+        opt_state, opt_patch = DeviceBackend.apply_changes(
+            DeviceBackend.init(), changes,
+            options=Options(kernel='xla', op_pad=64, actor_pad=8, seg_pad=16))
+        assert opt_state.fields == base_state.fields
+        assert sorted(d['key'] for d in opt_patch['diffs']) == \
+            sorted(d['key'] for d in base_patch['diffs'])
+
+    def test_device_doc_set_takes_options(self):
+        dds = DeviceDocSet(options=Options(kernel='xla'))
+        dds.apply_changes('d1', _changes_from_edits(
+            lambda d: d.__setitem__('x', 1)))
+        assert dds.get_doc('d1')['x'] == 1
+
+    def test_batch_merge_docs_with_options(self):
+        changes = _changes_from_edits(lambda d: d.__setitem__('k', 'v'))
+        out = batch_merge_docs([changes], options=Options(op_pad=16))
+        (fields,) = out
+        assert fields[(am.ROOT_ID, 'k')]['value'] == 'v'
+
+    def test_sharded_engine_mesh_from_options(self):
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip('needs 4 virtual devices')
+        eng = ShardedDocSetEngine(options=Options(n_devices=4))
+        assert eng.mesh.devices.size == 4
+        changes = _changes_from_edits(lambda d: d.__setitem__('k', 1))
+        results, stats = eng.apply_changes_batch([changes, changes])
+        assert stats['ops_applied'] >= 2

@@ -187,6 +187,30 @@ class TestSequenceKernel:
             assert got == kernel_list_state(ops, dels, pad_to=64)
             assert got == oracle_list_state(ops, dels)
 
+    @pytest.mark.parametrize('axis', ['nodes', 'docs'])
+    def test_sharded_ordering_matches_unsharded(self, axis):
+        # sp (node axis) and dp (doc axis) shardings must not change the
+        # ordering the kernel computes — XLA's cross-shard gathers are
+        # semantics-preserving or this fails.
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        if len(jax.devices()) < 8:
+            pytest.skip('needs 8 virtual devices')
+        rng = random.Random(41)
+        traces = [random_trace(rng, n_ops=25) for _ in range(8)]
+        packed = [pack_trace(ops, dels, pad_to=64) for ops, dels in traces]
+        args = tuple(np.stack([p[0][k] for p in packed]) for k in range(5))
+        reference = jax.jit(seq_kernel.rga_order_batch)(
+            *(jnp.asarray(a) for a in args))
+        mesh = Mesh(np.array(jax.devices()[:8]), ('d',))
+        spec = P(None, 'd') if axis == 'nodes' else P('d', None)
+        placed = tuple(jax.device_put(a, NamedSharding(mesh, spec))
+                       for a in args)
+        sharded = jax.jit(seq_kernel.rga_order_batch)(*placed)
+        for k in ('tree_pos', 'vis_index', 'length'):
+            np.testing.assert_array_equal(np.asarray(sharded[k]),
+                                          np.asarray(reference[k]), err_msg=k)
+
 
 class TestMergeKernel:
     def _pack_field_ops(self, ops_per_key, actor_names):
