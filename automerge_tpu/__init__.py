@@ -84,9 +84,14 @@ def load(data, actor_id=None):
 
 
 def save(doc):
-    """Serialize the full change history (src/automerge.js:49-52)."""
+    """Serialize the full change history (src/automerge.js:49-52).
+
+    Works for host-oracle and device-backed documents alike: both backend
+    states expose the SharedChangeLog surface (the device state directly,
+    the oracle via its op_set)."""
     state = Frontend.get_backend_state(doc)
-    history = state.op_set.get_history()
+    log = state.op_set if hasattr(state, 'op_set') else state
+    history = log.get_history()
     return _json.dumps({'format': 'automerge-tpu@1', 'changes': history})
 
 

@@ -29,11 +29,15 @@ class Options:
       n_devices: mesh size for sharded engines (None = every device).
       op_pad: fixed op-axis padding per document batch (None = next pow2).
       seg_pad: fixed segment (field) capacity (None = next pow2).
+      node_pad: fixed sequence-tree node capacity for the RGA ordering
+        pass (None = next pow2 of the largest dirty tree).
       actor_pad: actor-table capacity — clocks are dense [actor_pad]
         vectors on device (None = next pow2 of the batch's actor count).
-      clock_dtype / index_dtype: device array widths. int32 everywhere by
+      clock_dtype / index_dtype: device array widths for clocks/seq
+        counters and segment/actor/node indexes. int32 everywhere by
         default: TPU VPU lanes are 32-bit and none of the CRDT counters
-        (seq numbers, list indexes) approach 2^31.
+        (seq numbers, list indexes) approach 2^31. Widening to int64
+        additionally requires jax's x64 mode.
     """
 
     kernel: str = 'auto'
@@ -41,13 +45,15 @@ class Options:
     op_pad: Optional[int] = None
     seg_pad: Optional[int] = None
     actor_pad: Optional[int] = None
+    node_pad: Optional[int] = None
     clock_dtype: np.dtype = np.dtype(np.int32)
     index_dtype: np.dtype = np.dtype(np.int32)
 
     def __post_init__(self):
         if self.kernel not in ('auto', 'xla', 'pallas'):
             raise ValueError(f'unknown kernel {self.kernel!r}')
-        for name in ('n_devices', 'op_pad', 'seg_pad', 'actor_pad'):
+        for name in ('n_devices', 'op_pad', 'seg_pad', 'actor_pad',
+                     'node_pad'):
             v = getattr(self, name)
             if v is not None and v < 1:
                 raise ValueError(f'{name} must be >= 1, got {v}')
@@ -61,6 +67,9 @@ class Options:
 
     def pad_actors(self, n):
         return self._pad(self.actor_pad, n, 'actor_pad')
+
+    def pad_nodes(self, n):
+        return self._pad(self.node_pad, n, 'node_pad')
 
     @staticmethod
     def _pad(fixed, n, name):

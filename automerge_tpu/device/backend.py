@@ -4,25 +4,30 @@ This module puts the device engine behind the frontend<->backend
 change/patch protocol (the reference's `backend/index.js:161-163` surface):
 ``apply_changes_batch`` takes per-document wire changes and returns
 per-document **patches** — diffs with obj/key/value/conflicts exactly as
-the reference's diff emission produces them (`backend/op_set.js:161-177`)
-— while the conflict resolution for every touched field of every document
-runs in ONE jitted device call (:mod:`.merge`).
+the reference's diff emission produces them (`backend/op_set.js:105-177`)
+— while the heavy resolution work for every document in the batch runs in
+two jitted device calls: one segment-reduction pass resolving every
+touched field (:mod:`.merge`), one RGA ordering pass recomputing document
+order for every dirty list/text object (:mod:`.sequence`).
 
 State model. :class:`DeviceBackendState` is a persistent snapshot (old
 snapshots stay valid after applies, like the oracle): per-field surviving
-op entries (winner first), the applied-change log per actor, vector clock,
-dep frontier, causal buffer. Each apply packs *prior surviving entries of
-the touched fields* plus the new assignment ops into dense arrays; the
-segment-reduction kernel re-resolves those fields; the unpacked winners
-become both the new field state and the patch diffs. Untouched fields are
-never re-packed, so incremental applies are O(touched), not O(doc).
+op entries (winner first), per-object records (inbound links; for
+sequences the insertion tree as packable columns plus the visible-order
+index), the applied-change log per actor, vector clock, dep frontier,
+causal buffer. Each apply packs *prior surviving entries of the touched
+fields* plus the new assignment ops into dense arrays; the kernel
+re-resolves those fields; the unpacked winners become the new field state.
+Untouched fields are never re-packed, so the assignment phase is
+O(touched), not O(doc). Dirty sequence objects are re-ordered whole by the
+RGA kernel — O(n log n) parallel device work replacing the oracle's
+per-element pointer walks — and the patch carries the remove/insert/set
+list edits derived from the kernel's visible indexes.
 
-Scope: map documents, including nested maps via makeMap/link ops
-(structural makeX ops are host-side create diffs; link assignments resolve
-on device like sets). Documents containing sequence ops are migrated to
-the host oracle by :class:`~automerge_tpu.sync.device_doc_set.DeviceDocSet`
-(the batched sequence kernel itself lives in
-:mod:`automerge_tpu.device.sequence`).
+Sequence diffs are emitted as a compaction of the oracle's per-op diff
+stream: removes (descending old index), then inserts (ascending final
+index), then sets (final index). Applying either stream through
+``Frontend.apply_patch`` yields the identical document.
 """
 
 import numpy as np
@@ -34,21 +39,66 @@ from ..utils.metrics import metrics
 from . import engine as _engine
 
 
+class _ObjRecord:
+    """Per-object device-backend state (counterpart of op_set.js:63-93).
+
+    For sequences the insertion tree is stored as columnar node arrays —
+    node 0 is the virtual ``'_head'`` — ready to pack for the RGA kernel,
+    plus ``elem_ids``, the visible document order (the order-statistic
+    index the reference keeps in its SkipList).
+    """
+
+    __slots__ = ('type', 'inbound', 'nodes', 'node_of', 'node_parent',
+                 'node_elem', 'node_actor', 'elem_ids')
+
+    SEQUENCE_TYPES = ('makeList', 'makeText')
+
+    def __init__(self, type_=None):
+        self.type = type_        # None (root) / 'makeMap'/'makeList'/'makeText'
+        self.inbound = []        # (obj, key) fields holding a link to this object
+        if type_ in self.SEQUENCE_TYPES:
+            self.nodes = ['_head']        # node index -> elemId
+            self.node_of = {'_head': 0}   # elemId -> node index
+            self.node_parent = [0]        # node index -> parent node index
+            self.node_elem = [0]          # node index -> Lamport elem counter
+            self.node_actor = ['']        # node index -> actor id string
+            self.elem_ids = []            # visible elemIds in document order
+        else:
+            self.nodes = None
+
+    def is_sequence(self):
+        return self.type in self.SEQUENCE_TYPES
+
+    def clone(self):
+        rec = _ObjRecord.__new__(_ObjRecord)
+        rec.type = self.type
+        rec.inbound = list(self.inbound)
+        if self.nodes is not None:
+            rec.nodes = list(self.nodes)
+            rec.node_of = dict(self.node_of)
+            rec.node_parent = list(self.node_parent)
+            rec.node_elem = list(self.node_elem)
+            rec.node_actor = list(self.node_actor)
+            rec.elem_ids = list(self.elem_ids)
+        else:
+            rec.nodes = None
+        return rec
+
+
 class DeviceBackendState(SharedChangeLog):
     """Persistent snapshot of one document's device-resident CRDT state.
 
     Mirrors what the oracle keeps in an OpSet (op_set.js:298-310), but with
-    field state stored as packable entry tuples instead of op dicts inside
-    an object tree. The change-log surface (actor_states/get_history/...)
+    field state stored as packable entry tuples and insertion trees as
+    columnar arrays. The change-log surface (actor_states/get_history/...)
     is shared with the oracle via :class:`SharedChangeLog`.
     """
 
     __slots__ = ('objects', 'fields', 'states', 'state_lens', 'clock',
-                 'deps', 'queue', 'history', 'history_len')
+                 'deps', 'queue', 'history', 'history_len', '_owned')
 
     def __init__(self):
-        # obj_id -> {'type': 'makeMap'|None, 'inbound': list of (obj, key)}
-        self.objects = {ROOT_ID: {'type': None, 'inbound': []}}
+        self.objects = {ROOT_ID: _ObjRecord(None)}
         # (obj, key) -> tuple of entries, winner first (actor-descending).
         # entry = {'actor','seq','all_deps','action'('set'|'link'),'value'}
         self.fields = {}
@@ -59,11 +109,11 @@ class DeviceBackendState(SharedChangeLog):
         self.queue = []         # causally-unready buffered changes
         self.history = []       # grow-only applied-change log
         self.history_len = 0
+        self._owned = {ROOT_ID}  # objectIds private to this snapshot
 
     def clone(self):
         new = DeviceBackendState.__new__(DeviceBackendState)
-        new.objects = {k: {'type': v['type'], 'inbound': list(v['inbound'])}
-                       for k, v in self.objects.items()}
+        new.objects = dict(self.objects)   # records copy-on-write
         new.fields = dict(self.fields)     # entry tuples are immutable
         new.states = dict(self.states)
         new.state_lens = dict(self.state_lens)
@@ -72,7 +122,15 @@ class DeviceBackendState(SharedChangeLog):
         new.queue = list(self.queue)
         new.history = self.history
         new.history_len = self.history_len
+        new._owned = set()
         return new
+
+    def _writable(self, object_id):
+        """Copy-on-write object record access (op_set.py _writable)."""
+        if object_id not in self._owned:
+            self.objects[object_id] = self.objects[object_id].clone()
+            self._owned.add(object_id)
+        return self.objects[object_id]
 
 
 def init():
@@ -128,45 +186,85 @@ def _admit_changes(state, changes):
 
 # -- host phase 2: collect structural ops + touched-field rows ---------------
 
-class _DocWork:
-    """Per-document staging between the host phases and the device call."""
+_MAKE_KIND = {'makeMap': 'map', 'makeList': 'list', 'makeText': 'text'}
 
-    __slots__ = ('state', 'create_diffs', 'touched', 'rows')
+
+class _DocWork:
+    """Per-document staging between the host phases and the device calls."""
+
+    __slots__ = ('state', 'create_diffs', 'touched', 'rows', 'dirty_seq',
+                 'touched_by_obj', 'survivors')
 
     def __init__(self, state):
         self.state = state
         self.create_diffs = []
-        self.touched = []      # (obj, key) in first-touch order
-        self.rows = []         # (field, entry_dict, is_del, is_new)
+        self.touched = []         # (obj, key) in first-touch order
+        self.rows = []            # (field, entry_dict, is_del, is_new)
+        self.dirty_seq = []       # sequence obj ids needing re-ordering
+        self.touched_by_obj = {}  # obj -> [key] (first-touch order)
+        self.survivors = {}       # field -> surviving entries (post-kernel)
 
 
 def _stage_changes(work, admitted):
     state = work.state
     touched_set = set()
+    dirty_set = set()
     for change, all_deps in admitted:
         actor, seq = change['actor'], change['seq']
         for op in change['ops']:
             action = op['action']
-            if action == 'makeMap':
+            if action in _MAKE_KIND:
                 obj = op['obj']
                 if obj in state.objects:
                     raise ValueError('Duplicate creation of object ' + obj)
-                state.objects[obj] = {'type': 'makeMap', 'inbound': []}
+                state.objects[obj] = _ObjRecord(action)
+                state._owned.add(obj)
                 work.create_diffs.append(
-                    {'action': 'create', 'obj': obj, 'type': 'map'})
-            elif action in ('makeList', 'makeText', 'ins'):
-                raise NotImplementedError(
-                    'sequence ops are not handled by the map backend; use '
-                    'DeviceDocSet (which migrates sequence documents to the '
-                    'host oracle) or the host backend directly')
-            elif action in ('set', 'del', 'link'):
-                if op['obj'] not in state.objects:
+                    {'action': 'create', 'obj': obj,
+                     'type': _MAKE_KIND[action]})
+            elif action == 'ins':
+                obj = op['obj']
+                if obj not in state.objects:
+                    raise ValueError('Modification of unknown object ' + obj)
+                rec = state._writable(obj)
+                if not rec.is_sequence():
                     raise ValueError(
-                        'Modification of unknown object ' + op['obj'])
-                field = (op['obj'], op['key'])
+                        'Insertion into non-sequence object ' + obj)
+                elem = op['elem']
+                elem_id = f'{actor}:{elem}'
+                if elem_id in rec.node_of:
+                    raise ValueError('Duplicate list element ID ' + elem_id)
+                parent = rec.node_of.get(op['key'])
+                if parent is None:
+                    raise ValueError(
+                        'List element insertion after unknown element '
+                        + str(op['key']))
+                rec.node_of[elem_id] = len(rec.nodes)
+                rec.nodes.append(elem_id)
+                rec.node_parent.append(parent)
+                rec.node_elem.append(elem)
+                rec.node_actor.append(actor)
+                if obj not in dirty_set:
+                    dirty_set.add(obj)
+                    work.dirty_seq.append(obj)
+            elif action in ('set', 'del', 'link'):
+                obj = op['obj']
+                rec = state.objects.get(obj)
+                if rec is None:
+                    raise ValueError('Modification of unknown object ' + obj)
+                if rec.is_sequence():
+                    if op['key'] not in rec.node_of:
+                        raise TypeError(
+                            'Missing index entry for list element '
+                            + str(op['key']))
+                    if obj not in dirty_set:
+                        dirty_set.add(obj)
+                        work.dirty_seq.append(obj)
+                field = (obj, op['key'])
                 if field not in touched_set:
                     touched_set.add(field)
                     work.touched.append(field)
+                    work.touched_by_obj.setdefault(obj, []).append(op['key'])
                 entry = {'actor': actor, 'seq': seq, 'all_deps': all_deps,
                          'action': action, 'value': op.get('value')}
                 work.rows.append((field, entry, action == 'del', True))
@@ -180,16 +278,16 @@ def _stage_changes(work, admitted):
             work.rows.append((field, entry, False, False))
 
 
-# -- device phase: pack, resolve, unpack -------------------------------------
+# -- device phase A: assignment resolution (pack, resolve, unpack) -----------
 
 def _pack_docs(works, options):
     """Pack every staged row of every doc, run ONE device resolution."""
     d = len(works)
     max_rows = max((len(w.rows) for w in works), default=0)
     n = options.pad_ops(max_rows)
-    seg_id = np.zeros((d, n), np.int32)
-    actor = np.zeros((d, n), np.int32)
-    seq = np.zeros((d, n), np.int32)
+    seg_id = np.zeros((d, n), options.index_dtype)
+    actor = np.zeros((d, n), options.index_dtype)
+    seq = np.zeros((d, n), options.clock_dtype)
     is_del = np.zeros((d, n), bool)
     valid = np.zeros((d, n), bool)
 
@@ -203,7 +301,7 @@ def _pack_docs(works, options):
         a = max(len(actor_names), 1)
         n_actors = max(n_actors, a)
         max_segs = max(max_segs, len(w.touched))
-        crows = np.zeros((n, a), np.int32)
+        crows = np.zeros((n, a), options.clock_dtype)
         for j, (field, entry, del_flag, _is_new) in enumerate(w.rows):
             seg_id[i, j] = seg_of[field]
             actor[i, j] = rank[entry['actor']]
@@ -218,7 +316,7 @@ def _pack_docs(works, options):
     # pad the actor axis to a power of two as well: all three kernel-input
     # dims stay bucketed, so the jit cache is shared across batches
     n_actors = options.pad_actors(n_actors)
-    clock = np.zeros((d, n, n_actors), np.int32)
+    clock = np.zeros((d, n, n_actors), options.clock_dtype)
     for i, crows in enumerate(clocks):
         clock[i, :, :crows.shape[1]] = crows
 
@@ -230,15 +328,53 @@ def _pack_docs(works, options):
     return np.asarray(out['surviving'])
 
 
+def _update_fields(work, surviving_row):
+    """Fold kernel survivors back into field state + the inbound graph
+    (the state effects of op_set.js:180-219); diff emission comes after."""
+    state = work.state
+    survivors_by_field = {f: [] for f in work.touched}
+    for j, (field, entry, _is_del, _is_new) in enumerate(work.rows):
+        if surviving_row[j]:
+            survivors_by_field[field].append(entry)
+
+    for field in work.touched:
+        before = state.fields.get(field, ())
+        survivors = sorted(survivors_by_field[field],
+                           key=lambda e: e['actor'], reverse=True)
+
+        # inbound maintenance: link refs that dropped out leave the target,
+        # new surviving links join it (op_set.js:194-208).
+        gone = [e for e in before if e not in survivors and e['action'] == 'link']
+        for e in gone:
+            if e['value'] in state.objects:
+                target = state._writable(e['value'])
+                target.inbound = [r for r in target.inbound if r != field]
+        for e in survivors:
+            if e['action'] == 'link':
+                target = state._writable(e['value'])
+                if field not in target.inbound:
+                    target.inbound.append(field)
+
+        state.fields[field] = tuple(survivors)
+        work.survivors[field] = survivors
+
+
 def _get_path(state, object_id):
-    """Key path from root (op_set.js:43-60), maps only."""
+    """Key path from root (op_set.js:43-60); list positions as indexes."""
     path = []
     while object_id != ROOT_ID:
         rec = state.objects.get(object_id)
-        if rec is None or not rec['inbound']:
+        if rec is None or not rec.inbound:
             return None
-        parent, key = rec['inbound'][0]
-        path.insert(0, key)
+        parent, key = rec.inbound[0]
+        prec = state.objects[parent]
+        if prec.is_sequence():
+            try:
+                path.insert(0, prec.elem_ids.index(key))
+            except ValueError:
+                return None
+        else:
+            path.insert(0, key)
         object_id = parent
     return path
 
@@ -253,36 +389,15 @@ def _conflict_entries(losers):
     return out
 
 
-def _unpack_doc(work, surviving_row):
-    """Update field state + inbound graph, emit diffs (op_set.js:161-177)."""
+def _emit_map_diffs(work):
+    """Map-key diffs for every touched map field (op_set.js:161-177)."""
     state = work.state
-    survivors_by_field = {f: [] for f in work.touched}
-    for j, (field, entry, _is_del, _is_new) in enumerate(work.rows):
-        if surviving_row[j]:
-            survivors_by_field[field].append(entry)
-
-    diffs = list(work.create_diffs)
+    diffs = []
     for field in work.touched:
         obj, key = field
-        before = state.fields.get(field, ())
-        survivors = sorted(survivors_by_field[field],
-                           key=lambda e: e['actor'], reverse=True)
-
-        # inbound maintenance: link refs that dropped out leave the target,
-        # new surviving links join it (op_set.js:194-208).
-        gone = [e for e in before if e not in survivors and e['action'] == 'link']
-        for e in gone:
-            target = state.objects.get(e['value'])
-            if target is not None:
-                target['inbound'] = [r for r in target['inbound'] if r != field]
-        for e in survivors:
-            if e['action'] == 'link':
-                target = state.objects[e['value']]
-                if field not in target['inbound']:
-                    target['inbound'].append(field)
-
-        state.fields[field] = tuple(survivors)
-
+        if state.objects[obj].is_sequence():
+            continue
+        survivors = work.survivors[field]
         edit = {'action': 'set' if survivors else 'remove', 'type': 'map',
                 'obj': obj, 'key': key, 'path': _get_path(state, obj)}
         if survivors:
@@ -295,6 +410,109 @@ def _unpack_doc(work, surviving_row):
         diffs.append(edit)
     return diffs
 
+
+# -- device phase B: sequence re-ordering (RGA kernel) -----------------------
+
+def _collect_seq_jobs(works):
+    """One job per dirty sequence object across the whole doc batch."""
+    jobs = []
+    for w in works:
+        for obj in w.dirty_seq:
+            rec = w.state._writable(obj)
+            visible = np.zeros(len(rec.nodes), bool)
+            fields = w.state.fields
+            for i in range(1, len(rec.nodes)):
+                visible[i] = bool(fields.get((obj, rec.nodes[i])))
+            jobs.append((w, obj, rec, visible))
+    return jobs
+
+
+def _run_seq_jobs(jobs, options):
+    """ONE rga_order_batch call ordering every dirty sequence object."""
+    from .sequence import rga_order_batch
+    k = len(jobs)
+    n_pad = options.pad_nodes(max(len(rec.nodes) for _, _, rec, _ in jobs))
+    parent = np.zeros((k, n_pad), options.index_dtype)
+    elem = np.zeros((k, n_pad), options.clock_dtype)
+    actor = np.zeros((k, n_pad), options.index_dtype)
+    vis = np.zeros((k, n_pad), bool)
+    valid = np.zeros((k, n_pad), bool)
+    for i, (_w, _obj, rec, visible) in enumerate(jobs):
+        n = len(rec.nodes)
+        parent[i, :n] = rec.node_parent
+        elem[i, :n] = rec.node_elem
+        # rank order must preserve actor-string order (op_set.js:371-377)
+        names = sorted(set(rec.node_actor))
+        rank = {a: j for j, a in enumerate(names)}
+        actor[i, :n] = [rank[a] for a in rec.node_actor]
+        vis[i, :n] = visible
+        valid[i, :n] = True
+    out = rga_order_batch(jnp.asarray(parent), jnp.asarray(elem),
+                          jnp.asarray(actor), jnp.asarray(vis),
+                          jnp.asarray(valid))
+    return {key: np.asarray(v) for key, v in out.items()}
+
+
+def _emit_seq_diffs(work, obj, rec, visible, vis_index):
+    """remove/insert/set list edits from the kernel's final ordering.
+
+    The oracle walks each touched element through the evolving SkipList
+    (op_set.js:105-159); here the final visible index of every node is
+    already on hand (``vis_index``), so the edit script is: removes at old
+    indexes (descending), inserts at final indexes (ascending), sets at
+    final indexes. Applied in that order the indexes are valid at every
+    intermediate step, and the resulting document equals the oracle's.
+    """
+    state = work.state
+    obj_type = 'text' if rec.type == 'makeText' else 'list'
+    old_index = {eid: i for i, eid in enumerate(rec.elem_ids)}
+    touched = work.touched_by_obj.get(obj, ())
+
+    removes, inserts, sets = [], [], []
+    for key in touched:
+        node = rec.node_of[key]
+        vis_after = visible[node]
+        was_visible = key in old_index
+        if was_visible and not vis_after:
+            removes.append(old_index[key])
+        elif vis_after:
+            survivors = work.survivors[(obj, key)]
+            winner = survivors[0]
+            edit = {'type': obj_type, 'obj': obj,
+                    'index': int(vis_index[node]), 'value': winner['value']}
+            if winner['action'] == 'link':
+                edit['link'] = True
+            if len(survivors) > 1:
+                edit['conflicts'] = _conflict_entries(survivors[1:])
+            if was_visible:
+                edit['action'] = 'set'
+                sets.append(edit)
+            else:
+                edit['action'] = 'insert'
+                edit['elemId'] = key
+                inserts.append(edit)
+
+    removes.sort(reverse=True)
+    inserts.sort(key=lambda e: e['index'])
+    sets.sort(key=lambda e: e['index'])
+
+    diffs = []
+    for idx in removes:
+        diffs.append({'action': 'remove', 'type': obj_type, 'obj': obj,
+                      'index': idx})
+        del rec.elem_ids[idx]
+    for edit in inserts:
+        rec.elem_ids.insert(edit['index'], edit['elemId'])
+        diffs.append(edit)
+    diffs.extend(sets)
+
+    path = _get_path(state, obj)
+    for edit in diffs:
+        edit['path'] = path
+    return diffs
+
+
+# -- patch assembly ----------------------------------------------------------
 
 def _make_patch(state, diffs):
     return {'clock': dict(state.clock), 'deps': dict(state.deps),
@@ -314,8 +532,9 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
 
     Returns:
       (new_states, patches) — patches carry reference-format diffs. One
-      diff per touched field (the compaction of the oracle's per-op diff
-      stream: applying either stream to a frontend yields the same doc).
+      diff per touched field / list element (the compaction of the
+      oracle's per-op diff stream: applying either stream to a frontend
+      yields the same doc).
     """
     opts = _engine.as_options(options, kernel)
     works = []
@@ -331,15 +550,32 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
         surviving = _pack_docs(works, opts)
     else:
         surviving = np.zeros((len(works), 1), bool)
+    for i, w in enumerate(works):
+        _update_fields(w, surviving[i])
+
+    seq_jobs = _collect_seq_jobs(works)
+    seq_out = _run_seq_jobs(seq_jobs, opts) if seq_jobs else None
+
+    seq_diffs_by_work = {}
+    if seq_jobs:
+        for i, (w, obj, rec, visible) in enumerate(seq_jobs):
+            n = len(rec.nodes)
+            diffs = _emit_seq_diffs(w, obj, rec, visible,
+                                    seq_out['vis_index'][i, :n])
+            seq_diffs_by_work.setdefault(id(w), []).extend(diffs)
 
     new_states, patches = [], []
-    for i, w in enumerate(works):
-        diffs = _unpack_doc(w, surviving[i])
+    for w in works:
+        diffs = list(w.create_diffs)
+        diffs.extend(_emit_map_diffs(w))
+        diffs.extend(seq_diffs_by_work.get(id(w), ()))
         new_states.append(w.state)
         patches.append(_make_patch(w.state, diffs))
 
     metrics.bump('device_backend_batches')
     metrics.bump('device_backend_ops', total_rows)
+    if seq_jobs:
+        metrics.bump('device_backend_seq_objects', len(seq_jobs))
     return new_states, patches
 
 
@@ -374,7 +610,8 @@ def apply_local_change(state, request, kernel=None, options=None):
 
 def get_patch(state):
     """Whole-document patch from empty (backend/index.js:201-207): create
-    diffs child-first, then field sets, so the frontend can resolve links."""
+    diffs child-first, then field sets / element inserts, so the frontend
+    can resolve links."""
     diffs = []
     emitted = set()
     # one pass over the field table, then per-object lookups are O(fields-of)
@@ -383,28 +620,47 @@ def get_patch(state):
         if entries:
             fields_by_obj.setdefault(obj, []).append((key, entries))
 
+    def emit_entry_objects(entries):
+        for e in entries:
+            if e['action'] == 'link':
+                emit_object(e['value'])
+
     def emit_object(obj_id):
         if obj_id in emitted:
             return
         emitted.add(obj_id)
-        # children first (MaterializationContext.make_patch order)
+        rec = state.objects[obj_id]
         obj_diffs = []
-        if obj_id != ROOT_ID:
-            obj_diffs.append({'action': 'create', 'obj': obj_id, 'type': 'map'})
-        for key, entries in fields_by_obj.get(obj_id, ()):
-            winner = entries[0]
-            if winner['action'] == 'link':
-                emit_object(winner['value'])
-            for e in entries[1:]:
-                if e['action'] == 'link':
-                    emit_object(e['value'])
-            edit = {'action': 'set', 'type': 'map', 'obj': obj_id, 'key': key,
-                    'value': winner['value']}
-            if winner['action'] == 'link':
-                edit['link'] = True
-            if len(entries) > 1:
-                edit['conflicts'] = _conflict_entries(entries[1:])
-            obj_diffs.append(edit)
+        if rec.is_sequence():
+            obj_type = 'text' if rec.type == 'makeText' else 'list'
+            obj_diffs.append({'action': 'create', 'obj': obj_id,
+                              'type': obj_type})
+            for index, elem_id in enumerate(rec.elem_ids):
+                entries = state.fields[(obj_id, elem_id)]
+                emit_entry_objects(entries)   # children first
+                winner = entries[0]
+                edit = {'action': 'insert', 'type': obj_type, 'obj': obj_id,
+                        'index': index, 'elemId': elem_id,
+                        'value': winner['value']}
+                if winner['action'] == 'link':
+                    edit['link'] = True
+                if len(entries) > 1:
+                    edit['conflicts'] = _conflict_entries(entries[1:])
+                obj_diffs.append(edit)
+        else:
+            if obj_id != ROOT_ID:
+                obj_diffs.append({'action': 'create', 'obj': obj_id,
+                                  'type': 'map'})
+            for key, entries in fields_by_obj.get(obj_id, ()):
+                emit_entry_objects(entries)   # children first
+                winner = entries[0]
+                edit = {'action': 'set', 'type': 'map', 'obj': obj_id,
+                        'key': key, 'value': winner['value']}
+                if winner['action'] == 'link':
+                    edit['link'] = True
+                if len(entries) > 1:
+                    edit['conflicts'] = _conflict_entries(entries[1:])
+                obj_diffs.append(edit)
         diffs.extend(obj_diffs)
 
     emit_object(ROOT_ID)

@@ -15,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..config import Options, DEFAULT as DEFAULT_OPTIONS
+from ..config import DEFAULT as DEFAULT_OPTIONS
 from ..utils.metrics import metrics
 from . import merge as merge_kernel
 from . import packing
@@ -57,10 +57,7 @@ def pick_resolve_kernel(kernel='auto'):
                (checked per call against the input shapes), xla
                otherwise and on non-TPU backends.
 
-    Accepts an :class:`~automerge_tpu.config.Options` too.
     """
-    if isinstance(kernel, Options):
-        kernel = kernel.kernel
     if kernel == 'auto':
         if jax.default_backend() != 'tpu':
             return merge_kernel.resolve_assignments_batch
@@ -157,7 +154,8 @@ def batch_merge_docs(docs_changes, return_timing=False, kernel=None,
     t0 = time.perf_counter()
     packed = [packing.pack_assignments(changes) for changes in docs_changes]
     seg_id, actor, seq, clock, is_del, valid, n_pad = packing.pad_and_stack(
-        packed, n_ops=opts.op_pad, n_actors=opts.actor_pad)
+        packed, n_ops=opts.op_pad, n_actors=opts.actor_pad,
+        index_dtype=opts.index_dtype, clock_dtype=opts.clock_dtype)
     n_segs = opts.pad_segments(max((p.n_segments for p in packed), default=1))
     t1 = time.perf_counter()
 

@@ -134,7 +134,8 @@ def pack_assignments(changes, prior_states=None):
                              segments, op_meta, actor_names)
 
 
-def pad_and_stack(packed_docs, n_ops=None, n_actors=None):
+def pad_and_stack(packed_docs, n_ops=None, n_actors=None,
+                  index_dtype=np.int32, clock_dtype=np.int32):
     """Stack per-doc :class:`PackedAssignments` into padded [D, ...] arrays.
 
     With `n_ops`/`n_actors` unset, pads to the next power of two (shared
@@ -152,12 +153,12 @@ def pad_and_stack(packed_docs, n_ops=None, n_actors=None):
         raise ValueError(f'batch needs {need_a} actors but actor_pad is '
                          f'fixed at {n_actors}')
     n = n_ops if n_ops is not None else max(_next_pow2(need_n), 1)
-    a = n_actors if n_actors is not None else need_a
+    a = n_actors if n_actors is not None else max(_next_pow2(need_a), 1)
 
-    seg_id = np.zeros((d, n), np.int32)
-    actor = np.zeros((d, n), np.int32)
-    seq = np.zeros((d, n), np.int32)
-    clock = np.zeros((d, n, a), np.int32)
+    seg_id = np.zeros((d, n), index_dtype)
+    actor = np.zeros((d, n), index_dtype)
+    seq = np.zeros((d, n), clock_dtype)
+    clock = np.zeros((d, n, a), clock_dtype)
     is_del = np.zeros((d, n), bool)
     valid = np.zeros((d, n), bool)
     for i, p in enumerate(packed_docs):

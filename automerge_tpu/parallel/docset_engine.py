@@ -28,7 +28,7 @@ except ImportError:  # older jax
 
 from ..device.merge import _resolve
 from ..device import packing
-from .mesh import make_mesh, shard_docs, DOC_AXIS
+from .mesh import shard_docs, DOC_AXIS
 
 
 def _merge_step(seg_id, actor, seq, clock, is_del, valid, num_segments):
@@ -87,8 +87,7 @@ class ShardedDocSetEngine:
             raise ValueError('ShardedDocSetEngine runs the XLA resolve '
                              'kernel; kernel="pallas" is single-chip only')
         if mesh is None:
-            mesh = (self.options.make_mesh() if self.options.n_devices
-                    else make_mesh())
+            mesh = self.options.make_mesh()
         self.mesh = mesh
 
     def apply_changes_batch(self, docs_changes):
@@ -100,8 +99,11 @@ class ShardedDocSetEngine:
         packed = [packing.pack_assignments(c) for c in docs_changes]
         d_real = len(packed)
         d_pad = -(-d_real // n_dev) * n_dev
-        arrays = packing.pad_and_stack(packed, n_ops=self.options.op_pad,
-                                       n_actors=self.options.actor_pad)
+        arrays = packing.pad_and_stack(
+            packed, n_ops=self.options.op_pad,
+            n_actors=self.options.actor_pad,
+            index_dtype=self.options.index_dtype,
+            clock_dtype=self.options.clock_dtype)
         seg_id, actor, seq, clock, is_del, valid, n_pad = arrays
         if d_pad != d_real:
             def pad_docs(a):

@@ -5,12 +5,14 @@ host backend (`src/doc_set.js:25-33`). A :class:`DeviceDocSet` keeps the
 same public surface (get_doc/set_doc/apply_changes/handlers — Connection
 works unchanged) and adds :meth:`apply_changes_batch`, which routes the
 wire changes of MANY documents through the batched device backend
-(:mod:`automerge_tpu.device.backend`) in one device call.
+(:mod:`automerge_tpu.device.backend`) in (at most) two device calls: one
+assignment-resolution pass and one RGA ordering pass across every dirty
+list/text object of every document.
 
-Routing. Map-only documents (set/del/link/makeMap ops) live on the device
-path. A document whose incoming changes contain sequence ops
-(ins/makeList/makeText) is transparently migrated to the host oracle by
-replaying its change log — the change/patch protocol makes the two
+Routing. Device-backed documents — any document first seen through this
+DocSet — take the device path for all object types (maps, nested maps,
+lists, text). A document added via ``set_doc`` with a host-oracle backend
+state keeps its oracle backend: the change/patch protocol makes the two
 backends interchangeable, so callers never see the difference.
 """
 
@@ -19,20 +21,13 @@ from .. import backend as Backend
 from ..device import backend as DeviceBackend
 from .doc_set import DocSet
 
-_MAP_ACTIONS = frozenset(('set', 'del', 'link', 'makeMap'))
-
-
-def _map_only(changes):
-    return all(op['action'] in _MAP_ACTIONS
-               for change in changes for op in change.get('ops', ()))
-
 
 class DeviceDocSet(DocSet):
     def __init__(self, kernel=None, options=None):
         super().__init__()
         from ..device.engine import as_options
         self.options = as_options(options, kernel)
-        self._oracle_docs = set()   # doc_ids migrated to the host backend
+        self._oracle_docs = set()   # doc_ids pinned to the host backend
 
     # -- routing -----------------------------------------------------------
 
@@ -41,25 +36,6 @@ class DeviceDocSet(DocSet):
         if doc is None:
             return DeviceBackend.init()
         return Frontend.get_backend_state(doc)
-
-    def _migrate_to_oracle(self, doc_id):
-        """Replay the device change log through the host oracle; the wire
-        protocol guarantees the rebuilt document is identical."""
-        doc = self.docs.get(doc_id)
-        state = Backend.init()
-        changes = []
-        if doc is not None:
-            dev_state = Frontend.get_backend_state(doc)
-            changes = dev_state.get_history() + list(dev_state.queue)
-        new_doc = Frontend.init({'backend': Backend})
-        if changes:
-            state, patch = Backend.apply_changes(state, changes)
-            patch['state'] = state
-            new_doc = Frontend.apply_patch(new_doc, patch)
-        self._oracle_docs.add(doc_id)
-        self.docs = dict(self.docs)
-        self.docs[doc_id] = new_doc
-        return new_doc
 
     # -- public surface ----------------------------------------------------
 
@@ -70,8 +46,8 @@ class DeviceDocSet(DocSet):
 
     def apply_changes_batch(self, changes_by_doc):
         """Apply `{doc_id: [change, ...]}` across documents; every
-        device-routed document resolves in ONE device call. Returns
-        `{doc_id: new_doc}` and fires handlers per document."""
+        device-routed document resolves in ONE batched device pass.
+        Returns `{doc_id: new_doc}` and fires handlers per document."""
         device_ids, device_states, device_changes = [], [], []
         oracle_ids = []
         for doc_id, changes in changes_by_doc.items():
@@ -82,12 +58,6 @@ class DeviceDocSet(DocSet):
             if doc_id in self._oracle_docs or not on_device:
                 # host-backed doc (e.g. added via set_doc) stays on the oracle
                 self._oracle_docs.add(doc_id)
-                oracle_ids.append(doc_id)
-            elif not _map_only(changes):
-                if doc is not None:
-                    self._migrate_to_oracle(doc_id)
-                else:
-                    self._oracle_docs.add(doc_id)
                 oracle_ids.append(doc_id)
             else:
                 device_ids.append(doc_id)

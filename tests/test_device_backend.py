@@ -260,18 +260,19 @@ class TestDeviceDocSet:
             lambda d: d.__setitem__('x', 1)))
         assert seen == ['d1']
 
-    def test_sequence_doc_migrates_to_oracle(self):
+    def test_sequence_doc_stays_on_device(self):
         list_changes = _changes_from_edits(
             lambda d: d.__setitem__('items', ['a', 'b']))
         dds = DeviceDocSet()
-        # first a map change lands on device...
         dds.apply_changes('d1', _changes_from_edits(
             lambda d: d.__setitem__('x', 1), actor_ids=['map-actor']))
-        # ...then a list change migrates the doc to the oracle
+        # a list change runs through the device sequence path, same doc
         dds.apply_changes('d1', list_changes)
         doc = dds.get_doc('d1')
         assert doc['x'] == 1
         assert list(doc['items']) == ['a', 'b']
+        assert isinstance(Frontend.get_backend_state(doc),
+                          DeviceBackend.DeviceBackendState)
 
     def test_host_backed_doc_added_via_set_doc_stays_on_oracle(self):
         """A doc created with the host backend and added via set_doc must
