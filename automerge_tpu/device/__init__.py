@@ -23,6 +23,10 @@ XLA fusion rather than per-element control flow):
 * :mod:`.engine`   — the batched document-store engine driving the kernels
 * :mod:`.backend`  — the batched device backend speaking the change/patch
   protocol (wire changes in, reference-format patches out)
+* :mod:`.blocks`   — columnar ChangeBlock/PatchBlock wire encoding + the
+  vectorized host-orchestrated bulk apply (unbounded capacities)
+* :mod:`.dense_store` — device-resident dense DocSet store: applyChanges
+  as scatter-max into HBM-resident planes (the collab-server engine)
 
 Batching model: one program, N documents — ``vmap`` over the leading doc
 axis; sharding over a device mesh is layered on top in
@@ -30,5 +34,9 @@ axis; sharding over a device mesh is layered on top in
 """
 
 from .engine import DocStore, batch_merge_docs, pick_resolve_kernel
+from .blocks import ChangeBlock, PatchBlock, BlockStore, apply_block
+from .dense_store import DenseMapStore, DensePatch
 
-__all__ = ['DocStore', 'batch_merge_docs', 'pick_resolve_kernel']
+__all__ = ['DocStore', 'batch_merge_docs', 'pick_resolve_kernel',
+           'ChangeBlock', 'PatchBlock', 'BlockStore', 'apply_block',
+           'DenseMapStore', 'DensePatch']

@@ -1,0 +1,874 @@
+"""Columnar change/patch blocks: the bulk path of the change protocol.
+
+The reference's wire protocol is per-change JSON (INTERNALS.md:142-146);
+applying C changes costs O(C) JS object churn. This module defines the
+same protocol messages in struct-of-arrays form for the bulk path — a
+:class:`ChangeBlock` is a batch of changes across MANY documents encoded
+as dense integer columns + interning tables, and a :class:`PatchBlock`
+is the corresponding batch of patches. The two encodings are losslessly
+interconvertible (:meth:`ChangeBlock.from_changes` /
+:meth:`ChangeBlock.to_changes`, :meth:`PatchBlock.to_patches`), so block
+users and dict users interoperate change-for-change.
+
+:class:`BlockStore` is the struct-of-arrays document store of SURVEY §7:
+per-field surviving entries as flat arrays (doc-major, field-grouped),
+vector clocks as sorted columnar (doc, actor, seq) rows, per-change
+transitive dependency closures as CSR. :func:`apply_block` is
+`applyChanges` for the bulk path: causal admission as vectorized
+fixed-point waves (the batch analogue of applyQueuedOps,
+op_set.js:267-283), ONE device kernel call resolving every touched field
+of every document (:mod:`.merge`), vectorized unpack back into the store,
+patches out. The only Python-level loops run over *waves* (the longest
+causal chain in the batch) and over queued/rare cross-block dependency
+rows — every per-op computation is a numpy array pass, so a million-op
+block packs in tens of milliseconds instead of tens of seconds.
+
+Scope: flat map documents (set/del on root fields) — the DocSet bulk
+merge shape of BASELINE config 5. Nested objects, links and sequences
+take the per-document path (:mod:`.backend`), which speaks the same
+change/patch protocol. One caveat vs the oracle: a change carrying TWO
+assignments to the same key (which the reference frontend never emits —
+`ensureSingleAssignment`, frontend/index.js:46) resolves to an arbitrary
+one of them here, where the oracle keeps both as a self-conflict.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common import ROOT_ID
+from ..utils.metrics import metrics
+from . import engine as _engine
+
+_SET, _DEL = 0, 1
+_ACTION_NAMES = {'set': _SET, 'del': _DEL}
+_ACTION_CODES = {v: k for k, v in _ACTION_NAMES.items()}
+
+_SEQ_BITS = 20    # seq numbers < 2^20 per actor (assert-guarded)
+
+
+def _intern(table, index, item):
+    """Intern one string/value into (list, id-dict); returns its id."""
+    i = index.get(item)
+    if i is None:
+        i = len(table)
+        index[item] = i
+        table.append(item)
+    return i
+
+
+def check_block_ranges(store, block):
+    """Composite-key range guards shared by every block consumer."""
+    if block.n_docs != store.n_docs:
+        raise ValueError(
+            f'block is for {block.n_docs} docs, store holds {store.n_docs}')
+    if block.n_changes and int(block.seq.max()) >= (1 << _SEQ_BITS):
+        raise ValueError(f'seq numbers must be < 2^{_SEQ_BITS}')
+    if store.n_docs >= (1 << 22):
+        raise ValueError('store exceeds the 4M-document key space')
+
+
+class ChangeBlock:
+    """A batch of wire changes across documents, as columns.
+
+    Change columns (length C, non-decreasing ``doc``):
+      doc     int32 — document index within the batch
+      actor   int32 — index into ``actors``
+      seq     int32
+      dep_ptr int32[C+1] — CSR over direct deps (dep_actor, dep_seq)
+    Op columns (length N, CSR over changes via ``op_ptr``):
+      action  int8  — 0 set, 1 del
+      key     int32 — index into ``keys``
+      value   int32 — index into ``values`` (-1 for del)
+    Tables: ``actors`` (strings), ``keys`` (strings), ``values`` (host
+    JSON values; never shipped to the device — ops reference them by row
+    and winners map back on unpack).
+    """
+
+    __slots__ = ('n_docs', 'doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
+                 'dep_seq', 'op_ptr', 'action', 'key', 'value',
+                 'actors', 'keys', 'values')
+
+    def __init__(self, n_docs, doc, actor, seq, dep_ptr, dep_actor, dep_seq,
+                 op_ptr, action, key, value, actors, keys, values):
+        if len(doc) and (np.diff(doc) < 0).any():
+            order = np.argsort(doc, kind='stable')
+            dep_ptr, (dep_actor, dep_seq) = _csr_take(
+                dep_ptr, order, (dep_actor, dep_seq))
+            op_ptr, (action, key, value) = _csr_take(
+                op_ptr, order, (action, key, value))
+            doc, actor, seq = doc[order], actor[order], seq[order]
+        self.n_docs = n_docs
+        self.doc = doc
+        self.actor = actor
+        self.seq = seq
+        self.dep_ptr = dep_ptr
+        self.dep_actor = dep_actor
+        self.dep_seq = dep_seq
+        self.op_ptr = op_ptr
+        self.action = action
+        self.key = key
+        self.value = value
+        self.actors = actors
+        self.keys = keys
+        self.values = values
+
+    @property
+    def n_changes(self):
+        return len(self.doc)
+
+    @property
+    def n_ops(self):
+        return len(self.action)
+
+    @classmethod
+    def from_changes(cls, changes_per_doc):
+        """Encode per-document dict changes (the JSON wire format) into one
+        block. O(total ops) Python — the compatibility edge, not the bulk
+        path."""
+        actors, actor_of = [], {}
+        keys, key_of = [], {}
+        values = []
+        doc, actor, seq = [], [], []
+        dep_ptr, dep_actor, dep_seq = [0], [], []
+        op_ptr, action, key, value = [0], [], [], []
+
+        for d, changes in enumerate(changes_per_doc):
+            for change in changes:
+                doc.append(d)
+                actor.append(_intern(actors, actor_of, change['actor']))
+                seq.append(change['seq'])
+                for da, ds in sorted(change['deps'].items()):
+                    dep_actor.append(_intern(actors, actor_of, da))
+                    dep_seq.append(ds)
+                dep_ptr.append(len(dep_actor))
+                for op in change['ops']:
+                    if op['action'] not in _ACTION_NAMES:
+                        raise ValueError(
+                            f"block path supports set/del ops only, got "
+                            f"{op['action']!r} (use the per-document path)")
+                    if op['obj'] != ROOT_ID:
+                        raise ValueError(
+                            'block path supports root-map fields only '
+                            '(use the per-document path)')
+                    action.append(_ACTION_NAMES[op['action']])
+                    key.append(_intern(keys, key_of, op['key']))
+                    if op['action'] == 'set':
+                        value.append(len(values))
+                        values.append(op.get('value'))
+                    else:
+                        value.append(-1)
+                op_ptr.append(len(action))
+
+        return cls(len(changes_per_doc),
+                   np.asarray(doc, np.int32), np.asarray(actor, np.int32),
+                   np.asarray(seq, np.int32),
+                   np.asarray(dep_ptr, np.int32),
+                   np.asarray(dep_actor, np.int32),
+                   np.asarray(dep_seq, np.int32),
+                   np.asarray(op_ptr, np.int32),
+                   np.asarray(action, np.int8), np.asarray(key, np.int32),
+                   np.asarray(value, np.int32), actors, keys, values)
+
+    def to_changes(self):
+        """Decode back to per-document dict change lists (lossless)."""
+        out = [[] for _ in range(self.n_docs)]
+        for c in range(self.n_changes):
+            out[self.doc[c]].append(self.change_dict(c))
+        return out
+
+    def change_dict(self, c):
+        """One change row as a reference-format dict."""
+        deps = {self.actors[self.dep_actor[j]]: int(self.dep_seq[j])
+                for j in range(self.dep_ptr[c], self.dep_ptr[c + 1])}
+        ops = []
+        for j in range(self.op_ptr[c], self.op_ptr[c + 1]):
+            op = {'action': _ACTION_CODES[int(self.action[j])],
+                  'obj': ROOT_ID, 'key': self.keys[self.key[j]]}
+            if self.action[j] == _SET:
+                op['value'] = self.values[self.value[j]]
+            ops.append(op)
+        return {'actor': self.actors[self.actor[c]],
+                'seq': int(self.seq[c]), 'deps': deps, 'ops': ops}
+
+
+def _csr_take(ptr, rows, payloads):
+    """Gather CSR rows (returns new ptr + payload arrays)."""
+    counts = np.diff(ptr)[rows]
+    new_ptr = np.zeros(len(rows) + 1, np.int32)
+    np.cumsum(counts, out=new_ptr[1:])
+    idx = _span_indices(ptr[rows], counts)
+    return new_ptr, tuple(p[idx] for p in payloads)
+
+
+def _span_indices(starts, counts):
+    """Concatenated [s, s+c) ranges, vectorized."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    ends = np.cumsum(counts)
+    pos = np.arange(total) - np.repeat(ends - counts, counts)
+    return np.repeat(starts.astype(np.int64), counts) + pos
+
+
+class PatchBlock:
+    """A batch of patches (one per document), as columns.
+
+    Field columns (length F, doc-major, sorted by (doc, key id)):
+    ``f_doc``/``f_key``/``f_action`` (0 set, 1 remove)/``f_value`` (store
+    value row, -1 for remove) plus winner actor ``f_actor``. The surviving
+    non-winner entries (the conflicts, op_set.js:95-103) live in the
+    entry columns ``s_field``/``s_actor``/``s_value``, grouped by field
+    via ``s_ptr``. ``diffs``/``to_patches`` materialize reference-format
+    dicts per document."""
+
+    __slots__ = ('n_docs', 'f_ptr', 'f_doc', 'f_key', 'f_action', 'f_value',
+                 'f_actor', 's_ptr', 's_actor', 's_value',
+                 'keys', 'values', 'actors', 'c_doc', 'c_actor', 'c_seq')
+
+    def __init__(self, n_docs, f_ptr, f_doc, f_key, f_action, f_value,
+                 f_actor, s_ptr, s_actor, s_value, keys, values, actors,
+                 c_doc, c_actor, c_seq):
+        self.n_docs = n_docs
+        self.f_ptr = f_ptr
+        self.f_doc = f_doc
+        self.f_key = f_key
+        self.f_action = f_action
+        self.f_value = f_value
+        self.f_actor = f_actor
+        self.s_ptr = s_ptr
+        self.s_actor = s_actor
+        self.s_value = s_value
+        self.keys = keys
+        self.values = values
+        self.actors = actors
+        self.c_doc = c_doc      # clock snapshot rows (doc-sorted)
+        self.c_actor = c_actor
+        self.c_seq = c_seq
+
+    @property
+    def n_fields(self):
+        return len(self.f_doc)
+
+    def clock_of(self, d):
+        lo, hi = np.searchsorted(self.c_doc, [d, d + 1])
+        return {self.actors[self.c_actor[j]]: int(self.c_seq[j])
+                for j in range(lo, hi)}
+
+    def diffs(self, d):
+        """Reference-format diff list for one document."""
+        out = []
+        for f in range(self.f_ptr[d], self.f_ptr[d + 1]):
+            key = self.keys[self.f_key[f]]
+            if self.f_action[f] == _DEL:
+                out.append({'action': 'remove', 'type': 'map',
+                            'obj': ROOT_ID, 'key': key, 'path': []})
+                continue
+            edit = {'action': 'set', 'type': 'map', 'obj': ROOT_ID,
+                    'key': key, 'path': [],
+                    'value': self.values[self.f_value[f]]}
+            lo, hi = self.s_ptr[f], self.s_ptr[f + 1]
+            losers = [(self.actors[self.s_actor[j]],
+                       self.values[self.s_value[j]]
+                       if self.s_value[j] >= 0 else None)
+                      for j in range(lo, hi)]
+            losers.sort(reverse=True)    # actor-descending (op_set.js:211)
+            if losers:
+                edit['conflicts'] = [{'actor': a, 'value': v}
+                                     for a, v in losers]
+            out.append(edit)
+        return out
+
+    def patch(self, d):
+        clock = self.clock_of(d)
+        return {'clock': clock, 'deps': dict(clock), 'canUndo': False,
+                'canRedo': False, 'diffs': self.diffs(d)}
+
+    def to_patches(self):
+        return [self.patch(d) for d in range(self.n_docs)]
+
+
+class BlockStore:
+    """Struct-of-arrays state for a batch of flat map documents.
+
+    The SURVEY §7 store. Entry columns are doc-major and field-grouped
+    (sorted by compact field key), so prior entries of touched fields
+    gather with boolean masks — no per-apply sorting of untouched state.
+    Mutated in place by :func:`apply_block`; durability comes from the
+    change log, exactly like the reference's save().
+    """
+
+    def __init__(self, n_docs):
+        self.n_docs = n_docs
+        self.actors = []                      # store actor table (strings)
+        self.actor_of = {}
+        self.keys = []                        # store key table (strings)
+        self.key_of = {}
+        self.values = []                      # host value store
+        z32 = np.zeros(0, np.int32)
+        # survivor entries (unordered; membership via compact field keys):
+        self.e_doc = z32
+        self.e_key = z32
+        self.e_actor = z32                    # store actor id
+        self.e_seq = z32
+        self.e_value = z32                    # store value row (-1: none)
+        self.e_change = z32                   # change-log row (closure ref)
+        # vector clocks: rows sorted by (doc << 32 | actor)
+        self.c_doc = z32
+        self.c_actor = z32
+        self.c_seq = z32
+        # applied-change log (append order) + closure CSR per change;
+        # l_order keeps a sorted view over l_key for lookups
+        self.l_key = np.zeros(0, np.int64)
+        self.l_order = np.zeros(0, np.int64)
+        self.l_dep_ptr = np.zeros(1, np.int32)
+        self.l_dep_actor = z32
+        self.l_dep_seq = z32
+        self.queue = []                       # [(doc, change dict)] buffered
+        self.history = []                     # applied (block, admitted) log
+        self._str_rank_cache = (0, None, None)
+
+    # -- interning / lookup helpers -----------------------------------------
+
+    def intern(self, items, table, index):
+        out = np.empty(len(items), np.int32)
+        for i, s in enumerate(items):
+            out[i] = _intern(table, index, s)
+        return out
+
+    def actor_str_ranks(self):
+        """store actor id -> rank in string order (cached per table size).
+        Conflict resolution sorts by actor string (op_set.js:211); device
+        ranks must preserve that order."""
+        n = len(self.actors)
+        if self._str_rank_cache[0] != n:
+            order = np.argsort(np.asarray(self.actors, dtype=object))
+            rank = np.empty(n, np.int64)
+            rank[order] = np.arange(n)
+            self._str_rank_cache = (n, rank, order.astype(np.int32))
+        return self._str_rank_cache[1]
+
+    def actor_by_rank(self):
+        self.actor_str_ranks()
+        return self._str_rank_cache[2]       # string rank -> store actor id
+
+    def change_key(self, doc, actor, seq):
+        """Composite int64 key for (doc, actor, seq) rows."""
+        assert len(self.actors) < (1 << 21), 'actor table exceeds key space'
+        return (((doc.astype(np.int64) << 21) | actor) << _SEQ_BITS) | seq
+
+    def clock_lookup(self, doc, actor):
+        """Applied seq per (doc, actor) pair — vectorized."""
+        if len(self.c_doc) == 0 or len(doc) == 0:
+            return np.zeros(len(doc), np.int32)
+        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        probe = (doc.astype(np.int64) << 32) | actor
+        pos = np.minimum(np.searchsorted(table, probe), len(table) - 1)
+        return np.where(table[pos] == probe, self.c_seq[pos], 0) \
+            .astype(np.int32)
+
+    def clock_merge(self, doc, actor, seq):
+        """Scatter-max (doc, actor, seq) rows into the sorted clock table."""
+        if len(doc) == 0:
+            return
+        key_new = (doc.astype(np.int64) << 32) | actor
+        order = np.argsort(key_new, kind='stable')
+        key_new, seq = key_new[order], seq[order]
+        # max seq per distinct key (segmented max over equal-key runs)
+        seg_start = np.concatenate([[True], key_new[1:] != key_new[:-1]])
+        seg_id = np.cumsum(seg_start) - 1
+        seg_max = np.zeros(seg_id[-1] + 1, seq.dtype)
+        np.maximum.at(seg_max, seg_id, seq)
+        key_new = key_new[seg_start]
+        seq = seg_max
+        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        pos = np.minimum(np.searchsorted(table, key_new),
+                         max(len(table) - 1, 0))
+        hit = (table[pos] == key_new) if len(table) else \
+            np.zeros(len(key_new), bool)
+        if hit.any():
+            np.maximum.at(self.c_seq, pos[hit], seq[hit])
+        if (~hit).any():
+            all_key = np.concatenate([table, key_new[~hit]])
+            all_seq = np.concatenate([self.c_seq, seq[~hit]])
+            order = np.argsort(all_key, kind='stable')
+            all_key, all_seq = all_key[order], all_seq[order]
+            self.c_doc = (all_key >> 32).astype(np.int32)
+            self.c_actor = (all_key & 0xFFFFFFFF).astype(np.int32)
+            self.c_seq = all_seq.astype(np.int32)
+
+    def clock_of(self, d):
+        lo, hi = np.searchsorted(self.c_doc, [d, d + 1])
+        return {self.actors[self.c_actor[j]]: int(self.c_seq[j])
+                for j in range(lo, hi) if self.c_seq[j] > 0}
+
+    def doc_fields(self, d):
+        """{key: [(actor, value), ...] winner first (actor-descending)}
+        for one document — the test/inspection surface."""
+        out = {}
+        for j in np.flatnonzero(self.e_doc == d):
+            key = self.keys[self.e_key[j]]
+            out.setdefault(key, []).append(
+                (self.actors[self.e_actor[j]],
+                 self.values[self.e_value[j]] if self.e_value[j] >= 0
+                 else None))
+        return {k: sorted(v, key=lambda t: t[0], reverse=True)
+                for k, v in out.items()}
+
+    def get_missing_deps(self):
+        """Unmet deps of buffered changes (op_set.js:347-358)."""
+        missing = {}
+        for d, change in self.queue:
+            deps = dict(change['deps'])
+            deps[change['actor']] = change['seq'] - 1
+            clock = self.clock_of(d)
+            for a, s in deps.items():
+                if clock.get(a, 0) < s:
+                    missing[a] = max(s, missing.get(a, 0))
+        return missing
+
+
+def init_store(n_docs):
+    return BlockStore(n_docs)
+
+
+# -- per-doc local actor coordinates -----------------------------------------
+
+class _LocalActors:
+    """Per-document actor slots, ordered by actor STRING rank within each
+    document — the rank order the conflict kernel relies on
+    (op_set.js:211). Built once per apply from every (doc, actor) pair
+    that can appear in a clock row."""
+
+    def __init__(self, store, pair_doc, pair_actor):
+        self.str_rank = store.actor_str_ranks()
+        by_rank = store.actor_by_rank()
+        key = (pair_doc.astype(np.int64) << 32) | self.str_rank[pair_actor]
+        self.key = np.unique(key)
+        la_doc = (self.key >> 32).astype(np.int32)
+        self.store_id = by_rank[(self.key & 0xFFFFFFFF).astype(np.int64)]
+        self.doc_start = np.searchsorted(
+            la_doc, np.arange(store.n_docs + 1)).astype(np.int64)
+        self.local = np.arange(len(self.key), dtype=np.int32) - \
+            self.doc_start[la_doc].astype(np.int32)
+        self.width = int(np.diff(self.doc_start).max()) \
+            if len(self.key) else 1
+
+    def local_of(self, doc, store_actor):
+        """Local slot per (doc, store actor) pair — pairs must be in the
+        universe (guaranteed by construction)."""
+        key = (doc.astype(np.int64) << 32) | self.str_rank[store_actor]
+        return self.local[np.searchsorted(self.key, key)]
+
+    def store_of(self, doc, local):
+        return self.store_id[self.doc_start[doc] + local]
+
+
+# -- vectorized causal admission ---------------------------------------------
+
+def _admit_block(store, block, b_actor, dep_actor_store, la):
+    """Fixed-point causal delivery over the whole block (vectorized waves).
+
+    Returns (admitted mask, leftover mask, R) where R[c] is the dense
+    [C, A_loc] transitive-deps clock of change c in doc-local actor
+    coordinates — the batch analogue of the oracle's per-change
+    ``all_deps`` (op_set.js:29-37). Updates the store clock and change
+    log. Duplicate changes — seq already applied, or a second copy of
+    the same (doc, actor, seq) within the block — are dropped (without
+    the oracle's content-equality verification).
+    """
+    C = block.n_changes
+    doc, seq = block.doc, block.seq
+    a_pad = max(la.width, 1)
+    R = np.zeros((C, a_pad), np.int32)
+
+    in_key = store.change_key(doc, b_actor, seq)
+    in_order = np.argsort(in_key, kind='stable')
+    in_sorted = in_key[in_order]
+    log_sorted = store.l_key[store.l_order]     # stable during admission
+
+    dep_change = np.repeat(np.arange(C, dtype=np.int64),
+                           np.diff(block.dep_ptr))
+    dep_seq = block.dep_seq
+    b_local = la.local_of(doc, b_actor)
+    dep_local = la.local_of(doc[dep_change], dep_actor_store)
+    dep_key = store.change_key(doc[dep_change], dep_actor_store, dep_seq)
+
+    def closure_from(sources_key, targets):
+        """Accumulate stored/in-block closures of dep changes into R rows.
+
+        sources_key: composite change key of the dependency; targets: R row
+        to accumulate into. In-block sources read R (same doc => same local
+        coords); prior-block sources read the store log CSR.
+        """
+        if len(sources_key) == 0:
+            return
+        pos = np.minimum(np.searchsorted(in_sorted, sources_key),
+                         max(C - 1, 0))
+        src = in_order[pos]
+        in_hit = (in_sorted[pos] == sources_key) if C else \
+            np.zeros(len(sources_key), bool)
+        in_hit = in_hit & admitted[src]
+        if in_hit.any():
+            np.maximum.at(R, targets[in_hit], R[src[in_hit]])
+        rest = ~in_hit
+        if rest.any() and len(log_sorted):
+            lpos = np.minimum(np.searchsorted(log_sorted,
+                                              sources_key[rest]),
+                              len(log_sorted) - 1)
+            lhit = log_sorted[lpos] == sources_key[rest]
+            rows = store.l_order[lpos[lhit]]
+            tgt = targets[rest][lhit]
+            counts = store.l_dep_ptr[rows + 1] - store.l_dep_ptr[rows]
+            if counts.sum():
+                idx = _span_indices(store.l_dep_ptr[rows], counts)
+                tgt_rep = np.repeat(tgt, counts)
+                cols = la.local_of(doc[tgt_rep],
+                                   store.l_dep_actor[idx])
+                np.maximum.at(R, (tgt_rep, cols), store.l_dep_seq[idx])
+
+    duplicate = store.clock_lookup(doc, b_actor) >= seq
+    # in-block duplicates: keep only the first row per (doc, actor, seq)
+    if C:
+        dup_sorted = np.zeros(C, bool)
+        dup_sorted[1:] = in_sorted[1:] == in_sorted[:-1]
+        duplicate[in_order[dup_sorted]] = True
+    pending = ~duplicate
+    admitted = np.zeros(C, bool)
+
+    while True:                      # terminates: pending shrinks per wave
+        if not pending.any():
+            break
+        own_prev = store.clock_lookup(doc, b_actor)
+        chain_ok = seq == own_prev + 1
+        dep_ok = np.ones(C, bool)
+        if len(dep_change):
+            dep_have = store.clock_lookup(doc[dep_change], dep_actor_store)
+            np.logical_and.at(dep_ok, dep_change, dep_have >= dep_seq)
+        ready = pending & chain_ok & dep_ok
+        if not ready.any():
+            break
+
+        # transitive closure: dep closures + the deps themselves ...
+        rdep = ready[dep_change] if len(dep_change) else \
+            np.zeros(0, bool)
+        if rdep.any():
+            dc = dep_change[rdep]
+            closure_from(dep_key[rdep], dc)
+            np.maximum.at(R, (dc, dep_local[rdep]), dep_seq[rdep])
+        # ... and the actor's own previous change (base_deps[actor]=seq-1)
+        rows = np.flatnonzero(ready)
+        prev = seq[rows] - 1
+        has_prev = prev > 0
+        if has_prev.any():
+            pr = rows[has_prev]
+            closure_from(store.change_key(doc[pr], b_actor[pr],
+                                          prev[has_prev]), pr)
+            np.maximum.at(R, (pr, b_local[pr]), prev[has_prev])
+
+        admitted |= ready
+        pending &= ~ready
+        store.clock_merge(doc[ready], b_actor[ready], seq[ready])
+
+    cmap = _log_append(store, in_key, admitted, R, doc, la)
+    return admitted, pending, R, cmap
+
+
+def _log_append(store, in_key, admitted, R, doc, la):
+    """Append admitted changes + closures to the change log (append-order
+    rows, sorted view refreshed). Returns cmap: block change row -> log
+    row id (-1 for non-admitted)."""
+    adm = np.flatnonzero(admitted)
+    cmap = np.full(len(admitted), -1, np.int64)
+    if not len(adm):
+        return cmap
+    base = len(store.l_key)
+    cmap[adm] = base + np.arange(len(adm))
+    Radm = R[adm]
+    nz_r, nz_c = np.nonzero(Radm)
+    ptr_new = np.zeros(len(adm), np.int32)
+    counts = np.bincount(nz_r, minlength=len(adm)).astype(np.int32)
+    np.cumsum(counts, out=ptr_new)
+    la_actor = la.store_of(doc[adm[nz_r]], nz_c).astype(np.int32)
+    la_seq = Radm[nz_r, nz_c]
+    store.l_key = np.concatenate([store.l_key, in_key[adm]])
+    store.l_dep_ptr = np.concatenate([
+        store.l_dep_ptr, store.l_dep_ptr[-1] + ptr_new])
+    store.l_dep_actor = np.concatenate([store.l_dep_actor, la_actor])
+    store.l_dep_seq = np.concatenate([store.l_dep_seq, la_seq])
+    store.l_order = np.argsort(store.l_key, kind='stable')
+    return cmap
+
+
+def _merge_queued(block, queue):
+    """Fold buffered dict changes into an incoming block (small path)."""
+    actors = list(block.actors)
+    actor_of = {a: i for i, a in enumerate(actors)}
+    keys = list(block.keys)
+    key_of = {k: i for i, k in enumerate(keys)}
+    values = list(block.values)
+
+    doc, actor, seq = [], [], []
+    dep_ptr = [int(block.dep_ptr[-1])]
+    dep_actor, dep_seq = [], []
+    op_ptr = [int(block.op_ptr[-1])]
+    action, key, value = [], [], []
+    for d, change in queue:
+        doc.append(d)
+        actor.append(_intern(actors, actor_of, change['actor']))
+        seq.append(change['seq'])
+        for da, ds in sorted(change['deps'].items()):
+            dep_actor.append(_intern(actors, actor_of, da))
+            dep_seq.append(ds)
+        dep_ptr.append(dep_ptr[0] + len(dep_actor))
+        for op in change['ops']:
+            action.append(_ACTION_NAMES[op['action']])
+            key.append(_intern(keys, key_of, op['key']))
+            if op['action'] == 'set':
+                value.append(len(values))
+                values.append(op.get('value'))
+            else:
+                value.append(-1)
+        op_ptr.append(op_ptr[0] + len(action))
+
+    return ChangeBlock(
+        block.n_docs,
+        np.concatenate([block.doc, np.asarray(doc, np.int32)]),
+        np.concatenate([block.actor, np.asarray(actor, np.int32)]),
+        np.concatenate([block.seq, np.asarray(seq, np.int32)]),
+        np.concatenate([block.dep_ptr,
+                        np.asarray(dep_ptr[1:], np.int32)]),
+        np.concatenate([block.dep_actor, np.asarray(dep_actor, np.int32)]),
+        np.concatenate([block.dep_seq, np.asarray(dep_seq, np.int32)]),
+        np.concatenate([block.op_ptr, np.asarray(op_ptr[1:], np.int32)]),
+        np.concatenate([block.action, np.asarray(action, np.int8)]),
+        np.concatenate([block.key, np.asarray(key, np.int32)]),
+        np.concatenate([block.value, np.asarray(value, np.int32)]),
+        actors, keys, values)
+
+
+# -- apply: pack -> resolve -> unpack ----------------------------------------
+
+def apply_block(store, block, options=None, return_timing=False):
+    """`applyChanges` for the bulk path: ONE device resolution for every
+    touched field of every document in the block.
+
+    Mutates `store`; returns a :class:`PatchBlock` (or (patches, timing)
+    with ``return_timing``). Duplicate changes are dropped; causally
+    unready changes are buffered in ``store.queue`` (retried on the next
+    apply; ``store.get_missing_deps()`` reports the gaps) — the block
+    analogue of op_set.js:267-283, 347-358.
+    """
+    import time
+    opts = _engine.as_options(options)
+    check_block_ranges(store, block)
+
+    if store.queue:
+        block = _merge_queued(block, store.queue)
+        store.queue = []
+
+    t0 = time.perf_counter()
+    # interning: block tables -> store tables
+    a_tab = store.intern(block.actors, store.actors, store.actor_of)
+    k_tab = store.intern(block.keys, store.keys, store.key_of)
+    v_base = len(store.values)
+    store.values.extend(block.values)
+
+    z32 = np.zeros(0, np.int32)
+    b_actor = a_tab[block.actor] if block.n_changes else z32
+    dep_actor_store = a_tab[block.dep_actor] if len(block.dep_actor) else z32
+
+    # per-doc local actor universe: change + dep + already-applied actors
+    dep_doc = np.repeat(block.doc, np.diff(block.dep_ptr))
+    la = _LocalActors(store,
+                      np.concatenate([block.doc, dep_doc, store.c_doc]),
+                      np.concatenate([b_actor, dep_actor_store,
+                                      store.c_actor]))
+
+    admitted, leftover, R, cmap = _admit_block(store, block, b_actor,
+                                               dep_actor_store, la)
+    for c in np.flatnonzero(leftover):
+        store.queue.append((int(block.doc[c]), block.change_dict(c)))
+    t1 = time.perf_counter()
+
+    # ---- pack: admitted ops + prior entries of touched fields ----
+    C = block.n_changes
+    D = store.n_docs
+    op_change = np.repeat(np.arange(C, dtype=np.int64),
+                          np.diff(block.op_ptr))
+    keep = admitted[op_change] if C else np.zeros(0, bool)
+    oc = op_change[keep]
+    o_doc = block.doc[oc]
+    o_actor = b_actor[oc]
+    o_seq = block.seq[oc]
+    o_action = block.action[keep]
+    o_key = k_tab[block.key[keep]] if keep.any() else z32
+    o_val = block.value[keep]
+    o_value = np.where(o_val >= 0, o_val + v_base, -1).astype(np.int32)
+
+    if len(o_doc) == 0:
+        empty = PatchBlock(
+            D, np.zeros(D + 1, np.int32), z32, z32,
+            np.zeros(0, np.int8), z32, z32, np.zeros(1, np.int32), z32, z32,
+            store.keys, store.values, store.actors,
+            store.c_doc.copy(), store.c_actor.copy(), store.c_seq.copy())
+        return (empty, {'admit': t1 - t0, 'pack': 0.0, 'device': 0.0,
+                        'unpack': 0.0}) if return_timing else empty
+
+    K = max(len(store.keys), 1)
+    fk_new = o_doc.astype(np.int64) * K + o_key
+    e_fk = store.e_doc.astype(np.int64) * K + store.e_key
+    if D * K <= (1 << 27):
+        present = np.zeros(D * K, bool)
+        present[fk_new] = True
+        touched_fk = np.flatnonzero(present)           # sorted
+        seg_of = np.full(D * K, -1, np.int64)
+        seg_of[touched_fk] = np.arange(len(touched_fk))
+        seg_new = seg_of[fk_new]
+        prior_mask = present[e_fk] if len(e_fk) else np.zeros(0, bool)
+        prior_rows = np.flatnonzero(prior_mask)
+        seg_prior = seg_of[e_fk[prior_rows]]
+    else:
+        touched_fk, seg_new = np.unique(fk_new, return_inverse=True)
+        if len(e_fk):
+            pos = np.minimum(np.searchsorted(touched_fk, e_fk),
+                             len(touched_fk) - 1)
+            prior_mask = touched_fk[pos] == e_fk
+            prior_rows = np.flatnonzero(prior_mask)
+            seg_prior = pos[prior_rows]
+        else:
+            prior_mask = np.zeros(0, bool)
+            prior_rows = np.zeros(0, np.int64)
+            seg_prior = np.zeros(0, np.int64)
+    F = len(touched_fk)
+    f_doc = (touched_fk // K).astype(np.int32)
+    f_key = (touched_fk % K).astype(np.int32)
+    f_doc_start = np.searchsorted(f_doc, np.arange(D + 1)).astype(np.int64)
+    S = opts.pad_segments(F)
+
+    # flat segmented layout: no per-doc slots — the kernel reduces over
+    # GLOBAL field segments, so packing is pure concatenation + padding
+    p_doc = store.e_doc[prior_rows]
+    n_new, n_prior = len(o_doc), len(prior_rows)
+    n_rows = n_new + n_prior
+    n_pad = opts.pad_ops(n_rows)
+    A = opts.pad_actors(max(la.width, 1))
+
+    def padded(new_vals, prior_vals, dtype):
+        out = np.zeros(n_pad, dtype)
+        out[:n_new] = new_vals
+        out[n_new:n_rows] = prior_vals
+        return out
+
+    # per-op local actor ranks: computed per CHANGE for new ops (cheap),
+    # per entry for priors
+    rank_of_change = la.local_of(block.doc, b_actor) if C else z32
+    seg_arr = padded(seg_new, seg_prior, np.int32)
+    actor_arr = padded(rank_of_change[oc],
+                       la.local_of(p_doc, store.e_actor[prior_rows]),
+                       np.int32)
+    seq_arr = padded(o_seq, store.e_seq[prior_rows], np.int32)
+    del_arr = padded(o_action == _DEL, np.zeros(n_prior, bool), bool)
+    valid_arr = np.zeros(n_pad, bool)
+    valid_arr[:n_rows] = True
+    # Clock rows: all-zero whenever every admitted change is wave-1
+    # concurrent (no deps, seq 1) and no prior entries carry closures —
+    # then the zeros are materialized ON DEVICE instead of shipping an
+    # [n_pad, A] zero plane over PCIe.
+    prior_nnz = 0
+    if n_prior:
+        e_log = store.e_change[prior_rows]
+        prior_counts = (store.l_dep_ptr[e_log + 1]
+                        - store.l_dep_ptr[e_log])
+        prior_nnz = int(prior_counts.sum())
+    r_any = bool(R.any())
+    if r_any or prior_nnz:
+        clock_arr = np.zeros((n_pad, A), np.int32)
+        if r_any:
+            new_clocks = R[oc]
+            clock_arr[:n_new, :new_clocks.shape[1]] = new_clocks
+        if prior_nnz:
+            idx = _span_indices(store.l_dep_ptr[e_log], prior_counts)
+            rows_rep = np.repeat(np.arange(n_new, n_rows), prior_counts)
+            doc_rep = np.repeat(p_doc, prior_counts)
+            clock_arr[rows_rep,
+                      la.local_of(doc_rep, store.l_dep_actor[idx])] = \
+                store.l_dep_seq[idx]
+        clock_dev = jnp.asarray(clock_arr)
+    else:
+        clock_dev = jnp.zeros((n_pad, A), jnp.int32)
+    t2 = time.perf_counter()
+
+    from .merge import resolve_assignments
+    if opts.kernel == 'pallas':
+        raise ValueError('the block path runs the flat XLA resolve kernel; '
+                         'kernel="pallas" applies to the per-document path')
+    out = resolve_assignments(
+        jnp.asarray(seg_arr), jnp.asarray(actor_arr), jnp.asarray(seq_arr),
+        clock_dev, jnp.asarray(del_arr),
+        jnp.asarray(valid_arr), num_segments=S)
+    surviving = np.asarray(out['surviving'])[:n_rows]
+    w_row = np.asarray(out['winner'])[:F]          # flat row id, -1 if none
+    t3 = time.perf_counter()
+
+    # ---- unpack: patch block + store update ----
+    r_value = np.concatenate([o_value, store.e_value[prior_rows]])
+    r_actor_store = np.concatenate([o_actor, store.e_actor[prior_rows]])
+    has_winner = w_row >= 0
+    w_safe = np.maximum(w_row, 0)
+    f_action = np.where(has_winner, _SET, _DEL).astype(np.int8)
+    f_value = np.where(has_winner, r_value[w_safe], -1).astype(np.int32)
+    f_actor = np.where(has_winner, r_actor_store[w_safe], -1) \
+        .astype(np.int32)
+
+    # conflicts: surviving losers grouped by field (radix argsort on the
+    # int32 segment ids keeps this O(n))
+    s_rows = np.flatnonzero(surviving)
+    r_seg = seg_arr[:n_rows]
+    ent_is_loser = s_rows != w_row[r_seg[s_rows]]
+    loser_rows = s_rows[ent_is_loser]
+    loser_rows = loser_rows[np.argsort(r_seg[loser_rows], kind='stable')]
+    s_counts = np.bincount(r_seg[loser_rows], minlength=F) if F else \
+        np.zeros(0, np.int64)
+    s_ptr = np.zeros(F + 1, np.int32)
+    np.cumsum(s_counts, out=s_ptr[1:])
+
+    patches = PatchBlock(
+        D, f_doc_start.astype(np.int32), f_doc, f_key, f_action, f_value,
+        f_actor, s_ptr, r_actor_store[loser_rows], r_value[loser_rows],
+        store.keys, store.values, store.actors,
+        store.c_doc.copy(), store.c_actor.copy(), store.c_seq.copy())
+
+    _store_update(
+        store, prior_mask, s_rows,
+        np.concatenate([o_doc, p_doc]),
+        np.concatenate([o_key, store.e_key[prior_rows]]),
+        r_actor_store,
+        np.concatenate([o_seq, store.e_seq[prior_rows]]),
+        r_value,
+        np.concatenate([cmap[oc].astype(np.int32),
+                        store.e_change[prior_rows]]))
+    t4 = time.perf_counter()
+
+    metrics.bump('block_batches')
+    metrics.bump('block_ops', n_new)
+    metrics.set_gauge('block_batch_occupancy', n_rows / max(n_pad, 1))
+    if return_timing:
+        return patches, {'admit': t1 - t0, 'pack': t2 - t1,
+                         'device': t3 - t2, 'unpack': t4 - t3}
+    return patches
+
+
+def _store_update(store, prior_mask, s_rows, r_doc, r_key, r_actor, r_seq,
+                  r_value, r_change):
+    """Replace touched fields' entries with the surviving rows. Entries
+    are unordered; closures live in the change log (``e_change`` refs),
+    so the update is mask + concatenate — no scatters, no CSR copies."""
+    keep = ~prior_mask if len(prior_mask) else np.zeros(0, bool)
+    store.e_doc = np.concatenate([store.e_doc[keep], r_doc[s_rows]])
+    store.e_key = np.concatenate([store.e_key[keep], r_key[s_rows]])
+    store.e_actor = np.concatenate([store.e_actor[keep], r_actor[s_rows]])
+    store.e_seq = np.concatenate([store.e_seq[keep], r_seq[s_rows]])
+    store.e_value = np.concatenate([store.e_value[keep], r_value[s_rows]])
+    store.e_change = np.concatenate([store.e_change[keep],
+                                     r_change[s_rows]])

@@ -1,0 +1,332 @@
+"""Device-resident dense document store: the collab-server engine.
+
+This is the SURVEY §7 architecture taken to its conclusion: the CRDT
+state of a whole DocSet lives in HBM as dense planes, and `applyChanges`
+for a million-op block is a handful of scatter-max ops — no host-side
+state walk at all. Wire traffic per apply is the compressed change
+columns in (a few bytes per op), and patches come back as device arrays
+with lazy host materialization.
+
+Representation. For flat map documents, a field's CRDT state is at most
+one surviving assignment per actor (same-actor ops on one field are
+always causally ordered, so the later one supersedes —
+op_set.js:180-219). That makes the whole store dense (all int32 — the
+TPU VPU's native lane width; no x64 anywhere):
+
+* ``ESeqDel[f, a]`` — ``(seq << 1) | is_del``: actor `a`'s latest
+  assignment to field `f` (0 = none). Applying an op is one scatter-max:
+  a later seq always wins.
+* ``EVal[f, a]`` — the value ref of that assignment, kept consistent
+  with ESeqDel by resetting every updated cell and re-scattering the
+  ops that achieved the new maximum.
+* ``M[f, a]`` — the running max over *every* applied op's transitive
+  closure clock. Supersession needs ``max over ops j on f of
+  clock_j[a]``; a superseding op's closure contains its victim's
+  closure, so the max over live ops equals the max over all ops ever
+  applied — M can accumulate monotonically (scatter-max, no removal).
+
+An entry (f, a) is **alive** iff ``seq > 0`` and ``M[f, a] < seq`` (not
+superseded) and not a delete; the winner is the alive entry with the
+highest actor string rank (op_set.js:211), the rest are the conflicts.
+
+Causal admission (vector-clock waves) and string interning stay on the
+host (:mod:`.blocks`); everything per-op runs on device. Capacities
+(docs, keys, actor slots) are fixed at construction — the price of dense
+addressing — with clear errors on overflow; the general unbounded path
+is :func:`automerge_tpu.device.blocks.apply_block`.
+
+Same caveat as the block path: two assignments to the same key within
+one change (never emitted by the reference frontend —
+`ensureSingleAssignment`, frontend/index.js:46) resolve to one of them.
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import metrics
+from . import blocks as _blocks
+from .blocks import _SET, _DEL
+
+_VAL_NONE = np.int32(-2147483648)      # "no value" sentinel for EVal
+
+
+@partial(jax.jit, static_argnames=('n_fields', 'n_actors'))
+def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
+                  change_clock, op_counts, op_key, op_isdel, op_value,
+                  n_ops, key_capacity, *, n_fields, n_actors):
+    """One block apply: expand change columns to op rows ON DEVICE, then
+    scatter-maxes into the resident planes."""
+    n_pad = op_key.shape[0]
+    c_pad = change_doc.shape[0]
+    op_change = jnp.repeat(jnp.arange(c_pad, dtype=jnp.int32), op_counts,
+                           total_repeat_length=n_pad)
+    valid = jnp.arange(n_pad) < n_ops
+
+    fidx = change_doc[op_change] * key_capacity + op_key.astype(jnp.int32)
+    fidx = jnp.where(valid, fidx, n_fields)            # park padding
+    aslot = change_actor[op_change]
+    seq_op = change_seq[op_change]
+
+    seqdel = (seq_op << 1) | op_isdel.astype(jnp.int32)
+    seqdel = jnp.where(valid, seqdel, 0)
+    new_eseq = eseq.at[fidx, aslot].max(seqdel)
+
+    # cells whose max advanced get their value re-scattered by exactly
+    # the ops that achieved the new maximum
+    new_eval = jnp.where(new_eseq != eseq, _VAL_NONE, eval_)
+    mine = valid & (seqdel == new_eseq[fidx, aslot])
+    new_eval = new_eval.at[jnp.where(mine, fidx, n_fields), aslot].max(
+        op_value)
+
+    clock_op = change_clock[op_change]                 # [n_pad, A]
+    clock_op = jnp.where(valid[:, None], clock_op, -1)
+    new_m = m.at[fidx].max(clock_op)
+    return new_eseq, new_eval, new_m
+
+
+@partial(jax.jit, static_argnames=('f_pad',))
+def _extract_kernel(eseq, eval_, m, str_rank, touched_mask, *, f_pad):
+    """Patch extraction for the touched fields, fully on device.
+
+    Returns (touched fidx [f_pad], winner slot [f_pad], winner value
+    [f_pad], alive mask [f_pad, A]); -1 fidx rows are padding.
+    """
+    (fidx,) = jnp.nonzero(touched_mask, size=f_pad, fill_value=-1)
+    frow = jnp.maximum(fidx, 0)
+    seqdel = eseq[frow]                                # [f_pad, A]
+    mrows = m[frow]
+    seq = seqdel >> 1
+    is_del = (seqdel & 1) != 0
+    alive = (seq > 0) & (mrows < seq) & ~is_del & (fidx >= 0)[:, None]
+
+    rank = jnp.where(alive, str_rank[None, :], -1)
+    winner_slot = jnp.argmax(rank, axis=1)
+    has_winner = jnp.max(rank, axis=1) >= 0
+    winner_slot = jnp.where(has_winner, winner_slot, -1)
+    values = eval_[frow]                               # [f_pad, A]
+    winner_value = jnp.take_along_axis(
+        values, jnp.maximum(winner_slot, 0)[:, None], axis=1)[:, 0]
+    winner_value = jnp.where(has_winner, winner_value, -1)
+    return fidx, winner_slot, winner_value, alive, values
+
+
+class DensePatch:
+    """Patches from one dense apply, as device arrays; host
+    materialization (`to_patch_block` / `diffs`) is lazy."""
+
+    def __init__(self, store, fidx, winner_slot, winner_value, alive,
+                 values):
+        self._store = store
+        self.fidx = fidx
+        self.winner_slot = winner_slot
+        self.winner_value = winner_value
+        self.alive = alive
+        self.values = values          # [f_pad, A] value refs per slot
+        self._block = None
+
+    def block_until_ready(self):
+        jax.block_until_ready(self.winner_value)
+        return self
+
+    def to_patch_block(self):
+        """Fetch + reshape into a host :class:`~.blocks.PatchBlock`."""
+        if self._block is not None:
+            return self._block
+        store = self._store
+        fidx = np.asarray(self.fidx)
+        live = fidx >= 0
+        fidx = fidx[live]
+        order = np.argsort(fidx, kind='stable')
+        fidx = fidx[order]
+        w_slot = np.asarray(self.winner_slot)[live][order]
+        w_value = np.asarray(self.winner_value)[live][order]
+        alive = np.asarray(self.alive)[live][order]
+
+        K = store.key_capacity
+        f_doc = (fidx // K).astype(np.int32)
+        f_key = (fidx % K).astype(np.int32)
+        f_ptr = np.searchsorted(f_doc, np.arange(store.n_docs + 1)) \
+            .astype(np.int32)
+        has_winner = w_slot >= 0
+        f_action = np.where(has_winner, _SET, _DEL).astype(np.int8)
+        f_value = np.where(has_winner, w_value, -1).astype(np.int32)
+        f_actor = np.where(has_winner,
+                           store.slot_actor_ids[np.maximum(w_slot, 0)],
+                           -1).astype(np.int32)
+
+        # conflicts: alive minus winner, COO -> CSR per field
+        losers = alive.copy()
+        rows = np.arange(len(fidx))
+        losers[rows[has_winner], w_slot[has_winner]] = False
+        lf, ls = np.nonzero(losers)
+        s_counts = np.bincount(lf, minlength=len(fidx))
+        s_ptr = np.zeros(len(fidx) + 1, np.int32)
+        np.cumsum(s_counts, out=s_ptr[1:])
+        host = store.host
+        s_actor = store.slot_actor_ids[ls].astype(np.int32)
+        values = np.asarray(self.values)[live][order]
+        s_value = values[lf, ls].astype(np.int32)
+
+        self._block = _blocks.PatchBlock(
+            store.n_docs, f_ptr, f_doc, f_key, f_action, f_value, f_actor,
+            s_ptr, s_actor, s_value, host.keys, host.values, host.actors,
+            host.c_doc.copy(), host.c_actor.copy(), host.c_seq.copy())
+        return self._block
+
+    def diffs(self, d):
+        return self.to_patch_block().diffs(d)
+
+    def to_patches(self):
+        return self.to_patch_block().to_patches()
+
+
+class DenseMapStore:
+    """A DocSet of flat map documents resident in device memory."""
+
+    def __init__(self, n_docs, key_capacity=64, actor_capacity=16,
+                 options=None):
+        from .engine import as_options
+        self.options = as_options(options)
+        self.n_docs = n_docs
+        self.key_capacity = key_capacity
+        self.actor_capacity = actor_capacity
+        self.n_fields = n_docs * key_capacity
+        self.host = _blocks.BlockStore(n_docs)   # interning/clock/log/queue
+        # one padding row (index n_fields) absorbs parked scatters
+        shape = (self.n_fields + 1, actor_capacity)
+        self.eseq = jnp.zeros(shape, jnp.int32)
+        self.eval_ = jnp.full(shape, _VAL_NONE, jnp.int32)
+        self.m = jnp.full(shape, -1, jnp.int32)
+        self.slot_actor_ids = np.zeros(0, np.int32)  # slot -> store actor
+
+    def reset(self):
+        self.eseq = jnp.zeros_like(self.eseq)
+        self.eval_ = jnp.full_like(self.eval_, _VAL_NONE)
+        self.m = jnp.full_like(self.m, -1)
+        self.host = _blocks.BlockStore(self.n_docs)
+        self.slot_actor_ids = np.zeros(0, np.int32)
+
+    # actor slots are store actor ids (stable across applies); capacity
+    # bounds the number of DISTINCT actors the store can hold
+    def _actor_slots(self):
+        host = self.host
+        n = len(host.actors)
+        if n > self.actor_capacity:
+            raise ValueError(
+                f'{n} actors exceed actor_capacity={self.actor_capacity}')
+        if len(self.slot_actor_ids) != n:
+            self.slot_actor_ids = np.arange(n, dtype=np.int32)
+        return self.slot_actor_ids
+
+    def apply_block(self, block, return_timing=False):
+        """Apply a :class:`~.blocks.ChangeBlock`; returns a
+        :class:`DensePatch` (device-resident; materialize lazily)."""
+        import time
+        host = self.host
+        opts = self.options
+        _blocks.check_block_ranges(host, block)
+        if store_queue := host.queue:
+            block = _blocks._merge_queued(block, store_queue)
+            host.queue = []
+
+        t0 = time.perf_counter()
+        a_tab = host.intern(block.actors, host.actors, host.actor_of)
+        k_tab = host.intern(block.keys, host.keys, host.key_of)
+        if len(host.keys) > self.key_capacity:
+            raise ValueError(
+                f'{len(host.keys)} keys exceed key_capacity='
+                f'{self.key_capacity}')
+        v_base = len(host.values)
+        host.values.extend(block.values)
+        self._actor_slots()
+
+        z32 = np.zeros(0, np.int32)
+        b_actor = a_tab[block.actor] if block.n_changes else z32
+        dep_actor_store = a_tab[block.dep_actor] \
+            if len(block.dep_actor) else z32
+        dep_doc = np.repeat(block.doc, np.diff(block.dep_ptr))
+        la = _blocks._LocalActors(
+            host, np.concatenate([block.doc, dep_doc, host.c_doc]),
+            np.concatenate([b_actor, dep_actor_store, host.c_actor]))
+        admitted, leftover, R, cmap = _blocks._admit_block(
+            host, block, b_actor, dep_actor_store, la)
+        for c in np.flatnonzero(leftover):
+            host.queue.append((int(block.doc[c]), block.change_dict(c)))
+        t1 = time.perf_counter()
+
+        # ---- compress + ship change columns ----
+        adm = admitted
+        C = block.n_changes
+        c_pad = opts.pad_ops(max(int(adm.sum()), 1))
+        rows = np.flatnonzero(adm)
+        change_doc = np.zeros(c_pad, np.int32)
+        change_doc[:len(rows)] = block.doc[rows]
+        change_actor = np.zeros(c_pad, np.int32)
+        change_actor[:len(rows)] = b_actor[rows]      # slot == store id
+        change_seq = np.zeros(c_pad, np.int32)
+        change_seq[:len(rows)] = block.seq[rows]
+        # closures in store-slot coordinates (skip entirely when empty)
+        A = self.actor_capacity
+        if R.any():
+            change_clock = np.zeros((c_pad, A), np.int32)
+            Radm = R[rows]
+            nz_r, nz_c = np.nonzero(Radm)
+            change_clock[nz_r,
+                         la.store_of(block.doc[rows[nz_r]], nz_c)] = \
+                Radm[nz_r, nz_c]
+            clock_dev = jnp.asarray(change_clock)
+        else:
+            clock_dev = jnp.zeros((c_pad, A), jnp.int32)
+
+        op_counts = np.zeros(c_pad, np.int32)
+        op_counts[:len(rows)] = np.diff(block.op_ptr)[rows]
+        op_change_mask = adm[np.repeat(np.arange(C, dtype=np.int64),
+                                       np.diff(block.op_ptr))]
+        n_ops = int(op_counts.sum())
+        n_pad = opts.pad_ops(max(n_ops, 1))
+        key_dtype = np.uint8 if self.key_capacity <= 256 else np.int32
+        op_key = np.zeros(n_pad, key_dtype)
+        op_key[:n_ops] = k_tab[block.key[op_change_mask]]
+        op_isdel = np.zeros(n_pad, bool)
+        op_isdel[:n_ops] = block.action[op_change_mask] == _DEL
+        op_value = np.full(n_pad, -1, np.int32)
+        vals = block.value[op_change_mask]
+        op_value[:n_ops] = np.where(vals >= 0, vals + v_base, -1)
+        t2 = time.perf_counter()
+
+        self.eseq, self.eval_, self.m = _apply_kernel(
+            self.eseq, self.eval_, self.m, jnp.asarray(change_doc),
+            jnp.asarray(change_actor), jnp.asarray(change_seq),
+            clock_dev, jnp.asarray(op_counts),
+            jnp.asarray(op_key), jnp.asarray(op_isdel),
+            jnp.asarray(op_value), jnp.asarray(n_ops),
+            jnp.asarray(self.key_capacity),
+            n_fields=self.n_fields, n_actors=A)
+
+        # touched fields -> device extraction
+        touched = np.zeros(self.n_fields + 1, bool)
+        fk = block.doc[np.repeat(np.arange(C, dtype=np.int64),
+                                 np.diff(block.op_ptr))].astype(np.int64) \
+            * self.key_capacity + k_tab[block.key]
+        touched[fk[op_change_mask]] = True
+        touched[-1] = False
+        n_touched = int(touched.sum())
+        f_pad = opts.pad_segments(max(n_touched, 1))
+        str_rank = np.full(A, -1, np.int64)
+        n_act = len(host.actors)
+        str_rank[:n_act] = host.actor_str_ranks()[self.slot_actor_ids]
+        fidx, w_slot, w_value, alive, values = _extract_kernel(
+            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
+            jnp.asarray(touched), f_pad=f_pad)
+        patch = DensePatch(self, fidx, w_slot, w_value, alive, values)
+        t3 = time.perf_counter()
+
+        metrics.bump('dense_batches')
+        metrics.bump('dense_ops', n_ops)
+        if return_timing:
+            return patch, {'admit': t1 - t0, 'pack': t2 - t1,
+                           'dispatch': t3 - t2}
+        return patch

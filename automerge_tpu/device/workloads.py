@@ -61,3 +61,37 @@ def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
     clock[d_idx, o_idx, actor] = seq - 1
     is_del = rng.random((n_docs, n_ops)) < del_p
     return seg_id, actor, seq, clock, is_del, valid
+
+
+def gen_block_workload(n_docs=10240, n_actors=10, ops_per_change=10,
+                       n_keys=40, seed=0, del_p=0.0):
+    """The BASELINE config-5 workload as wire changes: a ChangeBlock with
+    one change per (doc, actor), all concurrent (seq=1, empty deps), each
+    change carrying ``ops_per_change`` set ops on distinct root keys.
+
+    Total ops = n_docs * n_actors * ops_per_change. With the defaults this
+    is the 1M-op / 10k-doc north-star shape, expressed in the columnar
+    wire encoding (the JSON dict encoding of the same changes is
+    ``block.to_changes()``).
+    """
+    from .blocks import ChangeBlock
+    rng = np.random.default_rng(seed)
+    n_changes = n_docs * n_actors
+    n_ops = n_changes * ops_per_change
+    doc = np.repeat(np.arange(n_docs, dtype=np.int32), n_actors)
+    actor = np.tile(np.arange(n_actors, dtype=np.int32), n_docs)
+    seq = np.ones(n_changes, np.int32)
+    dep_ptr = np.zeros(n_changes + 1, np.int32)
+    op_ptr = np.arange(n_changes + 1, dtype=np.int32) * ops_per_change
+    # distinct keys per change (first ops_per_change of a random key perm)
+    key = rng.random((n_changes, n_keys)).argsort(axis=1) \
+        [:, :ops_per_change].astype(np.int32).ravel()
+    action = (rng.random(n_ops) < del_p).astype(np.int8)
+    value = np.where(action == 0, np.arange(n_ops, dtype=np.int32), -1)
+    values = rng.integers(0, 1 << 20, n_ops).tolist()
+    z32 = np.zeros(0, np.int32)
+    return ChangeBlock(
+        n_docs, doc, actor, seq, dep_ptr, z32, z32, op_ptr, action,
+        key, value.astype(np.int32),
+        [f'peer-{i:03d}' for i in range(n_actors)],
+        [f'field{i:02d}' for i in range(n_keys)], values)

@@ -17,7 +17,6 @@ backends interchangeable, so callers never see the difference.
 """
 
 from .. import frontend as Frontend
-from .. import backend as Backend
 from ..device import backend as DeviceBackend
 from .doc_set import DocSet
 

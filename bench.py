@@ -1,14 +1,23 @@
-"""Benchmark: batched CRDT merge throughput on one chip.
+"""Benchmark: CRDT merge throughput on one chip, END TO END.
 
-Driver metric (BASELINE.md): ops merged/sec across a DocSet. The headline
-config is BASELINE config 5 — a 10k-document DocSet each receiving ~100
-concurrent map ops, merged in one batched device call (the reference
-resolves these one op at a time through `applyAssign`,
-op_set.js:180-219). North star: 1M ops across 10k docs in <100ms on one
-v5e chip => 1e7 ops/sec; `vs_baseline` is measured throughput over that
-target.
+Driver metric (BASELINE.md): ops merged/sec across a DocSet; p99
+applyChanges latency. The headline config is BASELINE config 5 — a
+10k-document DocSet receiving 1M concurrent map ops as wire changes
+(columnar ChangeBlock encoding), applied through the device-resident
+dense store: host causal admission + packing, device scatter-max apply,
+device patch extraction. The measured time covers the FULL
+changes-in -> patches-out path (pack + device + patch extraction);
+reference equivalent: `Backend.applyChanges` over every doc
+(backend/index.js:161-163). North star: 1M ops / 10k docs < 100 ms on
+one v5e chip => 1e7 ops/s; `vs_baseline` is measured end-to-end
+throughput over that target.
 
-Prints exactly ONE JSON line on stdout; auxiliary configs go to stderr.
+Auxiliary configs (stderr): the raw resolve-kernel microbenchmark, the
+general host-orchestrated block path, the card-list merge (config 1),
+concurrent Text merge (config 2), DocSet+Connection sync (config 3) and
+the automerge-perf editing-trace replay (config 4).
+
+Prints exactly ONE JSON line on stdout.
 """
 
 import json
@@ -22,16 +31,65 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-from automerge_tpu.device.workloads import gen_docset_workload  # noqa: E402
+from automerge_tpu.device.workloads import (  # noqa: E402
+    gen_docset_workload, gen_block_workload)
 
 
-def bench_docset_merge(jnp, resolve_batch, n_docs=10240, n_ops=128, iters=20):
+def bench_e2e_dense(iters=50):
+    """Headline: 1M wire ops across 10k docs through DenseMapStore."""
+    import jax
+    from automerge_tpu.device.dense_store import DenseMapStore
+
+    block = gen_block_workload()        # 10240 docs x 10 actors x 10 ops
+    store = DenseMapStore(block.n_docs, key_capacity=64, actor_capacity=16)
+    patch = store.apply_block(block)    # compile + warm
+    patch.block_until_ready()
+
+    times = []
+    for _ in range(iters):
+        store.reset()
+        t0 = time.perf_counter()
+        patch = store.apply_block(block)
+        patch.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t_med = float(np.median(times))
+    t_p99 = float(np.quantile(times, 0.99))
+
+    # pipelined throughput: dispatch without per-apply blocking
+    k = 8
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(k):
+        store.reset()
+        last = store.apply_block(block)
+    last.block_until_ready()
+    t_pipe = (time.perf_counter() - t0) / k
+    return block.n_ops, t_med, t_p99, t_pipe
+
+
+def bench_e2e_host_blocks(n_docs=2048, iters=10):
+    """The general host-orchestrated block path (unbounded capacities)."""
+    from automerge_tpu.device import blocks
+
+    block = gen_block_workload(n_docs=n_docs)
+    blocks.apply_block(blocks.init_store(n_docs), block)   # warm jit
+    times = []
+    for _ in range(iters):
+        store = blocks.init_store(n_docs)
+        t0 = time.perf_counter()
+        blocks.apply_block(store, block)
+        times.append(time.perf_counter() - t0)
+    return block.n_ops, float(np.median(times))
+
+
+def bench_kernel(jnp, resolve_batch, n_docs=10240, n_ops=128, iters=50):
+    """Raw resolve-kernel microbenchmark (round-1 headline, now a
+    diagnostic: excludes pack/unpack)."""
     seg_id, actor, seq, clock, is_del, valid = gen_docset_workload(
         n_docs=n_docs, n_ops=n_ops)
     args = tuple(jnp.asarray(a) for a in (seg_id, actor, seq, clock, is_del, valid))
 
     import jax
-    # compile + warmup
     out = resolve_batch(*args, num_segments=n_ops)
     jax.block_until_ready(out)
 
@@ -42,14 +100,114 @@ def bench_docset_merge(jnp, resolve_batch, n_docs=10240, n_ops=128, iters=20):
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     total_ops = n_docs * n_ops
-    t_med = float(np.median(times))
-    t_p99 = float(np.quantile(times, 0.99))
-    return total_ops, t_med, t_p99
+    return total_ops, float(np.median(times)), float(np.quantile(times, 0.99))
 
 
-def bench_text_merge(jnp, rga_order, n_nodes=1 << 18, iters=10):
-    """Config 2/4 analogue: one huge Text insertion tree ordered on device
-    (the parallel replacement of the skip-list path)."""
+def bench_card_list(iters=20):
+    """Config 1: the README card-list example — 2 actors, map+list ops,
+    merge via the public API (host frontend + oracle backend)."""
+    import automerge_tpu as am
+
+    def build():
+        a = am.init('aaaa-bench')
+        a = am.change(a, lambda d: d.__setitem__('cards', []))
+        a = am.change(a, lambda d: d['cards'].append(
+            {'title': 'Rewrite everything in JAX', 'done': False}))
+        a = am.change(a, lambda d: d['cards'].insert(
+            0, {'title': 'Rewrite everything in Pallas', 'done': False}))
+        b = am.merge(am.init('bbbb-bench'), a)
+        a = am.change(a, lambda d: d['cards'][1].__setitem__('done', True))
+        b = am.change(b, lambda d: d['cards'].__delitem__(0))
+        return a, b
+
+    a, b = build()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        merged = am.merge(am.merge(am.init('cccc-bench'), a), b)
+    dt = (time.perf_counter() - t0) / iters
+    assert [c['done'] for c in merged['cards']] == [True]
+    return dt
+
+
+def bench_text_concurrent(n_chars=10000):
+    """Config 2: 3 concurrent actors typing 10k chars total into one
+    Text, merged through the batched device backend (wire changes in,
+    patches out) vs the host oracle."""
+    from automerge_tpu import backend as Backend, frontend as Frontend
+    from automerge_tpu.device import backend as DeviceBackend
+    from automerge_tpu.text import Text
+
+    base_doc = Frontend.init({'backend': Backend})
+    base_doc = Frontend.set_actor_id(base_doc, 'base')
+    base_doc, _ = Frontend.change(base_doc,
+                                  lambda d: d.__setitem__('text', Text()))
+    base = Backend.get_changes_for_actor(
+        Frontend.get_backend_state(base_doc), 'base')
+    per_actor = n_chars // 3
+    changes = list(base)
+    for i in range(3):
+        actor = f'writer-{i}'
+        doc = Frontend.init({'backend': Backend})
+        doc = Frontend.set_actor_id(doc, actor)
+        st, p = Backend.apply_changes(Frontend.get_backend_state(doc), base)
+        p['state'] = st
+        doc = Frontend.apply_patch(doc, p)
+        doc, _ = Frontend.change(
+            doc, lambda d, c=chr(97 + i): d['text'].insert_at(
+                0, *(c * per_actor)))
+        changes.extend(Backend.get_changes_for_actor(
+            Frontend.get_backend_state(doc), actor))
+
+    # warm the jit caches (resolve + RGA at this shape), then measure
+    DeviceBackend.apply_changes(DeviceBackend.init(), changes)
+    t0 = time.perf_counter()
+    state, patch = DeviceBackend.apply_changes(DeviceBackend.init(), changes)
+    t_dev = time.perf_counter() - t0
+    n_applied = sum(len(c['ops']) for c in changes)
+
+    t0 = time.perf_counter()
+    Backend.apply_changes(Backend.init(), changes)
+    t_host = time.perf_counter() - t0
+    return n_applied, t_dev, t_host
+
+
+def bench_docset_sync(n_docs=100, iters=3):
+    """Config 3: DocSet + Connection — 2 replicas exchanging 100 docs."""
+    import automerge_tpu as am
+    from automerge_tpu.sync import DocSet, Connection
+
+    def one_round():
+        src, dst = DocSet(), DocSet()
+        for i in range(n_docs):
+            doc = am.change(am.init(f'actor-{i:03d}'),
+                            lambda d, i=i: d.update({'id': i, 'n': i * 2}))
+            src.set_doc(f'doc{i}', doc)
+        msgs_a, msgs_b = [], []
+        ca, cb = Connection(src, msgs_a.append), Connection(dst, msgs_b.append)
+        n_msgs = 0
+        ca.open()
+        cb.open()
+        while msgs_a or msgs_b:
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                n_msgs += 1
+                cb.receive_msg(m)
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                n_msgs += 1
+                ca.receive_msg(m)
+        assert dst.get_doc(f'doc{n_docs-1}') is not None
+        return n_msgs
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        n_msgs = one_round()
+    dt = (time.perf_counter() - t0) / iters
+    return n_docs, n_msgs, dt
+
+
+def bench_text_order(jnp, rga_order, n_nodes=1 << 18, iters=10):
+    """Long-text RGA ordering kernel (the skip-list replacement)."""
     rng = np.random.default_rng(1)
     parent = np.zeros(n_nodes, dtype=np.int32)
     parent[1:] = (rng.random(n_nodes - 1) * np.arange(1, n_nodes)).astype(np.int32)
@@ -74,11 +232,9 @@ def bench_text_merge(jnp, rga_order, n_nodes=1 << 18, iters=10):
 
 
 def bench_trace_replay(n_ops=180000, host_ops=20000):
-    """automerge-perf analogue (BASELINE.md): a ~180k-keystroke editing
-    trace. Device path: the full insertion tree ordered in one RGA-kernel
-    call. Host path: wire changes through the oracle backend in one batched
-    apply session (native C++ sequence index) — measured at a smaller size
-    and reported as changes/s."""
+    """Config 4: automerge-perf analogue — ~180k-keystroke editing trace.
+    Device path: full insertion tree ordered in one RGA call. Host path:
+    wire changes through the oracle (native C++ sequence index)."""
     import jax
     from automerge_tpu import traces
     from automerge_tpu import backend as B
@@ -112,38 +268,53 @@ def bench_trace_replay(n_ops=180000, host_ops=20000):
 def main():
     import jax
     import jax.numpy as jnp
-    from automerge_tpu.device.merge import resolve_assignments_batch
     from automerge_tpu.device.engine import pick_resolve_kernel
     from automerge_tpu.device.sequence import rga_order
 
     log(f'devices: {jax.devices()}')
 
-    # Headline: config 5 — 10k-doc DocSet batched merge, measured on the
-    # kernel the auto path actually selects (what default-API users get).
-    # The alternate kernel is logged to stderr as a diagnostic only.
-    total_ops, t_med, t_p99 = bench_docset_merge(jnp, pick_resolve_kernel())
-    ops_per_sec = total_ops / t_med
-    log(f'docset-merge[auto]: {total_ops} ops in {t_med * 1e3:.2f} ms '
-        f'(p99 {t_p99 * 1e3:.2f} ms) -> {ops_per_sec / 1e6:.1f}M ops/s')
-    if jax.default_backend() == 'tpu':
-        _, t_xla, _ = bench_docset_merge(jnp, resolve_assignments_batch)
-        log(f'docset-merge[xla diagnostic]: {t_xla * 1e3:.2f} ms '
-            f'-> {total_ops / t_xla / 1e6:.1f}M ops/s')
+    # ---- HEADLINE: config 5 end to end (wire changes -> patches) ----
+    total_ops, t_med, t_p99, t_pipe = bench_e2e_dense()
+    e2e_ops_per_sec = total_ops / t_med
+    log(f'e2e-docset-merge[dense store]: {total_ops} wire ops / 10240 docs '
+        f'in {t_med * 1e3:.1f} ms (p99 {t_p99 * 1e3:.1f} ms, pipelined '
+        f'{t_pipe * 1e3:.1f} ms/apply) -> {e2e_ops_per_sec / 1e6:.1f}M ops/s')
 
-    # Secondary: long-text RGA ordering
-    n_nodes, t_text = bench_text_merge(jnp, rga_order)
-    log(f'text-order: {n_nodes} elems in {t_text * 1e3:.2f} ms '
-        f'-> {n_nodes / t_text / 1e6:.1f}M elems/s')
+    n_blk, t_blk = bench_e2e_host_blocks()
+    log(f'e2e-docset-merge[host block path]: {n_blk} ops in '
+        f'{t_blk * 1e3:.1f} ms -> {n_blk / t_blk / 1e6:.1f}M ops/s')
 
-    # Secondary: automerge-perf editing-trace replay (device + host oracle)
+    # ---- diagnostics ----
+    k_ops, k_med, k_p99 = bench_kernel(jnp, pick_resolve_kernel())
+    log(f'resolve-kernel[auto]: {k_ops} ops in {k_med * 1e3:.2f} ms '
+        f'(p99 {k_p99 * 1e3:.2f} ms) -> {k_ops / k_med / 1e6:.1f}M ops/s')
+
+    t_card = bench_card_list()
+    log(f'card-list-merge[config 1]: {t_card * 1e3:.2f} ms per 3-way merge')
+
+    n_text, t_text_dev, t_text_host = bench_text_concurrent()
+    log(f'text-concurrent[config 2]: {n_text} ops device={t_text_dev:.3f}s '
+        f'({n_text / t_text_dev / 1e3:.1f}k ops/s) '
+        f'host-oracle={t_text_host:.3f}s')
+
+    n_sdocs, n_msgs, t_sync = bench_docset_sync()
+    log(f'docset-sync[config 3]: {n_sdocs} docs, {n_msgs} messages in '
+        f'{t_sync:.3f}s -> {n_sdocs / t_sync:.0f} docs/s')
+
+    n_nodes, t_order = bench_text_order(jnp, rga_order)
+    log(f'text-order: {n_nodes} elems in {t_order * 1e3:.2f} ms '
+        f'-> {n_nodes / t_order / 1e6:.1f}M elems/s')
+
     bench_trace_replay()
 
-    north_star = 1e7  # 1M ops / 100ms (BASELINE.json)
+    north_star = 1e7  # 1M ops / 100ms end-to-end (BASELINE.json)
     print(json.dumps({
-        'metric': 'docset_merge_ops_per_sec',
-        'value': round(ops_per_sec, 1),
+        'metric': 'e2e_docset_merge_ops_per_sec',
+        'value': round(e2e_ops_per_sec, 1),
         'unit': 'ops/s',
-        'vs_baseline': round(ops_per_sec / north_star, 2),
+        'vs_baseline': round(e2e_ops_per_sec / north_star, 2),
+        'p99_apply_ms': round(t_p99 * 1e3, 2),
+        'kernel_ops_per_sec': round(k_ops / k_med, 1),
     }), flush=True)
 
 

@@ -1,0 +1,329 @@
+"""Differential tests: the columnar block path vs the host oracle.
+
+ChangeBlock/PatchBlock are the bulk (struct-of-arrays) encoding of the
+same change/patch protocol the dict path speaks; `apply_block` must
+produce patches that materialize documents identical to the oracle's for
+every workload shape: concurrent conflicts, deletes, causal chains,
+cross-block dependencies, buffering, duplicates.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import blocks
+from automerge_tpu.device.workloads import gen_block_workload
+
+
+def _oracle_doc(changes):
+    state, _ = Backend.apply_changes(Backend.init(), changes)
+    return Frontend.apply_patch(Frontend.init('viewer'),
+                                Backend.get_patch(state))
+
+
+def _doc_from_diffs(diffs):
+    return Frontend.apply_patch(
+        Frontend.init('viewer'),
+        {'clock': {}, 'deps': {}, 'canUndo': False, 'canRedo': False,
+         'diffs': diffs})
+
+
+def assert_block_matches_oracle(changes_per_doc, n_applies=1):
+    """Apply via blocks (optionally split across several apply_block
+    calls) and compare every doc against the oracle."""
+    n_docs = len(changes_per_doc)
+    store = blocks.init_store(n_docs)
+    if n_applies == 1:
+        splits = [changes_per_doc]
+    else:
+        splits = []
+        for i in range(n_applies):
+            splits.append([doc_chs[i::n_applies]
+                           for doc_chs in changes_per_doc])
+    patches = None
+    docs = [Frontend.init('viewer') for _ in range(n_docs)]
+    for chunk in splits:
+        block = blocks.ChangeBlock.from_changes(chunk)
+        patches = blocks.apply_block(store, block)
+        for d in range(n_docs):
+            docs[d] = Frontend.apply_patch(
+                docs[d], {'clock': {}, 'deps': {}, 'canUndo': False,
+                          'canRedo': False, 'diffs': patches.diffs(d)})
+    for d in range(n_docs):
+        oracle = _oracle_doc(changes_per_doc[d])
+        got = {k: v for k, v in docs[d].items()}
+        want = {k: v for k, v in oracle.items()}
+        assert got == want, (d, got, want)
+        assert docs[d]._conflicts == oracle._conflicts, d
+    return store, patches
+
+
+def _mk_change(actor, seq, deps, ops):
+    return {'actor': actor, 'seq': seq, 'deps': deps, 'ops': ops}
+
+
+def _set(key, value):
+    return {'action': 'set', 'obj': ROOT_ID, 'key': key, 'value': value}
+
+
+def _del(key):
+    return {'action': 'del', 'obj': ROOT_ID, 'key': key}
+
+
+class TestRoundTrip:
+    def test_from_to_changes_lossless(self):
+        changes_per_doc = [
+            [_mk_change('aa', 1, {}, [_set('x', 1), _del('y')]),
+             _mk_change('bb', 1, {}, [_set('x', {'nested': 'json'})])],
+            [],
+            [_mk_change('aa', 1, {}, [_set('z', None)]),
+             _mk_change('aa', 2, {'bb': 1}, [_set('z', 9)])],
+        ]
+        # deps reference bb in doc 2 — make it resolvable for later tests
+        changes_per_doc[2][1]['deps'] = {}
+        block = blocks.ChangeBlock.from_changes(changes_per_doc)
+        assert block.to_changes() == changes_per_doc
+
+    def test_generated_workload_roundtrips(self):
+        block = gen_block_workload(n_docs=5, n_actors=2, ops_per_change=3,
+                                   n_keys=5, seed=2, del_p=0.3)
+        rt = blocks.ChangeBlock.from_changes(block.to_changes())
+        assert rt.to_changes() == block.to_changes()
+
+    def test_doc_sort_normalization(self):
+        """Changes arriving doc-interleaved are normalized doc-major."""
+        per_doc = [[_mk_change('aa', 1, {}, [_set('x', 1)])],
+                   [_mk_change('bb', 1, {}, [_set('y', 2)])]]
+        block = blocks.ChangeBlock.from_changes(per_doc)
+        shuffled = blocks.ChangeBlock(
+            2, block.doc[::-1].copy(), block.actor[::-1].copy(),
+            block.seq[::-1].copy(),
+            np.zeros(3, np.int32), block.dep_actor, block.dep_seq,
+            np.array([0, 1, 2], np.int32), block.action[::-1].copy(),
+            block.key[::-1].copy(), block.value[::-1].copy(),
+            block.actors, block.keys, block.values)
+        assert list(shuffled.doc) == [0, 1]
+        assert shuffled.to_changes() == per_doc
+
+    def test_rejects_non_map_ops(self):
+        with pytest.raises(ValueError, match='set/del'):
+            blocks.ChangeBlock.from_changes(
+                [[_mk_change('aa', 1, {}, [
+                    {'action': 'ins', 'obj': ROOT_ID, 'key': '_head',
+                     'elem': 1}])]])
+        with pytest.raises(ValueError, match='root-map'):
+            blocks.ChangeBlock.from_changes(
+                [[_mk_change('aa', 1, {}, [
+                    {'action': 'set', 'obj': 'other-obj', 'key': 'k',
+                     'value': 1}])]])
+
+
+class TestDifferential:
+    def test_concurrent_conflicts(self):
+        per_doc = [[
+            _mk_change('aa', 1, {}, [_set('x', 'low'), _set('y', 1)]),
+            _mk_change('zz', 1, {}, [_set('x', 'high')]),
+            _mk_change('mm', 1, {}, [_set('x', 'mid')]),
+        ]]
+        store, patches = assert_block_matches_oracle(per_doc)
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 'high'
+        assert doc._conflicts['x'] == {'aa': 'low', 'mm': 'mid'}
+
+    def test_causal_chain_supersedes(self):
+        per_doc = [[
+            _mk_change('aa', 1, {}, [_set('x', 1)]),
+            _mk_change('aa', 2, {}, [_set('x', 2)]),
+            _mk_change('bb', 1, {'aa': 2}, [_set('x', 3)]),
+        ]]
+        _, patches = assert_block_matches_oracle(per_doc)
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 3 and 'x' not in doc._conflicts
+
+    def test_delete_vs_concurrent_set(self):
+        per_doc = [[
+            _mk_change('aa', 1, {}, [_set('x', 'orig')]),
+            _mk_change('bb', 1, {'aa': 1}, [_del('x')]),
+            _mk_change('cc', 1, {'aa': 1}, [_set('x', 'new')]),
+        ]]
+        _, patches = assert_block_matches_oracle(per_doc)
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 'new'
+
+    def test_delete_wins_when_nothing_concurrent(self):
+        per_doc = [[
+            _mk_change('aa', 1, {}, [_set('x', 1), _set('keep', 2)]),
+            _mk_change('aa', 2, {}, [_del('x')]),
+        ]]
+        _, patches = assert_block_matches_oracle(per_doc)
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert 'x' not in doc and doc['keep'] == 2
+
+    def test_multi_doc_independent(self):
+        per_doc = [
+            [_mk_change('aa', 1, {}, [_set('x', d * 10)])]
+            for d in range(7)]
+        per_doc[3].append(_mk_change('bb', 1, {}, [_set('x', 'b')]))
+        assert_block_matches_oracle(per_doc)
+
+    def test_shuffled_delivery_within_block(self):
+        chain = [
+            _mk_change('aa', 1, {}, [_set('x', 1)]),
+            _mk_change('aa', 2, {}, [_set('y', 2)]),
+            _mk_change('bb', 1, {'aa': 2}, [_set('x', 3)]),
+            _mk_change('bb', 2, {}, [_set('z', 4)]),
+        ]
+        assert_block_matches_oracle([chain[::-1]])
+
+    def test_incremental_applies_match(self):
+        per_doc = [[
+            _mk_change('aa', s, {}, [_set('k%d' % (s % 3), s)])
+            for s in range(1, 7)]]
+        assert_block_matches_oracle(per_doc, n_applies=3)
+
+    def test_cross_block_transitive_deps(self):
+        """A dep resolved through the change log of an earlier block."""
+        first = [[
+            _mk_change('aa', 1, {}, [_set('x', 1)]),
+            _mk_change('bb', 1, {'aa': 1}, [_set('x', 2)]),
+        ]]
+        second = [[
+            # cc saw bb:1 (which transitively covers aa:1): its write
+            # supersedes BOTH
+            _mk_change('cc', 1, {'bb': 1}, [_set('x', 3)]),
+        ]]
+        store = blocks.init_store(1)
+        blocks.apply_block(store,
+                           blocks.ChangeBlock.from_changes(first))
+        patches = blocks.apply_block(store,
+                                     blocks.ChangeBlock.from_changes(second))
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 3
+        assert 'x' not in doc._conflicts    # superseded, not conflicting
+        oracle = _oracle_doc(first[0] + second[0])
+        assert oracle['x'] == 3 and 'x' not in oracle._conflicts
+
+    @pytest.mark.parametrize('seed', range(4))
+    def test_random_workload_one_shot(self, seed):
+        block = gen_block_workload(n_docs=6, n_actors=3, ops_per_change=4,
+                                   n_keys=6, seed=seed, del_p=0.25)
+        assert_block_matches_oracle(block.to_changes())
+
+    @pytest.mark.parametrize('seed', [11, 12])
+    def test_random_causal_history(self, seed):
+        rng = random.Random(seed)
+        per_doc = []
+        for d in range(3):
+            actors = ['a-%d' % i for i in range(3)]
+            seqs = {a: 0 for a in actors}
+            clock = {a: 0 for a in actors}
+            changes = []
+            for _ in range(10):
+                a = rng.choice(actors)
+                seqs[a] += 1
+                deps = {b: rng.randint(0, clock[b])
+                        for b in actors if b != a and clock[b]}
+                # distinct keys per change: the reference frontend dedupes
+                # same-key assignments within one change
+                # (ensureSingleAssignment, frontend/index.js:46)
+                keys = rng.sample(['k0', 'k1', 'k2', 'k3'],
+                                  rng.randint(1, 3))
+                ops = [_set(k, rng.randrange(100)) for k in keys[:-1]]
+                if rng.random() < 0.2:
+                    ops.append(_del(keys[-1]))
+                else:
+                    ops.append(_set(keys[-1], rng.randrange(100)))
+                changes.append(_mk_change(a, seqs[a], deps, ops))
+                clock[a] = seqs[a]
+            rng.shuffle(changes)
+            per_doc.append(changes)
+        assert_block_matches_oracle(per_doc)
+        assert_block_matches_oracle(per_doc, n_applies=2)
+
+
+class TestBufferingAndDuplicates:
+    def test_unready_change_buffers_and_reports(self):
+        store = blocks.init_store(1)
+        later = [[_mk_change('aa', 2, {}, [_set('x', 2)])]]
+        patches = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes(later))
+        assert patches.n_fields == 0
+        assert store.get_missing_deps() == {'aa': 1}
+        first = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
+        patches = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes(first))
+        # both apply once the gap fills
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 2
+        assert store.get_missing_deps() == {}
+
+    def test_duplicates_dropped(self):
+        store = blocks.init_store(1)
+        chs = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes(chs))
+        patches = blocks.apply_block(store,
+                                     blocks.ChangeBlock.from_changes(chs))
+        assert patches.n_fields == 0
+        assert store.clock_of(0) == {'aa': 1}
+
+    def test_missing_dep_on_other_actor(self):
+        store = blocks.init_store(2)
+        chs = [[], [_mk_change('bb', 1, {'aa': 3}, [_set('x', 1)])]]
+        patches = blocks.apply_block(store,
+                                     blocks.ChangeBlock.from_changes(chs))
+        assert patches.n_fields == 0
+        assert store.get_missing_deps() == {'aa': 3}
+
+    def test_long_causal_chain_admits_fully(self):
+        """A 150-deep per-actor chain in ONE block must fully apply (the
+        wave loop runs to fixpoint, like applyQueuedOps)."""
+        chain = [_mk_change('aa', s, {}, [_set('x', s)])
+                 for s in range(1, 151)]
+        store = blocks.init_store(1)
+        patches = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes([chain]))
+        assert store.clock_of(0) == {'aa': 150}
+        assert store.queue == []
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 150
+
+    def test_in_block_duplicate_change_dropped(self):
+        """Two copies of one change in a block (e.g. a retransmission
+        folded in with the queued copy) must not self-conflict."""
+        ch = _mk_change('aa', 1, {}, [_set('x', 1)])
+        store = blocks.init_store(1)
+        patches = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes([[ch, dict(ch)]]))
+        doc = _doc_from_diffs(patches.diffs(0))
+        assert doc['x'] == 1
+        assert doc._conflicts == {}
+        assert len(store.e_doc) == 1       # one entry, not two
+
+
+class TestPatchBlock:
+    def test_to_patches_clock_and_diffs(self):
+        per_doc = [
+            [_mk_change('aa', 1, {}, [_set('x', 1)]),
+             _mk_change('aa', 2, {}, [_set('x', 2)])],
+            [_mk_change('bb', 1, {}, [_set('y', 'v')])],
+        ]
+        store = blocks.init_store(2)
+        patches = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes(per_doc))
+        ps = patches.to_patches()
+        assert ps[0]['clock'] == {'aa': 2}
+        assert ps[1]['clock'] == {'bb': 1}
+        assert [d['key'] for d in ps[1]['diffs']] == ['y']
+
+    def test_store_doc_fields_surface(self):
+        per_doc = [[
+            _mk_change('aa', 1, {}, [_set('x', 'lo')]),
+            _mk_change('zz', 1, {}, [_set('x', 'hi')]),
+        ]]
+        store, _ = assert_block_matches_oracle(per_doc)
+        fields = store.doc_fields(0)
+        assert fields['x'] == [('zz', 'hi'), ('aa', 'lo')]

@@ -1,0 +1,142 @@
+"""Differential tests: the device-resident dense store vs the oracle."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import blocks
+from automerge_tpu.device.dense_store import DenseMapStore
+from automerge_tpu.device.workloads import gen_block_workload
+
+
+def _oracle_doc(changes):
+    state, _ = Backend.apply_changes(Backend.init(), changes)
+    return Frontend.apply_patch(Frontend.init('viewer'),
+                                Backend.get_patch(state))
+
+
+def _doc_from_diffs(diffs):
+    return Frontend.apply_patch(
+        Frontend.init('viewer'),
+        {'clock': {}, 'deps': {}, 'canUndo': False, 'canRedo': False,
+         'diffs': diffs})
+
+
+def _change(actor, seq, deps, ops):
+    return {'actor': actor, 'seq': seq, 'deps': deps, 'ops': ops}
+
+
+def _set(key, value):
+    return {'action': 'set', 'obj': ROOT_ID, 'key': key, 'value': value}
+
+
+def _del(key):
+    return {'action': 'del', 'obj': ROOT_ID, 'key': key}
+
+
+class TestDenseDifferential:
+    @pytest.mark.parametrize('seed', range(4))
+    def test_random_workload_matches_oracle(self, seed):
+        block = gen_block_workload(n_docs=6, n_actors=3, ops_per_change=4,
+                                   n_keys=6, seed=seed, del_p=0.25)
+        per_doc = block.to_changes()
+        store = DenseMapStore(6, key_capacity=8, actor_capacity=4)
+        patch = store.apply_block(block)
+        for d in range(6):
+            oracle = _oracle_doc(per_doc[d])
+            got = _doc_from_diffs(patch.diffs(d))
+            assert {k: v for k, v in got.items()} == \
+                {k: v for k, v in oracle.items()}, (seed, d)
+            assert got._conflicts == oracle._conflicts, (seed, d)
+
+    def test_incremental_applies_and_supersession(self):
+        first = [[_change('aa', 1, {}, [_set('x', 1)]),
+                  _change('bb', 1, {'aa': 1}, [_set('x', 2)])]]
+        second = [[_change('cc', 1, {'bb': 1}, [_set('x', 3)])]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        store.apply_block(blocks.ChangeBlock.from_changes(first))
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(second))
+        doc = _doc_from_diffs(patch.diffs(0))
+        # cc saw bb (and transitively aa): supersedes both, no conflict
+        assert doc['x'] == 3 and 'x' not in doc._conflicts
+
+    def test_delete_vs_concurrent_set(self):
+        per_doc = [[
+            _change('aa', 1, {}, [_set('x', 'orig'), _set('keep', 1)]),
+            _change('bb', 1, {'aa': 1}, [_del('x')]),
+            _change('cc', 1, {'aa': 1}, [_set('x', 'new')]),
+        ]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(per_doc))
+        doc = _doc_from_diffs(patch.diffs(0))
+        oracle = _oracle_doc(per_doc[0])
+        assert doc['x'] == oracle['x'] == 'new'
+        assert doc['keep'] == 1
+
+    def test_plain_delete_removes(self):
+        per_doc = [[_change('aa', 1, {}, [_set('x', 1)]),
+                    _change('aa', 2, {}, [_del('x')])]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(per_doc))
+        doc = _doc_from_diffs(patch.diffs(0))
+        assert 'x' not in doc
+
+    def test_buffering_and_missing_deps(self):
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        later = [[_change('aa', 2, {}, [_set('x', 2)])]]
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(later))
+        assert patch.to_patch_block().n_fields == 0
+        assert store.host.get_missing_deps() == {'aa': 1}
+        first = [[_change('aa', 1, {}, [_set('x', 1)])]]
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(first))
+        doc = _doc_from_diffs(patch.diffs(0))
+        assert doc['x'] == 2
+
+    def test_duplicates_dropped(self):
+        chs = [[_change('aa', 1, {}, [_set('x', 1)])]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        assert patch.to_patch_block().n_fields == 0
+        assert store.host.clock_of(0) == {'aa': 1}
+
+    def test_capacity_errors(self):
+        store = DenseMapStore(1, key_capacity=2, actor_capacity=2)
+        too_many_keys = [[_change('aa', 1, {},
+                                  [_set('k%d' % i, i) for i in range(3)])]]
+        with pytest.raises(ValueError, match='key_capacity'):
+            store.apply_block(
+                blocks.ChangeBlock.from_changes(too_many_keys))
+        store = DenseMapStore(1, key_capacity=8, actor_capacity=2)
+        many_actors = [[_change('a%d' % i, 1, {}, [_set('k', i)])
+                        for i in range(3)]]
+        with pytest.raises(ValueError, match='actor_capacity'):
+            store.apply_block(blocks.ChangeBlock.from_changes(many_actors))
+
+    def test_reset(self):
+        chs = [[_change('aa', 1, {}, [_set('x', 1)])]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        store.reset()
+        assert store.host.clock_of(0) == {}
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        assert _doc_from_diffs(patch.diffs(0))['x'] == 1
+
+    def test_matches_host_block_path(self):
+        """The two bulk engines agree field-for-field."""
+        block = gen_block_workload(n_docs=8, n_actors=4, ops_per_change=5,
+                                   n_keys=10, seed=42, del_p=0.2)
+        dense = DenseMapStore(8, key_capacity=16, actor_capacity=8)
+        dense_pb = dense.apply_block(block).to_patch_block()
+        host_store = blocks.init_store(8)
+        host_pb = blocks.apply_block(
+            host_store, gen_block_workload(n_docs=8, n_actors=4,
+                                           ops_per_change=5, n_keys=10,
+                                           seed=42, del_p=0.2))
+        for d in range(8):
+            assert _doc_from_diffs(dense_pb.diffs(d))._conflicts == \
+                _doc_from_diffs(host_pb.diffs(d))._conflicts
+            assert dict(_doc_from_diffs(dense_pb.diffs(d)).items()) == \
+                dict(_doc_from_diffs(host_pb.diffs(d)).items())
