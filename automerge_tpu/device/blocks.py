@@ -647,32 +647,46 @@ def _merge_queued(block, queue):
         actors, keys, values)
 
 
-# -- apply: pack -> resolve -> unpack ----------------------------------------
+# -- shared host preamble -----------------------------------------------------
 
-def apply_block(store, block, options=None, return_timing=False):
-    """`applyChanges` for the bulk path: ONE device resolution for every
-    touched field of every document in the block.
+class _Staged:
+    """Output of the shared admission preamble: the (possibly
+    queue-merged) block, admission results, and the admitted ops as
+    columns with store-id keys/actors and store value refs."""
 
-    Mutates `store`; returns a :class:`PatchBlock` (or (patches, timing)
-    with ``return_timing``). Duplicate changes are dropped; causally
-    unready changes are buffered in ``store.queue`` (retried on the next
-    apply; ``store.get_missing_deps()`` reports the gaps) — the block
-    analogue of op_set.js:267-283, 347-358.
+    __slots__ = ('block', 'admitted', 'R', 'cmap', 'la', 'b_actor',
+                 'oc', 'o_doc', 'o_actor', 'o_seq', 'o_action', 'o_key',
+                 'o_value')
+
+
+def _admit_and_stage(store, block, max_keys=None, max_actors=None):
+    """Queue merge + interning + causal admission + admitted-op staging —
+    the host phase shared by apply_block and DenseMapStore.
+
+    Capacity limits are checked BEFORE any store mutation, so a rejected
+    block leaves the store usable. Values are interned for ADMITTED ops
+    only — a change stuck in the queue does not grow ``store.values`` on
+    every retry.
     """
-    import time
-    opts = _engine.as_options(options)
     check_block_ranges(store, block)
-
     if store.queue:
         block = _merge_queued(block, store.queue)
         store.queue = []
 
-    t0 = time.perf_counter()
-    # interning: block tables -> store tables
+    if max_keys is not None:
+        n_keys = len(store.keys) + sum(1 for k in set(block.keys)
+                                       if k not in store.key_of)
+        if n_keys > max_keys:
+            raise ValueError(f'{n_keys} keys exceed key_capacity={max_keys}')
+    if max_actors is not None:
+        n_actors = len(store.actors) + sum(1 for a in set(block.actors)
+                                           if a not in store.actor_of)
+        if n_actors > max_actors:
+            raise ValueError(
+                f'{n_actors} actors exceed actor_capacity={max_actors}')
+
     a_tab = store.intern(block.actors, store.actors, store.actor_of)
     k_tab = store.intern(block.keys, store.keys, store.key_of)
-    v_base = len(store.values)
-    store.values.extend(block.values)
 
     z32 = np.zeros(0, np.int32)
     b_actor = a_tab[block.actor] if block.n_changes else z32
@@ -689,23 +703,71 @@ def apply_block(store, block, options=None, return_timing=False):
                                                dep_actor_store, la)
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
-    t1 = time.perf_counter()
 
-    # ---- pack: admitted ops + prior entries of touched fields ----
+    # admitted ops as columns
     C = block.n_changes
-    D = store.n_docs
     op_change = np.repeat(np.arange(C, dtype=np.int64),
                           np.diff(block.op_ptr))
     keep = admitted[op_change] if C else np.zeros(0, bool)
     oc = op_change[keep]
-    o_doc = block.doc[oc]
-    o_actor = b_actor[oc]
-    o_seq = block.seq[oc]
-    o_action = block.action[keep]
-    o_key = k_tab[block.key[keep]] if keep.any() else z32
-    o_val = block.value[keep]
-    o_value = np.where(o_val >= 0, o_val + v_base, -1).astype(np.int32)
 
+    st = _Staged()
+    st.block = block
+    st.admitted, st.R, st.cmap, st.la, st.b_actor = (admitted, R, cmap,
+                                                     la, b_actor)
+    st.oc = oc
+    st.o_doc = block.doc[oc]
+    st.o_actor = b_actor[oc]
+    st.o_seq = block.seq[oc]
+    st.o_action = block.action[keep]
+    st.o_key = k_tab[block.key[keep]] if keep.any() else z32
+
+    # value interning, admitted ops only
+    v_base = len(store.values)
+    o_val = block.value[keep]
+    refs = o_val[o_val >= 0]
+    if admitted.all() and len(refs) == len(block.values):
+        # fast path: every block value is referenced exactly once
+        store.values.extend(block.values)
+        st.o_value = np.where(o_val >= 0, o_val + v_base, -1) \
+            .astype(np.int32)
+    else:
+        used = np.unique(refs)
+        mapping = np.full(max(len(block.values), 1), -1, np.int64)
+        mapping[used] = np.arange(len(used)) + v_base
+        store.values.extend(block.values[i] for i in used.tolist())
+        st.o_value = np.where(
+            o_val >= 0, mapping[np.maximum(o_val, 0)], -1).astype(np.int32)
+    return st
+
+
+# -- apply: pack -> resolve -> unpack ----------------------------------------
+
+def apply_block(store, block, options=None, return_timing=False):
+    """`applyChanges` for the bulk path: ONE device resolution for every
+    touched field of every document in the block.
+
+    Mutates `store`; returns a :class:`PatchBlock` (or (patches, timing)
+    with ``return_timing``). Duplicate changes are dropped; causally
+    unready changes are buffered in ``store.queue`` (retried on the next
+    apply; ``store.get_missing_deps()`` reports the gaps) — the block
+    analogue of op_set.js:267-283, 347-358.
+    """
+    import time
+    opts = _engine.as_options(options)
+    t0 = time.perf_counter()
+    st = _admit_and_stage(store, block)
+    block = st.block
+    admitted, R, cmap, la, b_actor = (st.admitted, st.R, st.cmap, st.la,
+                                      st.b_actor)
+    oc, o_doc, o_actor = st.oc, st.o_doc, st.o_actor
+    o_seq, o_action, o_key, o_value = (st.o_seq, st.o_action, st.o_key,
+                                       st.o_value)
+    t1 = time.perf_counter()
+
+    # ---- pack: admitted ops + prior entries of touched fields ----
+    D = store.n_docs
+    z32 = np.zeros(0, np.int32)
     if len(o_doc) == 0:
         empty = PatchBlock(
             D, np.zeros(D + 1, np.int32), z32, z32,
@@ -762,7 +824,8 @@ def apply_block(store, block, options=None, return_timing=False):
 
     # per-op local actor ranks: computed per CHANGE for new ops (cheap),
     # per entry for priors
-    rank_of_change = la.local_of(block.doc, b_actor) if C else z32
+    rank_of_change = la.local_of(block.doc, b_actor) \
+        if block.n_changes else z32
     seg_arr = padded(seg_new, seg_prior, np.int32)
     actor_arr = padded(rank_of_change[oc],
                        la.local_of(p_doc, store.e_actor[prior_rows]),

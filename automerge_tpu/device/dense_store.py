@@ -227,55 +227,34 @@ class DenseMapStore:
         import time
         host = self.host
         opts = self.options
-        _blocks.check_block_ranges(host, block)
-        if store_queue := host.queue:
-            block = _blocks._merge_queued(block, store_queue)
-            host.queue = []
 
         t0 = time.perf_counter()
-        a_tab = host.intern(block.actors, host.actors, host.actor_of)
-        k_tab = host.intern(block.keys, host.keys, host.key_of)
-        if len(host.keys) > self.key_capacity:
-            raise ValueError(
-                f'{len(host.keys)} keys exceed key_capacity='
-                f'{self.key_capacity}')
-        v_base = len(host.values)
-        host.values.extend(block.values)
+        st = _blocks._admit_and_stage(host, block,
+                                      max_keys=self.key_capacity,
+                                      max_actors=self.actor_capacity)
+        block = st.block
         self._actor_slots()
-
-        z32 = np.zeros(0, np.int32)
-        b_actor = a_tab[block.actor] if block.n_changes else z32
-        dep_actor_store = a_tab[block.dep_actor] \
-            if len(block.dep_actor) else z32
-        dep_doc = np.repeat(block.doc, np.diff(block.dep_ptr))
-        la = _blocks._LocalActors(
-            host, np.concatenate([block.doc, dep_doc, host.c_doc]),
-            np.concatenate([b_actor, dep_actor_store, host.c_actor]))
-        admitted, leftover, R, cmap = _blocks._admit_block(
-            host, block, b_actor, dep_actor_store, la)
-        for c in np.flatnonzero(leftover):
-            host.queue.append((int(block.doc[c]), block.change_dict(c)))
         t1 = time.perf_counter()
 
         # ---- compress + ship change columns ----
-        adm = admitted
-        C = block.n_changes
-        c_pad = opts.pad_ops(max(int(adm.sum()), 1))
+        adm = st.admitted
         rows = np.flatnonzero(adm)
+        c_pad = opts.pad_ops(max(len(rows), 1))
         change_doc = np.zeros(c_pad, np.int32)
         change_doc[:len(rows)] = block.doc[rows]
         change_actor = np.zeros(c_pad, np.int32)
-        change_actor[:len(rows)] = b_actor[rows]      # slot == store id
+        change_actor[:len(rows)] = st.b_actor[rows]   # slot == store id
         change_seq = np.zeros(c_pad, np.int32)
         change_seq[:len(rows)] = block.seq[rows]
         # closures in store-slot coordinates (skip entirely when empty)
         A = self.actor_capacity
+        R = st.R
         if R.any():
             change_clock = np.zeros((c_pad, A), np.int32)
             Radm = R[rows]
             nz_r, nz_c = np.nonzero(Radm)
             change_clock[nz_r,
-                         la.store_of(block.doc[rows[nz_r]], nz_c)] = \
+                         st.la.store_of(block.doc[rows[nz_r]], nz_c)] = \
                 Radm[nz_r, nz_c]
             clock_dev = jnp.asarray(change_clock)
         else:
@@ -283,18 +262,15 @@ class DenseMapStore:
 
         op_counts = np.zeros(c_pad, np.int32)
         op_counts[:len(rows)] = np.diff(block.op_ptr)[rows]
-        op_change_mask = adm[np.repeat(np.arange(C, dtype=np.int64),
-                                       np.diff(block.op_ptr))]
-        n_ops = int(op_counts.sum())
+        n_ops = len(st.oc)
         n_pad = opts.pad_ops(max(n_ops, 1))
         key_dtype = np.uint8 if self.key_capacity <= 256 else np.int32
         op_key = np.zeros(n_pad, key_dtype)
-        op_key[:n_ops] = k_tab[block.key[op_change_mask]]
+        op_key[:n_ops] = st.o_key
         op_isdel = np.zeros(n_pad, bool)
-        op_isdel[:n_ops] = block.action[op_change_mask] == _DEL
+        op_isdel[:n_ops] = st.o_action == _DEL
         op_value = np.full(n_pad, -1, np.int32)
-        vals = block.value[op_change_mask]
-        op_value[:n_ops] = np.where(vals >= 0, vals + v_base, -1)
+        op_value[:n_ops] = st.o_value
         t2 = time.perf_counter()
 
         self.eseq, self.eval_, self.m = _apply_kernel(
@@ -308,10 +284,8 @@ class DenseMapStore:
 
         # touched fields -> device extraction
         touched = np.zeros(self.n_fields + 1, bool)
-        fk = block.doc[np.repeat(np.arange(C, dtype=np.int64),
-                                 np.diff(block.op_ptr))].astype(np.int64) \
-            * self.key_capacity + k_tab[block.key]
-        touched[fk[op_change_mask]] = True
+        fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
+        touched[fk] = True
         touched[-1] = False
         n_touched = int(touched.sum())
         f_pad = opts.pad_segments(max(n_touched, 1))

@@ -175,3 +175,210 @@ def shard_peers(mesh, *arrays):
     sharding = NamedSharding(mesh, P(PEER_AXIS))
     placed = tuple(jax.device_put(np.asarray(a), sharding) for a in arrays)
     return placed if len(placed) != 1 else placed[0]
+
+
+# -- delta shipping: clock-diff windows ---------------------------------------
+#
+# The all-gather round above ships every peer's whole buffer every round —
+# fine for a one-shot union, wrong bandwidth shape for repeated sync. The
+# reference ships only what the peer is missing, derived from clocks
+# (`maybeSendChanges`, src/connection.js:58-66). The static-shape ICI
+# version: each round every peer advertises its replica clock (tiny
+# all_gather), selects up to `window` of its locally-held ops that some
+# other peer's clock does NOT cover, and ships just that window. Receivers
+# accept an op iff it extends their per-actor contiguous prefix (clock
+# semantics preserved under partial windows), append it to their buffer,
+# and advance their clock. Shipped-op counts shrink to zero at
+# convergence — the per-round traffic is the clock diff, not the union.
+
+
+def _accept_incoming(in_actor, in_seq, in_clock, in_seg, in_del, in_valid,
+                     buf, peer_clock, count, n_cap):
+    """Fold incoming window rows into the local buffer.
+
+    Dedups identical (actor, seq) rows (several peers may ship the same
+    op), then accepts each actor's rows only as a contiguous seq prefix
+    beyond the local clock — exactly `causallyReady` for per-actor op
+    rows — and appends them at `count`.
+    """
+    f_actor = jnp.where(in_valid, in_actor, 0)
+    f_seq = jnp.where(in_valid, in_seq, 0)         # seq 0 = never accepted
+    order = jnp.lexsort((f_seq, f_actor))
+    s_actor, s_seq = f_actor[order], f_seq[order]
+    s_valid = in_valid[order]
+
+    prev_same = jnp.concatenate([
+        jnp.array([False]),
+        (s_actor[1:] == s_actor[:-1]) & (s_seq[1:] == s_seq[:-1])])
+    cand = s_valid & ~prev_same & (s_seq > peer_clock[s_actor])
+
+    # rank within each actor's candidate run (segmented cumsum)
+    new_actor = jnp.concatenate([
+        jnp.array([True]), s_actor[1:] != s_actor[:-1]])
+    r = jnp.cumsum(cand.astype(jnp.int32))
+    base = jax.lax.cummax(
+        jnp.where(new_actor, r - cand.astype(jnp.int32), 0))
+    rank = r - base                                # 1-based among accepted
+    accept = cand & (s_seq == peer_clock[s_actor] + rank)
+
+    # append accepted rows at the end of the buffer; rows past capacity
+    # are rejected outright (clock must not advance past stored ops).
+    # Rejections are a suffix of each actor's accepted run, so per-actor
+    # prefix contiguity survives.
+    acc32 = accept.astype(jnp.int32)
+    pos = count + jnp.cumsum(acc32) - acc32
+    accept = accept & (pos < n_cap)
+    acc32 = accept.astype(jnp.int32)
+    slot = jnp.where(accept, pos, n_cap)
+    seg_b, actor_b, seq_b, clock_b, del_b, valid_b = buf
+    actor_b = actor_b.at[slot].set(s_actor, mode='drop')
+    seq_b = seq_b.at[slot].set(s_seq, mode='drop')
+    seg_b = seg_b.at[slot].set(in_seg[order], mode='drop')
+    clock_b = clock_b.at[slot].set(in_clock[order], mode='drop')
+    del_b = del_b.at[slot].set(in_del[order], mode='drop')
+    valid_b = valid_b.at[slot].set(True, mode='drop')
+
+    new_count = count + jnp.sum(acc32)
+    new_clock = peer_clock.at[s_actor].add(acc32)
+    accepted_total = jnp.sum(acc32)
+    return (seg_b, actor_b, seq_b, clock_b, del_b, valid_b), \
+        new_clock, new_count, accepted_total
+
+
+def _delta_round_body(seg_id, actor, seq, clock, is_del, valid, count,
+                      peer_clock, *, window, n_peers, ring):
+    """One delta-sync round (SPMD body; local leading axis = 1)."""
+    assert seg_id.shape[0] == 1, \
+        f'{seg_id.shape[0]} peers share one device; use one device per peer'
+    me = jax.lax.axis_index(PEER_AXIS)
+    n_cap = seg_id.shape[1]
+    ac, sq, vd = actor[0], seq[0], valid[0]
+
+    clocks_all = jax.lax.all_gather(peer_clock[0], PEER_AXIS)   # [P, A]
+    if ring:
+        # ship to the next ring neighbor only, against ITS clock
+        nxt = (me + 1) % n_peers
+        target_clock = clocks_all[nxt]
+        uncovered = target_clock[ac] < sq
+    else:
+        covered = clocks_all[:, ac] >= sq[None, :]              # [P, n]
+        mine = jnp.arange(n_peers)[:, None] == me
+        uncovered = ~jnp.all(covered | mine, axis=0)
+    needed = vd & uncovered
+
+    # select up to `window` needed ops in (actor, seq) order, so a
+    # truncated window still ships contiguous per-actor prefixes
+    order = jnp.lexsort((sq, ac, ~needed))
+    take = order[:window]
+    w_valid = needed[take]
+    w_actor, w_seq, w_seg = ac[take], sq[take], seg_id[0][take]
+    w_clock, w_del = clock[0][take], is_del[0][take]
+
+    if ring:
+        perm = [(i, (i + 1) % n_peers) for i in range(n_peers)]
+        ship = lambda x: jax.lax.ppermute(x, PEER_AXIS, perm)  # noqa: E731
+        in_actor, in_seq, in_seg = map(ship, (w_actor, w_seq, w_seg))
+        in_clock, in_del, in_valid = map(ship, (w_clock, w_del, w_valid))
+    else:
+        g = lambda x: jax.lax.all_gather(x, PEER_AXIS)         # noqa: E731
+        from_others = jnp.arange(n_peers) != me
+        in_actor, in_seq, in_seg = (g(w_actor).reshape(-1),
+                                    g(w_seq).reshape(-1),
+                                    g(w_seg).reshape(-1))
+        in_clock = g(w_clock).reshape(-1, w_clock.shape[-1])
+        in_del = g(w_del).reshape(-1)
+        in_valid = (g(w_valid) & from_others[:, None]).reshape(-1)
+
+    buf = (seg_id[0], ac, sq, clock[0], is_del[0], vd)
+    buf, new_clock, new_count, accepted = _accept_incoming(
+        in_actor, in_seq, in_clock, in_seg, in_del, in_valid,
+        buf, peer_clock[0], count[0], n_cap)
+
+    shipped = jax.lax.psum(jnp.sum(w_valid), PEER_AXIS)
+    accepted = jax.lax.psum(accepted, PEER_AXIS)
+    seg_b, actor_b, seq_b, clock_b, del_b, valid_b = buf
+    return (seg_b[None], actor_b[None], seq_b[None], clock_b[None],
+            del_b[None], valid_b[None], new_count[None],
+            new_clock[None], shipped, accepted)
+
+
+@lru_cache(maxsize=64)
+def _delta_round_fn(mesh, window, ring):
+    n_peers = mesh.devices.size
+    spec = P(PEER_AXIS)
+    return jax.jit(shard_map(
+        partial(_delta_round_body, window=window, n_peers=n_peers,
+                ring=ring),
+        mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=(spec,) * 8 + (P(), P()),
+    ))
+
+
+def delta_sync_round(mesh, state, *, window=64, ring=False):
+    """One clock-diff delta round. `state` is the 8-tuple
+    (seg_id, actor, seq, clock, is_del, valid, count, peer_clock) with a
+    leading peer axis; returns (new_state, shipped, accepted)."""
+    out = _delta_round_fn(mesh, window, ring)(*state)
+    return out[:8], int(out[8]), int(out[9])
+
+
+def delta_sync_converge(mesh, state, *, window=64, ring=False,
+                        max_rounds=1000):
+    """Run delta rounds until a round ships nothing. Returns
+    (state, shipped_per_round) — the last entry is always 0, certifying
+    convergence; per-round traffic is bounded by P * window ops."""
+    shipped_log = []
+    for _ in range(max_rounds):
+        state, shipped, _ = delta_sync_round(mesh, state, window=window,
+                                             ring=ring)
+        shipped_log.append(shipped)
+        if shipped == 0:
+            return state, shipped_log
+    raise RuntimeError(f'no convergence after {max_rounds} delta rounds')
+
+
+def make_delta_state(mesh, seg_id, actor, seq, clock, is_del, valid,
+                     n_cap):
+    """Build + place the per-peer delta-sync state from each peer's
+    locally-generated ops ([P, n] columns). Buffers are padded to
+    ``n_cap`` (capacity for the converged union); replica clocks start
+    as each peer's own contribution.
+
+    Preconditions (validated): each peer's ``valid`` rows form a
+    contiguous prefix (accepted ops append at ``count``), and each
+    (peer, actor)'s held seqs are contiguous from 1 — the clock-prefix
+    model the acceptance logic relies on. Holes would silently corrupt
+    buffers or stall convergence, so they are rejected here.
+    """
+    p, n = seg_id.shape
+    a = clock.shape[-1]
+
+    def pad(x, fill=0):
+        out = np.full((p, n_cap) + x.shape[2:], fill, x.dtype)
+        out[:, :n] = x
+        return out
+
+    counts = valid.sum(axis=1).astype(np.int32)
+    peer_clock = np.zeros((p, a), np.int32)
+    for i in range(p):
+        if valid[i].any() and not valid[i][:counts[i]].all():
+            raise ValueError(
+                f'peer {i}: valid rows must form a contiguous prefix')
+        acts, sqs = actor[i][valid[i]], seq[i][valid[i]]
+        np.maximum.at(peer_clock[i], acts, sqs)
+        held = np.bincount(acts, minlength=a)
+        if (peer_clock[i] != held[:a]).any():
+            bad = int(np.flatnonzero(peer_clock[i] != held[:a])[0])
+            raise ValueError(
+                f'peer {i}, actor {bad}: held seqs must be contiguous '
+                f'from 1 (max seq {peer_clock[i][bad]}, '
+                f'{held[bad]} ops held)')
+    state = (pad(np.asarray(seg_id, np.int32)),
+             pad(np.asarray(actor, np.int32)),
+             pad(np.asarray(seq, np.int32)),
+             pad(np.asarray(clock, np.int32)),
+             pad(np.asarray(is_del, bool)),
+             pad(np.asarray(valid, bool)),
+             counts, peer_clock)
+    return tuple(shard_peers(mesh, x) for x in state)

@@ -102,18 +102,37 @@ class TestDenseDifferential:
         assert patch.to_patch_block().n_fields == 0
         assert store.host.clock_of(0) == {'aa': 1}
 
-    def test_capacity_errors(self):
+    def test_capacity_errors_leave_store_usable(self):
         store = DenseMapStore(1, key_capacity=2, actor_capacity=2)
         too_many_keys = [[_change('aa', 1, {},
                                   [_set('k%d' % i, i) for i in range(3)])]]
         with pytest.raises(ValueError, match='key_capacity'):
             store.apply_block(
                 blocks.ChangeBlock.from_changes(too_many_keys))
+        # the rejected block must not have mutated the store: a valid
+        # block still applies
+        ok = [[_change('aa', 1, {}, [_set('k0', 7)])]]
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(ok))
+        assert _doc_from_diffs(patch.diffs(0))['k0'] == 7
+
         store = DenseMapStore(1, key_capacity=8, actor_capacity=2)
         many_actors = [[_change('a%d' % i, 1, {}, [_set('k', i)])
                         for i in range(3)]]
         with pytest.raises(ValueError, match='actor_capacity'):
             store.apply_block(blocks.ChangeBlock.from_changes(many_actors))
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(ok))
+        assert _doc_from_diffs(patch.diffs(0))['k0'] == 7
+
+    def test_queued_change_values_not_reinterned_per_retry(self):
+        """A buffered change must not grow store.values on every apply."""
+        store = DenseMapStore(1, key_capacity=8, actor_capacity=4)
+        stuck = [[_change('aa', 5, {}, [_set('x', 'big-value')])]]
+        store.apply_block(blocks.ChangeBlock.from_changes(stuck))
+        n0 = len(store.host.values)
+        for _ in range(3):
+            store.apply_block(blocks.ChangeBlock.from_changes([[]]))
+        assert len(store.host.values) == n0
+        assert store.host.get_missing_deps() == {'aa': 4}
 
     def test_reset(self):
         chs = [[_change('aa', 1, {}, [_set('x', 1)])]]

@@ -117,3 +117,106 @@ class TestRingSync:
             ring_counts = seg_counts(np.asarray(ring['surviving'])[p],
                                      seg_ring)
             np.testing.assert_array_equal(ring_counts, ag_counts)
+
+
+class TestDeltaSync:
+    """Clock-diff delta shipping: per-round traffic is the diff, not the
+    union, and it shrinks to zero at convergence
+    (src/connection.js:58-66)."""
+
+    def _converged_state(self, mesh, window=64, ring=False, seed=0,
+                         n_cap=N_PEERS * N_OPS):
+        seg_id, actor, seq, clock, is_del, valid, _ = peer_workload(seed)
+        state = ici_sync.make_delta_state(
+            mesh, seg_id, actor, seq, clock, is_del, valid, n_cap=n_cap)
+        state, shipped = ici_sync.delta_sync_converge(
+            mesh, state, window=window, ring=ring)
+        return state, shipped
+
+    @pytest.mark.parametrize('ring', [False, True])
+    def test_converges_and_then_ships_zero(self, mesh, ring):
+        state, shipped = self._converged_state(mesh, ring=ring)
+        assert shipped[-1] == 0
+        assert shipped[0] > 0
+        # a further round after convergence ships nothing
+        _, again, _ = ici_sync.delta_sync_round(mesh, state, window=64,
+                                                ring=ring)
+        assert again == 0
+        # all peers hold the full union and identical clocks
+        counts = np.asarray(state[6])
+        np.testing.assert_array_equal(counts, N_PEERS * N_OPS)
+        clocks = np.asarray(state[7])
+        for p in range(1, N_PEERS):
+            np.testing.assert_array_equal(clocks[p], clocks[0])
+        assert (clocks[0] == N_OPS).all()
+
+    def test_buffers_hold_identical_op_sets(self, mesh):
+        state, _ = self._converged_state(mesh)
+        actor = np.asarray(state[1])
+        seq = np.asarray(state[2])
+        valid = np.asarray(state[5])
+        ref = None
+        for p in range(N_PEERS):
+            ops = set(zip(actor[p][valid[p]].tolist(),
+                          seq[p][valid[p]].tolist()))
+            assert len(ops) == N_PEERS * N_OPS     # no duplicates
+            ref = ops if ref is None else ref
+            assert ops == ref
+
+    def test_converged_resolve_matches_union(self, mesh):
+        """Each peer resolving its own buffer gets the same per-segment
+        outcome as the one-shot union resolve."""
+        state, _ = self._converged_state(mesh)
+        seg_id, actor, seq, clock, is_del, valid, _ = peer_workload()
+        ref = _resolve(seg_id.reshape(-1), actor.reshape(-1),
+                       seq.reshape(-1), clock.reshape(-1, N_PEERS),
+                       is_del.reshape(-1), valid.reshape(-1),
+                       num_segments=N_SEGS)
+        for p in range(N_PEERS):
+            got = _resolve(np.asarray(state[0])[p], np.asarray(state[1])[p],
+                           np.asarray(state[2])[p], np.asarray(state[3])[p],
+                           np.asarray(state[4])[p], np.asarray(state[5])[p],
+                           num_segments=N_SEGS)
+            np.testing.assert_array_equal(np.asarray(got['seg_max_actor']),
+                                          np.asarray(ref['seg_max_actor']))
+            assert int(np.asarray(got['surviving']).sum()) == \
+                int(np.asarray(ref['surviving']).sum())
+
+    def test_small_window_needs_more_rounds_but_converges(self, mesh):
+        state_big, shipped_big = self._converged_state(mesh, window=128)
+        state_small, shipped_small = self._converged_state(mesh, window=8)
+        assert len(shipped_small) > len(shipped_big)
+        # every round's traffic respects the window budget
+        assert max(shipped_small) <= 8 * N_PEERS
+        np.testing.assert_array_equal(np.asarray(state_small[7]),
+                                      np.asarray(state_big[7]))
+
+    def test_traffic_is_delta_after_partial_sync(self, mesh):
+        """After convergence, one peer adds a few new ops; the next round
+        ships only those (times the peers that need them), not the
+        union."""
+        state, _ = self._converged_state(mesh, n_cap=N_PEERS * N_OPS + 8)
+        seg_id, actor, seq, clock, is_del, valid, count, peer_clock = \
+            [np.asarray(x).copy() for x in state]
+        # peer 0 authors 2 fresh ops (seq N_OPS+1, N_OPS+2)
+        base = count[0]
+        for k in range(2):
+            seg_id[0, base + k] = k
+            actor[0, base + k] = 0
+            seq[0, base + k] = N_OPS + 1 + k
+            clock[0, base + k, :] = peer_clock[0]
+            clock[0, base + k, 0] = N_OPS + k
+            is_del[0, base + k] = False
+            valid[0, base + k] = True
+        count[0] += 2
+        peer_clock[0, 0] = N_OPS + 2
+        state = tuple(ici_sync.shard_peers(mesh, x) for x in
+                      (seg_id, actor, seq, clock, is_del, valid, count,
+                       peer_clock))
+        state, shipped, accepted = ici_sync.delta_sync_round(
+            mesh, state, window=64)
+        assert shipped == 2                      # the delta, not the union
+        assert accepted == 2 * (N_PEERS - 1)
+        state, shipped, _ = ici_sync.delta_sync_round(mesh, state,
+                                                      window=64)
+        assert shipped == 0
