@@ -201,6 +201,7 @@ get_object_id = Frontend.get_object_id
 get_element_ids = Frontend.get_element_ids
 
 from .config import Options                 # noqa: E402
+from .snapshot import save_snapshot, load_snapshot  # noqa: E402
 from .sync.doc_set import DocSet            # noqa: E402
 from .sync.watchable_doc import WatchableDoc  # noqa: E402
 from .sync.connection import Connection     # noqa: E402

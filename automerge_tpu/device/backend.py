@@ -159,7 +159,10 @@ def _admit_changes(state, changes):
             actor, seq = change['actor'], change['seq']
             _, n = state.actor_states(actor)
             if seq <= n:
-                if state.actor_state(actor, seq - 1)['change'] != change:
+                prior = state.actor_state(actor, seq - 1)['change']
+                # prior is None for snapshot-era entries (body dropped by
+                # the packed checkpoint): drop the duplicate unverified
+                if prior is not None and prior != change:
                     raise ValueError(
                         f'Inconsistent reuse of sequence number {seq} by {actor}')
                 continue
@@ -674,13 +677,24 @@ def get_missing_changes(state, have_deps):
     for actor in state.states:
         lst, n = state.actor_states(actor)
         for entry in lst[all_deps.get(actor, 0):n]:
+            if entry['change'] is None:
+                raise ValueError(
+                    'change log truncated by a snapshot resume; a peer '
+                    'this far behind needs the snapshot or the full log')
             changes.append(entry['change'])
     return changes
 
 
 def get_changes_for_actor(state, for_actor, after_seq=0):
     lst, n = state.actor_states(for_actor)
-    return [entry['change'] for entry in lst[after_seq:n]]
+    out = []
+    for entry in lst[after_seq:n]:
+        if entry['change'] is None:
+            raise ValueError(
+                'change log truncated by a snapshot resume; a peer '
+                'this far behind needs the snapshot or the full log')
+        out.append(entry['change'])
+    return out
 
 
 def get_missing_deps(state):

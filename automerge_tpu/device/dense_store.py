@@ -209,6 +209,88 @@ class DenseMapStore:
         self.host = _blocks.BlockStore(self.n_docs)
         self.slot_actor_ids = np.zeros(0, np.int32)
 
+    def extract_all(self):
+        """Patch covering every populated field — materializes the whole
+        store (the dense analogue of getPatch, backend/index.js:201-207)."""
+        populated = np.asarray((self.eseq != 0).any(axis=1)).copy()
+        populated[-1] = False
+        n = max(int(populated.sum()), 1)
+        f_pad = self.options.pad_segments(n)
+        A = self.actor_capacity
+        str_rank = np.full(A, -1, np.int64)
+        n_act = len(self.host.actors)
+        self._actor_slots()
+        str_rank[:n_act] = \
+            self.host.actor_str_ranks()[self.slot_actor_ids]
+        fidx, w_slot, w_value, alive, values = _extract_kernel(
+            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
+            jnp.asarray(populated), f_pad=f_pad)
+        return DensePatch(self, fidx, w_slot, w_value, alive, values)
+
+    # -- packed checkpoint (SURVEY §5: replay-free resume) -------------------
+
+    def save_snapshot(self):
+        """Serialize the packed device planes + host tables to bytes.
+
+        Resume is replay-free: the planes load straight back into HBM.
+        Size is the dense capacity plus the interned value table and the
+        per-change closure CSR (both grow with applied history — the
+        metadata that keeps future causal checks exact)."""
+        import io
+        import json
+        host = self.host
+        meta = {'format': 'automerge-tpu-dense-snapshot@1',
+                'n_docs': self.n_docs,
+                'key_capacity': self.key_capacity,
+                'actor_capacity': self.actor_capacity,
+                'actors': host.actors, 'keys': host.keys,
+                'values': host.values, 'queue': host.queue}
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            eseq=np.asarray(self.eseq), eval=np.asarray(self.eval_),
+            m=np.asarray(self.m),
+            c_doc=host.c_doc, c_actor=host.c_actor, c_seq=host.c_seq,
+            l_key=host.l_key, l_order=host.l_order,
+            l_dep_ptr=host.l_dep_ptr, l_dep_actor=host.l_dep_actor,
+            l_dep_seq=host.l_dep_seq,
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
+        return buf.getvalue()
+
+    @classmethod
+    def load_snapshot(cls, data, options=None):
+        """Rebuild a store from :meth:`save_snapshot` bytes."""
+        import io
+        import json
+        with np.load(io.BytesIO(data)) as z:
+            meta = json.loads(bytes(z['meta']).decode())
+            if meta.get('format') != 'automerge-tpu-dense-snapshot@1':
+                raise ValueError('not a dense-store snapshot')
+            store = cls(meta['n_docs'],
+                        key_capacity=meta['key_capacity'],
+                        actor_capacity=meta['actor_capacity'],
+                        options=options)
+            store.eseq = jnp.asarray(z['eseq'])
+            store.eval_ = jnp.asarray(z['eval'])
+            store.m = jnp.asarray(z['m'])
+            host = store.host
+            host.actors = list(meta['actors'])
+            host.actor_of = {a: i for i, a in enumerate(host.actors)}
+            host.keys = list(meta['keys'])
+            host.key_of = {k: i for i, k in enumerate(host.keys)}
+            host.values = list(meta['values'])
+            host.queue = [(d, ch) for d, ch in meta['queue']]
+            host.c_doc = z['c_doc']
+            host.c_actor = z['c_actor']
+            host.c_seq = z['c_seq']
+            host.l_key = z['l_key']
+            host.l_order = z['l_order']
+            host.l_dep_ptr = z['l_dep_ptr']
+            host.l_dep_actor = z['l_dep_actor']
+            host.l_dep_seq = z['l_dep_seq']
+        store._actor_slots()
+        return store
+
     # actor slots are store actor ids (stable across applies); capacity
     # bounds the number of DISTINCT actors the store can hold
     def _actor_slots(self):

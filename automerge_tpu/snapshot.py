@@ -1,0 +1,131 @@
+"""Packed-state checkpoints: O(state) resume alongside the change log.
+
+The reference's only durability format is the change log — ``save()``
+serializes every change ever applied and ``load()`` replays them
+(src/automerge.js:45-52), so resume cost is a full CRDT replay of the
+history. This module adds the SURVEY §5 "packed device-state snapshot":
+the CRDT state itself — field entries with their closure clocks,
+sequence insertion trees as columnar node arrays, vector clock, dep
+frontier, causal buffer, and the per-change closure table — WITHOUT op
+payloads or change bodies. Loading rebuilds a working backend with NO
+replay: cost is the size of the live state, which includes O(history)
+closure *metadata* (one actor->seq clock per applied change — the same
+table the engine keeps in memory, and what keeps future concurrency
+checks exact) but none of the op/value payloads, so snapshots are much
+smaller than the log and resume skips all resolution work.
+
+What a snapshot preserves: the document (bit-identical materialization),
+convergence behavior for all future changes (closure table keeps
+concurrency checks exact, even against pre-snapshot entries), duplicate
+tolerance, causal buffering. What it drops: the replayable change bodies
+— ``get_missing_changes`` for a peer whose clock predates the snapshot
+raises (such a peer needs the snapshot or the full log), and ``save()``
+of a resumed doc carries only post-resume changes. Keep the log for
+archival; use snapshots for fast resume — the same split as a database
+checkpoint + WAL.
+"""
+
+import json as _json
+
+from .common import ROOT_ID
+from .device.backend import DeviceBackendState, _ObjRecord, get_patch
+from . import frontend as Frontend
+from .device import backend as DeviceBackend
+
+FORMAT = 'automerge-tpu-snapshot@1'
+
+
+def snapshot_state(state):
+    """DeviceBackendState -> JSON-ready dict (no op payload duplication:
+    field entries reference values inline, change bodies are dropped)."""
+    objects = []
+    for obj_id, rec in state.objects.items():
+        entry = {'obj': obj_id, 'type': rec.type, 'inbound': rec.inbound}
+        if rec.is_sequence():
+            entry['nodes'] = rec.nodes
+            entry['parent'] = rec.node_parent
+            entry['elem'] = rec.node_elem
+            entry['actor'] = rec.node_actor
+            entry['elem_ids'] = rec.elem_ids
+        objects.append(entry)
+
+    fields = [[obj, key, list(entries)]
+              for (obj, key), entries in state.fields.items() if entries]
+
+    closures = {actor: [e['all_deps'] for e in lst[:n]]
+                for actor, (lst, n) in
+                ((a, state.actor_states(a)) for a in state.states)}
+
+    return {'format': FORMAT,
+            'objects': objects,
+            'fields': fields,
+            'clock': state.clock,
+            'deps': state.deps,
+            'queue': state.queue,
+            'closures': closures}
+
+
+def restore_state(payload):
+    """JSON dict -> DeviceBackendState (O(state))."""
+    if payload.get('format') != FORMAT:
+        raise ValueError(f'not a {FORMAT} snapshot')
+    state = DeviceBackendState()
+    state.objects = {}
+    for entry in payload['objects']:
+        rec = _ObjRecord(entry['type'])
+        rec.inbound = [tuple(ref) for ref in entry['inbound']]
+        if rec.is_sequence():
+            rec.nodes = list(entry['nodes'])
+            rec.node_of = {e: i for i, e in enumerate(rec.nodes)}
+            rec.node_parent = list(entry['parent'])
+            rec.node_elem = list(entry['elem'])
+            rec.node_actor = list(entry['actor'])
+            rec.elem_ids = list(entry['elem_ids'])
+        state.objects[entry['obj']] = rec
+    if ROOT_ID not in state.objects:
+        state.objects[ROOT_ID] = _ObjRecord(None)
+    state._owned = set(state.objects)
+
+    state.fields = {(obj, key): tuple(entries)
+                    for obj, key, entries in payload['fields']}
+    state.clock = dict(payload['clock'])
+    state.deps = dict(payload['deps'])
+    state.queue = list(payload['queue'])
+    # closure table: per (actor, seq) transitive deps, change bodies gone.
+    # 'change': None marks a snapshot-era entry (duplicate deliveries are
+    # dropped unverified; get_missing_changes refuses the range).
+    for actor, rows in payload['closures'].items():
+        state.states[actor] = [{'change': None, 'all_deps': deps}
+                               for deps in rows]
+        state.state_lens[actor] = len(rows)
+    state.history = []
+    state.history_len = 0
+    return state
+
+
+def save_snapshot(doc):
+    """Serialize a device-backed document's packed state (the fast-resume
+    artifact; `save()` remains the archival change log)."""
+    state = Frontend.get_backend_state(doc)
+    if not isinstance(state, DeviceBackendState):
+        raise TypeError(
+            'save_snapshot requires a device-backed document; host-oracle '
+            'documents use save() (the change log)')
+    return _json.dumps(snapshot_state(state))
+
+
+def load_snapshot(data, actor_id=None):
+    """Materialize a document from a packed snapshot in O(state)."""
+    state = restore_state(_json.loads(data))
+    options = {'backend': DeviceBackend}
+    if actor_id is not None:
+        options['actorId'] = actor_id
+    doc = Frontend.init(options)
+    patch = get_patch(state)
+    patch['state'] = state
+    return Frontend.apply_patch(doc, patch)
+
+
+# camelCase aliases (reference API style)
+saveSnapshot = save_snapshot
+loadSnapshot = load_snapshot

@@ -206,6 +206,38 @@ def bench_docset_sync(n_docs=100, iters=3):
     return n_docs, n_msgs, dt
 
 
+def bench_snapshot_resume(n_changes=20000, n_keys=8):
+    """Checkpoint/resume: the packed snapshot loads with no CRDT replay
+    (closure metadata only), vs the change log's full replay."""
+    import automerge_tpu as am
+    from automerge_tpu import frontend as Frontend
+    from automerge_tpu import snapshot
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.device import backend as DeviceBackend
+
+    changes = [{'actor': 'hist-actor', 'seq': s, 'deps': {},
+                'ops': [{'action': 'set', 'obj': ROOT_ID,
+                         'key': f'k{s % n_keys}', 'value': s}]}
+               for s in range(1, n_changes + 1)]
+    state = DeviceBackend.init()
+    for i in range(0, n_changes, 2000):
+        state, _ = DeviceBackend.apply_changes(state, changes[i:i + 2000])
+    doc = Frontend.apply_patch(Frontend.init({'backend': DeviceBackend}),
+                               dict(DeviceBackend.get_patch(state),
+                                    state=state))
+    log = am.save(doc)
+    snap = snapshot.save_snapshot(doc)
+
+    t0 = time.perf_counter()
+    via_log = am.load(log)
+    t_log = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    via_snap = snapshot.load_snapshot(snap)
+    t_snap = time.perf_counter() - t0
+    assert dict(via_snap.items()) == dict(via_log.items())
+    return n_changes, t_log, t_snap, len(log), len(snap)
+
+
 def bench_text_order(jnp, rga_order, n_nodes=1 << 18, iters=10):
     """Long-text RGA ordering kernel (the skip-list replacement)."""
     rng = np.random.default_rng(1)
@@ -300,6 +332,13 @@ def main():
     n_sdocs, n_msgs, t_sync = bench_docset_sync()
     log(f'docset-sync[config 3]: {n_sdocs} docs, {n_msgs} messages in '
         f'{t_sync:.3f}s -> {n_sdocs / t_sync:.0f} docs/s')
+
+    n_hist, t_log_load, t_snap_load, sz_log, sz_snap = \
+        bench_snapshot_resume()
+    log(f'snapshot-resume: {n_hist}-change history — log load '
+        f'{t_log_load:.2f}s ({sz_log >> 10}KB), snapshot load '
+        f'{t_snap_load * 1e3:.1f}ms ({sz_snap >> 10}KB) -> '
+        f'{t_log_load / max(t_snap_load, 1e-9):.0f}x faster resume')
 
     n_nodes, t_order = bench_text_order(jnp, rga_order)
     log(f'text-order: {n_nodes} elems in {t_order * 1e3:.2f} ms '

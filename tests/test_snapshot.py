@@ -1,0 +1,182 @@
+"""Packed-state checkpoints: load(snapshot) == load(log), and resumed
+states keep exact CRDT semantics for future (even concurrent) changes."""
+
+import json
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu import snapshot
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.device import blocks
+from automerge_tpu.device.dense_store import DenseMapStore
+from automerge_tpu.device.workloads import gen_block_workload
+from automerge_tpu.text import Text
+
+
+def _materialize(doc):
+    def conv(obj):
+        name = type(obj).__name__
+        if name == 'Text':
+            return ''.join(str(c) for c in obj)
+        if name == 'AmList':
+            return [conv(v) for v in obj]
+        if hasattr(obj, '_conflicts'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+def _frontend_changes(actor, *edits):
+    doc = Frontend.init({'backend': Backend})
+    doc = Frontend.set_actor_id(doc, actor)
+    for e in edits:
+        doc, _ = Frontend.change(doc, e)
+    return Backend.get_changes_for_actor(
+        Frontend.get_backend_state(doc), actor)
+
+
+def _device_doc(changes):
+    state = DeviceBackend.init()
+    state, patch = DeviceBackend.apply_changes(state, changes)
+    patch['state'] = state
+    return Frontend.apply_patch(
+        Frontend.init({'backend': DeviceBackend}), patch)
+
+
+class TestDeviceSnapshot:
+    def _rich_changes(self):
+        return _frontend_changes(
+            'author',
+            lambda d: d.update({'title': 'doc', 'meta': {'v': 1}}),
+            lambda d: d.__setitem__('items', ['a', 'b', 'c']),
+            lambda d: d['items'].insert(1, 'x'),
+            lambda d: d.__setitem__('text', Text()),
+            lambda d: d['text'].insert_at(0, *'hello'),
+            lambda d: d['items'].__delitem__(0))
+
+    def test_snapshot_equals_log_load(self):
+        changes = self._rich_changes()
+        doc = _device_doc(changes)
+        via_log = am.load(am.save(doc))
+        via_snap = snapshot.load_snapshot(snapshot.save_snapshot(doc))
+        assert _materialize(via_snap) == _materialize(via_log) \
+            == _materialize(doc)
+
+    def test_snapshot_is_json(self):
+        doc = _device_doc(self._rich_changes())
+        payload = json.loads(snapshot.save_snapshot(doc))
+        assert payload['format'] == snapshot.FORMAT
+        assert payload['clock'] == {'author': 6}
+
+    def test_resume_then_concurrent_change_matches_full_log(self):
+        """A change CONCURRENT with pre-snapshot state must resolve
+        identically after resume (the closure table keeps concurrency
+        checks exact)."""
+        base = _frontend_changes('base', lambda d: d.__setitem__('x', 1))
+        later = _frontend_changes('base',
+                                  lambda d: d.__setitem__('x', 1),
+                                  lambda d: d.__setitem__('x', 2))[1:]
+        # a concurrent writer who saw only seq 1
+        doc_c = Frontend.init({'backend': Backend})
+        doc_c = Frontend.set_actor_id(doc_c, 'writer')
+        st, p = Backend.apply_changes(
+            Frontend.get_backend_state(doc_c), base)
+        p['state'] = st
+        doc_c = Frontend.apply_patch(doc_c, p)
+        doc_c, _ = Frontend.change(doc_c, lambda d: d.__setitem__('x', 9))
+        conc = Backend.get_changes_for_actor(
+            Frontend.get_backend_state(doc_c), 'writer')
+
+        # full-log path
+        full = _device_doc(base + later + conc)
+        # snapshot at base+later, then the concurrent change arrives
+        snap_doc = snapshot.load_snapshot(
+            snapshot.save_snapshot(_device_doc(base + later)))
+        state = Frontend.get_backend_state(snap_doc)
+        state, patch = DeviceBackend.apply_changes(state, conc)
+        patch['state'] = state
+        snap_doc = Frontend.apply_patch(snap_doc, patch)
+        assert _materialize(snap_doc) == _materialize(full)
+        assert snap_doc._conflicts == full._conflicts
+
+    def test_resume_duplicate_pre_snapshot_change_dropped(self):
+        changes = _frontend_changes('aa', lambda d: d.__setitem__('x', 1))
+        doc = snapshot.load_snapshot(
+            snapshot.save_snapshot(_device_doc(changes)))
+        state = Frontend.get_backend_state(doc)
+        state, patch = DeviceBackend.apply_changes(state, changes)
+        assert patch['diffs'] == []
+
+    def test_resume_buffered_queue_survives(self):
+        c1, c2 = _frontend_changes('aa',
+                                   lambda d: d.__setitem__('x', 1),
+                                   lambda d: d.__setitem__('y', 2))
+        state = DeviceBackend.init()
+        state, _ = DeviceBackend.apply_changes(state, [c2])  # buffered
+        payload = snapshot.snapshot_state(state)
+        restored = snapshot.restore_state(
+            json.loads(json.dumps(payload)))
+        assert DeviceBackend.get_missing_deps(restored) == {'aa': 1}
+        restored, patch = DeviceBackend.apply_changes(restored, [c1])
+        assert {d['key'] for d in patch['diffs']} == {'x', 'y'}
+
+    def test_truncated_log_raises_for_stale_peer(self):
+        changes = _frontend_changes('aa', lambda d: d.__setitem__('x', 1))
+        doc = snapshot.load_snapshot(
+            snapshot.save_snapshot(_device_doc(changes)))
+        state = Frontend.get_backend_state(doc)
+        with pytest.raises(ValueError, match='truncated'):
+            DeviceBackend.get_missing_changes(state, {})
+        # post-resume changes remain shippable
+        doc2, _ = Frontend.change(
+            Frontend.set_actor_id(doc, 'bb'),
+            lambda d: d.__setitem__('z', 3))
+        st2 = Frontend.get_backend_state(doc2)
+        assert DeviceBackend.get_changes_for_actor(st2, 'bb')[0]['ops']
+
+    def test_oracle_doc_rejected(self):
+        doc = am.change(am.init('aa'), lambda d: d.__setitem__('x', 1))
+        with pytest.raises(TypeError, match='device-backed'):
+            snapshot.save_snapshot(doc)
+
+
+class TestDenseSnapshot:
+    def test_roundtrip_and_continue(self):
+        block = gen_block_workload(n_docs=6, n_actors=3, ops_per_change=4,
+                                   n_keys=6, seed=5, del_p=0.2)
+        store = DenseMapStore(6, key_capacity=8, actor_capacity=4)
+        store.apply_block(block)
+        data = store.save_snapshot()
+        assert isinstance(data, bytes)
+
+        restored = DenseMapStore.load_snapshot(data)
+        # full materialization of every doc must match (extract_all reads
+        # the restored device planes, not just host metadata)
+        pb_orig = store.extract_all().to_patch_block()
+        pb_rest = restored.extract_all().to_patch_block()
+        for d in range(6):
+            assert pb_rest.diffs(d) == pb_orig.diffs(d)
+            assert restored.host.clock_of(d) == store.host.clock_of(d)
+
+        # future applies behave identically on both stores
+        more = blocks.ChangeBlock.from_changes(
+            [[{'actor': 'peer-000', 'seq': 2, 'deps': {},
+               'ops': [{'action': 'set',
+                        'obj': am.ROOT_ID, 'key': 'field00',
+                        'value': 'post-resume'}]}]] + [[]] * 5)
+        p1 = store.apply_block(more).to_patch_block()
+        more2 = blocks.ChangeBlock.from_changes(
+            [[{'actor': 'peer-000', 'seq': 2, 'deps': {},
+               'ops': [{'action': 'set',
+                        'obj': am.ROOT_ID, 'key': 'field00',
+                        'value': 'post-resume'}]}]] + [[]] * 5)
+        p2 = restored.apply_block(more2).to_patch_block()
+        assert p1.diffs(0) == p2.diffs(0)
+
+    def test_rejects_garbage(self):
+        with pytest.raises(Exception):
+            DenseMapStore.load_snapshot(b'not a snapshot')
