@@ -88,9 +88,17 @@ def save(doc):
 
     Works for host-oracle and device-backed documents alike: both backend
     states expose the SharedChangeLog surface (the device state directly,
-    the oracle via its op_set)."""
+    the oracle via its op_set). A document resumed from a packed snapshot
+    no longer holds pre-snapshot change bodies, so saving it here would
+    silently produce a log that cannot replay — that case raises; use
+    :func:`save_snapshot` for such documents."""
     state = Frontend.get_backend_state(doc)
     log = state.op_set if hasattr(state, 'op_set') else state
+    if getattr(log, 'log_truncated', False):
+        raise ValueError(
+            'this document was resumed from a packed snapshot and no '
+            'longer holds its full change log; persist it with '
+            'save_snapshot() instead')
     history = log.get_history()
     return _json.dumps({'format': 'automerge-tpu@1', 'changes': history})
 

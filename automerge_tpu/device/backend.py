@@ -95,7 +95,8 @@ class DeviceBackendState(SharedChangeLog):
     """
 
     __slots__ = ('objects', 'fields', 'states', 'state_lens', 'clock',
-                 'deps', 'queue', 'history', 'history_len', '_owned')
+                 'deps', 'queue', 'history', 'history_len', '_owned',
+                 'log_truncated')
 
     def __init__(self):
         self.objects = {ROOT_ID: _ObjRecord(None)}
@@ -110,6 +111,7 @@ class DeviceBackendState(SharedChangeLog):
         self.history = []       # grow-only applied-change log
         self.history_len = 0
         self._owned = {ROOT_ID}  # objectIds private to this snapshot
+        self.log_truncated = False  # True after a snapshot resume
 
     def clone(self):
         new = DeviceBackendState.__new__(DeviceBackendState)
@@ -123,6 +125,7 @@ class DeviceBackendState(SharedChangeLog):
         new.history = self.history
         new.history_len = self.history_len
         new._owned = set()
+        new.log_truncated = self.log_truncated
         return new
 
     def _writable(self, object_id):
@@ -157,6 +160,9 @@ def _admit_changes(state, changes):
         progress, remaining = False, []
         for change in pending:
             actor, seq = change['actor'], change['seq']
+            if not isinstance(seq, int) or seq < 1:
+                raise ValueError(
+                    f'Change requires a positive integer seq, got {seq!r}')
             _, n = state.actor_states(actor)
             if seq <= n:
                 prior = state.actor_state(actor, seq - 1)['change']

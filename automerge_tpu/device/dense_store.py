@@ -209,23 +209,27 @@ class DenseMapStore:
         self.host = _blocks.BlockStore(self.n_docs)
         self.slot_actor_ids = np.zeros(0, np.int32)
 
+    def _extract(self, mask):
+        """Device patch extraction over a boolean field mask (shared by
+        apply_block and extract_all)."""
+        f_pad = self.options.pad_segments(max(int(mask.sum()), 1))
+        A = self.actor_capacity
+        self._actor_slots()
+        str_rank = np.full(A, -1, np.int64)
+        n_act = len(self.host.actors)
+        str_rank[:n_act] = \
+            self.host.actor_str_ranks()[self.slot_actor_ids]
+        fidx, w_slot, w_value, alive, values = _extract_kernel(
+            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
+            jnp.asarray(mask), f_pad=f_pad)
+        return DensePatch(self, fidx, w_slot, w_value, alive, values)
+
     def extract_all(self):
         """Patch covering every populated field — materializes the whole
         store (the dense analogue of getPatch, backend/index.js:201-207)."""
         populated = np.asarray((self.eseq != 0).any(axis=1)).copy()
         populated[-1] = False
-        n = max(int(populated.sum()), 1)
-        f_pad = self.options.pad_segments(n)
-        A = self.actor_capacity
-        str_rank = np.full(A, -1, np.int64)
-        n_act = len(self.host.actors)
-        self._actor_slots()
-        str_rank[:n_act] = \
-            self.host.actor_str_ranks()[self.slot_actor_ids]
-        fidx, w_slot, w_value, alive, values = _extract_kernel(
-            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
-            jnp.asarray(populated), f_pad=f_pad)
-        return DensePatch(self, fidx, w_slot, w_value, alive, values)
+        return self._extract(populated)
 
     # -- packed checkpoint (SURVEY §5: replay-free resume) -------------------
 
@@ -369,15 +373,7 @@ class DenseMapStore:
         fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
         touched[fk] = True
         touched[-1] = False
-        n_touched = int(touched.sum())
-        f_pad = opts.pad_segments(max(n_touched, 1))
-        str_rank = np.full(A, -1, np.int64)
-        n_act = len(host.actors)
-        str_rank[:n_act] = host.actor_str_ranks()[self.slot_actor_ids]
-        fidx, w_slot, w_value, alive, values = _extract_kernel(
-            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
-            jnp.asarray(touched), f_pad=f_pad)
-        patch = DensePatch(self, fidx, w_slot, w_value, alive, values)
+        patch = self._extract(touched)
         t3 = time.perf_counter()
 
         metrics.bump('dense_batches')

@@ -110,16 +110,22 @@ def apply_patch_to_doc(doc, patch, state, from_backend):
     inbound = dict(doc._inbound)
     updated = {}
     # Queued undo/redo requests replayed through this path carry no diffs.
-    apply_diffs(patch.get('diffs', []), doc._cache, updated, inbound)
+    # Replayed request diffs (not from_backend) are OT-transformed
+    # approximations and get lenient index handling; authoritative
+    # backend diffs stay strict.
+    apply_diffs(patch.get('diffs', []), doc._cache, updated, inbound,
+                lenient=not from_backend)
     update_parent_objects(doc._cache, updated, inbound)
 
     if from_backend:
         seq = patch.get('clock', {}).get(actor)
         if seq and seq > state['seq']:
             state['seq'] = seq
-        state['deps'] = patch['deps']
-        state['canUndo'] = patch['canUndo']
-        state['canRedo'] = patch['canRedo']
+        # hand-built patches may omit deps/undo state (the reference
+        # tolerates undefined here — frontend/index.js:114-129)
+        state['deps'] = patch.get('deps', {})
+        state['canUndo'] = patch.get('canUndo', False)
+        state['canRedo'] = patch.get('canRedo', False)
     return update_root_object(doc, updated, inbound, state)
 
 

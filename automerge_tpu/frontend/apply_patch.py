@@ -142,8 +142,19 @@ def clone_list_object(original, object_id):
     return lst
 
 
-def update_list_object(diff, cache, updated, inbound):
-    """Apply one diff to a list object (apply_patch.js:168-210)."""
+def update_list_object(diff, cache, updated, inbound, lenient=False):
+    """Apply one diff to a list object (apply_patch.js:168-210).
+
+    ``lenient`` is set ONLY when replaying in-flight local request diffs:
+    they pass through the deliberately-approximate OT
+    (frontend/index.js:131-192, documented there as "incomplete and
+    incorrect"), which can produce out-of-range indexes and inserts
+    without elemIds. The reference survives because JS arrays tolerate
+    both; here lenient mode clamps indexes (a remove past the end is a
+    no-op) — the backend's authoritative patch replaces every transient
+    approximation. Authoritative patches stay strict: a bad index there
+    is a backend bug and must fail loudly, not diverge silently.
+    """
     if diff['obj'] not in updated:
         updated[diff['obj']] = clone_list_object(cache.get(diff['obj']), diff['obj'])
     lst = updated[diff['obj']]
@@ -160,22 +171,40 @@ def update_list_object(diff, cache, updated, inbound):
     if diff['action'] == 'create':
         pass
     elif diff['action'] == 'insert':
-        object.__setattr__(lst, '_max_elem',
-                           max(lst._max_elem, parse_elem_id(diff['elemId'])[0]))
-        list.insert(lst, diff['index'], value)
-        conflicts.insert(diff['index'], conflict)
-        elem_ids.insert(diff['index'], diff['elemId'])
-        refs_after = _child_references_list(lst, diff['index'])
+        index = diff['index']
+        elem_id = diff.get('elemId')
+        if lenient:
+            index = min(index, len(lst))
+        if elem_id is not None:
+            object.__setattr__(lst, '_max_elem',
+                               max(lst._max_elem, parse_elem_id(elem_id)[0]))
+        elif not lenient:
+            raise ValueError('List insert diff requires an elemId')
+        if index > len(lst):
+            raise IndexError(f'List insert index {index} out of range')
+        list.insert(lst, index, value)
+        conflicts.insert(index, conflict)
+        elem_ids.insert(index, elem_id)
+        refs_after = _child_references_list(lst, index)
     elif diff['action'] == 'set':
-        refs_before = _child_references_list(lst, diff['index'])
-        list.__setitem__(lst, diff['index'], value)
-        conflicts[diff['index']] = conflict
-        refs_after = _child_references_list(lst, diff['index'])
+        if lenient and diff['index'] >= len(lst):  # transient OT overshoot
+            list.append(lst, value)
+            conflicts.append(conflict)
+            elem_ids.append(None)
+            refs_after = _child_references_list(lst, len(lst) - 1)
+        else:
+            refs_before = _child_references_list(lst, diff['index'])
+            list.__setitem__(lst, diff['index'], value)
+            conflicts[diff['index']] = conflict
+            refs_after = _child_references_list(lst, diff['index'])
     elif diff['action'] == 'remove':
-        refs_before = _child_references_list(lst, diff['index'])
-        list.__delitem__(lst, diff['index'])
-        del conflicts[diff['index']]
-        del elem_ids[diff['index']]
+        if lenient and diff['index'] >= len(lst):
+            pass                                   # transient OT overshoot
+        else:
+            refs_before = _child_references_list(lst, diff['index'])
+            list.__delitem__(lst, diff['index'])
+            del conflicts[diff['index']]
+            del elem_ids[diff['index']]
     else:
         raise ValueError('Unknown action type: ' + diff['action'])
 
@@ -275,16 +304,17 @@ def update_parent_objects(cache, updated, inbound):
                 parent_map_object(object_id, cache, updated)
 
 
-def apply_diffs(diffs, cache, updated, inbound):
+def apply_diffs(diffs, cache, updated, inbound, lenient=False):
     """Dispatch diffs to the per-type appliers; text diffs are grouped into
-    runs per object (apply_patch.js:353-373)."""
+    runs per object (apply_patch.js:353-373). ``lenient`` applies only to
+    replayed in-flight request diffs (see update_list_object)."""
     start_index = 0
     for end_index, diff in enumerate(diffs):
         if diff['type'] == 'map':
             update_map_object(diff, cache, updated, inbound)
             start_index = end_index + 1
         elif diff['type'] == 'list':
-            update_list_object(diff, cache, updated, inbound)
+            update_list_object(diff, cache, updated, inbound, lenient)
             start_index = end_index + 1
         elif diff['type'] == 'text':
             if end_index == len(diffs) - 1 or diffs[end_index + 1]['obj'] != diff['obj']:

@@ -100,6 +100,7 @@ def restore_state(payload):
         state.state_lens[actor] = len(rows)
     state.history = []
     state.history_len = 0
+    state.log_truncated = True
     return state
 
 

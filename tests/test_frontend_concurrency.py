@@ -1,0 +1,522 @@
+"""Port of the reference frontend suite (test/frontend_test.js) —
+request emission, the backend-concurrency simulation (lagging seq/clock
+patches interleaved with queued local requests, exercising the request
+queue + operational transform), and hand-built patch application.
+
+The frontend here runs WITHOUT a backend (split mode): requests queue up
+optimistically and remote patches replay the pending queue on top.
+"""
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.uuid import uuid
+
+
+def get_requests(doc):
+    """Pending queued requests minus internal bookkeeping
+    (frontend_test.js:109-116)."""
+    out = []
+    for req in doc._state['requests']:
+        req = {k: v for k, v in req.items() if k not in ('before', 'diffs')}
+        out.append(req)
+    return out
+
+
+def mat(doc):
+    def conv(obj):
+        name = type(obj).__name__
+        if name == 'AmList':
+            return [conv(v) for v in obj]
+        if hasattr(obj, '_conflicts'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+class TestPerformingChanges:
+    """frontend_test.js:24-106 — exact request emission."""
+
+    def test_unmodified_doc_returned_when_nothing_changed(self):
+        doc0 = Frontend.init()
+        doc1, req = Frontend.change(doc0, lambda d: None)
+        assert doc1 is doc0
+        assert req is None
+
+    def test_deferred_actor_id(self):
+        doc0 = Frontend.init({'deferActorId': True})
+        assert Frontend.get_actor_id(doc0) is None
+        with pytest.raises(ValueError, match='set_actor_id'):
+            Frontend.change(doc0, lambda d: d.__setitem__('foo', 'bar'))
+        doc1 = Frontend.set_actor_id(doc0, uuid())
+        doc2, _ = Frontend.change(doc1, lambda d: d.__setitem__('foo', 'bar'))
+        assert mat(doc2) == {'foo': 'bar'}
+
+    def test_set_root_property_request(self):
+        actor = uuid()
+        doc, req = Frontend.change(Frontend.init(actor),
+                                   lambda d: d.__setitem__('bird', 'magpie'))
+        assert mat(doc) == {'bird': 'magpie'}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': ROOT_ID, 'action': 'set', 'key': 'bird',
+                            'value': 'magpie'}]}
+
+    def test_create_nested_map_request(self):
+        doc, req = Frontend.change(Frontend.init(),
+                                   lambda d: d.__setitem__('birds',
+                                                           {'wrens': 3}))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert mat(doc) == {'birds': {'wrens': 3}}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': birds, 'action': 'makeMap'},
+                           {'obj': birds, 'action': 'set', 'key': 'wrens',
+                            'value': 3},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds',
+                            'value': birds}]}
+
+    def test_update_inside_nested_map_request(self):
+        doc1, _ = Frontend.change(Frontend.init(),
+                                  lambda d: d.__setitem__('birds',
+                                                          {'wrens': 3}))
+        doc2, req2 = Frontend.change(
+            doc1, lambda d: d['birds'].__setitem__('sparrows', 15))
+        birds = Frontend.get_object_id(doc2['birds'])
+        actor = Frontend.get_actor_id(doc1)
+        assert mat(doc2) == {'birds': {'wrens': 3, 'sparrows': 15}}
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2,
+                        'deps': {}, 'ops': [
+                            {'obj': birds, 'action': 'set',
+                             'key': 'sparrows', 'value': 15}]}
+
+    def test_delete_map_key_request(self):
+        actor = uuid()
+        doc1, _ = Frontend.change(
+            Frontend.init(actor),
+            lambda d: d.update({'magpies': 2, 'sparrows': 15}))
+        doc2, req2 = Frontend.change(doc1,
+                                     lambda d: d.__delitem__('magpies'))
+        assert mat(doc2) == {'sparrows': 15}
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2,
+                        'deps': {}, 'ops': [
+                            {'obj': ROOT_ID, 'action': 'del',
+                             'key': 'magpies'}]}
+
+    def test_create_list_request(self):
+        doc, req = Frontend.change(
+            Frontend.init(), lambda d: d.__setitem__('birds', ['chaffinch']))
+        birds = Frontend.get_object_id(doc['birds'])
+        actor = Frontend.get_actor_id(doc)
+        assert mat(doc) == {'birds': ['chaffinch']}
+        assert req == {'requestType': 'change', 'actor': actor, 'seq': 1,
+                       'deps': {}, 'ops': [
+                           {'obj': birds, 'action': 'makeList'},
+                           {'obj': birds, 'action': 'ins', 'key': '_head',
+                            'elem': 1},
+                           {'obj': birds, 'action': 'set',
+                            'key': f'{actor}:1', 'value': 'chaffinch'},
+                           {'obj': ROOT_ID, 'action': 'link', 'key': 'birds',
+                            'value': birds}]}
+
+    def test_set_list_index_request(self):
+        doc1, _ = Frontend.change(
+            Frontend.init(), lambda d: d.__setitem__('birds', ['chaffinch']))
+        doc2, req2 = Frontend.change(
+            doc1, lambda d: d['birds'].__setitem__(0, 'greenfinch'))
+        birds = Frontend.get_object_id(doc2['birds'])
+        actor = Frontend.get_actor_id(doc2)
+        assert mat(doc2) == {'birds': ['greenfinch']}
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2,
+                        'deps': {}, 'ops': [
+                            {'obj': birds, 'action': 'set',
+                             'key': f'{actor}:1', 'value': 'greenfinch'}]}
+
+    def test_delete_list_element_request(self):
+        doc1, _ = Frontend.change(
+            Frontend.init(),
+            lambda d: d.__setitem__('birds', ['chaffinch', 'goldfinch']))
+        doc2, req2 = Frontend.change(doc1, lambda d: d['birds'].delete_at(0))
+        birds = Frontend.get_object_id(doc2['birds'])
+        actor = Frontend.get_actor_id(doc2)
+        assert mat(doc2) == {'birds': ['goldfinch']}
+        assert req2 == {'requestType': 'change', 'actor': actor, 'seq': 2,
+                        'deps': {}, 'ops': [
+                            {'obj': birds, 'action': 'del',
+                             'key': f'{actor}:1'}]}
+
+
+class TestBackendConcurrency:
+    """frontend_test.js:108-228 — the backend-concurrency simulation."""
+
+    def test_deps_and_seq_come_from_backend_patch(self):
+        local, remote1, remote2 = uuid(), uuid(), uuid()
+        patch1 = {
+            'clock': {local: 4, remote1: 11, remote2: 41},
+            'deps': {local: 4, remote2: 41},
+            'diffs': [{'action': 'set', 'obj': ROOT_ID, 'type': 'map',
+                       'key': 'blackbirds', 'value': 24}]}
+        doc1 = Frontend.apply_patch(Frontend.init(local), patch1)
+        doc2, req = Frontend.change(doc1,
+                                    lambda d: d.__setitem__('partridges', 1))
+        assert get_requests(doc2) == [
+            {'requestType': 'change', 'actor': local, 'seq': 5,
+             'deps': {remote2: 41}, 'ops': [
+                 {'obj': ROOT_ID, 'action': 'set', 'key': 'partridges',
+                  'value': 1}]}]
+
+    def test_pending_requests_removed_once_handled(self):
+        actor = uuid()
+        doc1, _ = Frontend.change(Frontend.init(actor),
+                                  lambda d: d.__setitem__('blackbirds', 24))
+        doc2, _ = Frontend.change(doc1,
+                                  lambda d: d.__setitem__('partridges', 1))
+        assert [r['seq'] for r in get_requests(doc2)] == [1, 2]
+
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'blackbirds', 'value': 24}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 1,
+                                           'diffs': diffs1})
+        assert mat(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert [r['seq'] for r in get_requests(doc2)] == [2]
+
+        diffs2 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'partridges', 'value': 1}]
+        doc2 = Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2,
+                                           'diffs': diffs2})
+        assert mat(doc2) == {'blackbirds': 24, 'partridges': 1}
+        assert get_requests(doc2) == []
+
+    def test_remote_patches_leave_request_queue_unchanged(self):
+        actor, other = uuid(), uuid()
+        doc, _ = Frontend.change(Frontend.init(actor),
+                                 lambda d: d.__setitem__('blackbirds', 24))
+        assert [r['seq'] for r in get_requests(doc)] == [1]
+
+        diffs1 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'pheasants', 'value': 2}]
+        doc = Frontend.apply_patch(doc, {'actor': other, 'seq': 1,
+                                         'diffs': diffs1})
+        assert mat(doc) == {'blackbirds': 24, 'pheasants': 2}
+        assert [r['seq'] for r in get_requests(doc)] == [1]
+
+        diffs2 = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                   'key': 'blackbirds', 'value': 24}]
+        doc = Frontend.apply_patch(doc, {'actor': actor, 'seq': 1,
+                                         'diffs': diffs2})
+        assert mat(doc) == {'blackbirds': 24, 'pheasants': 2}
+        assert get_requests(doc) == []
+
+    def test_request_patches_must_apply_in_order(self):
+        doc1, _ = Frontend.change(Frontend.init(),
+                                  lambda d: d.__setitem__('blackbirds', 24))
+        doc2, _ = Frontend.change(doc1,
+                                  lambda d: d.__setitem__('partridges', 1))
+        actor = Frontend.get_actor_id(doc2)
+        diffs = [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                  'key': 'partridges', 'value': 1}]
+        with pytest.raises(ValueError, match='Mismatched sequence number'):
+            Frontend.apply_patch(doc2, {'actor': actor, 'seq': 2,
+                                        'diffs': diffs})
+
+    def test_transforms_concurrent_insertions(self):
+        doc1, _ = Frontend.change(
+            Frontend.init(), lambda d: d.__setitem__('birds', ['goldfinch']))
+        birds = Frontend.get_object_id(doc1['birds'])
+        actor = Frontend.get_actor_id(doc1)
+        diffs1 = [
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'goldfinch', 'elemId': f'{actor}:1'},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True}]
+        doc1 = Frontend.apply_patch(doc1, {'actor': actor, 'seq': 1,
+                                           'diffs': diffs1})
+        assert mat(doc1) == {'birds': ['goldfinch']}
+        assert get_requests(doc1) == []
+
+        def edit(d):
+            d['birds'].insert_at(0, 'chaffinch')
+            d['birds'].insert_at(2, 'greenfinch')
+        doc2, _ = Frontend.change(doc1, edit)
+        assert mat(doc2) == {'birds': ['chaffinch', 'goldfinch',
+                                       'greenfinch']}
+
+        # a remote insertion lands while the local request is in flight:
+        # the pending local diffs are transformed past it
+        remote = uuid()
+        diffs3 = [{'obj': birds, 'type': 'list', 'action': 'insert',
+                   'index': 1, 'value': 'bullfinch',
+                   'elemId': f'{remote}:2'}]
+        doc3 = Frontend.apply_patch(doc2, {'actor': remote, 'seq': 1,
+                                           'diffs': diffs3})
+        assert mat(doc3) == {'birds': ['chaffinch', 'goldfinch',
+                                       'bullfinch', 'greenfinch']}
+
+        # the backend's authoritative reply for the local request
+        diffs4 = [
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'chaffinch', 'elemId': f'{actor}:2'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 2,
+             'value': 'greenfinch', 'elemId': f'{actor}:3'}]
+        doc4 = Frontend.apply_patch(doc3, {'actor': actor, 'seq': 2,
+                                           'diffs': diffs4})
+        assert mat(doc4) == {'birds': ['chaffinch', 'goldfinch',
+                                       'greenfinch', 'bullfinch']}
+        assert get_requests(doc4) == []
+
+    def test_interleaving_patches_and_changes(self):
+        actor = uuid()
+        doc1, req1 = Frontend.change(Frontend.init(actor),
+                                     lambda d: d.__setitem__('number', 1))
+        doc2, req2 = Frontend.change(doc1,
+                                     lambda d: d.__setitem__('number', 2))
+        assert req1['seq'] == 1 and req2['seq'] == 2
+        state0 = Backend.init(actor)
+        state1, patch1 = Backend.apply_local_change(state0, req1)
+        doc2a = Frontend.apply_patch(doc2, patch1)
+        doc3, req3 = Frontend.change(doc2a,
+                                     lambda d: d.__setitem__('number', 3))
+        assert req3 == {'requestType': 'change', 'actor': actor, 'seq': 3,
+                        'deps': {}, 'ops': [
+                            {'obj': ROOT_ID, 'action': 'set', 'key': 'number',
+                             'value': 3}]}
+
+    def test_lagging_clock_does_not_regress_seq(self):
+        """A backend patch whose clock lags the frontend's local seq must
+        not wind the sequence counter backwards."""
+        actor = uuid()
+        doc, _ = Frontend.change(Frontend.init(actor),
+                                 lambda d: d.__setitem__('a', 1))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('b', 2))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('c', 3))
+        # backend confirms only seq 1 (clock lags at 1)
+        doc = Frontend.apply_patch(
+            doc, {'actor': actor, 'seq': 1, 'clock': {actor: 1},
+                  'diffs': [{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                             'key': 'a', 'value': 1}]})
+        _, req = Frontend.change(doc, lambda d: d.__setitem__('d', 4))
+        assert req['seq'] == 4
+        assert [r['seq'] for r in get_requests(_)] == [2, 3, 4]
+
+    def test_own_confirmations_replay_pending_list_requests(self):
+        """Split mode: three queued list changes confirmed one at a time.
+        The transient replay goes through the deliberately-approximate OT
+        (which the reference documents as incorrect for this shape) but
+        must never crash, and once every request is confirmed the
+        document equals the backend's authoritative state."""
+        ui = Frontend.init('ui-actor')
+        backend = Backend.init('ui-actor')
+        pending = []
+
+        def local(doc, fn):
+            doc, req = Frontend.change(doc, fn)
+            pending.append(req)
+            return doc
+
+        ui = local(ui, lambda d: d.__setitem__('cards', ['a', 'b']))
+        ui = local(ui, lambda d: d['cards'].insert_at(1, 'mid'))
+        ui = local(ui, lambda d: d['cards'].__setitem__(0, 'A'))
+        assert [str(x) for x in ui['cards']] == ['A', 'mid', 'b']
+
+        while pending:
+            backend, patch = Backend.apply_local_change(backend,
+                                                        pending.pop(0))
+            ui = Frontend.apply_patch(ui, patch)
+        assert [str(x) for x in ui['cards']] == ['A', 'mid', 'b']
+        assert get_requests(ui) == []
+
+    def test_transform_set_against_remote_remove(self):
+        """A queued local 'set' at an index a remote patch removed turns
+        into an insert (frontend/index.js:131-192)."""
+        actor = uuid()
+        base = {'clock': {}, 'deps': {}, 'diffs': []}
+        doc = Frontend.init(actor)
+        birds = uuid()
+        setup = [
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'a', 'elemId': f'{actor}:1'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 1,
+             'value': 'b', 'elemId': f'{actor}:2'},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True}]
+        doc = Frontend.apply_patch(doc, dict(base, diffs=setup))
+        doc, _ = Frontend.change(
+            doc, lambda d: d['birds'].__setitem__(1, 'B!'))
+        # remote removes index 1 while the set is pending
+        remote = uuid()
+        doc = Frontend.apply_patch(
+            doc, {'actor': remote, 'seq': 1,
+                  'diffs': [{'obj': birds, 'type': 'list',
+                             'action': 'remove', 'index': 1}]})
+        assert mat(doc) == {'birds': ['a', 'B!']}
+
+
+class TestApplyingPatches:
+    """frontend_test.js:230-423 — hand-built diff application."""
+
+    def _apply(self, diffs, doc=None):
+        return Frontend.apply_patch(doc if doc is not None
+                                    else Frontend.init(), {'diffs': diffs})
+
+    def test_set_root_properties(self):
+        doc = self._apply([{'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+                            'key': 'bird', 'value': 'magpie'}])
+        assert mat(doc) == {'bird': 'magpie'}
+
+    def test_reveal_conflicts_on_root_properties(self):
+        actor = uuid()
+        doc = self._apply([
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+             'key': 'favoriteBird', 'value': 'wagtail',
+             'conflicts': [{'actor': actor, 'value': 'robin'}]}])
+        assert mat(doc) == {'favoriteBird': 'wagtail'}
+        assert Frontend.get_conflicts(doc) == {'favoriteBird':
+                                               {actor: 'robin'}}
+
+    def test_create_nested_maps(self):
+        birds = uuid()
+        doc = self._apply([
+            {'obj': birds, 'type': 'map', 'action': 'create'},
+            {'obj': birds, 'type': 'map', 'action': 'set', 'key': 'wrens',
+             'value': 3},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True}])
+        assert mat(doc) == {'birds': {'wrens': 3}}
+
+    def test_update_inside_map_key_conflict(self):
+        birds1, birds2, actor = uuid(), uuid(), uuid()
+        doc1 = self._apply([
+            {'obj': birds1, 'type': 'map', 'action': 'create'},
+            {'obj': birds1, 'type': 'map', 'action': 'set', 'key': 'wrens',
+             'value': 3},
+            {'obj': birds2, 'type': 'map', 'action': 'create'},
+            {'obj': birds2, 'type': 'map', 'action': 'set',
+             'key': 'blackbirds', 'value': 1},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+             'key': 'favoriteBirds', 'value': birds1, 'link': True,
+             'conflicts': [{'actor': actor, 'value': birds2, 'link': True}]}])
+        doc2 = self._apply([
+            {'obj': birds2, 'type': 'map', 'action': 'set',
+             'key': 'blackbirds', 'value': 2}], doc1)
+        assert mat(doc1) == {'favoriteBirds': {'wrens': 3}}
+        assert mat(doc2) == {'favoriteBirds': {'wrens': 3}}
+        c1 = Frontend.get_conflicts(doc1)['favoriteBirds'][actor]
+        c2 = Frontend.get_conflicts(doc2)['favoriteBirds'][actor]
+        assert dict(c1.items()) == {'blackbirds': 1}
+        assert dict(c2.items()) == {'blackbirds': 2}
+
+    def test_structure_sharing_of_unmodified_objects(self):
+        birds, mammals = uuid(), uuid()
+        doc1 = self._apply([
+            {'obj': birds, 'type': 'map', 'action': 'create'},
+            {'obj': birds, 'type': 'map', 'action': 'set', 'key': 'wrens',
+             'value': 3},
+            {'obj': mammals, 'type': 'map', 'action': 'create'},
+            {'obj': mammals, 'type': 'map', 'action': 'set',
+             'key': 'badgers', 'value': 1},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+             'key': 'mammals', 'value': mammals, 'link': True}])
+        doc2 = self._apply([
+            {'obj': birds, 'type': 'map', 'action': 'set',
+             'key': 'sparrows', 'value': 15}], doc1)
+        assert mat(doc2) == {'birds': {'wrens': 3, 'sparrows': 15},
+                             'mammals': {'badgers': 1}}
+        assert doc1['mammals'] is doc2['mammals']
+
+    def test_remove_keys_in_maps(self):
+        doc1 = self._apply([
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+             'key': 'magpies', 'value': 2},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set',
+             'key': 'sparrows', 'value': 15}])
+        doc2 = self._apply([
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'remove',
+             'key': 'magpies'}], doc1)
+        assert mat(doc2) == {'sparrows': 15}
+
+    def test_list_insert_set_remove(self):
+        birds, actor = uuid(), uuid()
+        doc1 = self._apply([
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': 'chaffinch', 'elemId': f'{actor}:1'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 1,
+             'value': 'goldfinch', 'elemId': f'{actor}:2'},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True}])
+        assert mat(doc1) == {'birds': ['chaffinch', 'goldfinch']}
+        doc2 = self._apply([
+            {'obj': birds, 'type': 'list', 'action': 'set', 'index': 0,
+             'value': 'greenfinch'}], doc1)
+        assert mat(doc2) == {'birds': ['greenfinch', 'goldfinch']}
+        doc3 = self._apply([
+            {'obj': birds, 'type': 'list', 'action': 'remove',
+             'index': 0}], doc2)
+        assert mat(doc3) == {'birds': ['goldfinch']}
+
+    def test_update_inside_list_element_conflict(self):
+        birds, item1, item2, actor = uuid(), uuid(), uuid(), uuid()
+        doc1 = self._apply([
+            {'obj': item1, 'type': 'map', 'action': 'create'},
+            {'obj': item1, 'type': 'map', 'action': 'set', 'key': 'species',
+             'value': 'lapwing'},
+            {'obj': item1, 'type': 'map', 'action': 'set', 'key': 'numSeen',
+             'value': 2},
+            {'obj': item2, 'type': 'map', 'action': 'create'},
+            {'obj': item2, 'type': 'map', 'action': 'set', 'key': 'species',
+             'value': 'woodpecker'},
+            {'obj': item2, 'type': 'map', 'action': 'set', 'key': 'numSeen',
+             'value': 1},
+            {'obj': birds, 'type': 'list', 'action': 'create'},
+            {'obj': birds, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': item1, 'link': True, 'elemId': f'{actor}:1',
+             'conflicts': [{'actor': actor, 'value': item2, 'link': True}]},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'birds',
+             'value': birds, 'link': True}])
+        doc2 = self._apply([
+            {'obj': item2, 'type': 'map', 'action': 'set', 'key': 'numSeen',
+             'value': 2}], doc1)
+        assert mat(doc1) == {'birds': [{'species': 'lapwing', 'numSeen': 2}]}
+        assert mat(doc2) == {'birds': [{'species': 'lapwing', 'numSeen': 2}]}
+        assert doc1['birds'][0] is doc2['birds'][0]
+        c1 = Frontend.get_conflicts(doc1['birds'])[0][actor]
+        c2 = Frontend.get_conflicts(doc2['birds'])[0][actor]
+        assert dict(c1.items()) == {'species': 'woodpecker', 'numSeen': 1}
+        assert dict(c2.items()) == {'species': 'woodpecker', 'numSeen': 2}
+
+    def test_updates_at_different_tree_levels(self):
+        counts, details, detail1, actor = uuid(), uuid(), uuid(), uuid()
+        doc1 = self._apply([
+            {'obj': counts, 'type': 'map', 'action': 'create'},
+            {'obj': counts, 'type': 'map', 'action': 'set', 'key': 'magpies',
+             'value': 2},
+            {'obj': detail1, 'type': 'map', 'action': 'create'},
+            {'obj': detail1, 'type': 'map', 'action': 'set', 'key': 'species',
+             'value': 'magpie'},
+            {'obj': detail1, 'type': 'map', 'action': 'set', 'key': 'family',
+             'value': 'corvidae'},
+            {'obj': details, 'type': 'list', 'action': 'create'},
+            {'obj': details, 'type': 'list', 'action': 'insert', 'index': 0,
+             'value': detail1, 'link': True, 'elemId': f'{actor}:1'},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'counts',
+             'value': counts, 'link': True},
+            {'obj': ROOT_ID, 'type': 'map', 'action': 'set', 'key': 'details',
+             'value': details, 'link': True}])
+        doc2 = self._apply([
+            {'obj': counts, 'type': 'map', 'action': 'set', 'key': 'magpies',
+             'value': 3},
+            {'obj': detail1, 'type': 'map', 'action': 'set', 'key': 'species',
+             'value': 'Eurasian magpie'}], doc1)
+        assert mat(doc1) == {'counts': {'magpies': 2},
+                             'details': [{'species': 'magpie',
+                                          'family': 'corvidae'}]}
+        assert mat(doc2) == {'counts': {'magpies': 3},
+                             'details': [{'species': 'Eurasian magpie',
+                                          'family': 'corvidae'}]}

@@ -143,6 +143,24 @@ class TestDeviceSnapshot:
         with pytest.raises(TypeError, match='device-backed'):
             snapshot.save_snapshot(doc)
 
+    def test_save_of_resumed_doc_raises_instead_of_truncating(self):
+        """save() on a snapshot-resumed doc would silently emit a log
+        that cannot replay — it must refuse and point at save_snapshot."""
+        changes = _frontend_changes('aa', lambda d: d.__setitem__('x', 1))
+        doc = snapshot.load_snapshot(
+            snapshot.save_snapshot(_device_doc(changes)))
+        with pytest.raises(ValueError, match='save_snapshot'):
+            am.save(doc)
+        # the packed format still round-trips
+        again = snapshot.load_snapshot(snapshot.save_snapshot(doc))
+        assert _materialize(again) == _materialize(doc)
+
+    def test_malformed_seq_rejected(self):
+        state = DeviceBackend.init()
+        with pytest.raises(ValueError, match='positive integer seq'):
+            DeviceBackend.apply_changes(
+                state, [{'actor': 'x', 'seq': 0, 'deps': {}, 'ops': []}])
+
 
 class TestDenseSnapshot:
     def test_roundtrip_and_continue(self):
