@@ -66,24 +66,28 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
     valid = jnp.arange(n_pad) < n_ops
 
     fidx = change_doc[op_change] * key_capacity + op_key.astype(jnp.int32)
-    fidx = jnp.where(valid, fidx, n_fields)            # park padding
+    # padding rows are parked at n_fields (out of bounds) and dropped by
+    # the scatters — planes stay exactly [n_fields, A], which shards
+    # cleanly over a doc-axis mesh (doc-major rows)
+    fidx = jnp.where(valid, fidx, n_fields)
     aslot = change_actor[op_change]
     seq_op = change_seq[op_change]
 
     seqdel = (seq_op << 1) | op_isdel.astype(jnp.int32)
     seqdel = jnp.where(valid, seqdel, 0)
-    new_eseq = eseq.at[fidx, aslot].max(seqdel)
+    new_eseq = eseq.at[fidx, aslot].max(seqdel, mode='drop')
 
     # cells whose max advanced get their value re-scattered by exactly
     # the ops that achieved the new maximum
     new_eval = jnp.where(new_eseq != eseq, _VAL_NONE, eval_)
-    mine = valid & (seqdel == new_eseq[fidx, aslot])
+    mine = valid & (seqdel == new_eseq.at[fidx, aslot].get(
+        mode='fill', fill_value=0))
     new_eval = new_eval.at[jnp.where(mine, fidx, n_fields), aslot].max(
-        op_value)
+        op_value, mode='drop')
 
     clock_op = change_clock[op_change]                 # [n_pad, A]
     clock_op = jnp.where(valid[:, None], clock_op, -1)
-    new_m = m.at[fidx].max(clock_op)
+    new_m = m.at[fidx].max(clock_op, mode='drop')
     return new_eseq, new_eval, new_m
 
 
@@ -96,8 +100,8 @@ def _extract_kernel(eseq, eval_, m, str_rank, touched_mask, *, f_pad):
     """
     (fidx,) = jnp.nonzero(touched_mask, size=f_pad, fill_value=-1)
     frow = jnp.maximum(fidx, 0)
-    seqdel = eseq[frow]                                # [f_pad, A]
-    mrows = m[frow]
+    seqdel = eseq.at[frow].get(mode='fill', fill_value=0)  # [f_pad, A]
+    mrows = m.at[frow].get(mode='fill', fill_value=-1)
     seq = seqdel >> 1
     is_del = (seqdel & 1) != 0
     alive = (seq > 0) & (mrows < seq) & ~is_del & (fidx >= 0)[:, None]
@@ -184,10 +188,17 @@ class DensePatch:
 
 
 class DenseMapStore:
-    """A DocSet of flat map documents resident in device memory."""
+    """A DocSet of flat map documents resident in device memory.
+
+    With a ``mesh`` (a 1-D document-axis mesh), the planes live sharded
+    across the devices — rows are doc-major, so splitting axis 0 places
+    each document's fields wholly on one device and the apply scatters
+    stay shard-local (dp for the dense engine). ``n_docs * key_capacity``
+    must divide evenly by the mesh size.
+    """
 
     def __init__(self, n_docs, key_capacity=64, actor_capacity=16,
-                 options=None):
+                 options=None, mesh=None):
         from .engine import as_options
         self.options = as_options(options)
         self.n_docs = n_docs
@@ -195,17 +206,32 @@ class DenseMapStore:
         self.actor_capacity = actor_capacity
         self.n_fields = n_docs * key_capacity
         self.host = _blocks.BlockStore(n_docs)   # interning/clock/log/queue
-        # one padding row (index n_fields) absorbs parked scatters
-        shape = (self.n_fields + 1, actor_capacity)
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            axis = mesh.axis_names[0]
+            # whole documents per shard (doc-locality: apply scatters
+            # stay shard-local), so the DOC count must divide
+            if n_docs % mesh.devices.size:
+                raise ValueError(
+                    f'{n_docs} docs do not divide over '
+                    f'{mesh.devices.size} devices')
+            self._sharding = NamedSharding(mesh, PartitionSpec(axis, None))
+        self._alloc_planes()
+        self.slot_actor_ids = np.zeros(0, np.int32)  # slot -> store actor
+
+    def _alloc_planes(self):
+        shape = (self.n_fields, self.actor_capacity)
         self.eseq = jnp.zeros(shape, jnp.int32)
         self.eval_ = jnp.full(shape, _VAL_NONE, jnp.int32)
         self.m = jnp.full(shape, -1, jnp.int32)
-        self.slot_actor_ids = np.zeros(0, np.int32)  # slot -> store actor
+        if self._sharding is not None:
+            self.eseq = jax.device_put(self.eseq, self._sharding)
+            self.eval_ = jax.device_put(self.eval_, self._sharding)
+            self.m = jax.device_put(self.m, self._sharding)
 
     def reset(self):
-        self.eseq = jnp.zeros_like(self.eseq)
-        self.eval_ = jnp.full_like(self.eval_, _VAL_NONE)
-        self.m = jnp.full_like(self.m, -1)
+        self._alloc_planes()
         self.host = _blocks.BlockStore(self.n_docs)
         self.slot_actor_ids = np.zeros(0, np.int32)
 
@@ -227,8 +253,7 @@ class DenseMapStore:
     def extract_all(self):
         """Patch covering every populated field — materializes the whole
         store (the dense analogue of getPatch, backend/index.js:201-207)."""
-        populated = np.asarray((self.eseq != 0).any(axis=1)).copy()
-        populated[-1] = False
+        populated = np.asarray((self.eseq != 0).any(axis=1))
         return self._extract(populated)
 
     # -- packed checkpoint (SURVEY §5: replay-free resume) -------------------
@@ -262,8 +287,12 @@ class DenseMapStore:
         return buf.getvalue()
 
     @classmethod
-    def load_snapshot(cls, data, options=None):
-        """Rebuild a store from :meth:`save_snapshot` bytes."""
+    def load_snapshot(cls, data, options=None, mesh=None):
+        """Rebuild a store from :meth:`save_snapshot` bytes.
+
+        Meshes are runtime topology, not state, so the caller resupplies
+        ``mesh`` to resume sharded (a store sized for a sharded HBM
+        footprint should not be resumed single-device)."""
         import io
         import json
         with np.load(io.BytesIO(data)) as z:
@@ -273,10 +302,14 @@ class DenseMapStore:
             store = cls(meta['n_docs'],
                         key_capacity=meta['key_capacity'],
                         actor_capacity=meta['actor_capacity'],
-                        options=options)
-            store.eseq = jnp.asarray(z['eseq'])
-            store.eval_ = jnp.asarray(z['eval'])
-            store.m = jnp.asarray(z['m'])
+                        options=options, mesh=mesh)
+            def place(arr):
+                if store._sharding is not None:
+                    return jax.device_put(arr, store._sharding)
+                return jnp.asarray(arr)
+            store.eseq = place(z['eseq'])
+            store.eval_ = place(z['eval'])
+            store.m = place(z['m'])
             host = store.host
             host.actors = list(meta['actors'])
             host.actor_of = {a: i for i, a in enumerate(host.actors)}
@@ -369,10 +402,9 @@ class DenseMapStore:
             n_fields=self.n_fields, n_actors=A)
 
         # touched fields -> device extraction
-        touched = np.zeros(self.n_fields + 1, bool)
+        touched = np.zeros(self.n_fields, bool)
         fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
         touched[fk] = True
-        touched[-1] = False
         patch = self._extract(touched)
         t3 = time.perf_counter()
 

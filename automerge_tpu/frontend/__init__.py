@@ -121,8 +121,11 @@ def apply_patch_to_doc(doc, patch, state, from_backend):
         seq = patch.get('clock', {}).get(actor)
         if seq and seq > state['seq']:
             state['seq'] = seq
-        # hand-built patches may omit deps/undo state (the reference
-        # tolerates undefined here — frontend/index.js:114-129)
+        # Patches may omit deps/undo state; the reference sets state.deps
+        # to undefined in that case, which its next makeChange treats as
+        # {} (frontend/index.js:114-129, :79) — the {} defaults here are
+        # that exact behavior, not a loosening. Both real backends always
+        # populate these fields.
         state['deps'] = patch.get('deps', {})
         state['canUndo'] = patch.get('canUndo', False)
         state['canRedo'] = patch.get('canRedo', False)
