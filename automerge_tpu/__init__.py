@@ -21,7 +21,7 @@ import json as _json
 
 from . import frontend as Frontend
 from . import backend as Backend
-from .common import ROOT_ID, is_object
+from .common import ROOT_ID, is_object, less_or_equal
 from .text import Text
 from .uuid import uuid
 
@@ -103,14 +103,28 @@ def save(doc):
     return _json.dumps({'format': 'automerge-tpu@1', 'changes': history})
 
 
+def _backend_of(state):
+    """The backend module a state belongs to — the facade works uniformly
+    over host-oracle and device-backed documents (and mixes of the two:
+    changes are the wire format either way)."""
+    if hasattr(state, 'op_set'):
+        return Backend
+    from .device import backend as DeviceBackend
+    return DeviceBackend
+
+
 def merge(local_doc, remote_doc):
     """Apply changes from `remote_doc` missing in `local_doc`
-    (src/automerge.js:54-64)."""
+    (src/automerge.js:54-64). The two documents may use different
+    backends (oracle or device) — the change wire format is shared."""
     if Frontend.get_actor_id(local_doc) == Frontend.get_actor_id(remote_doc):
         raise ValueError('Cannot merge an actor with itself')
     local_state = Frontend.get_backend_state(local_doc)
     remote_state = Frontend.get_backend_state(remote_doc)
-    state, patch = Backend.merge(local_state, remote_state)
+    changes = _backend_of(remote_state).get_missing_changes(
+        remote_state, local_state.clock)
+    state, patch = _backend_of(local_state).apply_changes(local_state,
+                                                          changes)
     if not patch['diffs']:
         return local_doc
     patch['state'] = state
@@ -121,27 +135,31 @@ def diff(old_doc, new_doc):
     """Diffs that transform `old_doc`'s tree into `new_doc`'s
     (src/automerge.js:66-72)."""
     old_state = Frontend.get_backend_state(old_doc)
-    new_state = Frontend.get_backend_state(new_doc)
-    changes = Backend.get_changes(old_state, new_state)
-    _, patch = Backend.apply_changes(old_state, changes)
+    changes = get_changes(old_doc, new_doc)
+    _, patch = _backend_of(old_state).apply_changes(old_state, changes)
     return patch['diffs']
 
 
 def get_changes(old_doc, new_doc):
     old_state = Frontend.get_backend_state(old_doc)
     new_state = Frontend.get_backend_state(new_doc)
-    return Backend.get_changes(old_state, new_state)
+    if not less_or_equal(dict(old_state.clock), dict(new_state.clock)):
+        raise ValueError('Cannot diff two states that have diverged')
+    return _backend_of(new_state).get_missing_changes(new_state,
+                                                      old_state.clock)
 
 
 def apply_changes(doc, changes):
     old_state = Frontend.get_backend_state(doc)
-    new_state, patch = Backend.apply_changes(old_state, changes)
+    new_state, patch = _backend_of(old_state).apply_changes(old_state,
+                                                            changes)
     patch['state'] = new_state
     return Frontend.apply_patch(doc, patch)
 
 
 def get_missing_deps(doc):
-    return Backend.get_missing_deps(Frontend.get_backend_state(doc))
+    state = Frontend.get_backend_state(doc)
+    return _backend_of(state).get_missing_deps(state)
 
 
 def equals(val1, val2):
@@ -195,7 +213,8 @@ class _HistoryEntry:
 def get_history(doc):
     state = Frontend.get_backend_state(doc)
     actor = Frontend.get_actor_id(doc)
-    history = state.op_set.get_history()
+    log = state.op_set if hasattr(state, 'op_set') else state
+    history = log.get_history()
     return [_HistoryEntry(actor, history, i) for i in range(len(history))]
 
 

@@ -96,7 +96,7 @@ class DeviceBackendState(SharedChangeLog):
 
     __slots__ = ('objects', 'fields', 'states', 'state_lens', 'clock',
                  'deps', 'queue', 'history', 'history_len', '_owned',
-                 'log_truncated')
+                 'log_truncated', 'undo_pos', 'undo_stack', 'redo_stack')
 
     def __init__(self):
         self.objects = {ROOT_ID: _ObjRecord(None)}
@@ -112,6 +112,9 @@ class DeviceBackendState(SharedChangeLog):
         self.history_len = 0
         self._owned = {ROOT_ID}  # objectIds private to this snapshot
         self.log_truncated = False  # True after a snapshot resume
+        self.undo_pos = 0
+        self.undo_stack = []     # per local change: list of inverse ops
+        self.redo_stack = []
 
     def clone(self):
         new = DeviceBackendState.__new__(DeviceBackendState)
@@ -126,6 +129,9 @@ class DeviceBackendState(SharedChangeLog):
         new.history_len = self.history_len
         new._owned = set()
         new.log_truncated = self.log_truncated
+        new.undo_pos = self.undo_pos
+        new.undo_stack = list(self.undo_stack)
+        new.redo_stack = list(self.redo_stack)
         return new
 
     def _writable(self, object_id):
@@ -525,7 +531,8 @@ def _emit_seq_diffs(work, obj, rec, visible, vis_index):
 
 def _make_patch(state, diffs):
     return {'clock': dict(state.clock), 'deps': dict(state.deps),
-            'canUndo': False, 'canRedo': False, 'diffs': diffs}
+            'canUndo': state.undo_pos > 0,
+            'canRedo': bool(state.redo_stack), 'diffs': diffs}
 
 
 # -- public surface ----------------------------------------------------------
@@ -596,22 +603,120 @@ def apply_changes(state, changes, kernel=None, options=None):
     return new_states[0], patches[0]
 
 
-def apply_local_change(state, request, kernel=None, options=None):
-    """Apply one local change request (backend/index.js:173-195).
+def _capture_undo_ops(state, change):
+    """Inverse ops for one local change: each touched pre-existing field's
+    surviving entries (as plain set/link ops), or a del if the field was
+    new (op_set.js:185-192)."""
+    new_objects = set()
+    undo_ops = []
+    seen = set()
+    for op in change.get('ops', ()):
+        action = op['action']
+        if action in _MAKE_KIND:
+            new_objects.add(op['obj'])
+        elif action in ('set', 'del', 'link') and op['obj'] not in new_objects:
+            field = (op['obj'], op['key'])
+            if field in seen:
+                continue
+            seen.add(field)
+            prior = state.fields.get(field, ())
+            if prior:
+                for e in prior:
+                    inv = {'action': e['action'], 'obj': op['obj'],
+                           'key': op['key'], 'value': e['value']}
+                    undo_ops.append(inv)
+            else:
+                undo_ops.append({'action': 'del', 'obj': op['obj'],
+                                 'key': op['key']})
+    return undo_ops
 
-    The device backend does not keep op-level undo history; 'undo'/'redo'
-    requests are rejected — documents needing undo use the oracle backend.
-    """
+
+def _field_ops_or_del(state, ref_ops):
+    """Current field state of each op's field as plain ops (the redo
+    capture of backend/index.js:262-276)."""
+    out = []
+    for op in ref_ops:
+        if op['action'] not in ('set', 'del', 'link'):
+            raise ValueError(
+                f'Unexpected operation type in undo history: {op}')
+        entries = state.fields.get((op['obj'], op['key']), ())
+        if not entries:
+            out.append({'action': 'del', 'obj': op['obj'],
+                        'key': op['key']})
+        else:
+            for e in entries:
+                out.append({'action': e['action'], 'obj': op['obj'],
+                            'key': op['key'], 'value': e['value']})
+    return out
+
+
+def _undo(state, request, kernel=None, options=None):
+    """Apply the inverse ops from the undo stack as a new change
+    (backend/index.js:252-285)."""
+    if state.undo_pos < 1:
+        raise ValueError('Cannot undo: there is nothing to be undone')
+    undo_ops = state.undo_stack[state.undo_pos - 1]
+    change = {'actor': request['actor'], 'seq': request['seq'],
+              'deps': dict(request.get('deps', {})), 'ops': undo_ops}
+    if request.get('message') is not None:
+        change['message'] = request['message']
+    redo_ops = _field_ops_or_del(state, undo_ops)
+
+    new_state, patch = apply_changes(state, [change], kernel=kernel,
+                                     options=options)
+    new_state.undo_pos = state.undo_pos - 1
+    new_state.redo_stack = state.redo_stack + [redo_ops]
+    patch['canUndo'] = new_state.undo_pos > 0
+    patch['canRedo'] = True
+    return new_state, patch
+
+
+def _redo(state, request, kernel=None, options=None):
+    """Re-apply the ops reverted by the last undo (backend/index.js:293-308)."""
+    if not state.redo_stack:
+        raise ValueError('Cannot redo: the last change was not an undo')
+    redo_ops = state.redo_stack[-1]
+    change = {'actor': request['actor'], 'seq': request['seq'],
+              'deps': dict(request.get('deps', {})), 'ops': redo_ops}
+    if request.get('message') is not None:
+        change['message'] = request['message']
+
+    new_state, patch = apply_changes(state, [change], kernel=kernel,
+                                     options=options)
+    new_state.undo_pos = state.undo_pos + 1
+    new_state.redo_stack = state.redo_stack[:-1]
+    patch['canUndo'] = True
+    patch['canRedo'] = bool(new_state.redo_stack)
+    return new_state, patch
+
+
+def apply_local_change(state, request, kernel=None, options=None):
+    """Apply one local change request, recording undo history
+    (backend/index.js:173-195)."""
     if not isinstance(request.get('actor'), str) or not isinstance(request.get('seq'), int):
         raise TypeError('Change request requires `actor` and `seq` properties')
     if request['seq'] <= state.clock.get(request['actor'], 0):
         raise ValueError('Change request has already been applied')
-    if request.get('requestType') != 'change':
-        raise NotImplementedError(
-            'device backend supports requestType "change" only')
-    change = {k: v for k, v in request.items() if k != 'requestType'}
-    new_state, patch = apply_changes(state, [change], kernel=kernel,
-                                     options=options)
+    request_type = request.get('requestType')
+    if request_type == 'change':
+        change = {k: v for k, v in request.items() if k != 'requestType'}
+        undo_ops = _capture_undo_ops(state, change)
+        new_state, patch = apply_changes(state, [change], kernel=kernel,
+                                         options=options)
+        new_state.undo_stack = \
+            state.undo_stack[:state.undo_pos] + [undo_ops]
+        new_state.undo_pos = state.undo_pos + 1
+        new_state.redo_stack = []
+        patch['canUndo'] = True
+        patch['canRedo'] = False
+    elif request_type == 'undo':
+        new_state, patch = _undo(state, request, kernel=kernel,
+                                 options=options)
+    elif request_type == 'redo':
+        new_state, patch = _redo(state, request, kernel=kernel,
+                                 options=options)
+    else:
+        raise ValueError(f'Unknown requestType: {request_type}')
     patch['actor'] = request['actor']
     patch['seq'] = request['seq']
     return new_state, patch

@@ -193,8 +193,8 @@ class DenseMapStore:
     With a ``mesh`` (a 1-D document-axis mesh), the planes live sharded
     across the devices — rows are doc-major, so splitting axis 0 places
     each document's fields wholly on one device and the apply scatters
-    stay shard-local (dp for the dense engine). ``n_docs * key_capacity``
-    must divide evenly by the mesh size.
+    stay shard-local (dp for the dense engine). ``n_docs`` must divide
+    evenly by the mesh size (doc-locality is the checked invariant).
     """
 
     def __init__(self, n_docs, key_capacity=64, actor_capacity=16,
@@ -303,6 +303,12 @@ class DenseMapStore:
                         key_capacity=meta['key_capacity'],
                         actor_capacity=meta['actor_capacity'],
                         options=options, mesh=mesh)
+            want = (store.n_fields, store.actor_capacity)
+            if z['eseq'].shape != want:
+                raise ValueError(
+                    f"incompatible snapshot: plane shape "
+                    f"{z['eseq'].shape} != {want} (saved by an older "
+                    f"format?)")
             def place(arr):
                 if store._sharding is not None:
                     return jax.device_put(arr, store._sharding)

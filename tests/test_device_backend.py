@@ -220,9 +220,9 @@ class TestDeviceLocalChange:
         state = Frontend.get_backend_state(doc)
         assert state.clock == {'local-1': 1}
 
-    def test_undo_rejected(self):
+    def test_undo_with_empty_history_rejected(self):
         state = DeviceBackend.init()
-        with pytest.raises(NotImplementedError):
+        with pytest.raises(ValueError, match='nothing to be undone'):
             DeviceBackend.apply_local_change(
                 state, {'requestType': 'undo', 'actor': 'a', 'seq': 1,
                         'deps': {}})

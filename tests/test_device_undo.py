@@ -1,0 +1,176 @@
+"""Undo/redo on the device backend — differential against the oracle.
+
+Shapes from the reference undo suite (test/test.js:790-1109): undo is a
+NEW change carrying the inverse ops (history grows), redo re-applies what
+the undo reverted, and a fresh local change clears the redo stack.
+"""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.text import Text
+
+
+def _mat(doc):
+    def conv(obj):
+        name = type(obj).__name__
+        if name == 'Text':
+            return ''.join(str(c) for c in obj)
+        if name == 'AmList':
+            return [conv(v) for v in obj]
+        if hasattr(obj, '_conflicts'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+def _pair(actor='undo-actor'):
+    """The same document driven through both backends."""
+    dev = Frontend.set_actor_id(Frontend.init({'backend': DeviceBackend}),
+                                actor)
+    orc = Frontend.set_actor_id(Frontend.init({'backend': Backend}), actor)
+    return dev, orc
+
+
+def _both(pair, fn):
+    dev, orc = pair
+    dev, _ = Frontend.change(dev, fn)
+    orc, _ = Frontend.change(orc, fn)
+    return dev, orc
+
+
+def _assert_same(pair):
+    dev, orc = pair
+    assert _mat(dev) == _mat(orc)
+    assert dev._conflicts == orc._conflicts
+    assert Frontend.can_undo(dev) == Frontend.can_undo(orc)
+    assert Frontend.can_redo(dev) == Frontend.can_redo(orc)
+    return pair
+
+
+def _undo(pair):
+    dev, orc = pair
+    dev, _ = Frontend.undo(dev)
+    orc, _ = Frontend.undo(orc)
+    return dev, orc
+
+
+def _redo(pair):
+    dev, orc = pair
+    dev, _ = Frontend.redo(dev)
+    orc, _ = Frontend.redo(orc)
+    return dev, orc
+
+
+class TestDeviceUndo:
+    def test_undo_set_restores_prior_value(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('x', 1))
+        pair = _both(pair, lambda d: d.__setitem__('x', 2))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0]) == {'x': 1}
+
+    def test_undo_new_key_deletes_it(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('keep', 'k'))
+        pair = _both(pair, lambda d: d.__setitem__('fresh', 'new'))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0]) == {'keep': 'k'}
+
+    def test_undo_delete_restores(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('x', 'val'))
+        pair = _both(pair, lambda d: d.__delitem__('x'))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0]) == {'x': 'val'}
+
+    def test_redo_after_undo(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('x', 1))
+        pair = _both(pair, lambda d: d.__setitem__('x', 2))
+        pair = _assert_same(_undo(pair))
+        pair = _assert_same(_redo(pair))
+        assert _mat(pair[0]) == {'x': 2}
+
+    def test_undo_chain_to_empty(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('a', 1))
+        pair = _both(pair, lambda d: d.__setitem__('b', 2))
+        pair = _assert_same(_undo(pair))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0]) == {}
+        assert not Frontend.can_undo(pair[0])
+
+    def test_new_change_clears_redo(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('x', 1))
+        pair = _assert_same(_undo(pair))
+        pair = _both(pair, lambda d: d.__setitem__('y', 9))
+        _assert_same(pair)
+        assert not Frontend.can_redo(pair[0])
+
+    def test_undo_list_element_set(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('items',
+                                                      ['a', 'b', 'c']))
+        pair = _both(pair, lambda d: d['items'].__setitem__(1, 'B'))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0])['items'] == ['a', 'b', 'c']
+
+    def test_undo_list_insert_removes_element(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('items', ['a']))
+        pair = _both(pair, lambda d: d['items'].append('z'))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0])['items'] == ['a']
+
+    def test_undo_text_edit(self):
+        pair = _both(_pair(), lambda d: d.__setitem__('t', Text()))
+        pair = _both(pair, lambda d: d['t'].insert_at(0, *'hi'))
+        pair = _assert_same(_undo(pair))
+        assert _mat(pair[0])['t'] == ''
+
+    def test_undo_grows_history(self):
+        """Undo is a change, not a rollback (test/test.js:852)."""
+        pair = _both(_pair(), lambda d: d.__setitem__('x', 1))
+        dev, orc = _undo(pair)
+        dev_hist = Frontend.get_backend_state(dev).get_history()
+        assert len(dev_hist) == 2
+        assert dev_hist[1]['ops'] == [
+            {'action': 'del', 'obj': am.ROOT_ID, 'key': 'x'}]
+
+    def test_public_api_on_device_doc(self):
+        doc = Frontend.set_actor_id(
+            Frontend.init({'backend': DeviceBackend}), 'pub')
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('n', 1))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('n', 2))
+        doc = am.undo(doc)
+        assert doc['n'] == 1
+        doc = am.redo(doc)
+        assert doc['n'] == 2
+
+    def test_cross_backend_merge_both_directions(self):
+        """am.merge works between oracle-backed and device-backed docs
+        (the change wire format is shared)."""
+        dev = Frontend.set_actor_id(
+            Frontend.init({'backend': DeviceBackend}), 'dev-side')
+        dev, _ = Frontend.change(dev, lambda d: d.__setitem__('from_dev', 1))
+        orc = am.change(am.init('orc-side'),
+                        lambda d: d.__setitem__('from_orc', 2))
+        merged_into_orc = am.merge(orc, dev)
+        assert _mat(merged_into_orc) == {'from_dev': 1, 'from_orc': 2}
+        merged_into_dev = am.merge(dev, orc)
+        assert _mat(merged_into_dev) == {'from_dev': 1, 'from_orc': 2}
+        # diff/get_changes across backends
+        assert am.get_changes(orc, merged_into_orc)[0]['actor'] == 'dev-side'
+        assert am.get_missing_deps(merged_into_dev) == {}
+
+    def test_interleaved_undo_redo_fuzz(self):
+        import random
+        rng = random.Random(4)
+        pair = _both(_pair(), lambda d: d.__setitem__('k0', 0))
+        for i in range(25):
+            roll = rng.random()
+            if roll < 0.5:
+                k = f'k{rng.randrange(3)}'
+                pair = _both(pair, lambda d, k=k, i=i: d.__setitem__(k, i))
+            elif roll < 0.8 and Frontend.can_undo(pair[0]):
+                pair = _undo(pair)
+            elif Frontend.can_redo(pair[0]):
+                pair = _redo(pair)
+            _assert_same(pair)
