@@ -619,15 +619,7 @@ def _capture_undo_ops(state, change):
             if field in seen:
                 continue
             seen.add(field)
-            prior = state.fields.get(field, ())
-            if prior:
-                for e in prior:
-                    inv = {'action': e['action'], 'obj': op['obj'],
-                           'key': op['key'], 'value': e['value']}
-                    undo_ops.append(inv)
-            else:
-                undo_ops.append({'action': 'del', 'obj': op['obj'],
-                                 'key': op['key']})
+            undo_ops.extend(_field_ops_or_del(state, [op]))
     return undo_ops
 
 

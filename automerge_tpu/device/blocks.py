@@ -298,8 +298,14 @@ class BlockStore:
     change log, exactly like the reference's save().
     """
 
-    def __init__(self, n_docs):
+    def __init__(self, n_docs, retain_log=True):
         self.n_docs = n_docs
+        # Retained ChangeBlocks (shared references, one append per apply)
+        # back get_missing_changes — the archival role the reference's
+        # opSet.history plays, with the same grows-with-history contract.
+        # retain_log=False drops retention: peers can then only be served
+        # via snapshots.
+        self.retain_log = retain_log
         self.actors = []                      # store actor table (strings)
         self.actor_of = {}
         self.keys = []                        # store key table (strings)
@@ -326,6 +332,8 @@ class BlockStore:
         self.l_dep_seq = z32
         self.queue = []                       # [(doc, change dict)] buffered
         self.history = []                     # applied (block, admitted) log
+        self.doc_log = {}                     # doc -> [(block, row idxs)]
+        self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
 
     # -- interning / lookup helpers -----------------------------------------
@@ -426,6 +434,43 @@ class BlockStore:
                 if clock.get(a, 0) < s:
                     missing[a] = max(s, missing.get(a, 0))
         return missing
+
+    def get_missing_changes(self, d, have_deps):
+        """Changes applied to document `d` that a peer with clock
+        `have_deps` lacks, in admission (causal) order — the Connection
+        primitive for bulk stores (src/connection.js:58-66). The log is
+        the retained ChangeBlocks (indexed per doc; converged peers
+        short-circuit without touching it); after a snapshot resume it
+        only goes back to the resume point (older gaps raise, like the
+        per-doc backend)."""
+        clock = self.clock_of(d)
+        if all(have_deps.get(a, 0) >= s for a, s in clock.items()):
+            return []
+        if not self.retain_log and not self.log_truncated:
+            raise ValueError(
+                'change-log retention is disabled on this store '
+                '(retain_log=False); serve lagging peers a snapshot')
+        out = []
+        for block, rows in self.doc_log.get(d, ()):
+            for c in rows:
+                actor = block.actors[block.actor[c]]
+                if block.seq[c] > have_deps.get(actor, 0):
+                    out.append(block.change_dict(c))
+        if self.log_truncated:
+            # per actor the retained seqs run (resume point, clock]; a
+            # peer needing anything below that range cannot be served
+            min_seq = {}
+            for ch in out:
+                a = ch['actor']
+                min_seq[a] = min(min_seq.get(a, ch['seq']), ch['seq'])
+            for a, s in self.clock_of(d).items():
+                h = have_deps.get(a, 0)
+                if h < s and (a not in min_seq or h + 1 < min_seq[a]):
+                    raise ValueError(
+                        'change log truncated by a snapshot resume; a '
+                        'peer this far behind needs the snapshot or the '
+                        'full log')
+        return out
 
 
 def init_store(n_docs):
@@ -663,27 +708,27 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
     """Queue merge + interning + causal admission + admitted-op staging —
     the host phase shared by apply_block and DenseMapStore.
 
-    Capacity limits are checked BEFORE any store mutation, so a rejected
-    block leaves the store usable. Values are interned for ADMITTED ops
-    only — a change stuck in the queue does not grow ``store.values`` on
-    every retry.
+    Capacity limits are checked BEFORE any store mutation — a rejected
+    block leaves the store usable AND its buffered queue intact. Values
+    are interned for ADMITTED ops only — a change stuck in the queue does
+    not grow ``store.values`` on every retry.
     """
     check_block_ranges(store, block)
-    if store.queue:
-        block = _merge_queued(block, store.queue)
-        store.queue = []
+    merged = _merge_queued(block, store.queue) if store.queue else block
 
     if max_keys is not None:
-        n_keys = len(store.keys) + sum(1 for k in set(block.keys)
+        n_keys = len(store.keys) + sum(1 for k in set(merged.keys)
                                        if k not in store.key_of)
         if n_keys > max_keys:
             raise ValueError(f'{n_keys} keys exceed key_capacity={max_keys}')
     if max_actors is not None:
-        n_actors = len(store.actors) + sum(1 for a in set(block.actors)
+        n_actors = len(store.actors) + sum(1 for a in set(merged.actors)
                                            if a not in store.actor_of)
         if n_actors > max_actors:
             raise ValueError(
                 f'{n_actors} actors exceed actor_capacity={max_actors}')
+    block = merged
+    store.queue = []
 
     a_tab = store.intern(block.actors, store.actors, store.actor_of)
     k_tab = store.intern(block.keys, store.keys, store.key_of)
@@ -703,6 +748,16 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
                                                dep_actor_store, la)
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
+    if store.retain_log and admitted.any():
+        store.history.append((block, admitted))
+        rows_adm = np.flatnonzero(admitted)
+        doc_of = block.doc[rows_adm]              # sorted (doc-major block)
+        uniq = np.unique(doc_of)
+        starts = np.searchsorted(doc_of, uniq)
+        ends = np.searchsorted(doc_of, uniq, side='right')
+        for d, lo, hi in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
+            store.doc_log.setdefault(d, []).append(
+                (block, rows_adm[lo:hi]))
 
     # admitted ops as columns
     C = block.n_changes

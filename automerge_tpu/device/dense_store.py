@@ -198,14 +198,16 @@ class DenseMapStore:
     """
 
     def __init__(self, n_docs, key_capacity=64, actor_capacity=16,
-                 options=None, mesh=None):
+                 options=None, mesh=None, retain_log=True):
         from .engine import as_options
         self.options = as_options(options)
         self.n_docs = n_docs
         self.key_capacity = key_capacity
         self.actor_capacity = actor_capacity
         self.n_fields = n_docs * key_capacity
-        self.host = _blocks.BlockStore(n_docs)   # interning/clock/log/queue
+        self.retain_log = retain_log
+        # interning/clock/log/queue
+        self.host = _blocks.BlockStore(n_docs, retain_log=retain_log)
         self._sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -232,7 +234,8 @@ class DenseMapStore:
 
     def reset(self):
         self._alloc_planes()
-        self.host = _blocks.BlockStore(self.n_docs)
+        self.host = _blocks.BlockStore(self.n_docs,
+                                       retain_log=self.retain_log)
         self.slot_actor_ids = np.zeros(0, np.int32)
 
     def _extract(self, mask):
@@ -331,6 +334,10 @@ class DenseMapStore:
             host.l_dep_ptr = z['l_dep_ptr']
             host.l_dep_actor = z['l_dep_actor']
             host.l_dep_seq = z['l_dep_seq']
+            # change bodies (retained blocks) are not serialized: the
+            # resumed store can sync peers forward from here, but not
+            # across the snapshot boundary
+            host.log_truncated = True
         store._actor_slots()
         return store
 

@@ -304,6 +304,97 @@ class TestBufferingAndDuplicates:
         assert len(store.e_doc) == 1       # one entry, not two
 
 
+class TestBlockSync:
+    """Bulk-store peers converge via get_missing_changes (the Connection
+    primitive, src/connection.js:58-66)."""
+
+    def test_two_block_stores_converge(self):
+        a_changes = [[_mk_change('aa', 1, {}, [_set('x', 1)]),
+                      _mk_change('aa', 2, {}, [_set('y', 2)])],
+                     [_mk_change('aa', 1, {}, [_set('z', 3)])]]
+        b_changes = [[_mk_change('bb', 1, {}, [_set('x', 9)])], []]
+        store_a = blocks.init_store(2)
+        store_b = blocks.init_store(2)
+        blocks.apply_block(store_a,
+                           blocks.ChangeBlock.from_changes(a_changes))
+        blocks.apply_block(store_b,
+                           blocks.ChangeBlock.from_changes(b_changes))
+
+        # ship clock-diff deltas both ways, per doc
+        for_b = [store_a.get_missing_changes(d, store_b.clock_of(d))
+                 for d in range(2)]
+        for_a = [store_b.get_missing_changes(d, store_a.clock_of(d))
+                 for d in range(2)]
+        blocks.apply_block(store_b, blocks.ChangeBlock.from_changes(for_b))
+        blocks.apply_block(store_a, blocks.ChangeBlock.from_changes(for_a))
+        for d in range(2):
+            assert store_a.doc_fields(d) == store_b.doc_fields(d)
+            assert store_a.clock_of(d) == store_b.clock_of(d)
+        # converged: nothing further to ship either way
+        assert store_a.get_missing_changes(0, store_b.clock_of(0)) == []
+        assert store_b.get_missing_changes(0, store_a.clock_of(0)) == []
+
+    def test_block_store_feeds_oracle_doc(self):
+        """Changes re-shipped from a block store replay through the host
+        oracle identically (the wire format is shared)."""
+        per_doc = [[_mk_change('aa', 1, {}, [_set('x', 'lo')]),
+                    _mk_change('zz', 1, {}, [_set('x', 'hi')])]]
+        store = blocks.init_store(1)
+        blocks.apply_block(store,
+                           blocks.ChangeBlock.from_changes(per_doc))
+        shipped = store.get_missing_changes(0, {})
+        oracle = _oracle_doc(shipped)
+        direct = _oracle_doc(per_doc[0])
+        assert {k: v for k, v in oracle.items()} == \
+            {k: v for k, v in direct.items()}
+        assert oracle._conflicts == direct._conflicts
+
+    def test_queue_survives_capacity_rejection(self):
+        """A buffered change must not be lost when a later block is
+        rejected by a capacity check."""
+        from automerge_tpu.device.dense_store import DenseMapStore
+        store = DenseMapStore(1, key_capacity=2, actor_capacity=4)
+        stuck = [[_mk_change('aa', 2, {}, [_set('k0', 'later')])]]
+        store.apply_block(blocks.ChangeBlock.from_changes(stuck))
+        assert len(store.host.queue) == 1
+        too_big = [[_mk_change('bb', 1, {},
+                               [_set('k%d' % i, i) for i in range(3)])]]
+        with pytest.raises(ValueError, match='key_capacity'):
+            store.apply_block(blocks.ChangeBlock.from_changes(too_big))
+        assert len(store.host.queue) == 1     # still buffered
+        first = [[_mk_change('aa', 1, {}, [_set('k0', 'first')])]]
+        patch = store.apply_block(blocks.ChangeBlock.from_changes(first))
+        doc = _doc_from_diffs(patch.diffs(0))
+        assert doc['k0'] == 'later'           # queued change applied
+
+    def test_retain_log_disabled(self):
+        from automerge_tpu.device.dense_store import DenseMapStore
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4,
+                              retain_log=False)
+        chs = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
+        store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        assert store.host.history == []
+        # a caught-up peer is fine; a lagging one is refused
+        assert store.host.get_missing_changes(0, {'aa': 1}) == []
+        with pytest.raises(ValueError, match='retention'):
+            store.host.get_missing_changes(0, {})
+
+    def test_snapshot_resume_truncates_block_log(self):
+        from automerge_tpu.device.dense_store import DenseMapStore
+        chs = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
+        store = DenseMapStore(1, key_capacity=4, actor_capacity=4)
+        store.apply_block(blocks.ChangeBlock.from_changes(chs))
+        restored = DenseMapStore.load_snapshot(store.save_snapshot())
+        # a peer already at the snapshot clock syncs forward fine
+        more = [[_mk_change('aa', 2, {}, [_set('x', 2)])]]
+        restored.apply_block(blocks.ChangeBlock.from_changes(more))
+        fwd = restored.host.get_missing_changes(0, {'aa': 1})
+        assert [c['seq'] for c in fwd] == [2]
+        # a peer behind the snapshot cannot be served from this store
+        with pytest.raises(ValueError, match='truncated'):
+            restored.host.get_missing_changes(0, {})
+
+
 class TestPatchBlock:
     def test_to_patches_clock_and_diffs(self):
         per_doc = [
