@@ -6,9 +6,12 @@ change/patch protocol (the reference's `backend/index.js:161-163` surface):
 per-document **patches** — diffs with obj/key/value/conflicts exactly as
 the reference's diff emission produces them (`backend/op_set.js:105-177`)
 — while the heavy resolution work for every document in the batch runs in
-two jitted device calls: one segment-reduction pass resolving every
-touched field (:mod:`.merge`), one RGA ordering pass recomputing document
-order for every dirty list/text object (:mod:`.sequence`).
+ONE fused jitted device call: a segment-reduction pass resolving every
+touched field (:mod:`.merge`), element visibility derived on device from
+the survivors, and an RGA ordering pass recomputing document order for
+every dirty list/text object (:mod:`.sequence`) — no host round-trip
+between resolution and ordering. Map-only batches keep the standalone
+resolve (Pallas-eligible) dispatch.
 
 State model. :class:`DeviceBackendState` is a persistent snapshot (old
 snapshots stay valid after applies, like the oracle): per-field surviving
@@ -30,7 +33,10 @@ index), then sets (final index). Applying either stream through
 ``Frontend.apply_patch`` yields the identical document.
 """
 
+from functools import partial
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..common import ROOT_ID
@@ -295,8 +301,14 @@ def _stage_changes(work, admitted):
 
 # -- device phase A: assignment resolution (pack, resolve, unpack) -----------
 
-def _pack_docs(works, options):
-    """Pack every staged row of every doc, run ONE device resolution."""
+def _pack_docs(works, options, job_of=None, m_pad=0):
+    """Pack every staged row of every doc into [D, n] planes.
+
+    With `job_of` (a (work id, obj) -> sequence-job index map), each row
+    touching a sequence element also gets a flat (job * m_pad + node)
+    slot so the fused kernel can derive element visibility on device
+    (-1 for map rows). Returns (arrays, n_segs, row_slot).
+    """
     d = len(works)
     max_rows = max((len(w.rows) for w in works), default=0)
     n = options.pad_ops(max_rows)
@@ -305,6 +317,7 @@ def _pack_docs(works, options):
     seq = np.zeros((d, n), options.clock_dtype)
     is_del = np.zeros((d, n), bool)
     valid = np.zeros((d, n), bool)
+    row_slot = np.full((d, n), -1, np.int32) if job_of is not None else None
 
     n_actors = 1
     clocks = []
@@ -317,6 +330,8 @@ def _pack_docs(works, options):
         n_actors = max(n_actors, a)
         max_segs = max(max_segs, len(w.touched))
         crows = np.zeros((n, a), options.clock_dtype)
+        wid = id(w)
+        objects = w.state.objects
         for j, (field, entry, del_flag, _is_new) in enumerate(w.rows):
             seg_id[i, j] = seg_of[field]
             actor[i, j] = rank[entry['actor']]
@@ -326,6 +341,11 @@ def _pack_docs(works, options):
                     crows[j, rank[da]] = ds
             is_del[i, j] = del_flag
             valid[i, j] = True
+            if job_of is not None:
+                job = job_of.get((wid, field[0]))
+                if job is not None:
+                    row_slot[i, j] = (job * m_pad
+                                      + objects[field[0]].node_of[field[1]])
         clocks.append(crows)
 
     # pad the actor axis to a power of two as well: all three kernel-input
@@ -336,11 +356,46 @@ def _pack_docs(works, options):
         clock[i, :, :crows.shape[1]] = crows
 
     n_segs = options.pad_segments(max_segs)
+    return (seg_id, actor, seq, clock, is_del, valid), n_segs, row_slot
+
+
+def _resolve_batch(arrays, n_segs, options):
+    """Assignment-only resolution (pallas-eligible dispatch)."""
     resolve = _engine.pick_resolve_kernel(options.kernel)
-    out = resolve(jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
-                  jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),
-                  num_segments=n_segs)
+    out = resolve(*(jnp.asarray(a) for a in arrays), num_segments=n_segs)
     return np.asarray(out['surviving'])
+
+
+@partial(jax.jit, static_argnames=('num_segments',))
+def _fused_step(seg_id, actor, seq, clock, is_del, valid, row_slot,
+                s_parent, s_elem, s_actor, s_prior_vis, s_valid, *,
+                num_segments):
+    """Resolve assignments + derive element visibility + RGA-order every
+    dirty sequence, in ONE device program (no host round-trip between
+    conflict resolution and ordering).
+
+    Element visibility after the batch: a node with any batch row keeps
+    a value iff some row survived (dels never survive); untouched nodes
+    keep their prior visibility.
+    """
+    from .merge import _resolve
+    from .sequence import _rga_order
+    out = jax.vmap(partial(_resolve, num_segments=num_segments))(
+        seg_id, actor, seq, clock, is_del, valid)
+
+    k, m = s_parent.shape
+    flat = jnp.where(row_slot >= 0, row_slot, k * m).reshape(-1)
+    vis_hit = jnp.zeros(k * m, bool).at[flat].max(
+        out['surviving'].reshape(-1), mode='drop')
+    touched = jnp.zeros(k * m, bool).at[flat].max(
+        valid.reshape(-1), mode='drop')
+    visible = jnp.where(touched.reshape(k, m), vis_hit.reshape(k, m),
+                        s_prior_vis)
+    visible = visible & s_valid
+
+    ordered = jax.vmap(_rga_order)(s_parent, s_elem, s_actor, visible,
+                                   s_valid)
+    return out, visible, ordered
 
 
 def _update_fields(work, surviving_row):
@@ -434,25 +489,26 @@ def _collect_seq_jobs(works):
     for w in works:
         for obj in w.dirty_seq:
             rec = w.state._writable(obj)
-            visible = np.zeros(len(rec.nodes), bool)
-            fields = w.state.fields
-            for i in range(1, len(rec.nodes)):
-                visible[i] = bool(fields.get((obj, rec.nodes[i])))
-            jobs.append((w, obj, rec, visible))
+            # prior visibility = the before-state order index (elem_ids
+            # holds exactly the visible elements); the fused kernel
+            # derives post-batch visibility for touched nodes on device
+            vis_set = set(rec.elem_ids)
+            prior_vis = np.fromiter((eid in vis_set for eid in rec.nodes),
+                                    bool, len(rec.nodes))
+            jobs.append((w, obj, rec, prior_vis))
     return jobs
 
 
-def _run_seq_jobs(jobs, options):
-    """ONE rga_order_batch call ordering every dirty sequence object."""
-    from .sequence import rga_order_batch
+def _pack_seq_jobs(jobs, m_pad, options):
+    """Pack every dirty sequence object's insertion tree into [K, m]
+    planes for the fused kernel."""
     k = len(jobs)
-    n_pad = options.pad_nodes(max(len(rec.nodes) for _, _, rec, _ in jobs))
-    parent = np.zeros((k, n_pad), options.index_dtype)
-    elem = np.zeros((k, n_pad), options.clock_dtype)
-    actor = np.zeros((k, n_pad), options.index_dtype)
-    vis = np.zeros((k, n_pad), bool)
-    valid = np.zeros((k, n_pad), bool)
-    for i, (_w, _obj, rec, visible) in enumerate(jobs):
+    parent = np.zeros((k, m_pad), options.index_dtype)
+    elem = np.zeros((k, m_pad), options.clock_dtype)
+    actor = np.zeros((k, m_pad), options.index_dtype)
+    prior_vis = np.zeros((k, m_pad), bool)
+    valid = np.zeros((k, m_pad), bool)
+    for i, (_w, _obj, rec, pv) in enumerate(jobs):
         n = len(rec.nodes)
         parent[i, :n] = rec.node_parent
         elem[i, :n] = rec.node_elem
@@ -460,12 +516,9 @@ def _run_seq_jobs(jobs, options):
         names = sorted(set(rec.node_actor))
         rank = {a: j for j, a in enumerate(names)}
         actor[i, :n] = [rank[a] for a in rec.node_actor]
-        vis[i, :n] = visible
+        prior_vis[i, :n] = pv
         valid[i, :n] = True
-    out = rga_order_batch(jnp.asarray(parent), jnp.asarray(elem),
-                          jnp.asarray(actor), jnp.asarray(vis),
-                          jnp.asarray(valid))
-    return {key: np.asarray(v) for key, v in out.items()}
+    return parent, elem, actor, prior_vis, valid
 
 
 def _emit_seq_diffs(work, obj, rec, visible, vis_index):
@@ -562,22 +615,37 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
         works.append(work)
 
     total_rows = sum(len(w.rows) for w in works)
-    if total_rows:
-        surviving = _pack_docs(works, opts)
+    seq_jobs = _collect_seq_jobs(works)
+
+    seq_vis = seq_out = None
+    if seq_jobs:
+        # ONE device program: resolve + visibility + RGA ordering
+        m_pad = opts.pad_nodes(max(len(rec.nodes)
+                                   for _, _, rec, _ in seq_jobs))
+        job_of = {(id(w), obj): i
+                  for i, (w, obj, _rec, _pv) in enumerate(seq_jobs)}
+        arrays, n_segs, row_slot = _pack_docs(works, opts, job_of, m_pad)
+        seq_arrays = _pack_seq_jobs(seq_jobs, m_pad, opts)
+        out, visible, ordered = _fused_step(
+            *(jnp.asarray(a) for a in arrays), jnp.asarray(row_slot),
+            *(jnp.asarray(a) for a in seq_arrays), num_segments=n_segs)
+        surviving = np.asarray(out['surviving'])
+        seq_vis = np.asarray(visible)
+        seq_out = np.asarray(ordered['vis_index'])
+    elif total_rows:
+        arrays, n_segs, _ = _pack_docs(works, opts)
+        surviving = _resolve_batch(arrays, n_segs, opts)
     else:
         surviving = np.zeros((len(works), 1), bool)
     for i, w in enumerate(works):
         _update_fields(w, surviving[i])
 
-    seq_jobs = _collect_seq_jobs(works)
-    seq_out = _run_seq_jobs(seq_jobs, opts) if seq_jobs else None
-
     seq_diffs_by_work = {}
     if seq_jobs:
-        for i, (w, obj, rec, visible) in enumerate(seq_jobs):
+        for i, (w, obj, rec, _pv) in enumerate(seq_jobs):
             n = len(rec.nodes)
-            diffs = _emit_seq_diffs(w, obj, rec, visible,
-                                    seq_out['vis_index'][i, :n])
+            diffs = _emit_seq_diffs(w, obj, rec, seq_vis[i, :n],
+                                    seq_out[i, :n])
             seq_diffs_by_work.setdefault(id(w), []).extend(diffs)
 
     new_states, patches = [], []

@@ -331,8 +331,9 @@ class BlockStore:
         self.l_dep_actor = z32
         self.l_dep_seq = z32
         self.queue = []                       # [(doc, change dict)] buffered
-        self.history = []                     # applied (block, admitted) log
-        self.doc_log = {}                     # doc -> [(block, row idxs)]
+        # retained-change index: doc -> [(block, admitted row idxs in
+        # admission order)] — blocks are shared references
+        self.doc_log = {}
         self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
 
@@ -463,7 +464,7 @@ class BlockStore:
             for ch in out:
                 a = ch['actor']
                 min_seq[a] = min(min_seq.get(a, ch['seq']), ch['seq'])
-            for a, s in self.clock_of(d).items():
+            for a, s in clock.items():
                 h = have_deps.get(a, 0)
                 if h < s and (a not in min_seq or h + 1 < min_seq[a]):
                     raise ValueError(
@@ -580,6 +581,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         duplicate[in_order[dup_sorted]] = True
     pending = ~duplicate
     admitted = np.zeros(C, bool)
+    adm_waves = []                   # rows per wave -> admission order
 
     while True:                      # terminates: pending shrinks per wave
         if not pending.any():
@@ -613,10 +615,13 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
 
         admitted |= ready
         pending &= ~ready
+        adm_waves.append(np.flatnonzero(ready))
         store.clock_merge(doc[ready], b_actor[ready], seq[ready])
 
+    adm_order = np.concatenate(adm_waves) if adm_waves else \
+        np.zeros(0, np.int64)
     cmap = _log_append(store, in_key, admitted, R, doc, la)
-    return admitted, pending, R, cmap
+    return admitted, pending, R, cmap, adm_order
 
 
 def _log_append(store, in_key, admitted, R, doc, la):
@@ -744,20 +749,21 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
                       np.concatenate([b_actor, dep_actor_store,
                                       store.c_actor]))
 
-    admitted, leftover, R, cmap = _admit_block(store, block, b_actor,
-                                               dep_actor_store, la)
+    admitted, leftover, R, cmap, adm_order = _admit_block(
+        store, block, b_actor, dep_actor_store, la)
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
-    if store.retain_log and admitted.any():
-        store.history.append((block, admitted))
-        rows_adm = np.flatnonzero(admitted)
-        doc_of = block.doc[rows_adm]              # sorted (doc-major block)
-        uniq = np.unique(doc_of)
-        starts = np.searchsorted(doc_of, uniq)
-        ends = np.searchsorted(doc_of, uniq, side='right')
+    if store.retain_log and len(adm_order):
+        # group per doc, keeping ADMISSION order within each doc (the
+        # causal order get_missing_changes promises its consumers)
+        doc_of = block.doc[adm_order]
+        order = np.argsort(doc_of, kind='stable')
+        rows, docs = adm_order[order], doc_of[order]
+        uniq = np.unique(docs)
+        starts = np.searchsorted(docs, uniq)
+        ends = np.searchsorted(docs, uniq, side='right')
         for d, lo, hi in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
-            store.doc_log.setdefault(d, []).append(
-                (block, rows_adm[lo:hi]))
+            store.doc_log.setdefault(d, []).append((block, rows[lo:hi]))
 
     # admitted ops as columns
     C = block.n_changes

@@ -275,6 +275,7 @@ class DenseMapStore:
                 'n_docs': self.n_docs,
                 'key_capacity': self.key_capacity,
                 'actor_capacity': self.actor_capacity,
+                'retain_log': self.retain_log,
                 'actors': host.actors, 'keys': host.keys,
                 'values': host.values, 'queue': host.queue}
         buf = io.BytesIO()
@@ -305,7 +306,8 @@ class DenseMapStore:
             store = cls(meta['n_docs'],
                         key_capacity=meta['key_capacity'],
                         actor_capacity=meta['actor_capacity'],
-                        options=options, mesh=mesh)
+                        options=options, mesh=mesh,
+                        retain_log=meta.get('retain_log', True))
             want = (store.n_fields, store.actor_capacity)
             if z['eseq'].shape != want:
                 raise ValueError(

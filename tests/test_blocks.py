@@ -349,6 +349,28 @@ class TestBlockSync:
             {k: v for k, v in direct.items()}
         assert oracle._conflicts == direct._conflicts
 
+    def test_missing_changes_in_causal_order(self):
+        """Shipped changes must come out in admission (causal) order even
+        when the block's row order is anti-causal."""
+        store = blocks.init_store(1)
+        later = [[_mk_change('aa', 2, {}, [_set('x', 2)])]]
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes(later))
+        # bb:1 depends on aa:2 (still queued); aa:1 arrives in the same
+        # block AFTER bb:1 in row order
+        mixed = [[_mk_change('bb', 1, {'aa': 2}, [_set('y', 9)]),
+                  _mk_change('aa', 1, {}, [_set('x', 1)])]]
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes(mixed))
+        shipped = store.get_missing_changes(0, {})
+        order = [(c['actor'], c['seq']) for c in shipped]
+        assert order == [('aa', 1), ('aa', 2), ('bb', 1)]
+        # a fresh oracle replays the shipped list one change at a time
+        # with nothing left buffered at the end
+        from automerge_tpu import backend as Backend
+        st = Backend.init()
+        for ch in shipped:
+            st, _ = Backend.apply_changes(st, [ch])
+        assert Backend.get_missing_deps(st) == {}
+
     def test_queue_survives_capacity_rejection(self):
         """A buffered change must not be lost when a later block is
         rejected by a capacity check."""
@@ -373,7 +395,7 @@ class TestBlockSync:
                               retain_log=False)
         chs = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
         store.apply_block(blocks.ChangeBlock.from_changes(chs))
-        assert store.host.history == []
+        assert store.host.doc_log == {}
         # a caught-up peer is fine; a lagging one is refused
         assert store.host.get_missing_changes(0, {'aa': 1}) == []
         with pytest.raises(ValueError, match='retention'):
