@@ -53,17 +53,33 @@ from .blocks import _SET, _DEL
 _VAL_NONE = np.int32(-2147483648)      # "no value" sentinel for EVal
 
 
-@partial(jax.jit, static_argnames=('n_fields', 'n_actors'))
+@partial(jax.jit, static_argnames=('n_fields', 'n_actors', 'seq_values'))
 def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
-                  change_clock, op_counts, op_key, op_isdel, op_value,
-                  n_ops, key_capacity, *, n_fields, n_actors):
+                  change_clock, op_counts, op_key, op_isdel_bits, op_value,
+                  n_ops, key_capacity, v_base, *, n_fields, n_actors,
+                  seq_values):
     """One block apply: expand change columns to op rows ON DEVICE, then
-    scatter-maxes into the resident planes."""
+    scatter-maxes into the resident planes.
+
+    Wire-lean inputs: the del mask arrives bit-packed (uint8, unpacked
+    here), and with ``seq_values`` the value refs are not shipped at all —
+    set ops reference values sequentially from ``v_base`` (the layout
+    ChangeBlock.from_changes and the workload generators produce), so the
+    refs are a cumulative sum computed on device.
+    """
     n_pad = op_key.shape[0]
     c_pad = change_doc.shape[0]
     op_change = jnp.repeat(jnp.arange(c_pad, dtype=jnp.int32), op_counts,
                            total_repeat_length=n_pad)
     valid = jnp.arange(n_pad) < n_ops
+
+    idx = jnp.arange(n_pad)
+    op_isdel = ((op_isdel_bits[idx >> 3] >> (7 - (idx & 7))) & 1) \
+        .astype(bool)
+    if seq_values:
+        sets = valid & ~op_isdel
+        op_value = jnp.where(
+            sets, v_base + jnp.cumsum(sets.astype(jnp.int32)) - 1, -1)
 
     fidx = change_doc[op_change] * key_capacity + op_key.astype(jnp.int32)
     # padding rows are parked at n_fields (out of bounds) and dropped by
@@ -401,20 +417,32 @@ class DenseMapStore:
         key_dtype = np.uint8 if self.key_capacity <= 256 else np.int32
         op_key = np.zeros(n_pad, key_dtype)
         op_key[:n_ops] = st.o_key
+        is_del = st.o_action == _DEL
         op_isdel = np.zeros(n_pad, bool)
-        op_isdel[:n_ops] = st.o_action == _DEL
-        op_value = np.full(n_pad, -1, np.int32)
-        op_value[:n_ops] = st.o_value
+        op_isdel[:n_ops] = is_del
+        # wire-lean fast path: sequential value refs reconstruct on device
+        v_base = int(st.o_value[~is_del][0]) if (~is_del).any() else 0
+        seq_values = bool(
+            np.array_equal(st.o_value[~is_del],
+                           np.arange(v_base,
+                                     v_base + int((~is_del).sum()),
+                                     dtype=np.int32)))
+        if seq_values:
+            op_value_dev = jnp.zeros(1, jnp.int32)     # unused placeholder
+        else:
+            op_value = np.full(n_pad, -1, np.int32)
+            op_value[:n_ops] = st.o_value
+            op_value_dev = jnp.asarray(op_value)
         t2 = time.perf_counter()
 
         self.eseq, self.eval_, self.m = _apply_kernel(
             self.eseq, self.eval_, self.m, jnp.asarray(change_doc),
             jnp.asarray(change_actor), jnp.asarray(change_seq),
             clock_dev, jnp.asarray(op_counts),
-            jnp.asarray(op_key), jnp.asarray(op_isdel),
-            jnp.asarray(op_value), jnp.asarray(n_ops),
-            jnp.asarray(self.key_capacity),
-            n_fields=self.n_fields, n_actors=A)
+            jnp.asarray(op_key), jnp.asarray(np.packbits(op_isdel)),
+            op_value_dev, jnp.asarray(n_ops),
+            jnp.asarray(self.key_capacity), jnp.asarray(v_base),
+            n_fields=self.n_fields, n_actors=A, seq_values=seq_values)
 
         # touched fields -> device extraction
         touched = np.zeros(self.n_fields, bool)
