@@ -138,7 +138,10 @@ class ChangeBlock:
                 doc.append(d)
                 actor.append(_intern(actors, actor_of, change['actor']))
                 seq.append(change['seq'])
-                for da, ds in sorted(change['deps'].items()):
+                # dep order is semantic: the reference folds deps in dict
+                # order and later entries can clobber earlier transitive
+                # seqs (transitiveDeps, op_set.js:29-37)
+                for da, ds in change['deps'].items():
                     dep_actor.append(_intern(actors, actor_of, da))
                     dep_seq.append(ds)
                 dep_ptr.append(len(dep_actor))
@@ -540,13 +543,10 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
     dep_local = la.local_of(doc[dep_change], dep_actor_store)
     dep_key = store.change_key(doc[dep_change], dep_actor_store, dep_seq)
 
-    def closure_from(sources_key, targets):
-        """Accumulate stored/in-block closures of dep changes into R rows.
-
-        sources_key: composite change key of the dependency; targets: R row
-        to accumulate into. In-block sources read R (same doc => same local
-        coords); prior-block sources read the store log CSR.
-        """
+    def gather_closure_rows(sources_key, dest, out_idx, target_doc):
+        """Fill dest[out_idx] with each source change's closure row (in
+        doc-local coords). In-block sources read R (same doc => same
+        local coords); prior-block sources read the store log CSR."""
         if len(sources_key) == 0:
             return
         pos = np.minimum(np.searchsorted(in_sorted, sources_key),
@@ -556,7 +556,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
             np.zeros(len(sources_key), bool)
         in_hit = in_hit & admitted[src]
         if in_hit.any():
-            np.maximum.at(R, targets[in_hit], R[src[in_hit]])
+            dest[out_idx[in_hit]] = R[src[in_hit]]
         rest = ~in_hit
         if rest.any() and len(log_sorted):
             lpos = np.minimum(np.searchsorted(log_sorted,
@@ -564,14 +564,80 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
                               len(log_sorted) - 1)
             lhit = log_sorted[lpos] == sources_key[rest]
             rows = store.l_order[lpos[lhit]]
-            tgt = targets[rest][lhit]
+            tgt = out_idx[rest][lhit]
             counts = store.l_dep_ptr[rows + 1] - store.l_dep_ptr[rows]
             if counts.sum():
                 idx = _span_indices(store.l_dep_ptr[rows], counts)
                 tgt_rep = np.repeat(tgt, counts)
-                cols = la.local_of(doc[tgt_rep],
+                cols = la.local_of(target_doc[tgt_rep],
                                    store.l_dep_actor[idx])
-                np.maximum.at(R, (tgt_rep, cols), store.l_dep_seq[idx])
+                dest[tgt_rep, cols] = store.l_dep_seq[idx]
+
+    def accumulate_closures(ready):
+        """The reference's transitiveDeps fold, vectorized for one wave
+        (op_set.js:29-37): for each ready change, deps are folded IN
+        ORDER (own seq-1 appended last) as merge-max of the dep's
+        closure followed by SET depActor = depSeq — the set can clobber
+        a higher transitive seq, so the result is order-dependent and
+        deliberately NOT a pure max. Equivalent closed form per dep j:
+        final[a_j] = max(s_j, suffix-max over later deps' closures),
+        and pure max for non-dep actors.
+        """
+        rdep = ready[dep_change] if len(dep_change) else np.zeros(0, bool)
+        rows_ready = np.flatnonzero(ready)
+        prev = seq[rows_ready] - 1
+        has_prev = prev > 0
+        # combined dep rows: block deps (wire order), own-prev LAST
+        t_change = np.concatenate([dep_change[rdep],
+                                   rows_ready[has_prev]])
+        t_actor = np.concatenate([dep_local[rdep],
+                                  b_local[rows_ready[has_prev]]])
+        t_seq = np.concatenate([dep_seq[rdep], prev[has_prev]])
+        t_key = np.concatenate([dep_key[rdep],
+                                store.change_key(
+                                    doc[rows_ready[has_prev]],
+                                    b_actor[rows_ready[has_prev]],
+                                    prev[has_prev])])
+        live = t_seq > 0                  # depSeq <= 0 rows are skipped
+        t_change, t_actor = t_change[live], t_actor[live]
+        t_seq, t_key = t_seq[live], t_key[live]
+        if len(t_change) == 0:
+            return
+        # stable sort by target change: block-dep order and the
+        # trailing own-prev position survive within each group
+        order = np.argsort(t_change, kind='stable')
+        t_change, t_actor = t_change[order], t_actor[order]
+        t_seq, t_key = t_seq[order], t_key[order]
+
+        n_r = len(t_change)
+        a_pad_ = R.shape[1]
+        D = np.zeros((n_r, a_pad_), np.int32)
+        gather_closure_rows(t_key, D, np.arange(n_r), doc[t_change])
+
+        # exclusive suffix max of D within each change's run (doubling:
+        # S[x] covers rows (x, x+step] of its run; clocks are >= 0 so
+        # zero is the identity)
+        S = np.zeros_like(D)
+        same1 = np.zeros(n_r, bool)
+        same1[:-1] = t_change[1:] == t_change[:-1]
+        j = np.flatnonzero(same1)
+        S[j] = D[j + 1]
+        step = 1
+        while True:
+            idx = np.arange(n_r) + step
+            ok = idx < n_r
+            ok &= np.where(ok, t_change[np.minimum(idx, n_r - 1)]
+                           == t_change, False)
+            if not ok.any():
+                break
+            upd = np.zeros_like(S)
+            upd[ok] = S[idx[ok]]
+            S = np.maximum(S, upd)
+            step *= 2
+
+        np.maximum.at(R, t_change, D)               # merge-max part
+        R[t_change, t_actor] = np.maximum(           # the SET override
+            t_seq, S[np.arange(n_r), t_actor])
 
     duplicate = store.clock_lookup(doc, b_actor) >= seq
     # in-block duplicates: keep only the first row per (doc, actor, seq)
@@ -596,22 +662,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         if not ready.any():
             break
 
-        # transitive closure: dep closures + the deps themselves ...
-        rdep = ready[dep_change] if len(dep_change) else \
-            np.zeros(0, bool)
-        if rdep.any():
-            dc = dep_change[rdep]
-            closure_from(dep_key[rdep], dc)
-            np.maximum.at(R, (dc, dep_local[rdep]), dep_seq[rdep])
-        # ... and the actor's own previous change (base_deps[actor]=seq-1)
-        rows = np.flatnonzero(ready)
-        prev = seq[rows] - 1
-        has_prev = prev > 0
-        if has_prev.any():
-            pr = rows[has_prev]
-            closure_from(store.change_key(doc[pr], b_actor[pr],
-                                          prev[has_prev]), pr)
-            np.maximum.at(R, (pr, b_local[pr]), prev[has_prev])
+        accumulate_closures(ready)
 
         admitted |= ready
         pending &= ~ready
@@ -667,7 +718,7 @@ def _merge_queued(block, queue):
         doc.append(d)
         actor.append(_intern(actors, actor_of, change['actor']))
         seq.append(change['seq'])
-        for da, ds in sorted(change['deps'].items()):
+        for da, ds in change['deps'].items():
             dep_actor.append(_intern(actors, actor_of, da))
             dep_seq.append(ds)
         dep_ptr.append(dep_ptr[0] + len(dep_actor))
