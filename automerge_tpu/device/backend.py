@@ -629,6 +629,7 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
         out, visible, ordered = _fused_step(
             *(jnp.asarray(a) for a in arrays), jnp.asarray(row_slot),
             *(jnp.asarray(a) for a in seq_arrays), num_segments=n_segs)
+        metrics.bump('device_backend_fused_calls')
         surviving = np.asarray(out['surviving'])
         seq_vis = np.asarray(visible)
         seq_out = np.asarray(ordered['vis_index'])

@@ -369,6 +369,63 @@ class TestDeviceDocSetSequences:
         assert got == want
         assert len(got) == 120
 
+    @pytest.mark.parametrize('seed', [0, 1])
+    def test_multi_doc_sequence_batch_fuzz(self, seed):
+        """A DocSet batch of randomized list/text/map documents resolved
+        in ONE fused device call must match per-doc oracle application."""
+        rng = random.Random(seed)
+        docs = {}
+        for i in range(6):
+            kind = rng.choice(['list', 'text', 'mixed'])
+            actor = f'author-{i}'
+            if kind == 'list':
+                base = _frontend_doc(
+                    actor, lambda d: d.__setitem__(
+                        'items', [f'v{j}' for j in range(rng.randint(1, 4))]))
+                edits = []
+                for k in range(rng.randint(1, 4)):
+                    def e(d, k=k, r=rng.random(), p=rng.random()):
+                        items = d['items']
+                        n = len(items)
+                        if r < 0.5 or n == 0:
+                            items.insert(int(p * (n + 1)), f'n{k}')
+                        elif r < 0.8:
+                            del items[int(p * n)]
+                        else:
+                            items[int(p * n)] = f's{k}'
+                    edits.append(e)
+                doc = base
+                for e in edits:
+                    doc, _ = Frontend.change(doc, e)
+            elif kind == 'text':
+                doc = _frontend_doc(
+                    actor, lambda d: d.__setitem__('t', Text()),
+                    lambda d: d['t'].insert_at(0, *'seed'),
+                    lambda d: d['t'].insert_at(rng.randint(0, 4), 'X'),
+                    lambda d: d['t'].delete_at(rng.randint(0, 3)))
+            else:
+                doc = _frontend_doc(
+                    actor,
+                    lambda d: d.update({'m': {'deep': [1, 2]}}),
+                    lambda d: d['m']['deep'].append(3))
+            docs[f'doc{i}'] = _changes_of(doc, actor)
+
+        from automerge_tpu.utils.metrics import metrics
+        before = metrics.counters.get('device_backend_fused_calls', 0)
+        dds = DeviceDocSet()
+        dds.apply_changes_batch(docs)
+        # the whole multi-doc batch resolves in ONE fused device program
+        assert metrics.counters.get('device_backend_fused_calls', 0) \
+            == before + 1
+        ods = DocSet()
+        for doc_id, chs in docs.items():
+            ods.apply_changes(doc_id, chs)
+        for doc_id in docs:
+            assert _materialize(dds.get_doc(doc_id)) == \
+                _materialize(ods.get_doc(doc_id)), doc_id
+            assert _conflicts_of(dds.get_doc(doc_id)) == \
+                _conflicts_of(ods.get_doc(doc_id)), doc_id
+
     def test_second_batch_extends_list(self):
         dds = DeviceDocSet()
         doc1 = _frontend_doc('aa', lambda d: d.__setitem__('items', ['a']))
