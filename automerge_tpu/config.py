@@ -14,7 +14,7 @@ of similar size, no recompilation storm — SURVEY §7 "padding + bucketing").
 """
 
 from dataclasses import dataclass, replace
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -30,7 +30,7 @@ Node 0 is the virtual ``'_head'`` element; padding slots carry
 ``valid=False`` and sort to the end.
 """
 
-from functools import partial
+
 
 import jax
 import jax.numpy as jnp

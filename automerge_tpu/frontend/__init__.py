@@ -15,7 +15,7 @@ from ..text import Text
 from ..uuid import uuid as _uuid
 from .apply_patch import apply_diffs, update_parent_objects, clone_root_object
 from .context import Context
-from .datatypes import AmMap, AmList, FrozenError
+from .datatypes import AmMap
 from .proxies import root_object_proxy, MapProxy, ListProxy
 
 __all__ = [

@@ -6,11 +6,11 @@ records both the operation list for the backend (``ops``) and the
 optimistic local diffs (``diffs``) applied immediately to the document.
 """
 
-from ..common import ROOT_ID, is_object
+from ..common import is_object
 from ..text import Text, get_elem_id
 from ..uuid import uuid
 from .apply_patch import apply_diffs
-from .datatypes import AmMap, AmList
+from .datatypes import AmList
 
 
 def _is_primitive(value):

@@ -13,7 +13,7 @@ earlier in the same callback.
 camelCase aliases).
 """
 
-from ..common import ROOT_ID, is_object
+from ..common import ROOT_ID
 from ..text import Text
 
 

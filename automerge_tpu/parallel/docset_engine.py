@@ -20,7 +20,7 @@ from functools import lru_cache, partial
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 try:
     from jax import shard_map
 except ImportError:  # older jax
