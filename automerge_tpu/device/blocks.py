@@ -32,6 +32,9 @@ assignments to the same key (which the reference frontend never emits —
 one of them here, where the oracle keeps both as a self-conflict.
 """
 
+import bisect as _bisect
+import json as _json
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -55,6 +58,103 @@ def _intern(table, index, item):
         index[item] = i
         table.append(item)
     return i
+
+
+_MISSING = object()
+
+
+class LazyValues:
+    """Op values as byte spans into a wire buffer, JSON-decoded on first
+    access (the native wire codec never parses values — most are never
+    materialized on the bulk path). A negative start marks a null value
+    (a set op without a "value" member, matching the dict edge's
+    ``op.get('value')``)."""
+
+    __slots__ = ('_buf', '_starts', '_ends', '_cache')
+
+    def __init__(self, buf, starts, ends):
+        self._buf = buf
+        self._starts = starts
+        self._ends = ends
+        self._cache = {}
+
+    def __len__(self):
+        return len(self._starts)
+
+    def __getitem__(self, i):
+        n = len(self._starts)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        v = self._cache.get(i, _MISSING)
+        if v is _MISSING:
+            s = self._starts[i]
+            v = None if s < 0 else _json.loads(
+                self._buf[s:self._ends[i]].decode('utf-8'))
+            self._cache[i] = v
+        return v
+
+    def __iter__(self):
+        for i in range(len(self._starts)):
+            yield self[i]
+
+    def compacted(self):
+        """A copy whose buffer holds ONLY the value bytes — retaining a
+        segment must not pin the whole wire message in memory."""
+        keep = self._starts >= 0
+        sizes = np.where(keep, self._ends - self._starts, 0)
+        new_ends = np.cumsum(sizes)
+        new_starts = np.where(keep, new_ends - sizes, -1)
+        buf = b''.join(
+            self._buf[self._starts[i]:self._ends[i]]
+            for i in np.flatnonzero(keep))
+        return LazyValues(buf, new_starts, new_ends)
+
+
+class ValueTable:
+    """The store's value store: plain appended values plus lazily-decoded
+    wire segments, indexable in append order. ``extend`` of a
+    :class:`LazyValues` keeps it as a segment (compacted — no decoding,
+    no pinning of the full wire buffer); everything else lands in plain
+    list segments."""
+
+    __slots__ = ('_segs', '_offsets', '_len')
+
+    def __init__(self):
+        self._segs = []
+        self._offsets = [0]
+        self._len = 0
+
+    def __len__(self):
+        return self._len
+
+    def extend(self, items):
+        if isinstance(items, LazyValues):
+            items = items.compacted()
+        elif isinstance(items, ValueTable):
+            for seg in items._segs:
+                self.extend(seg)
+            return
+        else:
+            items = list(items)
+        if not len(items):
+            return
+        self._segs.append(items)
+        self._len += len(items)
+        self._offsets.append(self._len)
+
+    def __getitem__(self, i):
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        seg = _bisect.bisect_right(self._offsets, i) - 1
+        return self._segs[seg][i - self._offsets[seg]]
+
+    def __iter__(self):
+        for seg in self._segs:
+            yield from seg
 
 
 def check_block_ranges(store, block):
@@ -135,6 +235,8 @@ class ChangeBlock:
 
         for d, changes in enumerate(changes_per_doc):
             for change in changes:
+                if 'deps' not in change:
+                    raise ValueError('change requires actor, seq and deps')
                 doc.append(d)
                 actor.append(_intern(actors, actor_of, change['actor']))
                 seq.append(change['seq'])
@@ -313,7 +415,7 @@ class BlockStore:
         self.actor_of = {}
         self.keys = []                        # store key table (strings)
         self.key_of = {}
-        self.values = []                      # host value store
+        self.values = ValueTable()            # host value store
         z32 = np.zeros(0, np.int32)
         # survivor entries (unordered; membership via compact field keys):
         self.e_doc = z32
@@ -702,12 +804,18 @@ def _log_append(store, in_key, admitted, R, doc, la):
 
 
 def _merge_queued(block, queue):
-    """Fold buffered dict changes into an incoming block (small path)."""
+    """Fold buffered dict changes into an incoming block (small path).
+
+    The block's values are NOT materialized: they carry over as a
+    ValueTable segment (lazy spans stay lazy) and only the queued
+    changes' values append as plain entries."""
     actors = list(block.actors)
     actor_of = {a: i for i, a in enumerate(actors)}
     keys = list(block.keys)
     key_of = {k: i for i, k in enumerate(keys)}
-    values = list(block.values)
+    values = ValueTable()
+    values.extend(block.values)
+    tail = []                      # queued changes' values (plain)
 
     doc, actor, seq = [], [], []
     dep_ptr = [int(block.dep_ptr[-1])]
@@ -726,11 +834,12 @@ def _merge_queued(block, queue):
             action.append(_ACTION_NAMES[op['action']])
             key.append(_intern(keys, key_of, op['key']))
             if op['action'] == 'set':
-                value.append(len(values))
-                values.append(op.get('value'))
+                value.append(len(values) + len(tail))
+                tail.append(op.get('value'))
             else:
                 value.append(-1)
         op_ptr.append(op_ptr[0] + len(action))
+    values.extend(tail)
 
     return ChangeBlock(
         block.n_docs,

@@ -293,7 +293,7 @@ class DenseMapStore:
                 'actor_capacity': self.actor_capacity,
                 'retain_log': self.retain_log,
                 'actors': host.actors, 'keys': host.keys,
-                'values': host.values, 'queue': host.queue}
+                'values': list(host.values), 'queue': host.queue}
         buf = io.BytesIO()
         np.savez_compressed(
             buf,
@@ -342,7 +342,8 @@ class DenseMapStore:
             host.actor_of = {a: i for i, a in enumerate(host.actors)}
             host.keys = list(meta['keys'])
             host.key_of = {k: i for i, k in enumerate(host.keys)}
-            host.values = list(meta['values'])
+            host.values = _blocks.ValueTable()
+            host.values.extend(meta['values'])
             host.queue = [(d, ch) for d, ch in meta['queue']]
             host.c_doc = z['c_doc']
             host.c_actor = z['c_actor']

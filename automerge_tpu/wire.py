@@ -1,0 +1,185 @@
+"""Native wire edge: JSON change batches -> ChangeBlock at C speed.
+
+`ChangeBlock.from_changes` walks every change/op dict in Python — fine
+for the compatibility edge, not for a million-op sync message. This
+module binds `native/wire_codec.cpp`: one pass over the raw JSON bytes
+produces the columnar block directly (interned actors/keys, CSR
+deps/ops), and op values come back as BYTE SPANS decoded lazily on first
+access (:class:`~automerge_tpu.device.blocks.LazyValues`) — on the bulk
+apply path values ride to the store without ever being parsed.
+
+`parse_change_block(data)` accepts the JSON text of
+``[[change, ...], ...]`` (one change list per document — exactly
+``json.dumps(block.to_changes())``). Falls back to
+``json.loads`` + ``from_changes`` when the native library is
+unavailable.
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from .device.blocks import ChangeBlock, LazyValues
+
+_LIB = None
+_LOAD_ATTEMPTED = False
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, '_native', 'libamwire.so')
+_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), 'native',
+                         'wire_codec.cpp')
+
+_i64 = ctypes.c_int64
+_p32 = ctypes.POINTER(ctypes.c_int32)
+_p64 = ctypes.POINTER(ctypes.c_int64)
+_p8 = ctypes.POINTER(ctypes.c_int8)
+
+
+def _bind(lib):
+    lib.amwc_parse.argtypes = [ctypes.c_char_p, _i64]
+    lib.amwc_parse.restype = ctypes.c_void_p
+    lib.amwc_error.argtypes = [ctypes.c_void_p]
+    lib.amwc_error.restype = ctypes.c_char_p
+    for name in ('amwc_n_docs', 'amwc_n_changes', 'amwc_n_ops',
+                 'amwc_n_deps', 'amwc_n_values', 'amwc_n_actors',
+                 'amwc_actors_bytes', 'amwc_n_keys', 'amwc_keys_bytes'):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = _i64
+    lib.amwc_fill_actors.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _p64]
+    lib.amwc_fill_actors.restype = None
+    lib.amwc_fill_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _p64]
+    lib.amwc_fill_keys.restype = None
+    lib.amwc_fill_changes.argtypes = [ctypes.c_void_p] + [_p32] * 5
+    lib.amwc_fill_changes.restype = None
+    lib.amwc_fill_deps.argtypes = [ctypes.c_void_p, _p32, _p32]
+    lib.amwc_fill_deps.restype = None
+    lib.amwc_fill_ops.argtypes = [ctypes.c_void_p, _p8, _p32, _p32]
+    lib.amwc_fill_ops.restype = None
+    lib.amwc_fill_value_spans.argtypes = [ctypes.c_void_p, _p64, _p64]
+    lib.amwc_fill_value_spans.restype = None
+    lib.amwc_free.argtypes = [ctypes.c_void_p]
+    lib.amwc_free.restype = None
+    return lib
+
+
+def _compile():
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix='.so', dir=os.path.dirname(_SO_PATH))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
+             _SRC_PATH, '-o', tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _LIB, _LOAD_ATTEMPTED
+    if _LOAD_ATTEMPTED:
+        return _LIB
+    _LOAD_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE', '1') == '0':
+        return None
+    have_src = os.path.exists(_SRC_PATH)
+    stale = (have_src and os.path.exists(_SO_PATH)
+             and os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH))
+    if not os.path.exists(_SO_PATH) or stale:
+        if not have_src or not _compile():
+            if not os.path.exists(_SO_PATH):
+                return None
+    try:
+        _LIB = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+def _ptr32(a):
+    return a.ctypes.data_as(_p32)
+
+
+def _table(lib, h, n_fn, bytes_fn, fill_fn):
+    n = int(n_fn(h))
+    nbytes = int(bytes_fn(h))
+    buf = ctypes.create_string_buffer(max(nbytes, 1))
+    offsets = np.empty(n + 1, np.int64)
+    fill_fn(h, buf, offsets.ctypes.data_as(_p64))
+    raw = buf.raw[:nbytes]
+    return [raw[offsets[i]:offsets[i + 1]].decode('utf-8')
+            for i in range(n)]
+
+
+def parse_change_block(data):
+    """Parse the JSON text of per-document change lists into a
+    :class:`~automerge_tpu.device.blocks.ChangeBlock` (native when the
+    codec library is available)."""
+    if isinstance(data, str):
+        data = data.encode('utf-8')
+    lib = _load()
+    if lib is None:
+        return ChangeBlock.from_changes(json.loads(data.decode('utf-8')))
+
+    h = lib.amwc_parse(data, len(data))
+    if not h:
+        raise MemoryError('wire codec allocation failed')
+    try:
+        err = lib.amwc_error(h)
+        if err:
+            raise ValueError('wire parse failed: ' + err.decode('utf-8'))
+        n_docs = int(lib.amwc_n_docs(h))
+        c = int(lib.amwc_n_changes(h))
+        n_ops = int(lib.amwc_n_ops(h))
+        n_deps = int(lib.amwc_n_deps(h))
+        n_vals = int(lib.amwc_n_values(h))
+
+        doc = np.empty(c, np.int32)
+        actor = np.empty(c, np.int32)
+        seq = np.empty(c, np.int32)
+        dep_ptr = np.empty(c + 1, np.int32)
+        op_ptr = np.empty(c + 1, np.int32)
+        lib.amwc_fill_changes(h, _ptr32(doc), _ptr32(actor), _ptr32(seq),
+                              _ptr32(dep_ptr), _ptr32(op_ptr))
+        dep_actor = np.empty(n_deps, np.int32)
+        dep_seq = np.empty(n_deps, np.int32)
+        lib.amwc_fill_deps(h, _ptr32(dep_actor), _ptr32(dep_seq))
+        action = np.empty(n_ops, np.int8)
+        key = np.empty(n_ops, np.int32)
+        value = np.empty(n_ops, np.int32)
+        lib.amwc_fill_ops(h, action.ctypes.data_as(_p8), _ptr32(key),
+                          _ptr32(value))
+        starts = np.empty(n_vals, np.int64)
+        ends = np.empty(n_vals, np.int64)
+        lib.amwc_fill_value_spans(h, starts.ctypes.data_as(_p64),
+                                  ends.ctypes.data_as(_p64))
+
+        actors = _table(lib, h, lib.amwc_n_actors, lib.amwc_actors_bytes,
+                        lib.amwc_fill_actors)
+        keys = _table(lib, h, lib.amwc_n_keys, lib.amwc_keys_bytes,
+                      lib.amwc_fill_keys)
+    finally:
+        lib.amwc_free(h)
+
+    values = LazyValues(data, starts, ends)
+    return ChangeBlock(n_docs, doc, actor, seq, dep_ptr, dep_actor,
+                       dep_seq, op_ptr, action, key, value, actors, keys,
+                       values)
+
+
+parseChangeBlock = parse_change_block

@@ -206,6 +206,27 @@ def bench_docset_sync(n_docs=100, iters=3):
     return n_docs, n_msgs, dt
 
 
+def bench_wire_parse(n_docs=2048):
+    """Native wire edge: raw JSON change batch -> columnar block."""
+    import json
+    from automerge_tpu import wire
+    from automerge_tpu.device import blocks as blk
+
+    block = gen_block_workload(n_docs=n_docs)
+    data = json.dumps(block.to_changes()).encode()
+    if wire.available():
+        wire.parse_change_block(data)      # warm (lib load)
+        t0 = time.perf_counter()
+        wire.parse_change_block(data)
+        t_nat = time.perf_counter() - t0
+    else:
+        t_nat = None
+    t0 = time.perf_counter()
+    blk.ChangeBlock.from_changes(json.loads(data.decode()))
+    t_py = time.perf_counter() - t0
+    return len(data), block.n_ops, t_nat, t_py
+
+
 def bench_snapshot_resume(n_changes=20000, n_keys=8):
     """Checkpoint/resume: the packed snapshot loads with no CRDT replay
     (closure metadata only), vs the change log's full replay."""
@@ -340,6 +361,15 @@ def main():
     n_sdocs, n_msgs, t_sync = bench_docset_sync()
     log(f'docset-sync[config 3]: {n_sdocs} docs, {n_msgs} messages in '
         f'{t_sync:.3f}s -> {n_sdocs / t_sync:.0f} docs/s')
+
+    wb, wops, t_nat, t_py = bench_wire_parse()
+    if t_nat is not None:
+        log(f'wire-parse[native codec]: {wb >> 20} MiB JSON / {wops} ops — '
+            f'native {t_nat * 1e3:.0f} ms ({wb / t_nat / 1e6:.0f} MB/s), '
+            f'python {t_py * 1e3:.0f} ms -> {t_py / t_nat:.1f}x')
+    else:
+        log(f'wire-parse: native codec unavailable (no g++/.so); '
+            f'python edge {t_py * 1e3:.0f} ms for {wb >> 20} MiB')
 
     n_hist, t_log_load, t_snap_load, sz_log, sz_snap = \
         bench_snapshot_resume()

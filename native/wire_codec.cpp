@@ -1,0 +1,444 @@
+// Native wire codec: JSON change batches -> columnar ChangeBlock arrays.
+//
+// The reference's wire format is per-change JSON (INTERNALS.md:142-146).
+// The Python edge (`ChangeBlock.from_changes`) walks ~1M op dicts per
+// million-op batch; this parser does the same work as one pass over the
+// raw bytes: a recursive-descent JSON scanner that interns actor/key
+// strings, validates the bulk-path op surface (set/del on the root map),
+// emits the CSR change/dep/op columns, and records each op value as a
+// byte SPAN into the input buffer — values are never decoded here; the
+// Python side materializes them lazily on first access.
+//
+// Input shape: [[change, ...], ...]  (one change array per document)
+// change:      {"actor": str, "seq": int, "deps": {str: int},
+//               "ops": [{"action": "set"|"del", "obj": ROOT_UUID,
+//                        "key": str, "value": any-json}], ...extras ignored}
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 wire_codec.cpp -o libamwire.so
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <unordered_map>
+
+namespace {
+
+constexpr const char* kRootId = "00000000-0000-0000-0000-000000000000";
+
+struct Interner {
+    std::unordered_map<std::string, int32_t> ids;
+    std::vector<std::string> strings;
+    int32_t intern(std::string&& s) {
+        auto it = ids.find(s);
+        if (it != ids.end()) return it->second;
+        int32_t id = static_cast<int32_t>(strings.size());
+        ids.emplace(s, id);
+        strings.push_back(std::move(s));
+        return id;
+    }
+};
+
+struct Parsed {
+    // change columns
+    std::vector<int32_t> doc, actor, seq;
+    std::vector<int32_t> dep_ptr{0}, dep_actor, dep_seq;
+    // op columns
+    std::vector<int32_t> op_ptr{0};
+    std::vector<int8_t> action;
+    std::vector<int32_t> key, value;
+    // value spans into the input buffer
+    std::vector<int64_t> vstart, vend;
+    Interner actors, keys;
+    int64_t n_docs = 0;
+    std::string error;
+};
+
+struct Cursor {
+    const char* p;
+    const char* end;
+    const char* base;
+    std::string err;
+
+    bool fail(const std::string& msg) {
+        if (err.empty())
+            err = msg + " at byte " + std::to_string(p - base);
+        return false;
+    }
+    void ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+            ++p;
+    }
+    bool lit(char c) {
+        ws();
+        if (p < end && *p == c) { ++p; return true; }
+        return fail(std::string("expected '") + c + "'");
+    }
+    bool peek(char c) {
+        ws();
+        return p < end && *p == c;
+    }
+
+    // decode a JSON string (with escapes) into out
+    bool str(std::string& out) {
+        ws();
+        if (p >= end || *p != '"') return fail("expected string");
+        ++p;
+        out.clear();
+        while (p < end) {
+            unsigned char c = *p;
+            if (c == '"') { ++p; return true; }
+            if (c == '\\') {
+                if (p + 1 >= end) return fail("bad escape");
+                ++p;
+                char e = *p++;
+                switch (e) {
+                    case '"': out += '"'; break;
+                    case '\\': out += '\\'; break;
+                    case '/': out += '/'; break;
+                    case 'b': out += '\b'; break;
+                    case 'f': out += '\f'; break;
+                    case 'n': out += '\n'; break;
+                    case 'r': out += '\r'; break;
+                    case 't': out += '\t'; break;
+                    case 'u': {
+                        if (p + 4 > end) return fail("bad \\u escape");
+                        auto hex4 = [&](uint32_t& v) -> bool {
+                            v = 0;
+                            for (int i = 0; i < 4; i++) {
+                                char h = *p++;
+                                v <<= 4;
+                                if (h >= '0' && h <= '9') v |= h - '0';
+                                else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+                                else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+                                else return false;
+                            }
+                            return true;
+                        };
+                        uint32_t cp;
+                        if (!hex4(cp)) return fail("bad \\u escape");
+                        if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+                            if (p + 6 > end || p[0] != '\\' || p[1] != 'u')
+                                return fail("unpaired surrogate");
+                            p += 2;
+                            uint32_t lo;
+                            if (!hex4(lo) || lo < 0xDC00 || lo > 0xDFFF)
+                                return fail("bad low surrogate");
+                            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                        }
+                        // utf-8 encode
+                        if (cp < 0x80) out += static_cast<char>(cp);
+                        else if (cp < 0x800) {
+                            out += static_cast<char>(0xC0 | (cp >> 6));
+                            out += static_cast<char>(0x80 | (cp & 0x3F));
+                        } else if (cp < 0x10000) {
+                            out += static_cast<char>(0xE0 | (cp >> 12));
+                            out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+                            out += static_cast<char>(0x80 | (cp & 0x3F));
+                        } else {
+                            out += static_cast<char>(0xF0 | (cp >> 18));
+                            out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+                            out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+                            out += static_cast<char>(0x80 | (cp & 0x3F));
+                        }
+                        break;
+                    }
+                    default: return fail("unknown escape");
+                }
+            } else {
+                out += static_cast<char>(c);
+                ++p;
+            }
+        }
+        return fail("unterminated string");
+    }
+
+    bool integer(int64_t& out) {
+        ws();
+        bool neg = false;
+        if (p < end && *p == '-') { neg = true; ++p; }
+        if (p >= end || *p < '0' || *p > '9') return fail("expected integer");
+        int64_t v = 0;
+        while (p < end && *p >= '0' && *p <= '9') {
+            v = v * 10 + (*p - '0');
+            ++p;
+        }
+        if (p < end && (*p == '.' || *p == 'e' || *p == 'E'))
+            return fail("expected integer, got float");
+        out = neg ? -v : v;
+        return true;
+    }
+
+    // skip any JSON value (string-aware), recording its span
+    bool skip_value(int64_t& s, int64_t& e) {
+        ws();
+        s = p - base;
+        if (p >= end) return fail("unexpected end");
+        char c = *p;
+        if (c == '"') {
+            std::string tmp;
+            if (!str(tmp)) return false;
+        } else if (c == '{' || c == '[') {
+            char close = (c == '{') ? '}' : ']';
+            int depth = 0;
+            while (p < end) {
+                char d = *p;
+                if (d == '"') {
+                    std::string tmp;
+                    if (!str(tmp)) return false;
+                    continue;
+                }
+                if (d == '{' || d == '[') depth++;
+                else if (d == '}' || d == ']') {
+                    depth--;
+                    ++p;
+                    if (depth == 0) { e = p - base; return true; }
+                    continue;
+                }
+                ++p;
+            }
+            return fail(std::string("unterminated ") + c + "..." + close);
+        } else {
+            // number / true / false / null
+            while (p < end && *p != ',' && *p != '}' && *p != ']' &&
+                   *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r')
+                ++p;
+            if (p - base == s) return fail("empty value");
+        }
+        e = p - base;
+        return true;
+    }
+};
+
+bool parse_op(Cursor& c, Parsed& out) {
+    if (!c.lit('{')) return false;
+    std::string field, action, obj, key;
+    bool have_action = false, have_obj = false, have_key = false;
+    bool have_value = false;
+    int64_t vs = -1, ve = -1;
+    if (!c.peek('}')) {
+        do {
+            if (!c.str(field) || !c.lit(':')) return false;
+            if (field == "action") {
+                if (!c.str(action)) return false;
+                have_action = true;
+            } else if (field == "obj") {
+                if (!c.str(obj)) return false;
+                have_obj = true;
+            } else if (field == "key") {
+                if (!c.str(key)) return false;
+                have_key = true;
+            } else if (field == "value") {
+                if (!c.skip_value(vs, ve)) return false;
+                have_value = true;
+            } else {
+                int64_t s_, e_;
+                if (!c.skip_value(s_, e_)) return false;
+            }
+        } while (c.peek(',') && c.lit(','));
+    }
+    if (!c.lit('}')) return false;
+
+    if (!have_action || !have_obj || !have_key)
+        return c.fail("op requires action/obj/key");
+    if (obj != kRootId)
+        return c.fail("block path supports root-map fields only");
+    int8_t code;
+    if (action == "set") code = 0;
+    else if (action == "del") code = 1;
+    else return c.fail("block path supports set/del ops only, got '"
+                       + action + "'");
+
+    out.action.push_back(code);
+    out.key.push_back(out.keys.intern(std::move(key)));
+    if (code == 0) {
+        // a set without "value" carries null (the dict edge's
+        // op.get('value')); a negative span start marks it
+        out.value.push_back(static_cast<int32_t>(out.vstart.size()));
+        out.vstart.push_back(have_value ? vs : -1);
+        out.vend.push_back(have_value ? ve : -1);
+    } else {
+        out.value.push_back(-1);
+    }
+    return true;
+}
+
+bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
+    if (!c.lit('{')) return false;
+    std::string field, actor_s;
+    bool have_actor = false, have_seq = false, have_deps = false;
+    int64_t seq_v = 0;
+    // deps/ops order within the change object is free-form; dep ORDER
+    // inside the deps object is semantic and preserved.
+    std::vector<int32_t> deps_a;
+    std::vector<int32_t> deps_s;
+    if (!c.peek('}')) {
+        do {
+            if (!c.str(field) || !c.lit(':')) return false;
+            if (field == "actor") {
+                if (!c.str(actor_s)) return false;
+                have_actor = true;
+            } else if (field == "seq") {
+                if (!c.integer(seq_v)) return false;
+                have_seq = true;
+            } else if (field == "deps") {
+                have_deps = true;
+                if (!c.lit('{')) return false;
+                if (!c.peek('}')) {
+                    do {
+                        std::string da;
+                        int64_t ds;
+                        if (!c.str(da) || !c.lit(':') || !c.integer(ds))
+                            return false;
+                        deps_a.push_back(out.actors.intern(std::move(da)));
+                        deps_s.push_back(static_cast<int32_t>(ds));
+                    } while (c.peek(',') && c.lit(','));
+                }
+                if (!c.lit('}')) return false;
+            } else if (field == "ops") {
+                if (!c.lit('[')) return false;
+                if (!c.peek(']')) {
+                    do {
+                        if (!parse_op(c, out)) return false;
+                    } while (c.peek(',') && c.lit(','));
+                }
+                if (!c.lit(']')) return false;
+            } else {
+                int64_t s_, e_;
+                if (!c.skip_value(s_, e_)) return false;  // message etc.
+            }
+        } while (c.peek(',') && c.lit(','));
+    }
+    if (!c.lit('}')) return false;
+    if (!have_actor || !have_seq || !have_deps)
+        return c.fail("change requires actor, seq and deps");
+
+    out.doc.push_back(doc_idx);
+    out.actor.push_back(out.actors.intern(std::move(actor_s)));
+    out.seq.push_back(static_cast<int32_t>(seq_v));
+    for (size_t i = 0; i < deps_a.size(); i++) {
+        out.dep_actor.push_back(deps_a[i]);
+        out.dep_seq.push_back(deps_s[i]);
+    }
+    out.dep_ptr.push_back(static_cast<int32_t>(out.dep_actor.size()));
+    out.op_ptr.push_back(static_cast<int32_t>(out.action.size()));
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* amwc_parse(const char* buf, int64_t len) {
+    auto* out = new (std::nothrow) Parsed();
+    if (!out) return nullptr;
+    Cursor c{buf, buf + len, buf, {}};
+
+    bool ok = [&]() -> bool {
+        if (!c.lit('[')) return false;
+        int32_t doc_idx = 0;
+        if (!c.peek(']')) {
+            do {
+                if (!c.lit('[')) return false;
+                if (!c.peek(']')) {
+                    do {
+                        if (!parse_change(c, *out, doc_idx)) return false;
+                    } while (c.peek(',') && c.lit(','));
+                }
+                if (!c.lit(']')) return false;
+                doc_idx++;
+            } while (c.peek(',') && c.lit(','));
+        }
+        if (!c.lit(']')) return false;
+        c.ws();
+        if (c.p != c.end) return c.fail("trailing data");
+        out->n_docs = doc_idx;
+        return true;
+    }();
+
+    if (!ok) out->error = c.err.empty() ? "parse error" : c.err;
+    return out;
+}
+
+const char* amwc_error(void* h) {
+    auto* p = static_cast<Parsed*>(h);
+    return p->error.empty() ? nullptr : p->error.c_str();
+}
+
+int64_t amwc_n_docs(void* h) { return static_cast<Parsed*>(h)->n_docs; }
+int64_t amwc_n_changes(void* h) { return static_cast<Parsed*>(h)->doc.size(); }
+int64_t amwc_n_ops(void* h) { return static_cast<Parsed*>(h)->action.size(); }
+int64_t amwc_n_deps(void* h) {
+    return static_cast<Parsed*>(h)->dep_actor.size();
+}
+int64_t amwc_n_values(void* h) {
+    return static_cast<Parsed*>(h)->vstart.size();
+}
+
+static int64_t table_bytes(const Interner& t) {
+    int64_t n = 0;
+    for (const auto& s : t.strings) n += static_cast<int64_t>(s.size());
+    return n;
+}
+static void fill_table(const Interner& t, char* out, int64_t* offsets) {
+    int64_t pos = 0;
+    size_t i = 0;
+    for (; i < t.strings.size(); i++) {
+        offsets[i] = pos;
+        std::memcpy(out + pos, t.strings[i].data(), t.strings[i].size());
+        pos += static_cast<int64_t>(t.strings[i].size());
+    }
+    offsets[i] = pos;
+}
+
+int64_t amwc_n_actors(void* h) {
+    return static_cast<Parsed*>(h)->actors.strings.size();
+}
+int64_t amwc_actors_bytes(void* h) {
+    return table_bytes(static_cast<Parsed*>(h)->actors);
+}
+void amwc_fill_actors(void* h, char* out, int64_t* offsets) {
+    fill_table(static_cast<Parsed*>(h)->actors, out, offsets);
+}
+int64_t amwc_n_keys(void* h) {
+    return static_cast<Parsed*>(h)->keys.strings.size();
+}
+int64_t amwc_keys_bytes(void* h) {
+    return table_bytes(static_cast<Parsed*>(h)->keys);
+}
+void amwc_fill_keys(void* h, char* out, int64_t* offsets) {
+    fill_table(static_cast<Parsed*>(h)->keys, out, offsets);
+}
+
+void amwc_fill_changes(void* h, int32_t* doc, int32_t* actor, int32_t* seq,
+                       int32_t* dep_ptr, int32_t* op_ptr) {
+    auto* p = static_cast<Parsed*>(h);
+    std::memcpy(doc, p->doc.data(), p->doc.size() * 4);
+    std::memcpy(actor, p->actor.data(), p->actor.size() * 4);
+    std::memcpy(seq, p->seq.data(), p->seq.size() * 4);
+    std::memcpy(dep_ptr, p->dep_ptr.data(), p->dep_ptr.size() * 4);
+    std::memcpy(op_ptr, p->op_ptr.data(), p->op_ptr.size() * 4);
+}
+
+void amwc_fill_deps(void* h, int32_t* dep_actor, int32_t* dep_seq) {
+    auto* p = static_cast<Parsed*>(h);
+    std::memcpy(dep_actor, p->dep_actor.data(), p->dep_actor.size() * 4);
+    std::memcpy(dep_seq, p->dep_seq.data(), p->dep_seq.size() * 4);
+}
+
+void amwc_fill_ops(void* h, int8_t* action, int32_t* key, int32_t* value) {
+    auto* p = static_cast<Parsed*>(h);
+    std::memcpy(action, p->action.data(), p->action.size());
+    std::memcpy(key, p->key.data(), p->key.size() * 4);
+    std::memcpy(value, p->value.data(), p->value.size() * 4);
+}
+
+void amwc_fill_value_spans(void* h, int64_t* starts, int64_t* ends) {
+    auto* p = static_cast<Parsed*>(h);
+    std::memcpy(starts, p->vstart.data(), p->vstart.size() * 8);
+    std::memcpy(ends, p->vend.data(), p->vend.size() * 8);
+}
+
+void amwc_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,185 @@
+"""Native wire codec: JSON change batches -> ChangeBlock, differentially
+against the Python edge (json.loads + from_changes)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from automerge_tpu import wire
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import blocks
+from automerge_tpu.device.dense_store import DenseMapStore
+from automerge_tpu.device.workloads import gen_block_workload
+
+pytestmark = pytest.mark.skipif(not wire.available(),
+                                reason='native wire codec unavailable')
+
+
+def _rich_changes():
+    return [
+        [{'actor': 'alice', 'seq': 1, 'deps': {},
+          'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'title',
+                   'value': 'quote " \\ é中\U0001F600 \n tab\t'},
+                  {'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+                   'value': {'nested': [1, 2.5, None, True, 'x]}'],
+                             'k{': '}v'}},
+                  {'action': 'del', 'obj': ROOT_ID, 'key': 'old'}]},
+         {'actor': 'bob', 'seq': 1, 'deps': {'alice': 1},
+          'message': 'ignored extra', 'ops': [
+              {'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+               'value': -42}]}],
+        [],
+        [{'actor': 'carolé', 'seq': 1, 'deps': {},
+          'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k☃',
+                   'value': [[]]}]}],
+    ]
+
+
+def _strip_extras(per_doc):
+    return [[{k: v for k, v in ch.items()
+              if k in ('actor', 'seq', 'deps', 'ops')} for ch in doc]
+            for doc in per_doc]
+
+
+class TestParse:
+    def test_rich_payload_roundtrip(self):
+        per_doc = _rich_changes()
+        blk = wire.parse_change_block(json.dumps(per_doc))
+        assert blk.to_changes() == _strip_extras(per_doc)
+
+    def test_matches_python_edge_exactly(self):
+        per_doc = _strip_extras(_rich_changes())
+        nat = wire.parse_change_block(json.dumps(per_doc))
+        ref = blocks.ChangeBlock.from_changes(per_doc)
+        for field in ('doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
+                      'dep_seq', 'op_ptr', 'action', 'key', 'value'):
+            np.testing.assert_array_equal(getattr(nat, field),
+                                          getattr(ref, field), err_msg=field)
+        assert nat.actors == ref.actors and nat.keys == ref.keys
+        assert list(nat.values) == list(ref.values)
+
+    def test_dep_order_preserved(self):
+        per_doc = [[{'actor': 'z', 'seq': 1,
+                     'deps': {'bb': 2, 'aa': 1},     # anti-alphabetical
+                     'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                              'value': 0}]}]]
+        blk = wire.parse_change_block(json.dumps(per_doc))
+        assert list(blk.to_changes()[0][0]['deps'].items()) == \
+            [('bb', 2), ('aa', 1)]
+
+    def test_whitespace_tolerant(self):
+        text = json.dumps(_strip_extras(_rich_changes()), indent=3)
+        blk = wire.parse_change_block(text)
+        assert blk.to_changes() == _strip_extras(_rich_changes())
+
+    @pytest.mark.parametrize('bad,msg', [
+        ('[[{"actor": "a", "seq": 1, "deps": {}, "ops": '
+         '[{"action": "ins", "obj": "%s", "key": "k"}]}]]' % ROOT_ID,
+         'set/del'),
+        ('[[{"actor": "a", "seq": 1, "deps": {}, "ops": '
+         '[{"action": "set", "obj": "other", "key": "k", "value": 1}]}]]',
+         'root-map'),
+        ('[[{"seq": 1, "deps": {}, "ops": []}]]', 'actor'),
+        ('[[{"actor": "a", "seq": 1.5, "deps": {}, "ops": []}]]', 'integer'),
+        ('[[', 'expected'),
+        ('[[]] trailing', 'trailing'),
+    ])
+    def test_errors(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            wire.parse_change_block(bad)
+
+    @pytest.mark.parametrize('seed', range(3))
+    def test_generated_workload_parses_identically(self, seed):
+        blk = gen_block_workload(n_docs=8, n_actors=3, ops_per_change=4,
+                                 n_keys=6, seed=seed, del_p=0.25)
+        js = json.dumps(blk.to_changes())
+        nat = wire.parse_change_block(js)
+        ref = blocks.ChangeBlock.from_changes(json.loads(js))
+        assert nat.to_changes() == ref.to_changes()
+
+
+class TestLazyValuesApply:
+    def test_apply_through_both_engines(self):
+        big = gen_block_workload(n_docs=16, n_actors=4, ops_per_change=5,
+                                 n_keys=8, seed=3, del_p=0.2)
+        js = json.dumps(big.to_changes())
+
+        parsed = wire.parse_change_block(js)
+        s1 = blocks.init_store(16)
+        p1 = blocks.apply_block(s1, parsed)
+        s2 = blocks.init_store(16)
+        p2 = blocks.apply_block(
+            s2, blocks.ChangeBlock.from_changes(json.loads(js)))
+        for d in range(16):
+            by_key = lambda x: sorted(x, key=lambda e: e['key'])  # noqa: E731
+            assert by_key(p1.diffs(d)) == by_key(p2.diffs(d)), d
+
+        dense = DenseMapStore(16, key_capacity=16, actor_capacity=8)
+        p3 = dense.apply_block(
+            wire.parse_change_block(js)).to_patch_block()
+        for d in range(16):
+            by_key = lambda x: sorted(x, key=lambda e: e['key'])  # noqa: E731
+            assert by_key(p3.diffs(d)) == by_key(p1.diffs(d)), d
+
+    def test_set_without_value_is_null_on_both_edges(self):
+        raw = ('[[{"actor": "a", "seq": 1, "deps": {}, "ops": '
+               '[{"action": "set", "obj": "%s", "key": "k"}]}]]' % ROOT_ID)
+        nat = wire.parse_change_block(raw)
+        ref = blocks.ChangeBlock.from_changes(json.loads(raw))
+        assert list(nat.values) == list(ref.values) == [None]
+        assert nat.to_changes() == ref.to_changes()
+
+    def test_missing_deps_rejected_on_both_edges(self):
+        raw = '[[{"actor": "a", "seq": 1, "ops": []}]]'
+        with pytest.raises(ValueError, match='deps'):
+            wire.parse_change_block(raw)
+        with pytest.raises(ValueError, match='deps'):
+            blocks.ChangeBlock.from_changes(json.loads(raw))
+
+    def test_queue_merge_keeps_values_lazy(self):
+        """A non-empty causal buffer must not force decoding of a lazy
+        block's values."""
+        store = blocks.init_store(1)
+        stuck = [[{'actor': 'aa', 'seq': 2, 'deps': {},
+                   'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'x',
+                            'value': 'late'}]}]]
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes(stuck))
+        assert store.queue
+        big = gen_block_workload(n_docs=1, n_actors=3, ops_per_change=4,
+                                 n_keys=6, seed=8)
+        parsed = wire.parse_change_block(json.dumps(big.to_changes()))
+        lazy = parsed.values
+        blocks.apply_block(store, parsed)
+        assert len(lazy._cache) == 0          # nothing decoded by apply
+
+    def test_values_decode_lazily(self):
+        big = gen_block_workload(n_docs=16, n_actors=4, ops_per_change=5,
+                                 n_keys=8, seed=4)
+        parsed = wire.parse_change_block(json.dumps(big.to_changes()))
+        store = blocks.init_store(16)
+        patch = blocks.apply_block(store, parsed)
+        assert len(parsed.values._cache) == 0  # apply decodes nothing
+        # the store holds a compacted lazy segment (value bytes only,
+        # not the whole wire message)
+        seg = store.values._segs[0]
+        assert isinstance(seg, blocks.LazyValues)
+        assert len(seg._buf) < len(parsed.values._buf)
+        patch.diffs(0)                         # one doc materialized
+        assert 0 < len(seg._cache) < len(seg)
+
+
+class TestValueTable:
+    def test_mixed_segments_index_in_order(self):
+        t = blocks.ValueTable()
+        t.extend([1, 2])
+        buf = b'["x","yy",3]'
+        t.extend(blocks.LazyValues(buf, np.array([1, 5, 10]),
+                                   np.array([4, 9, 11])))
+        t.extend(['plain'])
+        assert len(t) == 6
+        assert [t[i] for i in range(6)] == [1, 2, 'x', 'yy', 3, 'plain']
+        assert list(t) == [1, 2, 'x', 'yy', 3, 'plain']
+        with pytest.raises(IndexError):
+            t[6]
+        assert t[-1] == 'plain'
