@@ -148,7 +148,9 @@ class DeviceBackendState(SharedChangeLog):
         return self.objects[object_id]
 
 
-def init():
+def init(_actor_id=None):
+    """Empty backend state; the optional actor argument is accepted for
+    reference-API compatibility and ignored (backend/index.js:123-125)."""
     return DeviceBackendState()
 
 
