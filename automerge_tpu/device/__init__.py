@@ -27,6 +27,9 @@ XLA fusion rather than per-element control flow):
   vectorized host-orchestrated bulk apply (unbounded capacities)
 * :mod:`.dense_store` — device-resident dense DocSet store: applyChanges
   as scatter-max into HBM-resident planes (the collab-server engine)
+* :mod:`.text_block` — bulk text replay: columnar editing traces (no
+  string interning — elemIds are structured pairs) resolved with
+  vectorized staging + one RGA call (the long-context engine)
 
 Batching model: one program, N documents — ``vmap`` over the leading doc
 axis; sharding over a device mesh is layered on top in
@@ -36,7 +39,9 @@ axis; sharding over a device mesh is layered on top in
 from .engine import DocStore, batch_merge_docs, pick_resolve_kernel
 from .blocks import ChangeBlock, PatchBlock, BlockStore, apply_block
 from .dense_store import DenseMapStore, DensePatch
+from .text_block import TextBlock, replay_text_block
 
 __all__ = ['DocStore', 'batch_merge_docs', 'pick_resolve_kernel',
            'ChangeBlock', 'PatchBlock', 'BlockStore', 'apply_block',
-           'DenseMapStore', 'DensePatch']
+           'DenseMapStore', 'DensePatch', 'TextBlock',
+           'replay_text_block']

@@ -325,6 +325,25 @@ def bench_trace_replay(n_ops=180000, wire_ops=60000):
         f'host oracle {t_wire_host:.2f}s '
         f'({wire_ops / t_wire_host / 1e3:.1f}k/s)')
 
+    # bulk columnar replay: whole trace (as a TextBlock, the columnar
+    # wire encoding) -> final text, one RGA call; the dict-edge decode
+    # cost is reported separately so the lines stay comparable
+    from automerge_tpu.device.text_block import (TextBlock,
+                                                 replay_text_block)
+    t0 = time.perf_counter()
+    block = TextBlock.from_changes(trace)
+    t_enc = time.perf_counter() - t0
+    replay_text_block(block).text()                           # warm jit
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        replay_text_block(block).text()
+        times.append(time.perf_counter() - t0)
+    t_bulk = float(np.median(times))
+    log(f'trace-replay[bulk block-to-text]: {n_ops} keystrokes in '
+        f'{t_bulk * 1e3:.0f} ms -> {n_ops / t_bulk / 1e6:.2f}M '
+        f'keystrokes/s (dict-edge encode adds {t_enc * 1e3:.0f} ms)')
+
 
 def main():
     import jax

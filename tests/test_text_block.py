@@ -1,0 +1,174 @@
+"""Bulk text replay (TextBlock) — differential against the oracle."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import traces
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device.text_block import TextBlock, replay_text_block
+
+OBJ = traces.TEXT_OBJ
+
+
+def _mk(actor, seq, ops):
+    return {'actor': actor, 'seq': seq, 'deps': {}, 'ops': ops}
+
+
+def _create():
+    return _mk('base-actor', 1, [
+        {'action': 'makeText', 'obj': OBJ},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': OBJ}])
+
+
+def _ins(actor, seq, after, elem, char):
+    return _mk(actor, seq, [
+        {'action': 'ins', 'obj': OBJ, 'key': after, 'elem': elem},
+        {'action': 'set', 'obj': OBJ, 'key': f'{actor}:{elem}',
+         'value': char}])
+
+
+def _del(actor, seq, elem_id):
+    return _mk(actor, seq, [{'action': 'del', 'obj': OBJ, 'key': elem_id}])
+
+
+def _oracle_text(changes):
+    state, _ = Backend.apply_changes(Backend.init(), changes)
+    return traces.oracle_text(state)
+
+
+def assert_matches_oracle(changes):
+    rep = replay_text_block(TextBlock.from_changes(changes))
+    assert rep.text() == _oracle_text(changes)
+    return rep
+
+
+class TestTraceReplay:
+    @pytest.mark.parametrize('seed', range(4))
+    def test_editing_trace_matches_oracle(self, seed):
+        trace = traces.gen_editing_trace(1500 + seed * 400, seed=seed)
+        assert_matches_oracle(trace)
+
+    def test_elem_ids_order_matches_oracle(self):
+        trace = traces.gen_editing_trace(500, seed=2)
+        rep = replay_text_block(TextBlock.from_changes(trace))
+        state, _ = Backend.apply_changes(Backend.init(), trace)
+        from automerge_tpu.backend import op_set as O
+        want = [e for _, e in O.list_iterator(state.op_set, OBJ, 'elems',
+                                              None)]
+        assert rep.elem_ids() == want
+
+
+class TestConcurrentActors:
+    def test_concurrent_typing_runs_do_not_interleave(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _ins('aaa', 2, 'aaa:1', 2, 'b'),
+                   _ins('bbb', 1, '_head', 1, 'X'),
+                   _ins('bbb', 2, 'bbb:1', 2, 'Y')]
+        rep = assert_matches_oracle(changes)
+        assert rep.text() == 'XYab'       # higher actor first, runs intact
+
+    def test_concurrent_set_beats_delete(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _del('bbb', 1, 'aaa:1')]      # concurrent: empty deps
+        rep = assert_matches_oracle(changes)
+        assert rep.text() == 'a'
+
+    def test_own_delete_wins(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _ins('aaa', 2, 'aaa:1', 2, 'b'),
+                   _del('aaa', 3, 'aaa:1')]
+        rep = assert_matches_oracle(changes)
+        assert rep.text() == 'b'
+
+    def test_concurrent_set_same_element_conflict(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _mk('zzz', 1, [{'action': 'set', 'obj': OBJ,
+                                   'key': 'aaa:1', 'value': 'Z'}])]
+        rep = assert_matches_oracle(changes)
+        assert rep.text() == 'Z'          # highest actor wins
+
+    def test_set_after_own_delete_resurrects(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _del('aaa', 2, 'aaa:1'),
+                   _mk('aaa', 3, [{'action': 'set', 'obj': OBJ,
+                                   'key': 'aaa:1', 'value': 'A'}])]
+        rep = assert_matches_oracle(changes)
+        assert rep.text() == 'A'
+
+    @pytest.mark.parametrize('seed', range(3))
+    def test_random_concurrent_actors(self, seed):
+        rng = np.random.default_rng(seed)
+        changes = [_create()]
+        for a in ('alpha', 'beta', 'gamma'):
+            n = int(rng.integers(5, 15))
+            last = '_head'
+            seq = 0
+            for e in range(1, n + 1):
+                seq += 1
+                after = last if rng.random() < 0.7 else '_head'
+                changes.append(_ins(a, seq, after, e,
+                                    chr(97 + int(rng.integers(0, 26)))))
+                last = f'{a}:{e}'
+                if rng.random() < 0.2:
+                    seq += 1
+                    changes.append(_del(a, seq, last))
+        rng.shuffle(changes[1:])
+        assert_matches_oracle(changes)
+
+
+class TestValidation:
+    def test_depful_changes_rejected(self):
+        changes = [_create(),
+                   _mk('aaa', 1, [{'action': 'ins', 'obj': OBJ,
+                                   'key': '_head', 'elem': 1}])]
+        changes[1]['deps'] = {'base-actor': 1}
+        with pytest.raises(ValueError, match='empty deps'):
+            TextBlock.from_changes(changes)
+
+    def test_seq_gap_rejected(self):
+        changes = [_create(), _ins('aaa', 2, '_head', 1, 'a')]
+        with pytest.raises(ValueError, match='non-contiguous'):
+            replay_text_block(TextBlock.from_changes(changes))
+
+    def test_unknown_parent_rejected(self):
+        changes = [_create(),
+                   _ins('aaa', 1, 'ghost:9', 1, 'a')]
+        with pytest.raises(ValueError, match='unknown list element'):
+            replay_text_block(TextBlock.from_changes(changes))
+
+    def test_duplicate_elem_id_rejected(self):
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _mk('aaa', 2, [{'action': 'ins', 'obj': OBJ,
+                                   'key': '_head', 'elem': 1}])]
+        with pytest.raises(ValueError, match='[Dd]uplicate'):
+            replay_text_block(TextBlock.from_changes(changes))
+
+    def test_no_text_object_rejected(self):
+        with pytest.raises(ValueError, match='text object'):
+            TextBlock.from_changes([_mk('a', 1, [])])
+
+    def test_dangling_reference_beyond_stride_raises(self):
+        """A reference whose counter exceeds every real counter must
+        raise, not alias another actor's node via key-stride collision."""
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'a'),
+                   _del('aaa', 2, 'base-actor:4')]
+        with pytest.raises(ValueError, match='unknown list element'):
+            replay_text_block(TextBlock.from_changes(changes))
+
+    def test_object_link_inside_text_rejected(self):
+        changes = [_create(),
+                   _mk('aaa', 1, [
+                       {'action': 'ins', 'obj': OBJ, 'key': '_head',
+                        'elem': 1},
+                       {'action': 'link', 'obj': OBJ, 'key': 'aaa:1',
+                        'value': 'child-obj'}])]
+        with pytest.raises(ValueError, match='link'):
+            TextBlock.from_changes(changes)
