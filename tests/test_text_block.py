@@ -122,6 +122,29 @@ class TestConcurrentActors:
         assert_matches_oracle(changes)
 
 
+class TestEngineTriangle:
+    """The same text history through THREE engines — host oracle,
+    per-document device backend, bulk TextBlock replay — must produce
+    the identical text."""
+
+    @pytest.mark.parametrize('seed', range(3))
+    def test_three_engines_agree(self, seed):
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.device import backend as DeviceBackend
+        trace = traces.gen_editing_trace(400 + seed * 300, seed=seed + 10)
+
+        want = _oracle_text(trace)
+        rep = replay_text_block(TextBlock.from_changes(trace))
+        assert rep.text() == want
+
+        state = DeviceBackend.init()
+        state, patch = DeviceBackend.apply_changes(state, trace)
+        patch['state'] = state
+        doc = Frontend.apply_patch(
+            Frontend.init({'backend': DeviceBackend}), patch)
+        assert ''.join(str(c) for c in doc['text']) == want
+
+
 class TestValidation:
     def test_depful_changes_rejected(self):
         changes = [_create(),
