@@ -426,6 +426,40 @@ class TestDeviceDocSetSequences:
             assert _conflicts_of(dds.get_doc(doc_id)) == \
                 _conflicts_of(ods.get_doc(doc_id)), doc_id
 
+    def test_card_list_doc_syncs_over_connection(self):
+        """The README card-list example (map + list + nested maps) on the
+        device path, replicated to an oracle DocSet over the Connection
+        protocol — both ends converge to the same document."""
+        from automerge_tpu.sync import Connection
+        dds, ods = DeviceDocSet(), DocSet()
+        msgs_a, msgs_b = [], []
+        conn_a = Connection(dds, msgs_a.append)
+        conn_b = Connection(ods, msgs_b.append)
+
+        doc = _frontend_doc(
+            'writer',
+            lambda d: d.__setitem__('cards', []),
+            lambda d: d['cards'].append({'title': 'pallas', 'done': False}),
+            lambda d: d['cards'].insert(0, {'title': 'jax', 'done': False}),
+            lambda d: d['cards'][0].__setitem__('done', True))
+        dds.apply_changes('cards', _changes_of(doc, 'writer'))
+
+        conn_a.open()
+        conn_b.open()
+        for _ in range(12):
+            if not msgs_a and not msgs_b:
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                conn_b.receive_msg(m)
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                conn_a.receive_msg(m)
+        want = {'cards': [{'title': 'jax', 'done': True},
+                          {'title': 'pallas', 'done': False}]}
+        assert _materialize(ods.get_doc('cards'))  == want
+        assert _materialize(dds.get_doc('cards')) == want
+
     def test_second_batch_extends_list(self):
         dds = DeviceDocSet()
         doc1 = _frontend_doc('aa', lambda d: d.__setitem__('items', ['a']))
