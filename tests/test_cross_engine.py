@@ -104,6 +104,16 @@ def _chunks(changes, splits):
     return [changes[i:i + size] for i in range(0, len(changes), size)]
 
 
+def _mat(doc):
+    return ({k: v for k, v in doc.items()}, dict(doc._conflicts))
+
+
+def _apply_diffs_to(doc, diffs):
+    return Frontend.apply_patch(
+        doc, {'clock': {}, 'deps': {}, 'canUndo': False, 'canRedo': False,
+              'diffs': diffs})
+
+
 class TestCrossEngine:
     @pytest.mark.parametrize('seed', range(12))
     @pytest.mark.parametrize('splits', [1, 3])
@@ -128,6 +138,58 @@ class TestCrossEngine:
         assert _via_device_backend(changes, 1) == want
         assert _via_block_path(changes, 4) == want
         assert _via_dense(changes, 4) == want
+
+    @pytest.mark.parametrize('seed', range(6))
+    def test_adversarial_delivery(self, seed):
+        """Chunked, duplicated and delayed deliveries across every
+        engine: random chunks (some delivered twice, one withheld to the
+        end — exercising causal buffering and duplicate dropping) must
+        still converge to the oracle's one-shot result."""
+        from automerge_tpu.device.dense_store import DenseMapStore
+        rng = random.Random(4000 + seed)
+        changes = _gen_causal_history(rng, n_actors=4, n_changes=20,
+                                      n_keys=5)
+        want = _via_oracle(changes)
+
+        chunks, i = [], 0
+        while i < len(changes):
+            k = rng.randint(1, 6)
+            chunks.append(changes[i:i + k])
+            i += k
+        delayed = chunks.pop(rng.randrange(len(chunks))) \
+            if len(chunks) > 1 else []
+        deliveries = []
+        for ch in chunks:
+            deliveries.append(ch)
+            if rng.random() < 0.3:
+                deliveries.append(ch)           # duplicate delivery
+        deliveries.append(delayed)
+
+        st = DeviceBackend.init()
+        doc = Frontend.init('viewer')
+        for ch in deliveries:
+            st, p = DeviceBackend.apply_changes(st, ch)
+            doc = Frontend.apply_patch(
+                doc, dict(p, clock={}, deps={}, canUndo=False,
+                          canRedo=False))
+        assert _mat(doc) == want
+
+        store = blocks.init_store(1)
+        bdoc = Frontend.init('viewer')
+        for ch in deliveries:
+            pb = blocks.apply_block(
+                store, blocks.ChangeBlock.from_changes([ch]))
+            bdoc = _apply_diffs_to(bdoc, pb.diffs(0))
+        assert _mat(bdoc) == want
+        assert store.queue == []
+
+        ds = DenseMapStore(1, key_capacity=8, actor_capacity=8)
+        ddoc = Frontend.init('viewer')
+        for ch in deliveries:
+            pb = ds.apply_block(
+                blocks.ChangeBlock.from_changes([ch])).to_patch_block()
+            ddoc = _apply_diffs_to(ddoc, pb.diffs(0))
+        assert _mat(ddoc) == want
 
     def test_interleaved_delivery_order_invariance(self):
         """Every engine converges to the same state regardless of the
