@@ -71,7 +71,12 @@ class MaterializationContext:
 
     def _instantiate_list(self, ops, object_id, obj_type):
         diffs = self.diffs[object_id]
-        diffs.append({'obj': object_id, 'type': obj_type, 'action': 'create'})
+        # maxElem rides on the create diff: visible inserts alone
+        # under-count it when the highest-counter element is a tombstone,
+        # and a frontend resuming from this patch would mint colliding
+        # elemIds. (The reference omits this and has that latent bug.)
+        diffs.append({'obj': object_id, 'type': obj_type, 'action': 'create',
+                      'maxElem': ops.by_object[object_id].max_elem})
 
         conflicts = OpSet.list_iterator(ops, object_id, 'conflicts', self)
         values = OpSet.list_iterator(ops, object_id, 'values', self)

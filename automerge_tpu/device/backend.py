@@ -216,7 +216,7 @@ class _DocWork:
     """Per-document staging between the host phases and the device calls."""
 
     __slots__ = ('state', 'create_diffs', 'touched', 'rows', 'dirty_seq',
-                 'touched_by_obj', 'survivors')
+                 'touched_by_obj', 'survivors', 'ins_dirty')
 
     def __init__(self, state):
         self.state = state
@@ -226,6 +226,7 @@ class _DocWork:
         self.dirty_seq = []       # sequence obj ids needing re-ordering
         self.touched_by_obj = {}  # obj -> [key] (first-touch order)
         self.survivors = {}       # field -> surviving entries (post-kernel)
+        self.ins_dirty = set()    # seq objs that gained nodes this batch
 
 
 def _stage_changes(work, admitted):
@@ -267,6 +268,7 @@ def _stage_changes(work, admitted):
                 rec.node_parent.append(parent)
                 rec.node_elem.append(elem)
                 rec.node_actor.append(actor)
+                work.ins_dirty.add(obj)
                 if obj not in dirty_set:
                     dirty_set.add(obj)
                     work.dirty_seq.append(obj)
@@ -567,6 +569,14 @@ def _emit_seq_diffs(work, obj, rec, visible, vis_index):
     sets.sort(key=lambda e: e['index'])
 
     diffs = []
+    if obj in work.ins_dirty:
+        # Batched diffs net out an element inserted AND deleted within
+        # one apply — its counter would never reach the frontend, whose
+        # next local insert would mint a colliding elemId. A maxElem
+        # diff keeps the frontend's counter truthful (extension over the
+        # reference, which has the latent collision; see README).
+        diffs.append({'action': 'maxElem', 'type': obj_type, 'obj': obj,
+                      'value': max(rec.node_elem)})
     for idx in removes:
         diffs.append({'action': 'remove', 'type': obj_type, 'obj': obj,
                       'index': idx})
@@ -811,7 +821,8 @@ def get_patch(state):
         if rec.is_sequence():
             obj_type = 'text' if rec.type == 'makeText' else 'list'
             obj_diffs.append({'action': 'create', 'obj': obj_id,
-                              'type': obj_type})
+                              'type': obj_type,
+                              'maxElem': max(rec.node_elem, default=0)})
             for index, elem_id in enumerate(rec.elem_ids):
                 entries = state.fields[(obj_id, elem_id)]
                 emit_entry_objects(entries)   # children first

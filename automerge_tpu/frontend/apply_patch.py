@@ -169,7 +169,16 @@ def update_list_object(diff, cache, updated, inbound, lenient=False):
 
     refs_before, refs_after = {}, {}
     if diff['action'] == 'create':
-        pass
+        # a create may carry the true maxElem — visible inserts alone
+        # under-count it past tombstones (see backend get_patch)
+        if diff.get('maxElem'):
+            object.__setattr__(lst, '_max_elem',
+                               max(lst._max_elem, diff['maxElem']))
+    elif diff['action'] == 'maxElem':
+        # batched device patches net out insert+delete within one apply;
+        # this diff keeps the local elemId counter truthful anyway
+        object.__setattr__(lst, '_max_elem',
+                           max(lst._max_elem, diff['value']))
     elif diff['action'] == 'insert':
         index = diff['index']
         elem_id = diff.get('elemId')
@@ -253,7 +262,10 @@ def update_text_object(diffs, start_index, end_index, cache, updated):
     while i <= end_index:
         diff = diffs[i]
         if diff['action'] == 'create':
-            pass
+            # true maxElem may exceed the visible inserts' (tombstones)
+            max_elem = max(max_elem, diff.get('maxElem', 0))
+        elif diff['action'] == 'maxElem':
+            max_elem = max(max_elem, diff['value'])
         elif diff['action'] == 'insert':
             if splice_pos < 0:
                 splice_pos, deletions, insertions = diff['index'], 0, []

@@ -262,7 +262,10 @@ class TestGetPatch:
         assert Backend.get_patch(s1) == {
             'canUndo': False, 'canRedo': False, 'clock': {actor: 1}, 'deps': {actor: 1},
             'diffs': [
-                {'action': 'create', 'obj': birds, 'type': 'list'},
+                # maxElem on create is a deliberate extension over the
+                # reference (prevents elemId reuse after load; see README "maxElem")
+                {'action': 'create', 'obj': birds, 'type': 'list',
+                 'maxElem': 1},
                 {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
                  'value': 'chaffinch', 'elemId': f'{actor}:1'},
                 {'action': 'set', 'obj': ROOT_ID, 'type': 'map', 'key': 'birds',
@@ -291,7 +294,8 @@ class TestGetPatch:
         assert Backend.get_patch(s1) == {
             'canUndo': False, 'canRedo': False, 'clock': {actor: 2}, 'deps': {actor: 2},
             'diffs': [
-                {'action': 'create', 'obj': birds, 'type': 'list'},
+                {'action': 'create', 'obj': birds, 'type': 'list',
+                 'maxElem': 3},
                 {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 0,
                  'value': 'greenfinch', 'elemId': f'{actor}:3'},
                 {'action': 'insert', 'obj': birds, 'type': 'list', 'index': 1,

@@ -426,6 +426,29 @@ class TestDeviceDocSetSequences:
             assert _conflicts_of(dds.get_doc(doc_id)) == \
                 _conflicts_of(ods.get_doc(doc_id)), doc_id
 
+    def test_netted_insert_delete_batch_keeps_elem_counter_truthful(self):
+        """An element inserted AND deleted within one delivered batch
+        produces no insert diff; the maxElem diff must still advance the
+        receiving frontend's counter so its next local insert does not
+        mint a colliding elemId."""
+        base = _frontend_doc('aa', lambda d: d.__setitem__('items', ['a']))
+        c_more = _fork(_changes_of(base, 'aa'), 'aa2',
+                       lambda d: d['items'].append('temp'),
+                       lambda d: d['items'].__delitem__(1))
+        # live device-backed doc receives [insert temp, delete temp] in
+        # ONE batch (netted out of the diff stream)
+        doc = Frontend.init({'backend': DeviceBackend})
+        doc = Frontend.set_actor_id(doc, 'aa2')
+        state = Frontend.get_backend_state(doc)
+        state, patch = DeviceBackend.apply_changes(
+            state, _changes_of(base, 'aa') + c_more)
+        patch['state'] = state
+        doc = Frontend.apply_patch(doc, patch)
+        assert _materialize(doc)['items'] == ['a']
+        # next local insert must not collide with the tombstoned elemId
+        doc, _ = Frontend.change(doc, lambda d: d['items'].append('new'))
+        assert _materialize(doc)['items'] == ['a', 'new']
+
     def test_card_list_doc_syncs_over_connection(self):
         """The README card-list example (map + list + nested maps) on the
         device path, replicated to an oracle DocSet over the Connection

@@ -155,6 +155,43 @@ class TestDeviceSnapshot:
         again = snapshot.load_snapshot(snapshot.save_snapshot(doc))
         assert _materialize(again) == _materialize(doc)
 
+    def test_resume_after_tombstoned_tail_mints_fresh_elem_ids(self):
+        """The highest-counter list element is deleted before the
+        checkpoint; a resumed frontend must NOT mint a colliding elemId
+        on its next insert (maxElem rides on the create diff — the
+        reference omits this and has the latent collision)."""
+        doc = Frontend.set_actor_id(
+            Frontend.init({'backend': DeviceBackend}), 'aa')
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('items',
+                                                              ['a', 'b']))
+        doc, _ = Frontend.change(doc, lambda d: d['items'].__delitem__(1))
+        resumed = snapshot.load_snapshot(snapshot.save_snapshot(doc),
+                                         actor_id='aa')
+        resumed, _ = Frontend.change(resumed,
+                                     lambda d: d['items'].append('c'))
+        assert _materialize(resumed)['items'] == ['a', 'c']
+        # same flow for text
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('t', Text()))
+        doc, _ = Frontend.change(doc, lambda d: d['t'].insert_at(0, *'xy'))
+        doc, _ = Frontend.change(doc, lambda d: d['t'].delete_at(1))
+        resumed = snapshot.load_snapshot(snapshot.save_snapshot(doc),
+                                         actor_id='aa')
+        resumed, _ = Frontend.change(resumed,
+                                     lambda d: d['t'].insert_at(1, 'z'))
+        assert _materialize(resumed)['t'] == 'xz'
+
+    def test_oracle_load_after_tombstoned_tail(self):
+        """Same fix through am.save/am.load on the host oracle."""
+        doc = am.change(am.init('aa'),
+                        lambda d: d.__setitem__('items', ['a', 'b']))
+        doc = am.change(doc, lambda d: d['items'].__delitem__(1))
+        loaded = am.load(am.save(doc), actor_id='aa')
+        loaded = am.change(loaded, lambda d: d['items'].append('c'))
+        assert _materialize(loaded)['items'] == ['a', 'c']
+        # the continued doc still merges with a peer of the original
+        peer = am.merge(am.init('bb'), loaded)
+        assert _materialize(peer)['items'] == ['a', 'c']
+
     def test_malformed_seq_rejected(self):
         state = DeviceBackend.init()
         with pytest.raises(ValueError, match='positive integer seq'):
