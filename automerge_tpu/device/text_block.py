@@ -50,10 +50,12 @@ class TextBlock:
     INS, SET, DEL = 0, 1, 2
 
     __slots__ = ('actors', 'obj', 'actor', 'seq', 'op_ptr', 'kind',
-                 'ref_actor', 'ref_elem', 'elem', 'value')
+                 'ref_actor', 'ref_elem', 'elem', 'value', 'root_key',
+                 'creator', 'linker')
 
     def __init__(self, actors, obj, actor, seq, op_ptr, kind, ref_actor,
-                 ref_elem, elem, value):
+                 ref_elem, elem, value, root_key=None, creator=None,
+                 linker=None):
         self.actors = actors
         self.obj = obj
         self.actor = actor
@@ -64,6 +66,9 @@ class TextBlock:
         self.ref_elem = ref_elem
         self.elem = elem
         self.value = value
+        self.root_key = root_key       # key linking the text at the root
+        self.creator = creator         # (actor name, seq) of makeText
+        self.linker = linker           # (actor name, seq) of the root link
 
     @property
     def n_changes(self):
@@ -96,6 +101,7 @@ class TextBlock:
             return intern(a), int(e)
 
         obj = None
+        root_key = creator = linker = None
         actor, seq = [], []
         op_ptr = [0]
         kind, ref_a, ref_e, elem, value = [], [], [], [], []
@@ -112,9 +118,12 @@ class TextBlock:
                     if obj is not None:
                         raise ValueError('multiple text objects in trace')
                     obj = op['obj']
+                    creator = (change['actor'], change['seq'])
                     continue
                 if action == 'link' and op['obj'] == ROOT_ID:
-                    continue                      # root link, structural
+                    root_key = op['key']          # structural root link
+                    linker = (change['actor'], change['seq'])
+                    continue
                 if obj is None or op['obj'] != obj or action == 'link':
                     raise ValueError(
                         'TextBlock holds exactly one text object of '
@@ -154,17 +163,19 @@ class TextBlock:
                    np.asarray(actor, np.int32), np.asarray(seq, np.int32),
                    np.asarray(op_ptr, np.int32), np.asarray(kind, np.int8),
                    np.asarray(ref_a, np.int32), np.asarray(ref_e, np.int32),
-                   np.asarray(elem, np.int32), np.asarray(value, np.int32))
+                   np.asarray(elem, np.int32), np.asarray(value, np.int32),
+                   root_key=root_key, creator=creator, linker=linker)
 
 
 class TextReplay:
     """Result of one bulk replay: the ordered document."""
 
     __slots__ = ('block', 'nodes_actor', 'nodes_elem', 'visible',
-                 'codepoint', 'order', 'n_nodes')
+                 'codepoint', 'order', 'n_nodes', 'parent', 'win_actor',
+                 'win_seq', 'survivors')
 
     def __init__(self, block, nodes_actor, nodes_elem, visible, codepoint,
-                 order, n_nodes):
+                 order, n_nodes, parent, win_actor, win_seq, survivors):
         self.block = block
         self.nodes_actor = nodes_actor   # per node (incl. head): actor idx
         self.nodes_elem = nodes_elem
@@ -172,6 +183,10 @@ class TextReplay:
         self.codepoint = codepoint
         self.order = order               # rga_order outputs (padded)
         self.n_nodes = n_nodes
+        self.parent = parent
+        self.win_actor = win_actor       # per node: winning actor idx / -1
+        self.win_seq = win_seq
+        self.survivors = survivors       # (node, actor, seq, cp) alive sets
 
     def text(self):
         """The final visible text (fetches only vis_index — the other
@@ -191,6 +206,88 @@ class TextReplay:
         actors = self.block.actors
         return [f'{actors[self.nodes_actor[n]]}:{self.nodes_elem[n]}'
                 for n in ordered]
+
+    def to_state(self):
+        """A live :class:`~automerge_tpu.device.backend.DeviceBackendState`
+        continuing from this replay — bulk-load a 180k-op history in one
+        device call, then keep editing through the normal change/patch
+        protocol. Change bodies are not retained (same contract as a
+        packed-snapshot resume: the log is truncated; peers behind this
+        point need the history or a snapshot)."""
+        from .backend import DeviceBackendState, _ObjRecord
+        block = self.block
+        if block.root_key is None or block.creator is None:
+            raise ValueError(
+                'block lacks the creation/link ops (built without the '
+                'creating change); cannot build a document state')
+        actors = block.actors
+        state = DeviceBackendState()
+
+        rec = _ObjRecord('makeText')
+        eids = [f'{actors[self.nodes_actor[i]]}:{self.nodes_elem[i]}'
+                for i in range(1, self.n_nodes)]
+        rec.nodes = ['_head'] + eids
+        rec.node_of = {e: i for i, e in enumerate(rec.nodes)}
+        rec.node_parent = self.parent.tolist()
+        rec.node_elem = self.nodes_elem.tolist()
+        rec.node_actor = ['' if i == 0 else actors[self.nodes_actor[i]]
+                          for i in range(self.n_nodes)]
+        rec.elem_ids = self.elem_ids()
+        state.objects[block.obj] = rec
+        state._owned.add(block.obj)
+
+        # ALL surviving entries per visible node (winner first, actor
+        # string descending — concurrent sets stay as conflicts)
+        s_node, s_actor, s_seq, s_cp = self.survivors
+        per_node = {}
+        for n, a, s, cp in zip(s_node.tolist(), s_actor.tolist(),
+                               s_seq.tolist(), s_cp.tolist()):
+            per_node.setdefault(n, []).append(
+                {'actor': actors[a], 'seq': s,
+                 'all_deps': {actors[a]: s - 1} if s > 1 else {},
+                 'action': 'set', 'value': chr(cp)})
+        for n, entries in per_node.items():
+            entries.sort(key=lambda e: e['actor'], reverse=True)
+            state.fields[(block.obj, rec.nodes[n])] = tuple(entries)
+
+        # root link: the op identity of the LINK change, not makeText
+        l_actor, l_seq = block.linker if block.linker else block.creator
+        rec.inbound = [(ROOT_ID, block.root_key)]
+        state.fields[(ROOT_ID, block.root_key)] = (
+            {'actor': l_actor, 'seq': l_seq,
+             'all_deps': {l_actor: l_seq - 1} if l_seq > 1 else {},
+             'action': 'link', 'value': block.obj},)
+
+        # clocks + body-less change log (snapshot-resume contract)
+        heads = {}
+        for i in range(block.n_changes):
+            a = actors[block.actor[i]]
+            heads[a] = max(heads.get(a, 0), int(block.seq[i]))
+        for who in (block.creator, block.linker):
+            if who:
+                heads[who[0]] = max(heads.get(who[0], 0), who[1])
+        state.clock = dict(heads)
+        state.deps = dict(heads)
+        for a, top in heads.items():
+            state.states[a] = [
+                {'change': None, 'all_deps': {a: s - 1} if s > 1 else {}}
+                for s in range(1, top + 1)]
+            state.state_lens[a] = top
+        state.log_truncated = True
+        return state
+
+    def to_doc(self, actor_id=None):
+        """A frontend document over :meth:`to_state` (ready to edit)."""
+        from .. import frontend as Frontend
+        from . import backend as DeviceBackend
+        state = self.to_state()
+        options = {'backend': DeviceBackend}
+        if actor_id is not None:
+            options['actorId'] = actor_id
+        doc = Frontend.init(options)
+        patch = DeviceBackend.get_patch(state)
+        patch['state'] = state
+        return Frontend.apply_patch(doc, patch)
 
 
 def replay_text_block(block, options=None):
@@ -292,6 +389,11 @@ def replay_text_block(block, options=None):
     ss = block.seq[op_change[set_rows]]
     mine = (win_actor[sn] == sa) & (win_seq[sn] == ss)
     codepoint[sn[mine]] = block.value[set_rows[mine]]
+    # ALL surviving set entries (each alive actor's latest set) — the
+    # conflict metadata a continued document state must carry
+    alive_row = set_alive[sn, sa] & ((fate[sn, sa] >> 1) == ss)
+    survivors = (sn[alive_row], sa[alive_row], ss[alive_row],
+                 block.value[set_rows[alive_row]])
 
     # ---- one device call: RGA order over the whole tree ----
     n_pad = opts.pad_nodes(n_nodes)
@@ -309,4 +411,4 @@ def replay_text_block(block, options=None):
                     jnp.asarray(valid))
     # outputs stay device-resident; consumers fetch what they use
     return TextReplay(block, nodes_actor, nodes_elem, visible, codepoint,
-                      out, n_nodes)
+                      out, n_nodes, parent, win_actor, win_seq, survivors)

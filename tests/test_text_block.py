@@ -145,6 +145,88 @@ class TestEngineTriangle:
         assert ''.join(str(c) for c in doc['text']) == want
 
 
+class TestToState:
+    """Bulk replay -> live device-backed document (the snapshot-resume
+    contract: full CRDT state, truncated change log)."""
+
+    def _replayed_doc(self, n_ops=800, seed=3):
+        trace = traces.gen_editing_trace(n_ops, seed=seed)
+        rep = replay_text_block(TextBlock.from_changes(trace))
+        return trace, rep.to_doc(actor_id='author')
+
+    def test_materialization_matches_oracle(self):
+        trace, doc = self._replayed_doc()
+        assert ''.join(str(c) for c in doc['text']) == _oracle_text(trace)
+
+    def test_continue_editing_and_interop(self):
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.device import backend as DeviceBackend
+        trace, doc = self._replayed_doc(300, seed=4)
+        doc, _ = Frontend.change(doc, lambda d: d['text'].insert_at(0, '!'))
+        got = ''.join(str(c) for c in doc['text'])
+        assert got == '!' + _oracle_text(trace)
+        # post-replay changes ship to a full-history peer and replay
+        st = Frontend.get_backend_state(doc)
+        new = DeviceBackend.get_changes_for_actor(st, 'author',
+                                                  after_seq=301)
+        full, _ = Backend.apply_changes(Backend.init(), trace + new)
+        assert traces.oracle_text(full) == got
+
+    def test_stale_peer_refused_with_truncation_error(self):
+        _, doc = self._replayed_doc(100, seed=5)
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.device import backend as DeviceBackend
+        with pytest.raises(ValueError, match='truncated'):
+            DeviceBackend.get_missing_changes(
+                Frontend.get_backend_state(doc), {})
+
+    def test_snapshot_roundtrip_of_replayed_doc(self):
+        import automerge_tpu as am
+        trace, doc = self._replayed_doc(200, seed=6)
+        again = am.load_snapshot(am.save_snapshot(doc), actor_id='author')
+        assert ''.join(str(c) for c in again['text']) == \
+            ''.join(str(c) for c in doc['text'])
+
+    def test_conflicts_survive_into_state(self):
+        """Concurrent sets on one element keep ALL survivors in the
+        continued state — exactly what the full device backend keeps."""
+        from automerge_tpu.device import backend as DeviceBackend
+        changes = [_create(),
+                   _ins('aaa', 1, '_head', 1, 'x'),
+                   _mk('ccc', 1, [{'action': 'set', 'obj': OBJ,
+                                   'key': 'aaa:1', 'value': 'y'}])]
+        rep = replay_text_block(TextBlock.from_changes(changes))
+        state = rep.to_state()
+        ref_state, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                                   changes)
+        got = state.fields[(OBJ, 'aaa:1')]
+        want = ref_state.fields[(OBJ, 'aaa:1')]
+        assert [(e['actor'], e['value']) for e in got] == \
+            [(e['actor'], e['value']) for e in want]
+        assert len(got) == 2                      # conflict preserved
+
+    def test_link_identity_from_link_change(self):
+        """The root-link entry carries the LINK change's identity even
+        when makeText and the link arrive in different changes."""
+        changes = [
+            _mk('aaa', 1, [{'action': 'makeText', 'obj': OBJ}]),
+            _mk('aaa', 2, [{'action': 'link', 'obj': ROOT_ID,
+                            'key': 'text', 'value': OBJ}]),
+            _ins('aaa', 3, '_head', 1, 'q')]
+        rep = replay_text_block(TextBlock.from_changes(changes))
+        state = rep.to_state()
+        (entry,) = state.fields[(ROOT_ID, 'text')]
+        assert (entry['actor'], entry['seq']) == ('aaa', 2)
+        assert entry['all_deps'] == {'aaa': 1}
+
+    def test_block_without_creation_refuses_state(self):
+        chs = [_ins('aaa', 1, '_head', 1, 'a')]
+        blk = TextBlock.from_changes([_create()] + chs)
+        blk.root_key = None
+        with pytest.raises(ValueError, match='creation'):
+            replay_text_block(blk).to_state()
+
+
 class TestValidation:
     def test_depful_changes_rejected(self):
         changes = [_create(),
