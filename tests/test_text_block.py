@@ -219,6 +219,36 @@ class TestToState:
         assert (entry['actor'], entry['seq']) == ('aaa', 2)
         assert entry['all_deps'] == {'aaa': 1}
 
+    @pytest.mark.parametrize('seed', range(3))
+    def test_continuation_fuzz_vs_full_history(self, seed):
+        """Replay -> continue with random protocol edits -> the shipped
+        post-replay changes must reproduce the same text on a
+        full-history oracle peer."""
+        import random
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.device import backend as DeviceBackend
+        rng = random.Random(7000 + seed)
+        n = rng.randint(50, 300)
+        trace = traces.gen_editing_trace(n, seed=seed)
+        doc = replay_text_block(
+            TextBlock.from_changes(trace)).to_doc(actor_id='author')
+        k = rng.randint(1, 5)
+        for _ in range(k):
+            def edit(d, rng=rng):
+                t = d['text']
+                if rng.random() < 0.7 or len(t) == 0:
+                    t.insert_at(rng.randint(0, len(t)),
+                                chr(65 + rng.randrange(26)))
+                else:
+                    t.delete_at(rng.randrange(len(t)))
+            doc, _ = Frontend.change(doc, edit)
+        got = ''.join(str(c) for c in doc['text'])
+        new = DeviceBackend.get_changes_for_actor(
+            Frontend.get_backend_state(doc), 'author', after_seq=n + 1)
+        assert len(new) == k
+        full, _ = Backend.apply_changes(Backend.init(), trace + new)
+        assert traces.oracle_text(full) == got
+
     def test_block_without_creation_refuses_state(self):
         chs = [_ins('aaa', 1, '_head', 1, 'a')]
         blk = TextBlock.from_changes([_create()] + chs)
