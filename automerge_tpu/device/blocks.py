@@ -233,19 +233,28 @@ class ChangeBlock:
         dep_ptr, dep_actor, dep_seq = [0], [], []
         op_ptr, action, key, value = [0], [], [], []
 
+        def check_i32(v, what):
+            # match the native codec: out-of-range wire counters are a
+            # ValueError, never a silent int32 wraparound
+            if not isinstance(v, int) or isinstance(v, bool) or \
+                    not 0 <= v <= 0x7FFFFFFF:
+                raise ValueError(
+                    f'{what} {v!r} out of range (must fit int32)')
+            return v
+
         for d, changes in enumerate(changes_per_doc):
             for change in changes:
                 if 'deps' not in change:
                     raise ValueError('change requires actor, seq and deps')
                 doc.append(d)
                 actor.append(_intern(actors, actor_of, change['actor']))
-                seq.append(change['seq'])
+                seq.append(check_i32(change['seq'], 'change seq'))
                 # dep order is semantic: the reference folds deps in dict
                 # order and later entries can clobber earlier transitive
                 # seqs (transitiveDeps, op_set.js:29-37)
                 for da, ds in change['deps'].items():
                     dep_actor.append(_intern(actors, actor_of, da))
-                    dep_seq.append(ds)
+                    dep_seq.append(check_i32(ds, 'dep seq'))
                 dep_ptr.append(len(dep_actor))
                 for op in change['ops']:
                     if op['action'] not in _ACTION_NAMES:

@@ -62,7 +62,12 @@ def snapshot_state(state):
             'clock': state.clock,
             'deps': state.deps,
             'queue': state.queue,
-            'closures': closures}
+            'closures': closures,
+            # undo/redo stacks are plain op lists — cheap to carry, and
+            # a resumed document keeps canUndo/canRedo working
+            'undo_pos': state.undo_pos,
+            'undo_stack': state.undo_stack,
+            'redo_stack': state.redo_stack}
 
 
 def restore_state(payload):
@@ -101,6 +106,10 @@ def restore_state(payload):
     state.history = []
     state.history_len = 0
     state.log_truncated = True
+    # absent in pre-undo snapshots: default to empty stacks
+    state.undo_pos = payload.get('undo_pos', 0)
+    state.undo_stack = [list(ops) for ops in payload.get('undo_stack', [])]
+    state.redo_stack = [list(ops) for ops in payload.get('redo_stack', [])]
     return state
 
 

@@ -20,6 +20,7 @@ import json
 import os
 import subprocess
 import tempfile
+import warnings
 
 import numpy as np
 
@@ -32,6 +33,22 @@ _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_PKG_DIR, '_native', 'libamwire.so')
 _SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), 'native',
                          'wire_codec.cpp')
+
+
+def _cache_so_path():
+    """Fallback build target when the package dir is read-only (e.g. a
+    system site-packages install): a per-user cache directory, keyed by
+    a source hash so two installs with different codec sources never
+    load each other's binary."""
+    import hashlib
+    try:
+        with open(_SRC_PATH, 'rb') as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        tag = 'nosrc'
+    base = os.environ.get('XDG_CACHE_HOME') or \
+        os.path.join(os.path.expanduser('~'), '.cache')
+    return os.path.join(base, 'automerge_tpu', f'libamwire-{tag}.so')
 
 _i64 = ctypes.c_int64
 _p32 = ctypes.POINTER(ctypes.c_int32)
@@ -67,16 +84,20 @@ def _bind(lib):
     return lib
 
 
-def _compile():
-    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-    fd, tmp = tempfile.mkstemp(suffix='.so', dir=os.path.dirname(_SO_PATH))
-    os.close(fd)
+def _compile(so_path):
+    try:
+        os.makedirs(os.path.dirname(so_path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix='.so',
+                                   dir=os.path.dirname(so_path))
+        os.close(fd)
+    except OSError:
+        return False
     try:
         subprocess.run(
             ['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
              _SRC_PATH, '-o', tmp],
             check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO_PATH)
+        os.replace(tmp, so_path)
         return True
     except (OSError, subprocess.SubprocessError):
         try:
@@ -94,15 +115,42 @@ def _load():
     if os.environ.get('AUTOMERGE_TPU_NATIVE', '1') == '0':
         return None
     have_src = os.path.exists(_SRC_PATH)
-    stale = (have_src and os.path.exists(_SO_PATH)
-             and os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH))
-    if not os.path.exists(_SO_PATH) or stale:
-        if not have_src or not _compile():
-            if not os.path.exists(_SO_PATH):
-                return None
+    candidates = (_SO_PATH, _cache_so_path())
+    so_path = None
+    for candidate in candidates:
+        stale = (have_src and os.path.exists(candidate)
+                 and os.path.getmtime(candidate)
+                 < os.path.getmtime(_SRC_PATH))
+        if os.path.exists(candidate) and not stale:
+            so_path = candidate
+            break
+        if have_src and _compile(candidate):
+            so_path = candidate
+            break
+    if so_path is None:
+        # last resort: a stale binary beats no binary, but only after
+        # every candidate (incl. the user cache dir) failed to rebuild
+        for candidate in candidates:
+            if os.path.exists(candidate):
+                so_path = candidate
+                warnings.warn(
+                    f'automerge_tpu: native wire codec at {candidate} is '
+                    f'older than its source and could not be rebuilt; '
+                    f'loading the stale binary.', RuntimeWarning)
+                break
+    if so_path is None:
+        warnings.warn(
+            'automerge_tpu: native wire codec unavailable (compilation '
+            'failed or no g++); falling back to the pure-Python parser. '
+            'Set AUTOMERGE_TPU_NATIVE=0 to silence.', RuntimeWarning)
+        return None
     try:
-        _LIB = _bind(ctypes.CDLL(_SO_PATH))
+        _LIB = _bind(ctypes.CDLL(so_path))
     except OSError:
+        warnings.warn(
+            f'automerge_tpu: failed to load native wire codec from '
+            f'{so_path}; falling back to the pure-Python parser.',
+            RuntimeWarning)
         _LIB = None
     return _LIB
 

@@ -161,6 +161,12 @@ struct Cursor {
         int64_t v = 0;
         while (p < end && *p >= '0' && *p <= '9') {
             v = v * 10 + (*p - '0');
+            // seq/dep/elem counters must fit int32 (the column dtype);
+            // rejecting here matches the Python edge, where
+            // np.asarray(..., np.int32) raises on overflow — a huge wire
+            // numeral must be a parse error, never a silent wraparound
+            if (v > 0x7FFFFFFFLL)
+                return fail("integer out of range (must fit int32)");
             ++p;
         }
         if (p < end && (*p == '.' || *p == 'e' || *p == 'E'))

@@ -72,6 +72,22 @@ class TestDeviceSnapshot:
         assert payload['format'] == snapshot.FORMAT
         assert payload['clock'] == {'author': 6}
 
+    def test_undo_redo_survive_snapshot_resume(self):
+        doc = Frontend.init({'backend': DeviceBackend,
+                             'actorId': 'undoer'})
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('k', 1))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('k', 2))
+        doc, _ = Frontend.undo(doc)
+        assert doc['k'] == 1
+        assert Frontend.can_undo(doc) and Frontend.can_redo(doc)
+
+        resumed = snapshot.load_snapshot(snapshot.save_snapshot(doc))
+        assert Frontend.can_undo(resumed) and Frontend.can_redo(resumed)
+        redone, _ = Frontend.redo(resumed)
+        assert redone['k'] == 2
+        undone, _ = Frontend.undo(resumed)
+        assert 'k' not in dict(undone.items())
+
     def test_resume_then_concurrent_change_matches_full_log(self):
         """A change CONCURRENT with pre-snapshot state must resolve
         identically after resume (the closure table keeps concurrency

@@ -89,6 +89,16 @@ class TestParse:
         with pytest.raises(ValueError, match=msg):
             wire.parse_change_block(bad)
 
+    def test_int32_overflow_rejected_on_both_edges(self):
+        # a seq >= 2^31 must be a parse error on BOTH edges — never a
+        # silent wraparound that could sneak past the seq-range guard
+        bad = ('[[{"actor": "a", "seq": 2147483648, "deps": {}, '
+               '"ops": []}]]')
+        with pytest.raises(ValueError, match='out of range'):
+            wire.parse_change_block(bad)
+        with pytest.raises(ValueError, match='out of range'):
+            blocks.ChangeBlock.from_changes(json.loads(bad))
+
     @pytest.mark.parametrize('seed', range(3))
     def test_generated_workload_parses_identically(self, seed):
         blk = gen_block_workload(n_docs=8, n_actors=3, ops_per_change=4,
