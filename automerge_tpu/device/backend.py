@@ -213,42 +213,92 @@ _MAKE_KIND = {'makeMap': 'map', 'makeList': 'list', 'makeText': 'text'}
 
 
 class _DocWork:
-    """Per-document staging between the host phases and the device calls."""
+    """Per-document staging between the host phases and the device calls.
 
-    __slots__ = ('state', 'create_diffs', 'touched', 'rows', 'dirty_seq',
-                 'touched_by_obj', 'survivors', 'ins_dirty')
+    Rows are kept as parallel columns: the per-row metadata of NEW ops is
+    derived from per-change metadata (``changes_meta``) by vectorized
+    gather in :func:`_pack_docs` — the per-op Python work is one dict
+    lookup for the segment id and the entry-dict construction; everything
+    per-row-numeric (actor rank, seq, clock) is a numpy gather. Prior
+    entries of touched fields (usually few) append as explicit rows with
+    ``row_change = -1``.
+    """
+
+    __slots__ = ('state', 'create_diffs', 'touched', 'dirty_seq',
+                 'touched_by_obj', 'survivors', 'ins_dirty',
+                 'changes_meta', 'row_field', 'row_entry', 'row_change',
+                 'row_seg', 'row_node', 'row_objloc', 'row_is_del',
+                 'n_new')
 
     def __init__(self, state):
         self.state = state
         self.create_diffs = []
         self.touched = []         # (obj, key) in first-touch order
-        self.rows = []            # (field, entry_dict, is_del, is_new)
         self.dirty_seq = []       # sequence obj ids needing re-ordering
         self.touched_by_obj = {}  # obj -> [key] (first-touch order)
         self.survivors = {}       # field -> surviving entries (post-kernel)
         self.ins_dirty = set()    # seq objs that gained nodes this batch
+        self.changes_meta = []    # per change: (actor, seq, all_deps)
+        self.row_field = []       # field tuple per row
+        self.row_entry = []       # entry dict per row
+        self.row_change = []      # change index per row (-1 for priors)
+        self.row_seg = []         # segment id per row
+        self.row_node = []        # node index within its seq obj (-1: map)
+        self.row_objloc = []      # index into dirty_seq (-1: map row)
+        self.row_is_del = []
+        self.n_new = 0
+
+    @property
+    def n_rows(self):
+        return len(self.row_field)
 
 
 def _stage_changes(work, admitted):
     state = work.state
-    touched_set = set()
-    dirty_set = set()
-    for change, all_deps in admitted:
+    seg_of = {}                  # field -> segment id (first-touch order)
+    dirty_of = {}                # seq obj -> index into dirty_seq
+    objects = state.objects
+    for ci, (change, all_deps) in enumerate(admitted):
         actor, seq = change['actor'], change['seq']
+        work.changes_meta.append((actor, seq, all_deps))
         for op in change['ops']:
             action = op['action']
-            if action in _MAKE_KIND:
+            if action in ('set', 'del', 'link'):
                 obj = op['obj']
-                if obj in state.objects:
-                    raise ValueError('Duplicate creation of object ' + obj)
-                state.objects[obj] = _ObjRecord(action)
-                state._owned.add(obj)
-                work.create_diffs.append(
-                    {'action': 'create', 'obj': obj,
-                     'type': _MAKE_KIND[action]})
+                rec = objects.get(obj)
+                if rec is None:
+                    raise ValueError('Modification of unknown object ' + obj)
+                key = op['key']
+                if rec.nodes is not None:       # sequence object
+                    node = rec.node_of.get(key)
+                    if node is None:
+                        raise TypeError(
+                            'Missing index entry for list element '
+                            + str(key))
+                    jl = dirty_of.get(obj)
+                    if jl is None:
+                        jl = dirty_of[obj] = len(work.dirty_seq)
+                        work.dirty_seq.append(obj)
+                else:
+                    node = jl = -1
+                field = (obj, key)
+                seg = seg_of.get(field)
+                if seg is None:
+                    seg = seg_of[field] = len(work.touched)
+                    work.touched.append(field)
+                    work.touched_by_obj.setdefault(obj, []).append(key)
+                work.row_field.append(field)
+                work.row_entry.append(
+                    {'actor': actor, 'seq': seq, 'all_deps': all_deps,
+                     'action': action, 'value': op.get('value')})
+                work.row_change.append(ci)
+                work.row_seg.append(seg)
+                work.row_node.append(node)
+                work.row_objloc.append(jl)
+                work.row_is_del.append(action == 'del')
             elif action == 'ins':
                 obj = op['obj']
-                if obj not in state.objects:
+                if obj not in objects:
                     raise ValueError('Modification of unknown object ' + obj)
                 rec = state._writable(obj)
                 if not rec.is_sequence():
@@ -269,38 +319,44 @@ def _stage_changes(work, admitted):
                 rec.node_elem.append(elem)
                 rec.node_actor.append(actor)
                 work.ins_dirty.add(obj)
-                if obj not in dirty_set:
-                    dirty_set.add(obj)
+                if obj not in dirty_of:
+                    dirty_of[obj] = len(work.dirty_seq)
                     work.dirty_seq.append(obj)
-            elif action in ('set', 'del', 'link'):
+            elif action in _MAKE_KIND:
                 obj = op['obj']
-                rec = state.objects.get(obj)
-                if rec is None:
-                    raise ValueError('Modification of unknown object ' + obj)
-                if rec.is_sequence():
-                    if op['key'] not in rec.node_of:
-                        raise TypeError(
-                            'Missing index entry for list element '
-                            + str(op['key']))
-                    if obj not in dirty_set:
-                        dirty_set.add(obj)
-                        work.dirty_seq.append(obj)
-                field = (obj, op['key'])
-                if field not in touched_set:
-                    touched_set.add(field)
-                    work.touched.append(field)
-                    work.touched_by_obj.setdefault(obj, []).append(op['key'])
-                entry = {'actor': actor, 'seq': seq, 'all_deps': all_deps,
-                         'action': action, 'value': op.get('value')}
-                work.rows.append((field, entry, action == 'del', True))
+                if obj in state.objects:
+                    raise ValueError('Duplicate creation of object ' + obj)
+                state.objects[obj] = _ObjRecord(action)
+                state._owned.add(obj)
+                work.create_diffs.append(
+                    {'action': 'create', 'obj': obj,
+                     'type': _MAKE_KIND[action]})
             else:
                 raise ValueError(f'Unknown operation type {action}')
 
     # Prior surviving entries of every touched field join the batch so the
     # kernel can both supersede them and rank them against the new ops.
+    work.n_new = len(work.row_field)
     for field in work.touched:
-        for entry in state.fields.get(field, ()):
-            work.rows.append((field, entry, False, False))
+        entries = state.fields.get(field)
+        if not entries:
+            continue
+        obj = field[0]
+        rec = objects[obj]
+        if rec.nodes is not None:
+            node = rec.node_of[field[1]]
+            jl = dirty_of[obj]
+        else:
+            node = jl = -1
+        seg = seg_of[field]
+        for entry in entries:
+            work.row_field.append(field)
+            work.row_entry.append(entry)
+            work.row_change.append(-1)
+            work.row_seg.append(seg)
+            work.row_node.append(node)
+            work.row_objloc.append(jl)
+            work.row_is_del.append(False)
 
 
 # -- device phase A: assignment resolution (pack, resolve, unpack) -----------
@@ -308,13 +364,16 @@ def _stage_changes(work, admitted):
 def _pack_docs(works, options, job_of=None, m_pad=0):
     """Pack every staged row of every doc into [D, n] planes.
 
-    With `job_of` (a (work id, obj) -> sequence-job index map), each row
+    Per-row metadata of new ops is GATHERED from per-change columns
+    (actor rank, seq, clock row) — the only per-row host loop left is
+    over prior entries, which are few on incremental workloads. With
+    `job_of` (a (work id, obj) -> sequence-job index map), each row
     touching a sequence element also gets a flat (job * m_pad + node)
     slot so the fused kernel can derive element visibility on device
     (-1 for map rows). Returns (arrays, n_segs, row_slot).
     """
     d = len(works)
-    max_rows = max((len(w.rows) for w in works), default=0)
+    max_rows = max((w.n_rows for w in works), default=0)
     n = options.pad_ops(max_rows)
     seg_id = np.zeros((d, n), options.index_dtype)
     actor = np.zeros((d, n), options.index_dtype)
@@ -327,29 +386,55 @@ def _pack_docs(works, options, job_of=None, m_pad=0):
     clocks = []
     max_segs = 1
     for i, w in enumerate(works):
-        actor_names = sorted({r[1]['actor'] for r in w.rows})
+        n_rows, n_new = w.n_rows, w.n_new
+        prior_entries = w.row_entry[n_new:]
+        actor_names = sorted(
+            {m[0] for m in w.changes_meta}
+            | {e['actor'] for e in prior_entries})
         rank = {a: j for j, a in enumerate(actor_names)}
-        seg_of = {f: j for j, f in enumerate(w.touched)}
         a = max(len(actor_names), 1)
         n_actors = max(n_actors, a)
         max_segs = max(max_segs, len(w.touched))
         crows = np.zeros((n, a), options.clock_dtype)
-        wid = id(w)
-        objects = w.state.objects
-        for j, (field, entry, del_flag, _is_new) in enumerate(w.rows):
-            seg_id[i, j] = seg_of[field]
+        if n_rows:
+            seg_id[i, :n_rows] = w.row_seg
+            is_del[i, :n_rows] = w.row_is_del
+            valid[i, :n_rows] = True
+        if n_new:
+            # per-change columns, gathered to rows
+            C = len(w.changes_meta)
+            ch_rank = np.empty(C, options.index_dtype)
+            ch_seq = np.empty(C, options.clock_dtype)
+            ch_clock = np.zeros((C, a), options.clock_dtype)
+            for c, (a_name, s, all_deps) in enumerate(w.changes_meta):
+                ch_rank[c] = rank[a_name]
+                ch_seq[c] = s
+                for da, ds in all_deps.items():
+                    r = rank.get(da)
+                    if r is not None:
+                        ch_clock[c, r] = ds
+            rows_change = np.asarray(w.row_change[:n_new], np.int64)
+            actor[i, :n_new] = ch_rank[rows_change]
+            seq[i, :n_new] = ch_seq[rows_change]
+            crows[:n_new] = ch_clock[rows_change]
+        for j in range(n_new, n_rows):            # prior entries (few)
+            entry = w.row_entry[j]
             actor[i, j] = rank[entry['actor']]
             seq[i, j] = entry['seq']
             for da, ds in entry['all_deps'].items():
-                if da in rank:
-                    crows[j, rank[da]] = ds
-            is_del[i, j] = del_flag
-            valid[i, j] = True
-            if job_of is not None:
-                job = job_of.get((wid, field[0]))
-                if job is not None:
-                    row_slot[i, j] = (job * m_pad
-                                      + objects[field[0]].node_of[field[1]])
+                r = rank.get(da)
+                if r is not None:
+                    crows[j, r] = ds
+        if job_of is not None and n_rows:
+            wid = id(w)
+            loc2job = np.asarray(
+                [job_of.get((wid, obj), -1) for obj in w.dirty_seq]
+                + [-1], np.int64)
+            objloc = np.asarray(w.row_objloc, np.int64)
+            node = np.asarray(w.row_node, np.int64)
+            job = loc2job[objloc]
+            row_slot[i, :n_rows] = np.where(
+                (objloc >= 0) & (job >= 0), job * m_pad + node, -1)
         clocks.append(crows)
 
     # pad the actor axis to a power of two as well: all three kernel-input
@@ -407,14 +492,15 @@ def _update_fields(work, surviving_row):
     (the state effects of op_set.js:180-219); diff emission comes after."""
     state = work.state
     survivors_by_field = {f: [] for f in work.touched}
-    for j, (field, entry, _is_del, _is_new) in enumerate(work.rows):
-        if surviving_row[j]:
-            survivors_by_field[field].append(entry)
+    row_field, row_entry = work.row_field, work.row_entry
+    for j in np.flatnonzero(surviving_row[:work.n_rows]):
+        survivors_by_field[row_field[j]].append(row_entry[j])
 
     for field in work.touched:
         before = state.fields.get(field, ())
-        survivors = sorted(survivors_by_field[field],
-                           key=lambda e: e['actor'], reverse=True)
+        survivors = survivors_by_field[field]
+        if len(survivors) > 1:
+            survivors.sort(key=lambda e: e['actor'], reverse=True)
 
         # inbound maintenance: link refs that dropped out leave the target,
         # new surviving links join it (op_set.js:194-208).
@@ -580,11 +666,18 @@ def _emit_seq_diffs(work, obj, rec, visible, vis_index):
     for idx in removes:
         diffs.append({'action': 'remove', 'type': obj_type, 'obj': obj,
                       'index': idx})
-        del rec.elem_ids[idx]
-    for edit in inserts:
-        rec.elem_ids.insert(edit['index'], edit['elemId'])
-        diffs.append(edit)
+    diffs.extend(inserts)
     diffs.extend(sets)
+
+    # rebuild the order index wholesale from the kernel's final ordering
+    # (incremental list insert/delete would be O(n) per edit); identical
+    # to applying the removes/inserts above in order
+    vis_nodes = np.flatnonzero(vis_index >= 0)
+    new_ids = [None] * len(vis_nodes)
+    nodes = rec.nodes
+    for node in vis_nodes.tolist():
+        new_ids[vis_index[node]] = nodes[node]
+    rec.elem_ids = new_ids
 
     path = _get_path(state, obj)
     for edit in diffs:
@@ -626,7 +719,7 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
         _stage_changes(work, admitted)
         works.append(work)
 
-    total_rows = sum(len(w.rows) for w in works)
+    total_rows = sum(w.n_rows for w in works)
     seq_jobs = _collect_seq_jobs(works)
 
     seq_vis = seq_out = None
@@ -642,9 +735,9 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
             *(jnp.asarray(a) for a in arrays), jnp.asarray(row_slot),
             *(jnp.asarray(a) for a in seq_arrays), num_segments=n_segs)
         metrics.bump('device_backend_fused_calls')
-        surviving = np.asarray(out['surviving'])
-        seq_vis = np.asarray(visible)
-        seq_out = np.asarray(ordered['vis_index'])
+        # one batched fetch (a single D2H round-trip, not three)
+        surviving, seq_vis, seq_out = jax.device_get(
+            (out['surviving'], visible, ordered['vis_index']))
     elif total_rows:
         arrays, n_segs, _ = _pack_docs(works, opts)
         surviving = _resolve_batch(arrays, n_segs, opts)

@@ -26,10 +26,13 @@ block packs in tens of milliseconds instead of tens of seconds.
 Scope: flat map documents (set/del on root fields) — the DocSet bulk
 merge shape of BASELINE config 5. Nested objects, links and sequences
 take the per-document path (:mod:`.backend`), which speaks the same
-change/patch protocol. One caveat vs the oracle: a change carrying TWO
-assignments to the same key (which the reference frontend never emits —
-`ensureSingleAssignment`, frontend/index.js:46) resolves to an arbitrary
-one of them here, where the oracle keeps both as a self-conflict.
+change/patch protocol. A change carrying TWO assignments to the same key
+(which the reference frontend never emits — `ensureSingleAssignment`,
+frontend/index.js:46) matches the oracle: both survive, the first op
+wins and the later ones surface as self-conflicts. Duplicate deliveries
+are verified against the retained change bodies and an inconsistent
+reuse of a seq number raises, exactly like the oracle (op_set.js:243-248);
+with retention off or a truncated log the duplicate is dropped unverified.
 """
 
 import bisect as _bisect
@@ -187,10 +190,11 @@ class ChangeBlock:
 
     __slots__ = ('n_docs', 'doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
                  'dep_seq', 'op_ptr', 'action', 'key', 'value',
-                 'actors', 'keys', 'values')
+                 'actors', 'keys', 'values', '_dup_keys')
 
     def __init__(self, n_docs, doc, actor, seq, dep_ptr, dep_actor, dep_seq,
-                 op_ptr, action, key, value, actors, keys, values):
+                 op_ptr, action, key, value, actors, keys, values,
+                 dup_keys=None):
         if len(doc) and (np.diff(doc) < 0).any():
             order = np.argsort(doc, kind='stable')
             dep_ptr, (dep_actor, dep_seq) = _csr_take(
@@ -212,6 +216,24 @@ class ChangeBlock:
         self.actors = actors
         self.keys = keys
         self.values = values
+        self._dup_keys = dup_keys
+
+    def has_dup_keys(self):
+        """True if any change assigns the same key more than once — the
+        self-conflict shape the reference frontend never emits
+        (ensureSingleAssignment, frontend/index.js:46) but hand-built
+        changes can. Computed lazily, cached; the wire edges set it
+        during their walk."""
+        if self._dup_keys is None:
+            if self.n_ops == 0:
+                self._dup_keys = False
+            else:
+                op_change = np.repeat(
+                    np.arange(self.n_changes, dtype=np.int64),
+                    np.diff(self.op_ptr))
+                cell = op_change * max(len(self.keys), 1) + self.key
+                self._dup_keys = bool(len(np.unique(cell)) < len(cell))
+        return self._dup_keys
 
     @property
     def n_changes(self):
@@ -242,6 +264,7 @@ class ChangeBlock:
                     f'{what} {v!r} out of range (must fit int32)')
             return v
 
+        dup_keys = False
         for d, changes in enumerate(changes_per_doc):
             for change in changes:
                 if 'deps' not in change:
@@ -256,6 +279,7 @@ class ChangeBlock:
                     dep_actor.append(_intern(actors, actor_of, da))
                     dep_seq.append(check_i32(ds, 'dep seq'))
                 dep_ptr.append(len(dep_actor))
+                change_keys = set()
                 for op in change['ops']:
                     if op['action'] not in _ACTION_NAMES:
                         raise ValueError(
@@ -266,7 +290,11 @@ class ChangeBlock:
                             'block path supports root-map fields only '
                             '(use the per-document path)')
                     action.append(_ACTION_NAMES[op['action']])
-                    key.append(_intern(keys, key_of, op['key']))
+                    k = _intern(keys, key_of, op['key'])
+                    if k in change_keys:
+                        dup_keys = True
+                    change_keys.add(k)
+                    key.append(k)
                     if op['action'] == 'set':
                         value.append(len(values))
                         values.append(op.get('value'))
@@ -282,7 +310,8 @@ class ChangeBlock:
                    np.asarray(dep_seq, np.int32),
                    np.asarray(op_ptr, np.int32),
                    np.asarray(action, np.int8), np.asarray(key, np.int32),
-                   np.asarray(value, np.int32), actors, keys, values)
+                   np.asarray(value, np.int32), actors, keys, values,
+                   dup_keys=dup_keys)
 
     def to_changes(self):
         """Decode back to per-document dict change lists (lossless)."""
@@ -386,7 +415,9 @@ class PatchBlock:
                        self.values[self.s_value[j]]
                        if self.s_value[j] >= 0 else None)
                       for j in range(lo, hi)]
-            losers.sort(reverse=True)    # actor-descending (op_set.js:211)
+            # STABLE actor-descending (op_set.js:211): rank ties (self-
+            # conflicts from one change) keep their op order
+            losers.sort(key=lambda t: t[0], reverse=True)
             if losers:
                 edit['conflicts'] = [{'actor': a, 'value': v}
                                      for a, v in losers]
@@ -626,6 +657,43 @@ class _LocalActors:
 
 # -- vectorized causal admission ---------------------------------------------
 
+def _body_index(store):
+    """(doc, actor, seq) -> (block, row) over the retained blocks, built
+    lazily on the first duplicate verification and cached until the log
+    grows — a full-history resync verifies O(1) per duplicate instead of
+    rescanning the log per row."""
+    token = len(store.l_key)
+    cached = getattr(store, '_body_index_cache', None)
+    if cached is not None and cached[0] == token:
+        return cached[1]
+    index = {}
+    for d, entries in store.doc_log.items():
+        for blk, rows in entries:
+            actors = blk.actors
+            b_actor, b_seq = blk.actor, blk.seq
+            for r in rows:
+                index[(d, actors[b_actor[r]], int(b_seq[r]))] = (blk, r)
+    store._body_index_cache = (token, index)
+    return index
+
+
+def _verify_duplicate(store, block, c):
+    """A change whose seq is already applied must equal the applied one
+    (op_set.js:243-248). Bodies live in the retained blocks; when the log
+    is truncated (snapshot resume) or retention is off, the duplicate is
+    dropped unverified — the same contract as the per-doc backend's
+    snapshot-era entries."""
+    d = int(block.doc[c])
+    a = block.actors[block.actor[c]]
+    s = int(block.seq[c])
+    hit = _body_index(store).get((d, a, s))
+    if hit is not None:
+        blk, r = hit
+        if blk.change_dict(int(r)) != block.change_dict(c):
+            raise ValueError(
+                f'Inconsistent reuse of sequence number {s} by {a}')
+
+
 def _admit_block(store, block, b_actor, dep_actor_store, la):
     """Fixed-point causal delivery over the whole block (vectorized waves).
 
@@ -634,8 +702,11 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
     coordinates — the batch analogue of the oracle's per-change
     ``all_deps`` (op_set.js:29-37). Updates the store clock and change
     log. Duplicate changes — seq already applied, or a second copy of
-    the same (doc, actor, seq) within the block — are dropped (without
-    the oracle's content-equality verification).
+    the same (doc, actor, seq) within the block — are verified against
+    the applied body (raising on an inconsistent seq reuse, like the
+    oracle, op_set.js:243-248) and dropped; with retention off or a
+    truncated log the check is skipped and the duplicate drops
+    unverified.
     """
     C = block.n_changes
     doc, seq = block.doc, block.seq
@@ -751,10 +822,27 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
             t_seq, S[np.arange(n_r), t_actor])
 
     duplicate = store.clock_lookup(doc, b_actor) >= seq
-    # in-block duplicates: keep only the first row per (doc, actor, seq)
+    # a duplicate must MATCH what was applied (op_set.js:243-248); check
+    # before any store mutation so a mismatch leaves the store untouched
+    for c in np.flatnonzero(duplicate):
+        _verify_duplicate(store, block, int(c))
+    # in-block duplicates: keep only the first row per (doc, actor, seq),
+    # verifying the dropped copies equal the kept one
     if C:
         dup_sorted = np.zeros(C, bool)
         dup_sorted[1:] = in_sorted[1:] == in_sorted[:-1]
+        if dup_sorted.any():
+            first_of_run = np.maximum.accumulate(
+                np.where(dup_sorted, -1, np.arange(C)))
+            for i in np.flatnonzero(dup_sorted):
+                kept = int(in_order[first_of_run[i]])
+                dup = int(in_order[i])
+                if not duplicate[kept] and \
+                        block.change_dict(kept) != block.change_dict(dup):
+                    raise ValueError(
+                        f'Inconsistent reuse of sequence number '
+                        f'{int(block.seq[dup])} by '
+                        f'{block.actors[block.actor[dup]]}')
         duplicate[in_order[dup_sorted]] = True
     pending = ~duplicate
     admitted = np.zeros(C, bool)
@@ -902,7 +990,7 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
             raise ValueError(
                 f'{n_actors} actors exceed actor_capacity={max_actors}')
     block = merged
-    store.queue = []
+    saved_queue, store.queue = store.queue, []
 
     a_tab = store.intern(block.actors, store.actors, store.actor_of)
     k_tab = store.intern(block.keys, store.keys, store.key_of)
@@ -918,8 +1006,15 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
                       np.concatenate([b_actor, dep_actor_store,
                                       store.c_actor]))
 
-    admitted, leftover, R, cmap, adm_order = _admit_block(
-        store, block, b_actor, dep_actor_store, la)
+    try:
+        admitted, leftover, R, cmap, adm_order = _admit_block(
+            store, block, b_actor, dep_actor_store, la)
+    except ValueError:
+        # duplicate-content verification raises BEFORE any store
+        # mutation; put the merged-away queue back so the store (and its
+        # buffered changes) stay usable
+        store.queue = saved_queue
+        raise
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
     if store.retain_log and len(adm_order):

@@ -35,9 +35,12 @@ host (:mod:`.blocks`); everything per-op runs on device. Capacities
 addressing — with clear errors on overflow; the general unbounded path
 is :func:`automerge_tpu.device.blocks.apply_block`.
 
-Same caveat as the block path: two assignments to the same key within
-one change (never emitted by the reference frontend —
-`ensureSingleAssignment`, frontend/index.js:46) resolve to one of them.
+One scope limit vs the block path: two assignments to the same key
+within one change (never emitted by the reference frontend —
+`ensureSingleAssignment`, frontend/index.js:46) need two surviving
+entries in one (field, actor) cell, which the dense planes cannot hold;
+such blocks are rejected before any mutation with a clear error and
+take :func:`automerge_tpu.device.blocks.apply_block` instead.
 """
 
 from functools import partial
@@ -380,6 +383,14 @@ class DenseMapStore:
         opts = self.options
 
         t0 = time.perf_counter()
+        if block.has_dup_keys():
+            # one dense cell per (field, actor) cannot hold two surviving
+            # assignments from one change; reject BEFORE any mutation so
+            # the store stays usable (the general path handles the shape)
+            raise ValueError(
+                'change assigns the same key twice (self-conflict shape); '
+                'the dense store holds one entry per (field, actor) — '
+                'apply through device.blocks.apply_block instead')
         st = _blocks._admit_and_stage(host, block,
                                       max_keys=self.key_capacity,
                                       max_actors=self.actor_capacity)

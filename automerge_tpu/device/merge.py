@@ -16,7 +16,10 @@ delivery):
   concurrency" loop and this order-independent fixpoint agree.
 * Surviving non-delete ops form the field's op set; the **winner** is the
   op with the highest actor rank (op_set.js:211 sorts actor-descending);
-  remaining survivors are the conflicts.
+  remaining survivors are the conflicts. Ties on actor rank (only possible
+  for multiple assignments within ONE change — same actor, same seq) go to
+  the LOWEST op index: the reference's sort is stable, so the first-applied
+  op stays in front and later ops of the change become self-conflicts.
 
 The key observation making this one segment-reduction instead of an
 all-pairs test: ``superseded[i] = (max_{j in segment} clock_j[actor_i])
@@ -45,15 +48,20 @@ def _resolve(seg_id, actor, seq, clock, is_del, valid, num_segments):
 
     surviving = valid & ~superseded & ~is_del
 
-    # Winner per segment = surviving op with max actor rank. Two reductions
-    # (max actor, then max index at that actor) avoid packing (actor, index)
-    # into one word, which could overflow int32 on million-op batches.
+    # Winner per segment = surviving op with max actor rank, MIN index on
+    # rank ties (stable actor-descending sort, op_set.js:211). Two
+    # reductions (max actor, then min index at that actor) avoid packing
+    # (actor, index) into one word, which could overflow int32 on
+    # million-op batches.
     actor_score = jnp.where(surviving, actor, -1)
     seg_max_actor = jax.ops.segment_max(actor_score, seg_id,
                                         num_segments=num_segments)  # [S]
     at_winner_actor = surviving & (actor == seg_max_actor[seg_id])
-    idx_score = jnp.where(at_winner_actor, jnp.arange(n, dtype=jnp.int32), -1)
-    winner = jax.ops.segment_max(idx_score, seg_id, num_segments=num_segments)
+    idx_score = jnp.where(at_winner_actor, -jnp.arange(n, dtype=jnp.int32),
+                          -n - 1)
+    neg_winner = jax.ops.segment_max(idx_score, seg_id,
+                                     num_segments=num_segments)
+    winner = jnp.where(neg_winner < -n, -1, -neg_winner)
 
     return {'surviving': surviving, 'winner': winner,
             'seg_max_actor': seg_max_actor}

@@ -84,8 +84,11 @@ def _make_kernel(n_tiles, n_actors):
 
             # ---- pass 2: winner election among survivors -----------------
             # winner_actor[i] = max actor among surviving ops in i's
-            # segment; winner_idx[i] = max op index at that actor (the
-            # reference's actor-descending conflict sort, op_set.js:211).
+            # segment; winner_idx[i] = MIN op index at that actor (the
+            # reference's STABLE actor-descending conflict sort,
+            # op_set.js:211 — rank ties, possible only for multiple
+            # assignments within one change, keep the first-applied op).
+            big = jnp.int32(n_tiles * OPS_TILE + 1)
             for ti in range(n_tiles):
                 seg_i = tile(seg_ref, d, ti)
                 wa_i = jnp.full((OPS_TILE,), neg)
@@ -97,7 +100,7 @@ def _make_kernel(n_tiles, n_actors):
                         (surv_j != 0)[None, :]
                     wa_i = jnp.maximum(wa_i, jnp.max(
                         jnp.where(mask, actor_j[None, :], neg), axis=1))
-                wi_i = jnp.full((OPS_TILE,), neg)
+                wi_i = jnp.full((OPS_TILE,), big)
                 for tj in range(n_tiles):
                     seg_j = tile(seg_ref, d, tj)
                     actor_j = tile(actor_ref, d, tj)
@@ -107,8 +110,9 @@ def _make_kernel(n_tiles, n_actors):
                     at_w = (seg_i[:, None] == seg_j[None, :]) & \
                         (surv_j != 0)[None, :] & \
                         (actor_j[None, :] == wa_i[:, None])
-                    wi_i = jnp.maximum(wi_i, jnp.max(
-                        jnp.where(at_w, j_idx, neg), axis=1))
+                    wi_i = jnp.minimum(wi_i, jnp.min(
+                        jnp.where(at_w, j_idx, big), axis=1))
+                wi_i = jnp.where(wi_i == big, neg, wi_i)
                 wactor_ref[d, pl.ds(ti * OPS_TILE, OPS_TILE)] = wa_i
                 widx_ref[d, pl.ds(ti * OPS_TILE, OPS_TILE)] = wi_i
                 surv_ref[d, pl.ds(ti * OPS_TILE, OPS_TILE)] = \
@@ -181,7 +185,9 @@ def resolve_assignments_batch_pallas(seg_id, actor, seq, clock, is_del, valid,
         return jax.vmap(lambda v, s: jax.ops.segment_max(
             v, s, num_segments=num_segments))(per_op, seg_id)
 
-    winner = to_seg(jnp.where(valid, widx, -1))
+    # clamp: segment_max fills op-less segments with INT32_MIN; the
+    # contract (like merge._resolve) is -1 for "no winner"
+    winner = jnp.maximum(to_seg(jnp.where(valid, widx, -1)), -1)
     seg_max_actor = to_seg(jnp.where(valid, wactor, -1))
     return {'surviving': surviving, 'winner': winner,
             'seg_max_actor': seg_max_actor}

@@ -94,4 +94,5 @@ def gen_block_workload(n_docs=10240, n_actors=10, ops_per_change=10,
         n_docs, doc, actor, seq, dep_ptr, z32, z32, op_ptr, action,
         key, value.astype(np.int32),
         [f'peer-{i:03d}' for i in range(n_actors)],
-        [f'field{i:02d}' for i in range(n_keys)], values)
+        [f'field{i:02d}' for i in range(n_keys)], values,
+        dup_keys=False)          # keys are distinct per change by draw

@@ -63,7 +63,8 @@ def _bind(lib):
     lib.amwc_error.restype = ctypes.c_char_p
     for name in ('amwc_n_docs', 'amwc_n_changes', 'amwc_n_ops',
                  'amwc_n_deps', 'amwc_n_values', 'amwc_n_actors',
-                 'amwc_actors_bytes', 'amwc_n_keys', 'amwc_keys_bytes'):
+                 'amwc_actors_bytes', 'amwc_n_keys', 'amwc_keys_bytes',
+                 'amwc_dup_keys'):
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_void_p]
         fn.restype = _i64
@@ -192,6 +193,7 @@ def parse_change_block(data):
         if err:
             raise ValueError('wire parse failed: ' + err.decode('utf-8'))
         n_docs = int(lib.amwc_n_docs(h))
+        dup_keys = bool(lib.amwc_dup_keys(h))
         c = int(lib.amwc_n_changes(h))
         n_ops = int(lib.amwc_n_ops(h))
         n_deps = int(lib.amwc_n_deps(h))
@@ -227,7 +229,7 @@ def parse_change_block(data):
     values = LazyValues(data, starts, ends)
     return ChangeBlock(n_docs, doc, actor, seq, dep_ptr, dep_actor,
                        dep_seq, op_ptr, action, key, value, actors, keys,
-                       values)
+                       values, dup_keys=dup_keys)
 
 
 parseChangeBlock = parse_change_block

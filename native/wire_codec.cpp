@@ -16,6 +16,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -std=c++17 wire_codec.cpp -o libamwire.so
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -51,6 +52,7 @@ struct Parsed {
     std::vector<int64_t> vstart, vend;
     Interner actors, keys;
     int64_t n_docs = 0;
+    bool dup_keys = false;   // some change assigns one key more than once
     std::string error;
 };
 
@@ -157,6 +159,9 @@ struct Cursor {
         ws();
         bool neg = false;
         if (p < end && *p == '-') { neg = true; ++p; }
+        // every integer() caller parses a counter (seq, dep seq, elem);
+        // negatives are out of range, matching the Python edge's check_i32
+        if (neg) return fail("integer out of range (must be >= 0)");
         if (p >= end || *p < '0' || *p > '9') return fail("expected integer");
         int64_t v = 0;
         while (p < end && *p >= '0' && *p <= '9') {
@@ -171,7 +176,7 @@ struct Cursor {
         }
         if (p < end && (*p == '.' || *p == 'e' || *p == 'E'))
             return fail("expected integer, got float");
-        out = neg ? -v : v;
+        out = v;
         return true;
     }
 
@@ -303,12 +308,28 @@ bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
                 if (!c.lit('}')) return false;
             } else if (field == "ops") {
                 if (!c.lit('[')) return false;
+                size_t op_start = out.action.size();
                 if (!c.peek(']')) {
                     do {
                         if (!parse_op(c, out)) return false;
                     } while (c.peek(',') && c.lit(','));
                 }
                 if (!c.lit(']')) return false;
+                if (!out.dup_keys) {
+                    // within-change duplicate-key detection (the flag the
+                    // Python edge computes during its walk too)
+                    size_t k = out.action.size() - op_start;
+                    if (k > 1) {
+                        std::vector<int32_t> ks(
+                            out.key.begin() + op_start, out.key.end());
+                        std::sort(ks.begin(), ks.end());
+                        for (size_t i = 1; i < ks.size(); i++)
+                            if (ks[i] == ks[i - 1]) {
+                                out.dup_keys = true;
+                                break;
+                            }
+                    }
+                }
             } else {
                 int64_t s_, e_;
                 if (!c.skip_value(s_, e_)) return false;  // message etc.
@@ -372,6 +393,9 @@ const char* amwc_error(void* h) {
 }
 
 int64_t amwc_n_docs(void* h) { return static_cast<Parsed*>(h)->n_docs; }
+int64_t amwc_dup_keys(void* h) {
+    return static_cast<Parsed*>(h)->dup_keys ? 1 : 0;
+}
 int64_t amwc_n_changes(void* h) { return static_cast<Parsed*>(h)->doc.size(); }
 int64_t amwc_n_ops(void* h) { return static_cast<Parsed*>(h)->action.size(); }
 int64_t amwc_n_deps(void* h) {

@@ -21,9 +21,12 @@ from automerge_tpu.device import blocks
 from automerge_tpu.device.dense_store import DenseMapStore
 
 
-def _gen_causal_history(rng, n_actors=3, n_changes=14, n_keys=5):
+def _gen_causal_history(rng, n_actors=3, n_changes=14, n_keys=5,
+                        dup_key_p=0.0):
     """A random causally-consistent multi-actor change history for one
-    flat map document, delivery-shuffled."""
+    flat map document, delivery-shuffled. With ``dup_key_p`` some changes
+    assign the same key twice (the self-conflict shape the reference
+    frontend never emits but applyChanges of hand-built changes can)."""
     actors = [f'actor-{i}' for i in range(n_actors)]
     seqs = {a: 0 for a in actors}
     clock = {a: 0 for a in actors}
@@ -36,6 +39,9 @@ def _gen_causal_history(rng, n_actors=3, n_changes=14, n_keys=5):
         deps = {b: s for b, s in deps.items() if s}
         keys = rng.sample([f'k{i}' for i in range(n_keys)],
                           rng.randint(1, 3))
+        if dup_key_p and rng.random() < dup_key_p:
+            keys = keys + [rng.choice(keys)]
+            rng.shuffle(keys)
         ops = []
         for k in keys:
             if rng.random() < 0.2:
@@ -190,6 +196,113 @@ class TestCrossEngine:
                 blocks.ChangeBlock.from_changes([ch])).to_patch_block()
             ddoc = _apply_diffs_to(ddoc, pb.diffs(0))
         assert _mat(ddoc) == want
+
+    @pytest.mark.parametrize('ops,want_doc,want_conflicts', [
+        ([('set', 1), ('set', 2)], {'k': 1}, {'k': {'actor-0': 2}}),
+        ([('set', 1), ('set', 2), ('set', 3)],
+         {'k': 1}, {'k': {'actor-0': 3}}),
+        ([('set', 1), ('del', None)], {'k': 1}, {}),
+        ([('del', None), ('set', 1)], {'k': 1}, {}),
+        ([('del', None), ('del', None)], {}, {}),
+    ])
+    def test_self_conflict_within_one_change(self, ops, want_doc,
+                                             want_conflicts):
+        """A change assigning one key twice keeps BOTH ops: the first
+        surviving set wins, later ones are self-conflicts (the oracle's
+        stable actor sort, op_set.js:211); the dense store rejects the
+        shape cleanly before mutating."""
+        change = {'actor': 'actor-0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': a, 'obj': ROOT_ID, 'key': 'k',
+             **({'value': v} if v is not None else {})}
+            for a, v in ops]}
+        want = (want_doc, want_conflicts)
+        assert _via_oracle([change]) == want
+        assert _via_device_backend([change], 1) == want
+        assert _via_block_path([change], 1) == want
+        store = DenseMapStore(1, key_capacity=8, actor_capacity=8)
+        with pytest.raises(ValueError, match='same key twice'):
+            store.apply_block(blocks.ChangeBlock.from_changes([[change]]))
+        # rejection is pre-mutation: the store still applies clean blocks
+        ok = {'actor': 'actor-0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 7}]}
+        patch = store.apply_block(blocks.ChangeBlock.from_changes([[ok]]))
+        assert patch.diffs(0)[0]['value'] == 7
+
+    @pytest.mark.parametrize('seed', range(6))
+    def test_self_conflict_fuzz(self, seed):
+        """Random histories where some changes double-assign keys: the
+        three general engines still agree with the oracle."""
+        rng = random.Random(7000 + seed)
+        changes = _gen_causal_history(rng, n_actors=3, n_changes=18,
+                                      n_keys=4, dup_key_p=0.4)
+        want = _via_oracle(changes)
+        assert _via_device_backend(changes, 2) == want
+        assert _via_block_path(changes, 2) == want
+
+    def test_duplicate_content_mismatch_raises(self):
+        """Re-delivering a seq number with DIFFERENT content must raise
+        on every engine (op_set.js:243-248), leaving the store usable;
+        equal-content duplicates stay silently dropped."""
+        ch1 = {'actor': 'a', 'seq': 1, 'deps': {},
+               'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                        'value': 1}]}
+        bad = dict(ch1, ops=[{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                              'value': 99}])
+
+        st, _ = Backend.apply_changes(Backend.init(), [ch1])
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            Backend.apply_changes(st, [bad])
+
+        dst, _ = DeviceBackend.apply_changes(DeviceBackend.init(), [ch1])
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            DeviceBackend.apply_changes(dst, [bad])
+
+        store = blocks.init_store(1)
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes([[ch1]]))
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            blocks.apply_block(store,
+                               blocks.ChangeBlock.from_changes([[bad]]))
+        # the store survives the rejection: equal-content duplicate
+        # still drops silently, state unchanged
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes([[ch1]]))
+        assert store.doc_fields(0) == {'k': [('a', 1)]}
+
+        dense = DenseMapStore(1, key_capacity=8, actor_capacity=8)
+        dense.apply_block(blocks.ChangeBlock.from_changes([[ch1]]))
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            dense.apply_block(blocks.ChangeBlock.from_changes([[bad]]))
+        dense.apply_block(blocks.ChangeBlock.from_changes([[ch1]]))
+        diffs = dense.extract_all().diffs(0)
+        assert [(d['key'], d['value']) for d in diffs] == [('k', 1)]
+
+    def test_duplicate_mismatch_within_one_block_raises(self):
+        ch1 = {'actor': 'a', 'seq': 1, 'deps': {},
+               'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                        'value': 1}]}
+        bad = dict(ch1, ops=[{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                              'value': 99}])
+        store = blocks.init_store(1)
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            blocks.apply_block(
+                store, blocks.ChangeBlock.from_changes([[ch1, bad]]))
+        # equal copies within one block: first kept, second dropped
+        pb = blocks.apply_block(
+            store, blocks.ChangeBlock.from_changes([[ch1, dict(ch1)]]))
+        assert store.doc_fields(0) == {'k': [('a', 1)]}
+
+    def test_duplicate_unverifiable_after_retention_off(self):
+        """With change-body retention off the duplicate cannot be
+        verified: it drops unverified (documented), mirroring the per-doc
+        backend's snapshot-era contract."""
+        ch1 = {'actor': 'a', 'seq': 1, 'deps': {},
+               'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                        'value': 1}]}
+        bad = dict(ch1, ops=[{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                              'value': 99}])
+        store = blocks.BlockStore(1, retain_log=False)
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes([[ch1]]))
+        blocks.apply_block(store, blocks.ChangeBlock.from_changes([[bad]]))
+        assert store.doc_fields(0) == {'k': [('a', 1)]}
 
     def test_interleaved_delivery_order_invariance(self):
         """Every engine converges to the same state regardless of the
