@@ -50,6 +50,15 @@ _SET, _DEL = 0, 1
 _ACTION_NAMES = {'set': _SET, 'del': _DEL}
 _ACTION_CODES = {v: k for k, v in _ACTION_NAMES.items()}
 
+# general-block action codes (superset; flat blocks only ever carry 0/1)
+_INS, _LINK, _MAKE_MAP, _MAKE_LIST, _MAKE_TEXT = 2, 3, 4, 5, 6
+_GEN_ACTION_NAMES = {'set': _SET, 'del': _DEL, 'ins': _INS, 'link': _LINK,
+                     'makeMap': _MAKE_MAP, 'makeList': _MAKE_LIST,
+                     'makeText': _MAKE_TEXT}
+_GEN_ACTION_CODES = {v: k for k, v in _GEN_ACTION_NAMES.items()}
+# key kinds for general blocks
+_KEY_STR, _KEY_ELEM, _KEY_HEAD, _KEY_NONE = 0, 1, 2, 3
+
 _SEQ_BITS = 20    # seq numbers < 2^20 per actor (assert-guarded)
 
 
@@ -165,8 +174,13 @@ def check_block_ranges(store, block):
     if block.n_docs != store.n_docs:
         raise ValueError(
             f'block is for {block.n_docs} docs, store holds {store.n_docs}')
-    if block.n_changes and int(block.seq.max()) >= (1 << _SEQ_BITS):
-        raise ValueError(f'seq numbers must be < 2^{_SEQ_BITS}')
+    if block.n_changes:
+        if int(block.seq.max()) >= (1 << _SEQ_BITS):
+            raise ValueError(f'seq numbers must be < 2^{_SEQ_BITS}')
+        if int(block.doc.max()) >= block.n_docs or \
+                int(block.doc.min()) < 0:
+            raise ValueError(
+                f'block doc index out of range for {block.n_docs} docs')
     if store.n_docs >= (1 << 22):
         raise ValueError('store exceeds the 4M-document key space')
 
@@ -190,15 +204,20 @@ class ChangeBlock:
 
     __slots__ = ('n_docs', 'doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
                  'dep_seq', 'op_ptr', 'action', 'key', 'value',
-                 'actors', 'keys', 'values', '_dup_keys')
+                 'actors', 'keys', 'values', '_dup_keys',
+                 'obj', 'key_kind', 'key_elem', 'elem', 'objs')
 
     def __init__(self, n_docs, doc, actor, seq, dep_ptr, dep_actor, dep_seq,
                  op_ptr, action, key, value, actors, keys, values,
-                 dup_keys=None):
+                 dup_keys=None, obj=None, key_kind=None, key_elem=None,
+                 elem=None, objs=None):
         if len(doc) and (np.diff(doc) < 0).any():
             order = np.argsort(doc, kind='stable')
             dep_ptr, (dep_actor, dep_seq) = _csr_take(
                 dep_ptr, order, (dep_actor, dep_seq))
+            if obj is not None:
+                op_ptr2, (obj, key_kind, key_elem, elem) = _csr_take(
+                    op_ptr, order, (obj, key_kind, key_elem, elem))
             op_ptr, (action, key, value) = _csr_take(
                 op_ptr, order, (action, key, value))
             doc, actor, seq = doc[order], actor[order], seq[order]
@@ -217,10 +236,24 @@ class ChangeBlock:
         self.keys = keys
         self.values = values
         self._dup_keys = dup_keys
+        # general-op columns (None on flat root-map blocks): per-op
+        # object row (into ``objs``), key kind (_KEY_*), elemId counter
+        # for _KEY_ELEM keys (the actor rides in ``key``), ins counter
+        self.obj = obj
+        self.key_kind = key_kind
+        self.key_elem = key_elem
+        self.elem = elem
+        self.objs = objs
+
+    def is_general(self):
+        """True when the block carries the general op schema (sequences,
+        nested objects, links) — such blocks apply through
+        :mod:`automerge_tpu.device.general`, not the flat-map paths."""
+        return self.obj is not None
 
     def has_dup_keys(self):
-        """True if any change assigns the same key more than once — the
-        self-conflict shape the reference frontend never emits
+        """True if any change assigns the same field more than once —
+        the self-conflict shape the reference frontend never emits
         (ensureSingleAssignment, frontend/index.js:46) but hand-built
         changes can. Computed lazily, cached; the wire edges set it
         during their walk."""
@@ -231,8 +264,28 @@ class ChangeBlock:
                 op_change = np.repeat(
                     np.arange(self.n_changes, dtype=np.int64),
                     np.diff(self.op_ptr))
-                cell = op_change * max(len(self.keys), 1) + self.key
-                self._dup_keys = bool(len(np.unique(cell)) < len(cell))
+                if self.obj is None:
+                    cell = op_change * max(len(self.keys), 1) + self.key
+                    self._dup_keys = bool(
+                        len(np.unique(cell)) < len(cell))
+                else:
+                    # general schema: field identity is (change, obj,
+                    # kind, key, key_elem), assignment ops only — make
+                    # and ins ops never collide
+                    assign = (self.action <= _DEL) | \
+                        (self.action == _LINK)
+                    if not assign.any():
+                        self._dup_keys = False
+                    else:
+                        cols = np.stack([
+                            op_change[assign],
+                            self.obj[assign].astype(np.int64),
+                            self.key_kind[assign].astype(np.int64),
+                            self.key[assign].astype(np.int64),
+                            self.key_elem[assign].astype(np.int64)])
+                        uniq = np.unique(cols, axis=1)
+                        self._dup_keys = bool(
+                            uniq.shape[1] < cols.shape[1])
         return self._dup_keys
 
     @property
@@ -244,10 +297,12 @@ class ChangeBlock:
         return len(self.action)
 
     @classmethod
-    def from_changes(cls, changes_per_doc):
+    def from_changes(cls, changes_per_doc, n_docs=None):
         """Encode per-document dict changes (the JSON wire format) into one
         block. O(total ops) Python — the compatibility edge, not the bulk
-        path."""
+        path. ``n_docs`` widens the block's document space beyond
+        ``len(changes_per_doc)`` (a sparse tick touching few documents of
+        a large store need not materialize one list per document)."""
         actors, actor_of = [], {}
         keys, key_of = [], {}
         values = []
@@ -302,7 +357,12 @@ class ChangeBlock:
                         value.append(-1)
                 op_ptr.append(len(action))
 
-        return cls(len(changes_per_doc),
+        if n_docs is None:
+            n_docs = len(changes_per_doc)
+        elif n_docs < len(changes_per_doc):
+            raise ValueError(
+                f'n_docs={n_docs} < {len(changes_per_doc)} change lists')
+        return cls(n_docs,
                    np.asarray(doc, np.int32), np.asarray(actor, np.int32),
                    np.asarray(seq, np.int32),
                    np.asarray(dep_ptr, np.int32),
@@ -325,12 +385,31 @@ class ChangeBlock:
         deps = {self.actors[self.dep_actor[j]]: int(self.dep_seq[j])
                 for j in range(self.dep_ptr[c], self.dep_ptr[c + 1])}
         ops = []
-        for j in range(self.op_ptr[c], self.op_ptr[c + 1]):
-            op = {'action': _ACTION_CODES[int(self.action[j])],
-                  'obj': ROOT_ID, 'key': self.keys[self.key[j]]}
-            if self.action[j] == _SET:
-                op['value'] = self.values[self.value[j]]
-            ops.append(op)
+        if self.obj is None:                       # flat root-map block
+            for j in range(self.op_ptr[c], self.op_ptr[c + 1]):
+                op = {'action': _ACTION_CODES[int(self.action[j])],
+                      'obj': ROOT_ID, 'key': self.keys[self.key[j]]}
+                if self.action[j] == _SET:
+                    op['value'] = self.values[self.value[j]]
+                ops.append(op)
+        else:
+            for j in range(self.op_ptr[c], self.op_ptr[c + 1]):
+                a = int(self.action[j])
+                op = {'action': _GEN_ACTION_CODES[a],
+                      'obj': self.objs[self.obj[j]]}
+                kind = int(self.key_kind[j])
+                if kind == _KEY_STR:
+                    op['key'] = self.keys[self.key[j]]
+                elif kind == _KEY_ELEM:
+                    op['key'] = (f'{self.actors[self.key[j]]}:'
+                                 f'{int(self.key_elem[j])}')
+                elif kind == _KEY_HEAD:
+                    op['key'] = '_head'
+                if a == _INS:
+                    op['elem'] = int(self.elem[j])
+                if a in (_SET, _LINK):
+                    op['value'] = self.values[self.value[j]]
+                ops.append(op)
         return {'actor': self.actors[self.actor[c]],
                 'seq': int(self.seq[c]), 'deps': deps, 'ops': ops}
 
@@ -472,13 +551,15 @@ class BlockStore:
         # l_order keeps a sorted view over l_key for lookups
         self.l_key = np.zeros(0, np.int64)
         self.l_order = np.zeros(0, np.int64)
+        self._l_sorted = np.zeros(0, np.int64)   # cache: l_key[l_order]
         self.l_dep_ptr = np.zeros(1, np.int32)
         self.l_dep_actor = z32
         self.l_dep_seq = z32
         self.queue = []                       # [(doc, change dict)] buffered
-        # retained-change index: doc -> [(block, admitted row idxs in
-        # admission order)] — blocks are shared references
-        self.doc_log = {}
+        # retained changes: [(block, rows, docs)] per apply — rows are
+        # admitted block rows sorted by doc (admission order within each
+        # doc), docs the parallel doc column; blocks are shared refs
+        self.retained = []
         self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
 
@@ -530,9 +611,7 @@ class BlockStore:
         key_new, seq = key_new[order], seq[order]
         # max seq per distinct key (segmented max over equal-key runs)
         seg_start = np.concatenate([[True], key_new[1:] != key_new[:-1]])
-        seg_id = np.cumsum(seg_start) - 1
-        seg_max = np.zeros(seg_id[-1] + 1, seq.dtype)
-        np.maximum.at(seg_max, seg_id, seq)
+        seg_max = np.maximum.reduceat(seq, np.flatnonzero(seg_start))
         key_new = key_new[seg_start]
         seq = seg_max
         table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
@@ -569,6 +648,18 @@ class BlockStore:
         return {k: sorted(v, key=lambda t: t[0], reverse=True)
                 for k, v in out.items()}
 
+    def log_sorted_keys(self):
+        """l_key in sorted order (cached; rebuilt only if the cache went
+        stale, e.g. after a snapshot load set l_order directly)."""
+        if len(self._l_sorted) != len(self.l_key):
+            self._l_sorted = self.l_key[self.l_order]
+        return self._l_sorted
+
+    def merge_queued_into(self, block):
+        """Fold this store's buffered queue into an incoming block (the
+        general store overrides with its own encoder)."""
+        return _merge_queued(block, self.queue)
+
     def get_missing_deps(self):
         """Unmet deps of buffered changes (op_set.js:347-358)."""
         missing = {}
@@ -597,8 +688,9 @@ class BlockStore:
                 'change-log retention is disabled on this store '
                 '(retain_log=False); serve lagging peers a snapshot')
         out = []
-        for block, rows in self.doc_log.get(d, ()):
-            for c in rows:
+        for block, rows, docs in self.retained:
+            lo, hi = np.searchsorted(docs, [d, d + 1])
+            for c in rows[lo:hi]:
                 actor = block.actors[block.actor[c]]
                 if block.seq[c] > have_deps.get(actor, 0):
                     out.append(block.change_dict(c))
@@ -659,21 +751,18 @@ class _LocalActors:
 
 def _body_index(store):
     """(doc, actor, seq) -> (block, row) over the retained blocks, built
-    lazily on the first duplicate verification and cached until the log
-    grows — a full-history resync verifies O(1) per duplicate instead of
-    rescanning the log per row."""
-    token = len(store.l_key)
-    cached = getattr(store, '_body_index_cache', None)
-    if cached is not None and cached[0] == token:
-        return cached[1]
-    index = {}
-    for d, entries in store.doc_log.items():
-        for blk, rows in entries:
-            actors = blk.actors
-            b_actor, b_seq = blk.actor, blk.seq
-            for r in rows:
-                index[(d, actors[b_actor[r]], int(b_seq[r]))] = (blk, r)
-    store._body_index_cache = (token, index)
+    lazily on the first duplicate verification and EXTENDED incrementally
+    as the (append-only) retained list grows — overlapping resyncs
+    verify O(1) per duplicate instead of rescanning history."""
+    seen, index = getattr(store, '_body_index_cache', (0, None))
+    if index is None:
+        index = {}
+    for blk, rows, docs in store.retained[seen:]:
+        actors = blk.actors
+        b_actor, b_seq = blk.actor, blk.seq
+        for r, d in zip(rows.tolist(), docs.tolist()):
+            index[(d, actors[b_actor[r]], int(b_seq[r]))] = (blk, r)
+    store._body_index_cache = (len(store.retained), index)
     return index
 
 
@@ -716,7 +805,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
     in_key = store.change_key(doc, b_actor, seq)
     in_order = np.argsort(in_key, kind='stable')
     in_sorted = in_key[in_order]
-    log_sorted = store.l_key[store.l_order]     # stable during admission
+    log_sorted = store.log_sorted_keys()        # stable during admission
 
     dep_change = np.repeat(np.arange(C, dtype=np.int64),
                            np.diff(block.dep_ptr))
@@ -755,7 +844,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
                                    store.l_dep_actor[idx])
                 dest[tgt_rep, cols] = store.l_dep_seq[idx]
 
-    def accumulate_closures(ready):
+    def accumulate_closures(ready, ext):
         """The reference's transitiveDeps fold, vectorized for one wave
         (op_set.js:29-37): for each ready change, deps are folded IN
         ORDER (own seq-1 appended last) as merge-max of the dep's
@@ -764,21 +853,28 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         deliberately NOT a pure max. Equivalent closed form per dep j:
         final[a_j] = max(s_j, suffix-max over later deps' closures),
         and pure max for non-dep actors.
+
+        Chain-EXTENSION changes (``ext``: admitted in the same wave as
+        their own-actor predecessor) fold only their LISTED deps here —
+        the own-prev merge is the run prefix-max applied afterwards,
+        which is exactly the reference fold because own-prev comes last:
+        R[s] = elementwise-max(D_s, R[s-1]) with R[s][own] = s-1.
         """
         rdep = ready[dep_change] if len(dep_change) else np.zeros(0, bool)
-        rows_ready = np.flatnonzero(ready)
-        prev = seq[rows_ready] - 1
+        start = ready & ~ext
+        rows_start = np.flatnonzero(start)
+        prev = seq[rows_start] - 1
         has_prev = prev > 0
         # combined dep rows: block deps (wire order), own-prev LAST
         t_change = np.concatenate([dep_change[rdep],
-                                   rows_ready[has_prev]])
+                                   rows_start[has_prev]])
         t_actor = np.concatenate([dep_local[rdep],
-                                  b_local[rows_ready[has_prev]]])
+                                  b_local[rows_start[has_prev]]])
         t_seq = np.concatenate([dep_seq[rdep], prev[has_prev]])
         t_key = np.concatenate([dep_key[rdep],
                                 store.change_key(
-                                    doc[rows_ready[has_prev]],
-                                    b_actor[rows_ready[has_prev]],
+                                    doc[rows_start[has_prev]],
+                                    b_actor[rows_start[has_prev]],
                                     prev[has_prev])])
         live = t_seq > 0                  # depSeq <= 0 rows are skipped
         t_change, t_actor = t_change[live], t_actor[live]
@@ -817,7 +913,14 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
             S = np.maximum(S, upd)
             step *= 2
 
-        np.maximum.at(R, t_change, D)               # merge-max part
+        # merge-max part: rows are sorted by t_change, so the per-change
+        # reduction is one reduceat (np.maximum.at is unbuffered and
+        # ~50x slower at this size)
+        run_starts = np.flatnonzero(np.concatenate(
+            [[True], t_change[1:] != t_change[:-1]]))
+        reduced = np.maximum.reduceat(D, run_starts, axis=0)
+        uniq = t_change[run_starts]
+        R[uniq] = np.maximum(R[uniq], reduced)
         R[t_change, t_actor] = np.maximum(           # the SET override
             t_seq, S[np.arange(n_r), t_actor])
 
@@ -856,16 +959,59 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         dep_ok = np.ones(C, bool)
         if len(dep_change):
             dep_have = store.clock_lookup(doc[dep_change], dep_actor_store)
-            np.logical_and.at(dep_ok, dep_change, dep_have >= dep_seq)
-        ready = pending & chain_ok & dep_ok
-        if not ready.any():
+            sat = dep_have >= dep_seq
+            # dep_change is sorted (a repeat of arange): per-change AND
+            # via reduceat on the runs
+            dstart = np.flatnonzero(np.concatenate(
+                [[True], dep_change[1:] != dep_change[:-1]]))
+            dep_ok[dep_change[dstart]] = \
+                np.logical_and.reduceat(sat, dstart)
+        # RUN admission: a maximal contiguous per-(doc, actor) seq run
+        # whose every element's LISTED deps are satisfied by the
+        # pre-wave clock admits as a unit — so a 100k-change single-
+        # actor chain takes ONE wave, not 100k. (Waves now count only
+        # cross-actor dependency depth within the block.)
+        X = pending & dep_ok
+        xs = X[in_order]
+        ks = in_sorted
+        start_ok_s = (pending & chain_ok & dep_ok)[in_order]
+        brk = np.ones(C, bool)
+        if C > 1:
+            brk[1:] = (ks[1:] != ks[:-1] + 1) | ~xs[:-1]
+        run_id = np.cumsum(brk) - 1
+        run_start_ok = start_ok_s[np.flatnonzero(brk)]
+        ready_s = xs & run_start_ok[run_id]
+        if not ready_s.any():
             break
+        ready = np.zeros(C, bool)
+        ready[in_order[ready_s]] = True
+        ext_s = ready_s & ~brk                   # chain extensions
+        ext = np.zeros(C, bool)
+        ext[in_order[ext_s]] = True
 
-        accumulate_closures(ready)
+        accumulate_closures(ready, ext)
+        if ext_s.any():
+            # segmented prefix max along runs (Hillis–Steele doubling),
+            # then the exact own-seq SET (the fold's last step)
+            Rs = R[in_order]
+            idx = np.arange(C)
+            step = 1
+            while step < C:
+                src = idx - step
+                ok = (src >= 0) & ready_s
+                srcc = np.maximum(src, 0)
+                ok &= (run_id == run_id[srcc]) & ready_s[srcc]
+                if ok.any():
+                    np.maximum(Rs, np.where(ok[:, None], Rs[srcc], 0),
+                               out=Rs)
+                step <<= 1
+            rows_ext = in_order[ext_s]
+            R[rows_ext] = Rs[ext_s]
+            R[rows_ext, b_local[rows_ext]] = seq[rows_ext] - 1
 
         admitted |= ready
         pending &= ~ready
-        adm_waves.append(np.flatnonzero(ready))
+        adm_waves.append(in_order[ready_s])
         store.clock_merge(doc[ready], b_actor[ready], seq[ready])
 
     adm_order = np.concatenate(adm_waves) if adm_waves else \
@@ -891,12 +1037,20 @@ def _log_append(store, in_key, admitted, R, doc, la):
     np.cumsum(counts, out=ptr_new)
     la_actor = la.store_of(doc[adm[nz_r]], nz_c).astype(np.int32)
     la_seq = Radm[nz_r, nz_c]
-    store.l_key = np.concatenate([store.l_key, in_key[adm]])
+    old_sorted = store.log_sorted_keys()
+    new_keys = in_key[adm]
+    store.l_key = np.concatenate([store.l_key, new_keys])
     store.l_dep_ptr = np.concatenate([
         store.l_dep_ptr, store.l_dep_ptr[-1] + ptr_new])
     store.l_dep_actor = np.concatenate([store.l_dep_actor, la_actor])
     store.l_dep_seq = np.concatenate([store.l_dep_seq, la_seq])
-    store.l_order = np.argsort(store.l_key, kind='stable')
+    # merge the (sorted) new keys into the sorted view instead of
+    # re-sorting the whole log every apply
+    new_order = np.argsort(new_keys, kind='stable')
+    new_sorted = new_keys[new_order]
+    pos = np.searchsorted(old_sorted, new_sorted)
+    store.l_order = np.insert(store.l_order, pos, new_order + base)
+    store._l_sorted = np.insert(old_sorted, pos, new_sorted)
     return cmap
 
 
@@ -959,11 +1113,13 @@ def _merge_queued(block, queue):
 class _Staged:
     """Output of the shared admission preamble: the (possibly
     queue-merged) block, admission results, and the admitted ops as
-    columns with store-id keys/actors and store value refs."""
+    columns with store-id keys/actors and store value refs. For general
+    blocks ``o_key`` is None (key semantics depend on the kind column);
+    consumers use ``keep``/``a_tab``/``k_tab`` to map the raw columns."""
 
     __slots__ = ('block', 'admitted', 'R', 'cmap', 'la', 'b_actor',
                  'oc', 'o_doc', 'o_actor', 'o_seq', 'o_action', 'o_key',
-                 'o_value')
+                 'o_value', 'keep', 'a_tab', 'k_tab')
 
 
 def _admit_and_stage(store, block, max_keys=None, max_actors=None):
@@ -976,7 +1132,7 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
     not grow ``store.values`` on every retry.
     """
     check_block_ranges(store, block)
-    merged = _merge_queued(block, store.queue) if store.queue else block
+    merged = store.merge_queued_into(block) if store.queue else block
 
     if max_keys is not None:
         n_keys = len(store.keys) + sum(1 for k in set(merged.keys)
@@ -1018,16 +1174,12 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
     if store.retain_log and len(adm_order):
-        # group per doc, keeping ADMISSION order within each doc (the
-        # causal order get_missing_changes promises its consumers)
+        # doc-sorted, ADMISSION order within each doc (the causal order
+        # get_missing_changes promises); stored whole — per-doc slices
+        # resolve by binary search at read time, so retention is O(sort)
         doc_of = block.doc[adm_order]
         order = np.argsort(doc_of, kind='stable')
-        rows, docs = adm_order[order], doc_of[order]
-        uniq = np.unique(docs)
-        starts = np.searchsorted(docs, uniq)
-        ends = np.searchsorted(docs, uniq, side='right')
-        for d, lo, hi in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
-            store.doc_log.setdefault(d, []).append((block, rows[lo:hi]))
+        store.retained.append((block, adm_order[order], doc_of[order]))
 
     # admitted ops as columns
     C = block.n_changes
@@ -1041,11 +1193,16 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
     st.admitted, st.R, st.cmap, st.la, st.b_actor = (admitted, R, cmap,
                                                      la, b_actor)
     st.oc = oc
+    st.keep = keep
+    st.a_tab, st.k_tab = a_tab, k_tab
     st.o_doc = block.doc[oc]
     st.o_actor = b_actor[oc]
     st.o_seq = block.seq[oc]
     st.o_action = block.action[keep]
-    st.o_key = k_tab[block.key[keep]] if keep.any() else z32
+    if block.is_general():
+        st.o_key = None          # kind-dependent; the general engine maps
+    else:
+        st.o_key = k_tab[block.key[keep]] if keep.any() else z32
 
     # value interning, admitted ops only
     v_base = len(store.values)
@@ -1080,6 +1237,10 @@ def apply_block(store, block, options=None, return_timing=False):
     """
     import time
     opts = _engine.as_options(options)
+    if block.is_general():
+        raise ValueError(
+            'block carries general ops (sequences/nested objects); apply '
+            'through automerge_tpu.device.general')
     t0 = time.perf_counter()
     st = _admit_and_stage(store, block)
     block = st.block

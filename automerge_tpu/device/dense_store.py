@@ -33,7 +33,12 @@ Causal admission (vector-clock waves) and string interning stay on the
 host (:mod:`.blocks`); everything per-op runs on device. Capacities
 (docs, keys, actor slots) are fixed at construction — the price of dense
 addressing — with clear errors on overflow; the general unbounded path
-is :func:`automerge_tpu.device.blocks.apply_block`.
+is :func:`automerge_tpu.device.blocks.apply_block`. Actor slots are PER
+DOCUMENT (``actor_capacity`` bounds the distinct actors editing one
+document, not the store-wide actor population — a 10k-doc fleet with
+10k distinct authors fits in 16 slots if no single doc has more than 16
+collaborators); winner election reads a device-resident per-doc
+string-rank plane.
 
 One scope limit vs the block path: two assignments to the same key
 within one change (never emitted by the reference frontend —
@@ -58,20 +63,28 @@ _VAL_NONE = np.int32(-2147483648)      # "no value" sentinel for EVal
 
 @partial(jax.jit, static_argnames=('n_fields', 'n_actors', 'seq_values'))
 def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
-                  change_clock, op_counts, op_key, op_isdel_bits, op_value,
-                  n_ops, key_capacity, v_base, *, n_fields, n_actors,
-                  seq_values):
+                  coo_row, coo_col, coo_val, op_counts, op_key,
+                  op_isdel_bits, op_value, n_ops, key_capacity, v_base, *,
+                  n_fields, n_actors, seq_values):
     """One block apply: expand change columns to op rows ON DEVICE, then
     scatter-maxes into the resident planes.
 
     Wire-lean inputs: the del mask arrives bit-packed (uint8, unpacked
-    here), and with ``seq_values`` the value refs are not shipped at all —
+    here); with ``seq_values`` the value refs are not shipped at all —
     set ops reference values sequentially from ``v_base`` (the layout
     ChangeBlock.from_changes and the workload generators produce), so the
-    refs are a cumulative sum computed on device.
+    refs are a cumulative sum computed on device; and the closure clock
+    plane is REBUILT ON DEVICE — a change's own-actor entry is always
+    seq-1 (the transitiveDeps fold ends with that SET), so only the
+    sparse cross-actor closure entries ship, as COO triples.
     """
     n_pad = op_key.shape[0]
     c_pad = change_doc.shape[0]
+    change_clock = jnp.zeros((c_pad, n_actors), jnp.int32)
+    change_clock = change_clock.at[
+        jnp.arange(c_pad), change_actor].set(change_seq - 1)
+    change_clock = change_clock.at[coo_row, coo_col].set(coo_val,
+                                                         mode='drop')
     op_change = jnp.repeat(jnp.arange(c_pad, dtype=jnp.int32), op_counts,
                            total_repeat_length=n_pad)
     valid = jnp.arange(n_pad) < n_ops
@@ -111,11 +124,15 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
 
 
 @partial(jax.jit, static_argnames=('f_pad',))
-def _extract_kernel(eseq, eval_, m, str_rank, touched_mask, *, f_pad):
+def _extract_kernel(eseq, eval_, m, rank_plane, key_capacity,
+                    touched_mask, *, f_pad):
     """Patch extraction for the touched fields, fully on device.
 
-    Returns (touched fidx [f_pad], winner slot [f_pad], winner value
-    [f_pad], alive mask [f_pad, A]); -1 fidx rows are padding.
+    ``rank_plane`` is the device-resident [n_docs, A] actor-string-rank
+    table (slots are PER DOCUMENT); each touched field gathers its own
+    document's row. Returns (touched fidx [f_pad], winner slot [f_pad],
+    winner value [f_pad], alive mask [f_pad, A]); -1 fidx rows are
+    padding.
     """
     (fidx,) = jnp.nonzero(touched_mask, size=f_pad, fill_value=-1)
     frow = jnp.maximum(fidx, 0)
@@ -125,7 +142,8 @@ def _extract_kernel(eseq, eval_, m, str_rank, touched_mask, *, f_pad):
     is_del = (seqdel & 1) != 0
     alive = (seq > 0) & (mrows < seq) & ~is_del & (fidx >= 0)[:, None]
 
-    rank = jnp.where(alive, str_rank[None, :], -1)
+    f_rank = rank_plane[frow // key_capacity]          # [f_pad, A]
+    rank = jnp.where(alive, f_rank, -1)
     winner_slot = jnp.argmax(rank, axis=1)
     has_winner = jnp.max(rank, axis=1) >= 0
     winner_slot = jnp.where(has_winner, winner_slot, -1)
@@ -177,7 +195,7 @@ class DensePatch:
         f_action = np.where(has_winner, _SET, _DEL).astype(np.int8)
         f_value = np.where(has_winner, w_value, -1).astype(np.int32)
         f_actor = np.where(has_winner,
-                           store.slot_actor_ids[np.maximum(w_slot, 0)],
+                           store.slot_actor[f_doc, np.maximum(w_slot, 0)],
                            -1).astype(np.int32)
 
         # conflicts: alive minus winner, COO -> CSR per field
@@ -189,7 +207,7 @@ class DensePatch:
         s_ptr = np.zeros(len(fidx) + 1, np.int32)
         np.cumsum(s_counts, out=s_ptr[1:])
         host = store.host
-        s_actor = store.slot_actor_ids[ls].astype(np.int32)
+        s_actor = store.slot_actor[f_doc[lf], ls].astype(np.int32)
         values = np.asarray(self.values)[live][order]
         s_value = values[lf, ls].astype(np.int32)
 
@@ -239,7 +257,20 @@ class DenseMapStore:
                     f'{mesh.devices.size} devices')
             self._sharding = NamedSharding(mesh, PartitionSpec(axis, None))
         self._alloc_planes()
-        self.slot_actor_ids = np.zeros(0, np.int32)  # slot -> store actor
+        self._init_slots()
+
+    def _init_slots(self):
+        # per-DOC actor slots: actor_capacity bounds the number of
+        # distinct actors per document, not store-wide. slot_actor is
+        # the host mirror (doc, slot) -> store actor id; the string-rank
+        # plane lives device-resident and re-ships only when it changes.
+        self.slot_actor = np.full((self.n_docs, self.actor_capacity), -1,
+                                  np.int32)
+        self.slot_count = np.zeros(self.n_docs, np.int32)
+        self._slot_keys = np.zeros(0, np.int64)   # sorted (doc<<32|actor)
+        self._slot_vals = np.zeros(0, np.int32)   # parallel slot numbers
+        self._rank_plane = None                   # device [D, A]
+        self._rank_actors = -1    # actor-table size the plane was built at
 
     def _alloc_planes(self):
         shape = (self.n_fields, self.actor_capacity)
@@ -255,21 +286,79 @@ class DenseMapStore:
         self._alloc_planes()
         self.host = _blocks.BlockStore(self.n_docs,
                                        retain_log=self.retain_log)
-        self.slot_actor_ids = np.zeros(0, np.int32)
+        self._init_slots()
+
+    # -- per-doc actor slots -------------------------------------------------
+
+    def _slots_of(self, doc, actor, allocate=False):
+        """Slot per (doc, store actor id) pair, vectorized; allocates
+        fresh per-doc slots for unseen pairs when ``allocate``."""
+        key = (doc.astype(np.int64) << 32) | actor
+        pos = np.minimum(np.searchsorted(self._slot_keys, key),
+                         max(len(self._slot_keys) - 1, 0))
+        hit = (self._slot_keys[pos] == key) if len(self._slot_keys) \
+            else np.zeros(len(key), bool)
+        slots = np.full(len(key), -1, np.int32)
+        if hit.any():
+            slots[hit] = self._slot_vals[pos[hit]]
+        miss = ~hit
+        if allocate and miss.any():
+            new_keys = np.unique(key[miss])
+            new_docs = (new_keys >> 32).astype(np.int64)
+            # per-doc sequential slot numbers continuing slot_count
+            starts = np.flatnonzero(np.concatenate(
+                [[True], new_docs[1:] != new_docs[:-1]]))
+            run = np.arange(len(new_keys)) - np.repeat(
+                starts, np.diff(np.append(starts, len(new_keys))))
+            new_slots = (self.slot_count[new_docs] + run).astype(np.int32)
+            if (new_slots >= self.actor_capacity).any():
+                bad = int(new_docs[np.argmax(new_slots)])
+                raise ValueError(
+                    f'document {bad} exceeds actor_capacity='
+                    f'{self.actor_capacity} distinct actors')
+            self.slot_actor[new_docs, new_slots] = \
+                (new_keys & 0xFFFFFFFF).astype(np.int32)
+            np.maximum.at(self.slot_count, new_docs, new_slots + 1)
+            merged = np.argsort(np.concatenate(
+                [self._slot_keys, new_keys]), kind='stable')
+            all_keys = np.concatenate([self._slot_keys, new_keys])
+            all_vals = np.concatenate([self._slot_vals, new_slots])
+            self._slot_keys = all_keys[merged]
+            self._slot_vals = all_vals[merged]
+            self._rank_actors = -1               # plane is stale
+            # resolve the misses now that they exist
+            pos = np.searchsorted(self._slot_keys, key[miss])
+            slots[miss] = self._slot_vals[pos]
+        elif miss.any():
+            raise KeyError('unknown (doc, actor) pair in slot lookup')
+        return slots
+
+    def _rank_plane_dev(self):
+        """Device-resident [D, A] actor string-rank plane, re-shipped
+        only when slots were added or the actor table grew (global
+        string ranks shift when a new actor interns)."""
+        n_act = len(self.host.actors)
+        if self._rank_plane is None or self._rank_actors != n_act:
+            ranks = np.full((self.n_docs, self.actor_capacity), -1,
+                            np.int64)
+            filled = self.slot_actor >= 0
+            ranks[filled] = self.host.actor_str_ranks()[
+                self.slot_actor[filled]]
+            plane = jnp.asarray(ranks.astype(np.int32))
+            if self._sharding is not None:
+                plane = jax.device_put(plane, self._sharding)
+            self._rank_plane = plane
+            self._rank_actors = n_act
+        return self._rank_plane
 
     def _extract(self, mask):
         """Device patch extraction over a boolean field mask (shared by
         apply_block and extract_all)."""
         f_pad = self.options.pad_segments(max(int(mask.sum()), 1))
-        A = self.actor_capacity
-        self._actor_slots()
-        str_rank = np.full(A, -1, np.int64)
-        n_act = len(self.host.actors)
-        str_rank[:n_act] = \
-            self.host.actor_str_ranks()[self.slot_actor_ids]
         fidx, w_slot, w_value, alive, values = _extract_kernel(
-            self.eseq, self.eval_, self.m, jnp.asarray(str_rank),
-            jnp.asarray(mask), f_pad=f_pad)
+            self.eseq, self.eval_, self.m, self._rank_plane_dev(),
+            jnp.asarray(self.key_capacity), jnp.asarray(mask),
+            f_pad=f_pad)
         return DensePatch(self, fidx, w_slot, w_value, alive, values)
 
     def extract_all(self):
@@ -302,6 +391,7 @@ class DenseMapStore:
             buf,
             eseq=np.asarray(self.eseq), eval=np.asarray(self.eval_),
             m=np.asarray(self.m),
+            slot_actor=self.slot_actor, slot_count=self.slot_count,
             c_doc=host.c_doc, c_actor=host.c_actor, c_seq=host.c_seq,
             l_key=host.l_key, l_order=host.l_order,
             l_dep_ptr=host.l_dep_ptr, l_dep_actor=host.l_dep_actor,
@@ -360,20 +450,65 @@ class DenseMapStore:
             # resumed store can sync peers forward from here, but not
             # across the snapshot boundary
             host.log_truncated = True
-        store._actor_slots()
+            if 'slot_actor' in z:
+                store.slot_actor = z['slot_actor']
+                store.slot_count = z['slot_count']
+            else:
+                # pre-slot snapshots used global slots == store ids
+                n = len(host.actors)
+                store.slot_actor[:, :n] = np.arange(n, dtype=np.int32)
+                store.slot_count[:] = n
+            # rebuild the sorted (doc<<32|actor) -> slot index
+            docs, slots = np.nonzero(store.slot_actor >= 0)
+            keys = (docs.astype(np.int64) << 32) \
+                | store.slot_actor[docs, slots]
+            order = np.argsort(keys, kind='stable')
+            store._slot_keys = keys[order]
+            store._slot_vals = slots[order].astype(np.int32)
         return store
 
-    # actor slots are store actor ids (stable across applies); capacity
-    # bounds the number of DISTINCT actors the store can hold
-    def _actor_slots(self):
+    def _check_slot_capacity(self, block):
+        """Reject a block whose (doc, actor) pairs would overflow any
+        document's slot table — BEFORE any store mutation (conservative:
+        counts queued and not-yet-admitted changes too)."""
         host = self.host
-        n = len(host.actors)
-        if n > self.actor_capacity:
+        tmp = {}
+
+        def aid(name):
+            """Stable counting id: store id, or a temporary for unseen."""
+            i = host.actor_of.get(name)
+            if i is None:
+                i = tmp.get(name)
+                if i is None:
+                    i = tmp[name] = len(host.actors) + len(tmp)
+            return i
+
+        keys = np.zeros(0, np.int64)
+        if block.n_changes:
+            amap = np.asarray([aid(a) for a in block.actors], np.int64)
+            keys = (block.doc.astype(np.int64) << 32) | amap[block.actor]
+        if host.queue:
+            qk = np.asarray(
+                [(d << 32) | aid(ch['actor']) for d, ch in host.queue],
+                np.int64)
+            keys = np.concatenate([keys, qk])
+        if not len(keys):
+            return
+        keys = np.unique(keys)
+        pos = np.minimum(np.searchsorted(self._slot_keys, keys),
+                         max(len(self._slot_keys) - 1, 0))
+        exists = (self._slot_keys[pos] == keys) \
+            if len(self._slot_keys) else np.zeros(len(keys), bool)
+        fresh_docs = (keys[~exists] >> 32).astype(np.int64)
+        if not len(fresh_docs):
+            return
+        counts = np.bincount(fresh_docs, minlength=self.n_docs)
+        total = counts + self.slot_count
+        if (total > self.actor_capacity).any():
+            bad = int(np.argmax(total))
             raise ValueError(
-                f'{n} actors exceed actor_capacity={self.actor_capacity}')
-        if len(self.slot_actor_ids) != n:
-            self.slot_actor_ids = np.arange(n, dtype=np.int32)
-        return self.slot_actor_ids
+                f'document {bad} would need {int(total[bad])} actor '
+                f'slots, exceeding actor_capacity={self.actor_capacity}')
 
     def apply_block(self, block, return_timing=False):
         """Apply a :class:`~.blocks.ChangeBlock`; returns a
@@ -383,6 +518,10 @@ class DenseMapStore:
         opts = self.options
 
         t0 = time.perf_counter()
+        if block.is_general():
+            raise ValueError(
+                'block carries general ops (sequences/nested objects); '
+                'apply through automerge_tpu.device.general')
         if block.has_dup_keys():
             # one dense cell per (field, actor) cannot hold two surviving
             # assignments from one change; reject BEFORE any mutation so
@@ -391,11 +530,11 @@ class DenseMapStore:
                 'change assigns the same key twice (self-conflict shape); '
                 'the dense store holds one entry per (field, actor) — '
                 'apply through device.blocks.apply_block instead')
+        _blocks.check_block_ranges(host, block)   # clear range errors
+        self._check_slot_capacity(block)
         st = _blocks._admit_and_stage(host, block,
-                                      max_keys=self.key_capacity,
-                                      max_actors=self.actor_capacity)
+                                      max_keys=self.key_capacity)
         block = st.block
-        self._actor_slots()
         t1 = time.perf_counter()
 
         # ---- compress + ship change columns ----
@@ -405,22 +544,34 @@ class DenseMapStore:
         change_doc = np.zeros(c_pad, np.int32)
         change_doc[:len(rows)] = block.doc[rows]
         change_actor = np.zeros(c_pad, np.int32)
-        change_actor[:len(rows)] = st.b_actor[rows]   # slot == store id
+        change_actor[:len(rows)] = self._slots_of(
+            block.doc[rows], st.b_actor[rows], allocate=True)
         change_seq = np.zeros(c_pad, np.int32)
         change_seq[:len(rows)] = block.seq[rows]
-        # closures in store-slot coordinates (skip entirely when empty)
+        # closure EXCEPTIONS in per-doc slot coordinates: the kernel
+        # sets every change's own-actor entry to seq-1 itself, so only
+        # the sparse cross-actor closure entries ship (zero for fully
+        # concurrent batches AND for plain per-actor chains)
         A = self.actor_capacity
         R = st.R
+        coo_row = coo_col = coo_val = np.zeros(0, np.int32)
         if R.any():
-            change_clock = np.zeros((c_pad, A), np.int32)
             Radm = R[rows]
             nz_r, nz_c = np.nonzero(Radm)
-            change_clock[nz_r,
-                         st.la.store_of(block.doc[rows[nz_r]], nz_c)] = \
-                Radm[nz_r, nz_c]
-            clock_dev = jnp.asarray(change_clock)
-        else:
-            clock_dev = jnp.zeros((c_pad, A), jnp.int32)
+            store_id = st.la.store_of(block.doc[rows[nz_r]], nz_c)
+            own = store_id == st.b_actor[rows[nz_r]]
+            coo_row = nz_r[~own].astype(np.int32)
+            # a closure actor always has an applied change on the doc,
+            # hence a slot
+            coo_col = self._slots_of(block.doc[rows[nz_r[~own]]],
+                                     store_id[~own]).astype(np.int32)
+            coo_val = Radm[nz_r[~own], nz_c[~own]].astype(np.int32)
+        nnz_pad = opts.pad_ops(max(len(coo_row), 1))
+        pad_n = nnz_pad - len(coo_row)
+        coo_row = np.concatenate(
+            [coo_row, np.full(pad_n, c_pad, np.int32)])
+        coo_col = np.concatenate([coo_col, np.zeros(pad_n, np.int32)])
+        coo_val = np.concatenate([coo_val, np.zeros(pad_n, np.int32)])
 
         op_counts = np.zeros(c_pad, np.int32)
         op_counts[:len(rows)] = np.diff(block.op_ptr)[rows]
@@ -450,7 +601,8 @@ class DenseMapStore:
         self.eseq, self.eval_, self.m = _apply_kernel(
             self.eseq, self.eval_, self.m, jnp.asarray(change_doc),
             jnp.asarray(change_actor), jnp.asarray(change_seq),
-            clock_dev, jnp.asarray(op_counts),
+            jnp.asarray(coo_row), jnp.asarray(coo_col),
+            jnp.asarray(coo_val), jnp.asarray(op_counts),
             jnp.asarray(op_key), jnp.asarray(np.packbits(op_isdel)),
             op_value_dev, jnp.asarray(n_ops),
             jnp.asarray(self.key_capacity), jnp.asarray(v_base),

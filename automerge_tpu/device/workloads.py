@@ -64,10 +64,13 @@ def gen_docset_workload(n_docs=10240, n_ops=128, n_actors=8, n_keys=32,
 
 
 def gen_block_workload(n_docs=10240, n_actors=10, ops_per_change=10,
-                       n_keys=40, seed=0, del_p=0.0):
+                       n_keys=40, seed=0, del_p=0.0, seq0=1):
     """The BASELINE config-5 workload as wire changes: a ChangeBlock with
-    one change per (doc, actor), all concurrent (seq=1, empty deps), each
-    change carrying ``ops_per_change`` set ops on distinct root keys.
+    one change per (doc, actor), all cross-actor concurrent (seq =
+    ``seq0``, empty deps), each change carrying ``ops_per_change`` set
+    ops on distinct root keys. ``seq0`` > 1 produces the k-th block of a
+    STREAM of such batches (each actor's chain advancing one seq per
+    block) — apply blocks seq0=1..k in order.
 
     Total ops = n_docs * n_actors * ops_per_change. With the defaults this
     is the 1M-op / 10k-doc north-star shape, expressed in the columnar
@@ -80,7 +83,7 @@ def gen_block_workload(n_docs=10240, n_actors=10, ops_per_change=10,
     n_ops = n_changes * ops_per_change
     doc = np.repeat(np.arange(n_docs, dtype=np.int32), n_actors)
     actor = np.tile(np.arange(n_actors, dtype=np.int32), n_docs)
-    seq = np.ones(n_changes, np.int32)
+    seq = np.full(n_changes, seq0, np.int32)
     dep_ptr = np.zeros(n_changes + 1, np.int32)
     op_ptr = np.arange(n_changes + 1, dtype=np.int32) * ops_per_change
     # distinct keys per change (first ops_per_change of a random key perm)

@@ -26,13 +26,17 @@ def _backend_of(doc):
 
 def clock_union(clock_map, doc_id, clock):
     """Merge `clock` into `clock_map[doc_id]`, taking per-actor maxima
-    (connection.js:9-12)."""
-    merged = dict(clock_map.get(doc_id, {}))
+    (connection.js:9-12). The reference rebuilds an immutable map; these
+    maps are private to one Connection, so updating in place is
+    observably identical and keeps a 10k-doc sync O(messages), not
+    O(messages * docs)."""
+    merged = clock_map.get(doc_id)
+    if merged is None:
+        merged = clock_map[doc_id] = {}
     for actor, seq in clock.items():
-        merged[actor] = max(merged.get(actor, 0), seq)
-    new_map = dict(clock_map)
-    new_map[doc_id] = merged
-    return new_map
+        if seq > merged.get(actor, 0):
+            merged[actor] = seq
+    return clock_map
 
 
 class Connection:
@@ -115,4 +119,56 @@ class Connection:
     sendMsg = send_msg
     maybeSendChanges = maybe_send_changes
     docChanged = doc_changed
+    receiveMsg = receive_msg
+
+
+class BatchingConnection(Connection):
+    """A Connection that accumulates incoming data messages and applies
+    them in ONE batched call per network tick.
+
+    The reference applies each data message's changes per document as it
+    arrives (src/connection.js:95-97); on this framework's batch engines
+    that wastes the whole point — a tick's worth of messages across MANY
+    documents is exactly one device dispatch. ``receive_msg`` buffers
+    data messages (clock bookkeeping still happens immediately, in
+    arrival order); :meth:`flush` routes the buffered changes through
+    ``doc_set.apply_changes_batch`` (one fused device call on a
+    :class:`~automerge_tpu.sync.device_doc_set.DeviceDocSet`) and then
+    runs the deferred per-doc protocol follow-ups. Call ``flush()`` at
+    the end of each delivery tick; message traffic is identical to the
+    eager Connection.
+    """
+
+    def __init__(self, doc_set, send_msg):
+        super().__init__(doc_set, send_msg)
+        self._incoming = []
+
+    def receive_msg(self, msg):
+        if 'changes' in msg and msg['changes'] is not None:
+            metrics.bump('sync_msgs_received')
+            if 'clock' in msg and msg['clock'] is not None:
+                self._their_clock = clock_union(
+                    self._their_clock, msg['docId'], msg['clock'])
+            self._incoming.append(msg)
+            return None                      # applied on flush()
+        return super().receive_msg(msg)
+
+    def flush(self):
+        """Apply every buffered data message in one batched call;
+        returns {doc_id: doc} for the docs that changed."""
+        if not self._incoming:
+            return {}
+        changes_by_doc = {}
+        for msg in self._incoming:
+            changes_by_doc.setdefault(msg['docId'], []) \
+                .extend(msg['changes'])
+        self._incoming = []
+        metrics.bump('sync_changes_received',
+                     sum(len(c) for c in changes_by_doc.values()))
+        apply_batch = getattr(self._doc_set, 'apply_changes_batch', None)
+        if apply_batch is not None:
+            return apply_batch(changes_by_doc)
+        return {doc_id: self._doc_set.apply_changes(doc_id, changes)
+                for doc_id, changes in changes_by_doc.items()}
+
     receiveMsg = receive_msg

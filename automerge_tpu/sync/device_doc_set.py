@@ -81,3 +81,30 @@ class DeviceDocSet(DocSet):
         return out
 
     applyChangesBatch = apply_changes_batch
+
+    def migrate_doc(self, doc_id):
+        """Move an oracle-pinned document (e.g. added via ``set_doc``)
+        onto the device backend by replaying its change log — the two
+        backends speak the same wire protocol, so the rebuilt document
+        is identical and all future changes take the batched device
+        path. Requires the full log (raises after a truncated resume)."""
+        from .. import backend as Backend
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            raise KeyError(doc_id)
+        state = Frontend.get_backend_state(doc)
+        if isinstance(state, DeviceBackend.DeviceBackendState):
+            self._oracle_docs.discard(doc_id)
+            return doc
+        changes = Backend.get_missing_changes(state, {})
+        new_state, _ = DeviceBackend.apply_changes(
+            DeviceBackend.init(), changes)
+        new_doc = Frontend.init({'backend': DeviceBackend})
+        patch = DeviceBackend.get_patch(new_state)
+        patch['state'] = new_state
+        new_doc = Frontend.apply_patch(new_doc, patch)
+        self._oracle_docs.discard(doc_id)
+        self.set_doc(doc_id, new_doc)
+        return new_doc
+
+    migrateDoc = migrate_doc

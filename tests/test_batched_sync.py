@@ -1,0 +1,137 @@
+"""Batched sync layer: BatchingConnection + DenseDocSet replicate the
+reference Connection protocol with identical message traffic while
+applying each delivery tick in one batched call; DeviceDocSet.migrate_doc
+moves oracle-pinned documents onto the device backend."""
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.sync import DocSet, Connection
+from automerge_tpu.sync.connection import BatchingConnection
+from automerge_tpu.sync.dense_doc_set import DenseDocSet
+from automerge_tpu.sync.device_doc_set import DeviceDocSet
+
+
+def _src_docset(n_docs):
+    src = DocSet()
+    for i in range(n_docs):
+        doc = am.change(am.init(f'actor-{i:03d}'),
+                        lambda d, i=i: d.update({'id': i, 'n': i * 2}))
+        src.set_doc(f'doc{i}', doc)
+    return src
+
+
+def _run_sync(src, dst, batching, collect_traffic=False):
+    msgs_a, msgs_b = [], []
+    ca = Connection(src, msgs_a.append)
+    cb = (BatchingConnection if batching else Connection)(
+        dst, msgs_b.append)
+    ca.open()
+    cb.open()
+    traffic = []
+    while msgs_a or msgs_b:
+        batch_a = msgs_a[:]
+        msgs_a.clear()
+        for m in batch_a:
+            traffic.append(('a->b', m['docId'],
+                            'changes' in m and m['changes'] is not None))
+            cb.receive_msg(m)
+        if batching:
+            cb.flush()
+        batch_b = msgs_b[:]
+        msgs_b.clear()
+        for m in batch_b:
+            traffic.append(('b->a', m['docId'],
+                            'changes' in m and m['changes'] is not None))
+            ca.receive_msg(m)
+    return traffic
+
+
+class TestBatchingConnection:
+    def test_dense_docset_converges(self):
+        src = _src_docset(20)
+        dst = DenseDocSet(20, key_capacity=8, actor_capacity=4)
+        _run_sync(src, dst, batching=True)
+        for i in range(20):
+            assert dst.get_doc(f'doc{i}')['n'] == i * 2
+            assert dst.get_doc(f'doc{i}')['id'] == i
+
+    def test_message_traffic_identical_to_eager(self):
+        src1 = _src_docset(6)
+        t_eager = _run_sync(src1, DocSet(), batching=False,
+                            collect_traffic=True)
+        src2 = _src_docset(6)
+        t_batch = _run_sync(src2,
+                            DenseDocSet(6, key_capacity=8,
+                                        actor_capacity=4),
+                            batching=True, collect_traffic=True)
+        assert sorted(t_eager) == sorted(t_batch)
+
+    def test_device_docset_batch_flush(self):
+        src = _src_docset(10)
+        dst = DeviceDocSet()
+        _run_sync(src, dst, batching=True)
+        for i in range(10):
+            doc = dst.get_doc(f'doc{i}')
+            assert doc['n'] == i * 2
+            assert isinstance(Frontend.get_backend_state(doc),
+                              DeviceBackend.DeviceBackendState)
+
+    def test_incremental_resync(self):
+        """New changes after a full sync ship and batch-apply too."""
+        src = _src_docset(4)
+        dst = DenseDocSet(4, key_capacity=8, actor_capacity=4)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+
+        def drain():
+            while msgs_a or msgs_b:
+                batch = msgs_a[:]
+                msgs_a.clear()
+                for m in batch:
+                    cb.receive_msg(m)
+                cb.flush()
+                batch = msgs_b[:]
+                msgs_b.clear()
+                for m in batch:
+                    ca.receive_msg(m)
+
+        drain()
+        doc0 = am.change(src.get_doc('doc0'),
+                         lambda d: d.__setitem__('extra', 'v'))
+        src.set_doc('doc0', doc0)
+        drain()
+        assert dst.get_doc('doc0')['extra'] == 'v'
+
+    def test_dense_handles_materialize(self):
+        src = _src_docset(3)
+        dst = DenseDocSet(3, key_capacity=8, actor_capacity=4)
+        _run_sync(src, dst, batching=True)
+        h = dst.get_doc('doc1')
+        assert dict(h.items()) == {'id': 1, 'n': 2}
+        assert 'id' in h and 'ghost' not in h
+
+
+class TestMigrateDoc:
+    def test_migrate_oracle_doc_to_device(self):
+        ds = DeviceDocSet()
+        doc = am.change(am.init('mig-actor'),
+                        lambda d: d.update({'k': 1, 'l': [1, 2]}))
+        ds.set_doc('d1', doc)
+        assert 'd1' in ds._oracle_docs or not isinstance(
+            Frontend.get_backend_state(ds.get_doc('d1')),
+            DeviceBackend.DeviceBackendState)
+        migrated = ds.migrate_doc('d1')
+        assert isinstance(Frontend.get_backend_state(migrated),
+                          DeviceBackend.DeviceBackendState)
+        assert migrated['k'] == 1 and list(migrated['l']) == [1, 2]
+        # future changes take the device path
+        out = ds.apply_changes('d1', Backend.get_changes_for_actor(
+            Frontend.get_backend_state(
+                am.change(am.load(am.save(migrated), actor_id='other'),
+                          lambda d: d.__setitem__('k', 2))), 'other'))
+        assert out['k'] == 2

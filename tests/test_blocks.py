@@ -395,7 +395,7 @@ class TestBlockSync:
                               retain_log=False)
         chs = [[_mk_change('aa', 1, {}, [_set('x', 1)])]]
         store.apply_block(blocks.ChangeBlock.from_changes(chs))
-        assert store.host.doc_log == {}
+        assert store.host.retained == []
         # a caught-up peer is fine; a lagging one is refused
         assert store.host.get_missing_changes(0, {'aa': 1}) == []
         with pytest.raises(ValueError, match='retention'):

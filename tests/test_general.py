@@ -1,0 +1,280 @@
+"""General bulk engine: differential conformance against the host
+oracle on full documents — nested maps, lists, text, links, causal
+deps, chunked/duplicated/delayed delivery — plus its own scope errors.
+
+The general engine (automerge_tpu/device/general.py) is the block-scale
+counterpart of Backend.applyChanges for the FULL op set; every test
+materializes through the real frontend patch applier, so the diffs'
+shape is validated end to end, not just the final values.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.device import blocks, general
+from automerge_tpu.text import Text
+
+
+def _mat_doc(doc):
+    def conv(o):
+        n = type(o).__name__
+        if n == 'Text':
+            return ''.join(str(c) for c in o)
+        if n == 'AmList':
+            return [conv(v) for v in o]
+        if hasattr(o, '_conflicts'):
+            return {k: conv(v) for k, v in o.items()}
+        return o
+    return conv(doc), {k: dict(v) if isinstance(v, dict) else v
+                       for k, v in dict(doc._conflicts).items()}
+
+
+def _apply_diff_lists(diff_lists):
+    d = Frontend.init('viewer')
+    for diffs in diff_lists:
+        d = Frontend.apply_patch(
+            d, {'clock': {}, 'deps': {}, 'canUndo': False,
+                'canRedo': False, 'diffs': diffs})
+    return d
+
+
+def _via_oracle(changes):
+    state, _ = Backend.apply_changes(Backend.init(), changes)
+    return _mat_doc(_apply_diff_lists([Backend.get_patch(state)['diffs']]))
+
+
+def _via_general(changes, splits=1):
+    store = general.init_store(1)
+    chunks = [changes] if splits <= 1 else [
+        changes[i:i + max(1, len(changes) // splits)]
+        for i in range(0, len(changes), max(1, len(changes) // splits))]
+    diff_lists = []
+    for chunk in chunks:
+        patch = general.apply_general_block(
+            store, store.encode_changes([chunk]))
+        diff_lists.append(patch.diffs(0))
+    return _mat_doc(_apply_diff_lists(diff_lists))
+
+
+def _frontend_history(*edit_sets):
+    """Per-actor frontend sessions with explicit merge points; returns
+    the combined wire changes of all actors."""
+    all_changes = []
+    for actor, base, edits in edit_sets:
+        doc = Frontend.init({'backend': Backend})
+        doc = Frontend.set_actor_id(doc, actor)
+        if base:
+            st, p = Backend.apply_changes(
+                Frontend.get_backend_state(doc), base)
+            p['state'] = st
+            doc = Frontend.apply_patch(doc, p)
+        for e in edits:
+            doc, _ = Frontend.change(doc, e)
+        mine = Backend.get_changes_for_actor(
+            Frontend.get_backend_state(doc), actor)
+        all_changes.extend(mine)
+    return all_changes
+
+
+class TestGeneralConformance:
+    def test_rich_document(self):
+        changes = _frontend_history(
+            ('author', [], [
+                lambda d: d.update({'title': 'doc', 'meta': {'v': 1}}),
+                lambda d: d.__setitem__('items', ['a', 'b', 'c']),
+                lambda d: d['items'].insert(1, 'x'),
+                lambda d: d.__setitem__('text', Text()),
+                lambda d: d['text'].insert_at(0, *'hello'),
+                lambda d: d['items'].__delitem__(0),
+                lambda d: d['meta'].__setitem__('deep', {'q': [1, 2]}),
+            ]))
+        want = _via_oracle(changes)
+        assert _via_general(changes) == want
+        assert _via_general(changes, splits=3) == want
+
+    def test_concurrent_writers_with_causal_base(self):
+        base = _frontend_history(
+            ('base', [], [lambda d: d.__setitem__('text', Text())]))
+        changes = list(base)
+        for i in range(3):
+            changes.extend(_frontend_history(
+                (f'writer-{i}', base,
+                 [lambda d, c=chr(97 + i): d['text'].insert_at(
+                     0, *(c * 40))])))
+        want = _via_oracle(changes)
+        assert _via_general(changes) == want
+        assert _via_general(changes, splits=4) == want
+
+    def test_concurrent_map_conflicts_and_deletes(self):
+        base = _frontend_history(
+            ('b0', [], [lambda d: d.update({'k': 0, 'gone': 1})]))
+        changes = list(base)
+        changes.extend(_frontend_history(
+            ('aaa', base, [lambda d: d.__setitem__('k', 'low')])))
+        changes.extend(_frontend_history(
+            ('zzz', base, [lambda d: d.__setitem__('k', 'high'),
+                           lambda d: d.__delitem__('gone')])))
+        want = _via_oracle(changes)
+        got = _via_general(changes)
+        assert got == want
+        assert got[0]['k'] == 'high' and 'gone' not in got[0]
+
+    def test_shuffled_and_duplicated_delivery(self):
+        rng = random.Random(7)
+        base = _frontend_history(
+            ('base', [], [lambda d: d.__setitem__('list', [])]))
+        changes = list(base)
+        for i in range(3):
+            changes.extend(_frontend_history(
+                (f'w{i}', base,
+                 [lambda d, i=i: d['list'].append(f'v{i}'),
+                  lambda d, i=i: d.__setitem__(f'k{i}', i)])))
+        want = _via_oracle(changes)
+
+        shuffled = list(changes)
+        rng.shuffle(shuffled)
+        store = general.init_store(1)
+        diff_lists = []
+        i = 0
+        while i < len(shuffled):
+            k = rng.randint(1, 4)
+            chunk = shuffled[i:i + k]
+            i += k
+            diff_lists.append(general.apply_general_block(
+                store, store.encode_changes([chunk])).diffs(0))
+            if rng.random() < 0.4:       # duplicate delivery
+                diff_lists.append(general.apply_general_block(
+                    store, store.encode_changes([chunk])).diffs(0))
+        assert store.queue == []
+        assert _mat_doc(_apply_diff_lists(diff_lists)) == want
+
+    def test_multi_doc_batch(self):
+        per_doc = []
+        wants = []
+        for d in range(4):
+            changes = _frontend_history(
+                (f'actor-{d}', [], [
+                    lambda d_, d=d: d_.update({'id': d}),
+                    lambda d_: d_.__setitem__('tags', ['t0', 't1']),
+                    lambda d_, d=d: d_['tags'].append(f'tag{d}'),
+                ]))
+            per_doc.append(changes)
+            wants.append(_via_oracle(changes))
+        store = general.init_store(4)
+        patch = general.apply_general_block(
+            store, store.encode_changes(per_doc))
+        for d in range(4):
+            got = _mat_doc(_apply_diff_lists([patch.diffs(d)]))
+            assert got == wants[d], f'doc {d}'
+
+    def test_unknown_object_buffers_until_creation_arrives(self):
+        changes = _frontend_history(
+            ('author', [], [lambda d: d.__setitem__('text', Text()),
+                            lambda d: d['text'].insert_at(0, 'h')]))
+        store = general.init_store(1)
+        # deliver the text edit BEFORE the creation: buffered
+        later, first = changes[1:], changes[:1]
+        p1 = general.apply_general_block(
+            store, store.encode_changes([later]))
+        assert p1.diffs(0) == []
+        assert store.get_missing_deps() == {'author': 1}
+        p2 = general.apply_general_block(
+            store, store.encode_changes([first]))
+        assert store.queue == []
+        want = _via_oracle(changes)
+        assert _mat_doc(_apply_diff_lists([p2.diffs(0)])) == want
+
+    def test_self_conflict_and_dup_verification_inherited(self):
+        ch = {'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 2}]}
+        want = _via_oracle([ch])
+        assert _via_general([ch]) == want
+        store = general.init_store(1)
+        general.apply_general_block(store, store.encode_changes([[ch]]))
+        bad = dict(ch, ops=[{'action': 'set', 'obj': ROOT_ID,
+                             'key': 'k', 'value': 9}])
+        with pytest.raises(ValueError, match='Inconsistent reuse'):
+            general.apply_general_block(store,
+                                        store.encode_changes([[bad]]))
+
+    def test_get_missing_changes_roundtrip(self):
+        changes = _frontend_history(
+            ('author', [], [lambda d: d.update({'a': 1}),
+                            lambda d: d.__setitem__('l', [1, 2])]))
+        store = general.init_store(1)
+        general.apply_general_block(store, store.encode_changes([changes]))
+        shipped = store.get_missing_changes(0, {})
+        st, _ = Backend.apply_changes(Backend.init(), shipped)
+        assert _mat_doc(_apply_diff_lists(
+            [Backend.get_patch(st)['diffs']])) == _via_oracle(changes)
+
+    @pytest.mark.parametrize('seed', range(4))
+    def test_fuzz_flat_maps_match_flat_engine(self, seed):
+        """On flat root-map histories the general engine must agree with
+        the flat block engine (and hence the oracle)."""
+        from tests.test_cross_engine import (_gen_causal_history,
+                                             _via_oracle as flat_oracle)
+        rng = random.Random(9000 + seed)
+        changes = _gen_causal_history(rng, n_actors=3, n_changes=16,
+                                      n_keys=5, dup_key_p=0.2)
+        want = flat_oracle(changes)
+        store = general.init_store(1)
+        diff_lists = []
+        for i in range(0, len(changes), 5):
+            diff_lists.append(general.apply_general_block(
+                store, store.encode_changes([changes[i:i + 5]])).diffs(0))
+        doc = _apply_diff_lists(diff_lists)
+        got = ({k: v for k, v in doc.items()}, dict(doc._conflicts))
+        assert got == want
+
+
+class TestGeneralScope:
+    def test_flat_paths_reject_general_blocks(self):
+        changes = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('l', [1])]))
+        store = general.init_store(1)
+        block = store.encode_changes([changes])
+        with pytest.raises(ValueError, match='general'):
+            blocks.apply_block(blocks.init_store(1), block)
+        from automerge_tpu.device.dense_store import DenseMapStore
+        with pytest.raises(ValueError, match='general'):
+            DenseMapStore(1, key_capacity=8,
+                          actor_capacity=4).apply_block(block)
+
+    def test_insertion_after_unknown_element(self):
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text())]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+        bad = [{'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'ghost:9', 'elem': 1}]}]
+        with pytest.raises(ValueError, match='unknown element'):
+            general.apply_general_block(store,
+                                        store.encode_changes([bad]))
+
+    def test_duplicate_element_id(self):
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text()),
+                       lambda d: d['t'].insert_at(0, 'x')]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+        dup = [{'actor': 'b', 'seq': 1, 'deps': {'a': 2}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+        ]}]
+        # b minting a:1's counter is fine; b reusing ITS OWN b:1 twice
+        # within a block is the duplicate
+        dup2 = [{'actor': 'c', 'seq': 1, 'deps': {'a': 2}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1}]}]
+        with pytest.raises(ValueError, match='Duplicate list element'):
+            general.apply_general_block(store,
+                                        store.encode_changes([dup2]))
