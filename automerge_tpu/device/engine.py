@@ -30,21 +30,28 @@ def as_options(options=None, kernel=None):
     return options
 
 
-def _pallas_fits(n_ops, n_actors):
-    """Whether the Pallas kernel's per-block working set fits VMEM.
+def _pallas_wins(n_docs, n_ops, n_actors):
+    """Whether the Pallas kernel should run for this shape.
 
-    The kernel keeps one DOC_BLOCK of every plane resident — 5 int32
-    inputs + 3 outputs + 1 scratch + the [.., n_actors] clock, i.e.
-    DOC_BLOCK * n_pad * (9 + n_actors) * 4 bytes — and unrolls
-    ~3 * n_tiles^2 tile-pair bodies; past these bounds Mosaic either
-    fails allocation or compiles pathologically, while the XLA path
-    handles the same shapes fine.
+    Feasibility: the kernel keeps one DOC_BLOCK of every plane resident
+    — 5 int32 inputs + 3 outputs + 1 scratch + the [.., n_actors]
+    clock, i.e. DOC_BLOCK * n_pad * (9 + n_actors) * 4 bytes — and
+    unrolls ~3 * n_tiles^2 tile-pair bodies; past those bounds Mosaic
+    either fails allocation or compiles pathologically.
+
+    Profitability (measured on v5e, amortized per-dispatch, r3):
+    pallas wins on LARGE doc batches with few op tiles — 2.26x at
+    [10240 x 128 x 8] (46.5 vs 105.2 ms, 28M vs 12M ops/s), 1.5x at
+    [1024 x 128 x 8] — and loses ~0.85x once the tile-pair unroll grows
+    ([256 x 512 x 16], [8 x 1024 x 8]) or the doc grid is too small to
+    fill the chip. Hence: tiles <= 2 AND docs >= 256.
     """
     from . import pallas_merge as pm
     n_pad = pm._round_up(max(n_ops, pm.OPS_TILE), pm.OPS_TILE)
     vmem_bytes = pm.DOC_BLOCK * n_pad * (9 + n_actors) * 4
     n_tiles = n_pad // pm.OPS_TILE
-    return vmem_bytes <= 8 * 1024 * 1024 and n_tiles <= 8
+    return (vmem_bytes <= 8 * 1024 * 1024 and n_tiles <= 2
+            and n_docs >= 256)
 
 
 def pick_resolve_kernel(kernel='auto'):
@@ -53,17 +60,17 @@ def pick_resolve_kernel(kernel='auto'):
     'xla'    — segment-reduction path (merge.py), runs everywhere.
     'pallas' — hand-scheduled VMEM-resident kernel (pallas_merge.py);
                requires a TPU backend (Mosaic).
-    'auto'   — on TPU, pallas when the block working set fits VMEM
-               (checked per call against the input shapes), xla
-               otherwise and on non-TPU backends.
-
+    'auto'   — on TPU, pallas for the shapes where the measured A/B
+               says it wins (large doc batches, few op tiles — see
+               `_pallas_wins`), xla otherwise and on non-TPU backends.
     """
     if kernel == 'auto':
         if jax.default_backend() != 'tpu':
             return merge_kernel.resolve_assignments_batch
 
         def dispatch(seg_id, actor, seq, clock, is_del, valid, *, num_segments):
-            if _pallas_fits(seg_id.shape[1], clock.shape[2]):
+            if _pallas_wins(seg_id.shape[0], seg_id.shape[1],
+                            clock.shape[2]):
                 from . import pallas_merge
                 fn = pallas_merge.resolve_assignments_batch_pallas
             else:

@@ -9,7 +9,9 @@ TPU path for whole-DocSet merges lives in
 
 from .doc_set import DocSet
 from .device_doc_set import DeviceDocSet
+from .dense_doc_set import DenseDocSet
 from .watchable_doc import WatchableDoc
-from .connection import Connection
+from .connection import Connection, BatchingConnection
 
-__all__ = ['DocSet', 'DeviceDocSet', 'WatchableDoc', 'Connection']
+__all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'WatchableDoc',
+           'Connection', 'BatchingConnection']

@@ -68,14 +68,22 @@ class Connection:
         self._send_msg(msg)
 
     def maybe_send_changes(self, doc_id):
-        """(connection.js:58-73)"""
+        """(connection.js:58-73). Extension over the reference: when the
+        peer is behind a snapshot-truncated log (get_missing_changes
+        raises — the change bodies it needs were dropped by a packed
+        resume), the full packed snapshot ships instead, and the normal
+        protocol resumes from there."""
         doc = self._doc_set.get_doc(doc_id)
         state = Frontend.get_backend_state(doc)
         clock = state.clock
 
         if doc_id in self._their_clock:
-            changes = _backend_of(doc).get_missing_changes(
-                state, self._their_clock[doc_id])
+            try:
+                changes = _backend_of(doc).get_missing_changes(
+                    state, self._their_clock[doc_id])
+            except ValueError as err:
+                self._send_snapshot(doc_id, doc, clock, err)
+                return
             if changes:
                 self._their_clock = clock_union(self._their_clock, doc_id, clock)
                 self.send_msg(doc_id, clock, changes)
@@ -83,6 +91,21 @@ class Connection:
 
         if clock != self._our_clock.get(doc_id, {}):
             self.send_msg(doc_id, clock)
+
+    def _send_snapshot(self, doc_id, doc, clock, original_err):
+        """Serve a too-far-behind peer the packed state itself. Only
+        device-backend documents carry a servable packed snapshot; for
+        other backends the original (clear) error propagates."""
+        from .. import snapshot as _snapshot
+        try:
+            payload = _snapshot.save_snapshot(doc)
+        except TypeError:
+            raise original_err
+        clock_union(self._their_clock, doc_id, clock)
+        clock_union(self._our_clock, doc_id, clock)
+        metrics.bump('sync_snapshots_sent')
+        self._send_msg({'docId': doc_id, 'clock': dict(clock),
+                        'snapshot': payload})
 
     def doc_changed(self, doc_id, doc):
         """DocSet handler (connection.js:76-89)."""
@@ -103,6 +126,8 @@ class Connection:
                          changes=len(msg.get('changes') or ()))
         if 'clock' in msg and msg['clock'] is not None:
             self._their_clock = clock_union(self._their_clock, msg['docId'], msg['clock'])
+        if 'snapshot' in msg:
+            return self._receive_snapshot(msg)
         if 'changes' in msg and msg['changes'] is not None:
             return self._doc_set.apply_changes(msg['docId'], msg['changes'])
 
@@ -114,6 +139,39 @@ class Connection:
             self.send_msg(msg['docId'], {})
 
         return self._doc_set.get_doc(msg['docId'])
+
+    def _receive_snapshot(self, msg):
+        """Resume from a served snapshot, then replay any LOCAL changes
+        the snapshot does not cover (concurrent edits survive the
+        resync; the peer gets them through the normal protocol)."""
+        from .. import snapshot as _snapshot
+        doc_id = msg['docId']
+        metrics.bump('sync_snapshots_received')
+        old_doc = self._doc_set.get_doc(doc_id)
+        actor_id = Frontend.get_actor_id(old_doc) if old_doc is not None \
+            else None
+        new_doc = _snapshot.load_snapshot(msg['snapshot'],
+                                          actor_id=actor_id)
+        if old_doc is not None:
+            old_state = Frontend.get_backend_state(old_doc)
+            new_state = Frontend.get_backend_state(new_doc)
+            try:
+                local_only = _backend_of(old_doc).get_missing_changes(
+                    old_state, new_state.clock)
+            except ValueError:
+                raise ValueError(
+                    'both replicas hold snapshot-truncated histories '
+                    'that diverged before their resume points; they '
+                    'cannot merge losslessly — resync one side from a '
+                    'full change log or a common snapshot') from None
+            if local_only:
+                from ..device import backend as DeviceBackend
+                new_state, patch = DeviceBackend.apply_changes(
+                    new_state, local_only)
+                patch['state'] = new_state
+                new_doc = Frontend.apply_patch(new_doc, patch)
+        self._doc_set.set_doc(doc_id, new_doc)
+        return new_doc
 
     # camelCase aliases (reference API parity)
     sendMsg = send_msg

@@ -38,8 +38,11 @@ class DocSet:
         doc = self.docs.get(doc_id)
         if doc is None:
             doc = Frontend.init({'backend': Backend})
+        # dispatch on the document's own backend: a device-backed doc
+        # (e.g. loaded from a packed snapshot) stays device-backed
+        backend = doc._options.get('backend') or Backend
         old_state = Frontend.get_backend_state(doc)
-        new_state, patch = Backend.apply_changes(old_state, changes)
+        new_state, patch = backend.apply_changes(old_state, changes)
         patch['state'] = new_state
         doc = Frontend.apply_patch(doc, patch)
         self.set_doc(doc_id, doc)

@@ -154,6 +154,35 @@ def bench_kernel(jnp, resolve_batch, n_docs=10240, n_ops=128, iters=30):
     return total_ops, float(np.median(times)), float(np.quantile(times, 0.99))
 
 
+def bench_pallas_ab(jnp, n_docs=10240, n_ops=128, k=30, reps=3):
+    """Amortized per-dispatch A/B of the two resolve kernels at the
+    DocSet flagship shape — k back-to-back dispatches, one sync, so the
+    link floor divides out. This is the data behind the auto-dispatch
+    rule (engine._pallas_wins)."""
+    import jax
+    from automerge_tpu.device.merge import resolve_assignments_batch
+    from automerge_tpu.device.pallas_merge import (
+        resolve_assignments_batch_pallas)
+    args = tuple(jax.device_put(jnp.asarray(a)) for a in
+                 gen_docset_workload(n_docs=n_docs, n_ops=n_ops,
+                                     cross_clock=True))
+
+    def run(fn):
+        out = fn(*args, num_segments=n_ops)
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(k):
+                out = fn(*args, num_segments=n_ops)
+            _ = jax.device_get(out['winner'][:1, :4])
+            times.append((time.perf_counter() - t0) / k)
+        return float(np.median(times))
+
+    return run(resolve_assignments_batch), \
+        run(resolve_assignments_batch_pallas)
+
+
 def bench_card_list(iters=20):
     """Config 1: the README card-list example — 2 actors, map+list ops,
     merge via the public API (host frontend + oracle backend)."""
@@ -486,6 +515,14 @@ def main():
         f'{k_med * 1e3:.2f} ms (p99 {k_p99 * 1e3:.2f} ms, ~'
         f'{t_floor * 1e3:.0f} ms of it link floor) -> '
         f'{k_ops / k_med / 1e6:.1f}M ops/s')
+
+    if jax.default_backend() == 'tpu':
+        t_xla, t_pal = bench_pallas_ab(jnp)
+        log(f'resolve-kernel[pallas vs xla, amortized 10240x128x8]: '
+            f'xla {t_xla * 1e3:.1f} ms, pallas {t_pal * 1e3:.1f} ms -> '
+            f'{"pallas" if t_pal < t_xla else "xla"} '
+            f'{max(t_xla, t_pal) / min(t_xla, t_pal):.2f}x '
+            f'(auto-dispatch backed by this A/B)')
 
     t_card = bench_card_list()
     log(f'card-list-merge[config 1]: {t_card * 1e3:.2f} ms per 3-way merge')

@@ -242,3 +242,109 @@ class TestConnection:
 def self_assert(condition):
     assert condition
     return True
+
+
+class TestSnapshotServing:
+    """Extension over the reference: a peer too far behind a
+    snapshot-truncated log receives the packed snapshot + tail instead
+    of an exception (SURVEY §5 checkpoint/resume meets the sync layer)."""
+
+    def _truncated_doc(self):
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu import snapshot
+        from automerge_tpu.device import backend as DeviceBackend
+        doc = Frontend.init({'backend': DeviceBackend,
+                             'actorId': 'history-holder'})
+        for i in range(5):
+            doc, _ = Frontend.change(doc,
+                                     lambda d, i=i: d.__setitem__(f'k{i}', i))
+        # packed resume: change bodies before this point are gone
+        doc = snapshot.load_snapshot(snapshot.save_snapshot(doc),
+                                     actor_id='history-holder')
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('tail', 'T'))
+        return doc
+
+    def test_lagging_peer_resumes_from_snapshot(self, nodes):
+        doc = self._truncated_doc()
+        nodes[0].set_doc('docA', doc)
+        h = Harness(nodes, [(0, 1)])
+        h.expect(0, 1, deliver=True)              # advertisement
+        # peer requests with empty clock -> log is truncated -> snapshot
+        h.expect(1, 0, deliver=True,
+                 match=lambda m: self_assert(m.get('changes') is None))
+        msg = h.expect(0, 1, deliver=True,
+                       match=lambda m: self_assert('snapshot' in m))
+        got = nodes[1].get_doc('docA')
+        assert dict(got.items()) == dict(doc.items())
+        assert got['tail'] == 'T' and got['k4'] == 4
+        # protocol resumes normally: peer acks its new clock
+        h.expect(1, 0, deliver=True)
+        h.check_no_unexpected_messages()
+
+    def test_concurrent_local_changes_survive_snapshot_resync(self, nodes):
+        from automerge_tpu import frontend as Frontend
+        doc = self._truncated_doc()
+        nodes[0].set_doc('docA', doc)
+        # peer holds a divergent copy with its OWN concurrent change but
+        # a clock that predates the snapshot point
+        peer_doc = Automerge.change(Automerge.init('peer-actor'),
+                                    lambda d: d.__setitem__('mine', 1))
+        nodes[1].set_doc('docA', peer_doc)
+        h = Harness(nodes, [(0, 1)])
+        h.expect(0, 1, deliver=True)              # 0 advertises
+        # 1 ships its own change AND its (stale) clock
+        h.expect(1, 0, deliver=True)
+        # 0 cannot serve 1's gap from the log -> snapshot
+        h.expect(0, 1, deliver=True,
+                 match=lambda m: self_assert('snapshot' in m))
+        got = nodes[1].get_doc('docA')
+        assert got['tail'] == 'T' and got['mine'] == 1   # both survive
+        for step in range(4):                     # settle remaining acks
+            moved = False
+            for (a, b), spy in h.spies.items():
+                while spy.call_count > h.count[(a, b)]:
+                    h.expect(a, b, deliver=True)
+                    moved = True
+            if not moved:
+                break
+        assert dict(nodes[0].get_doc('docA').items()) == \
+            dict(nodes[1].get_doc('docA').items())
+
+    def test_snapshot_resync_preserves_actor_identity(self, nodes):
+        from automerge_tpu import frontend as Frontend
+        doc = self._truncated_doc()
+        nodes[0].set_doc('docA', doc)
+        peer_doc = Automerge.change(Automerge.init('stable-actor'),
+                                    lambda d: d.__setitem__('mine', 1))
+        nodes[1].set_doc('docA', peer_doc)
+        h = Harness(nodes, [(0, 1)])
+        h.expect(0, 1, deliver=True)
+        h.expect(1, 0, deliver=True)
+        h.expect(0, 1, deliver=True,
+                 match=lambda m: self_assert('snapshot' in m))
+        assert Frontend.get_actor_id(nodes[1].get_doc('docA')) == \
+            'stable-actor'
+
+    def test_divergent_truncated_replicas_raise_clearly(self, nodes):
+        import pytest as _pytest
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu import snapshot
+        from automerge_tpu.device import backend as DeviceBackend
+
+        def truncated(actor):
+            d = Frontend.init({'backend': DeviceBackend, 'actorId': actor})
+            for i in range(3):
+                d, _ = Frontend.change(d, lambda x, i=i:
+                                       x.__setitem__(f'{actor}{i}', i))
+            return snapshot.load_snapshot(snapshot.save_snapshot(d),
+                                          actor_id=actor)
+
+        nodes[0].set_doc('docA', truncated('aaa'))
+        nodes[1].set_doc('docA', truncated('zzz'))
+        h = Harness(nodes, [(0, 1)])
+        h.expect(0, 1, deliver=True)
+        with _pytest.raises(ValueError, match='cannot merge losslessly'):
+            # 1 advertises; 0 snapshots; 1 cannot reconcile its own
+            # pre-resume history against it
+            h.expect(1, 0, deliver=True)
+            h.expect(0, 1, deliver=True)
