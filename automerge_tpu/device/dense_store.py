@@ -123,6 +123,28 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
     return new_eseq, new_eval, new_m
 
 
+@partial(jax.jit, static_argnames=('n_fields', 'n_actors', 'seq_values',
+                                   'f_pad'))
+def _apply_extract_kernel(eseq, eval_, m, change_doc, change_actor,
+                          change_seq, coo_row, coo_col, coo_val,
+                          op_counts, op_key, op_isdel_bits, op_value,
+                          n_ops, key_capacity, v_base, rank_plane,
+                          touched_mask, *, n_fields, n_actors,
+                          seq_values, f_pad):
+    """Apply + patch extraction in ONE device program — a dense apply is
+    a single dispatch, so each apply risks one link-latency spike, not
+    two (p99 on a jittery link is dominated by per-dispatch outliers)."""
+    new_eseq, new_eval, new_m = _apply_kernel.__wrapped__(
+        eseq, eval_, m, change_doc, change_actor, change_seq, coo_row,
+        coo_col, coo_val, op_counts, op_key, op_isdel_bits, op_value,
+        n_ops, key_capacity, v_base, n_fields=n_fields,
+        n_actors=n_actors, seq_values=seq_values)
+    extracted = _extract_kernel.__wrapped__(
+        new_eseq, new_eval, new_m, rank_plane, key_capacity,
+        touched_mask, f_pad=f_pad)
+    return (new_eseq, new_eval, new_m) + extracted
+
+
 @partial(jax.jit, static_argnames=('f_pad',))
 def _extract_kernel(eseq, eval_, m, rank_plane, key_capacity,
                     touched_mask, *, f_pad):
@@ -598,7 +620,17 @@ class DenseMapStore:
             op_value_dev = jnp.asarray(op_value)
         t2 = time.perf_counter()
 
-        self.eseq, self.eval_, self.m = _apply_kernel(
+        # touched fields (host, pre-dispatch) -> ONE fused device call:
+        # apply + patch extraction
+        touched = np.zeros(self.n_fields, bool)
+        fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
+        touched[fk] = True
+        # floor the extract bucket at 4096 so sparse ticks share ONE
+        # compile of the fused kernel (f_pad is static; an unfloored
+        # pow2 would recompile per touched-count bucket)
+        f_pad = opts.pad_segments(
+            max(int(touched.sum()), min(4096, self.n_fields)))
+        out = _apply_extract_kernel(
             self.eseq, self.eval_, self.m, jnp.asarray(change_doc),
             jnp.asarray(change_actor), jnp.asarray(change_seq),
             jnp.asarray(coo_row), jnp.asarray(coo_col),
@@ -606,13 +638,11 @@ class DenseMapStore:
             jnp.asarray(op_key), jnp.asarray(np.packbits(op_isdel)),
             op_value_dev, jnp.asarray(n_ops),
             jnp.asarray(self.key_capacity), jnp.asarray(v_base),
-            n_fields=self.n_fields, n_actors=A, seq_values=seq_values)
-
-        # touched fields -> device extraction
-        touched = np.zeros(self.n_fields, bool)
-        fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
-        touched[fk] = True
-        patch = self._extract(touched)
+            self._rank_plane_dev(), jnp.asarray(touched),
+            n_fields=self.n_fields, n_actors=A, seq_values=seq_values,
+            f_pad=f_pad)
+        self.eseq, self.eval_, self.m = out[:3]
+        patch = DensePatch(self, *out[3:])
         t3 = time.perf_counter()
 
         metrics.bump('dense_batches')

@@ -1042,7 +1042,7 @@ def apply_general_block(store, block, options=None, return_timing=False):
     coo_val = np.concatenate(
         [coo_val, np.zeros(nnz_pad - len(coo_val), np.int32)])
 
-    # ---- sequence job planes ----
+    # ---- sequence job planes (one scatter per plane, not per object) ----
     K = max(len(dirty), 1)
     m_pad = opts.pad_nodes(max(max((store.seqs[r].n_nodes
                                     for r in dirty), default=1), 8))
@@ -1053,21 +1053,30 @@ def apply_general_block(store, block, options=None, return_timing=False):
     str_rank = store.actor_str_ranks()
     prev_vis_index = {}
     dirty_n = []
-    for ji, obj_row in enumerate(dirty):
-        seq_state = store.seqs[obj_row]
-        seq_state.sync()
-        n = seq_state.n_nodes
-        dirty_n.append(n)
-        s_parent[ji, :n] = seq_state.parent
-        s_elem[ji, :n] = seq_state.elemc
+    if dirty:
+        states = []
+        for obj_row in dirty:
+            seq_state = store.seqs[obj_row]
+            seq_state.sync()
+            states.append(seq_state)
+            dirty_n.append(seq_state.n_nodes)
+            prev_vis_index[obj_row] = seq_state.vis_index.copy()
+        n_j = np.asarray(dirty_n, np.int64)
+        flat = _span_indices(np.arange(len(dirty), dtype=np.int64)
+                             * m_pad, n_j)
+        cat_actor = np.concatenate([s.actor for s in states])
+        s_parent.reshape(-1)[flat] = np.concatenate(
+            [s.parent for s in states])
+        s_elem.reshape(-1)[flat] = np.concatenate(
+            [s.elemc for s in states])
         # rank by actor string order (op_set.js:371-377); head actor -1
-        ranks = np.zeros(n, np.int64)
-        real = seq_state.actor >= 0
-        ranks[real] = str_rank[seq_state.actor[real]]
-        s_actor_rank[ji, :n] = ranks
-        s_prior_vis[ji, :n] = seq_state.visible
-        s_valid[ji, :n] = True
-        prev_vis_index[obj_row] = seq_state.vis_index.copy()
+        ranks = np.zeros(len(cat_actor), np.int64)
+        real = cat_actor >= 0
+        ranks[real] = str_rank[cat_actor[real]]
+        s_actor_rank.reshape(-1)[flat] = ranks
+        s_prior_vis.reshape(-1)[flat] = np.concatenate(
+            [s.visible for s in states])
+        s_valid.reshape(-1)[flat] = True
 
     # per-row (job, node) slots
     row_slot = np.full(n_pad, -1, np.int64)

@@ -13,15 +13,9 @@ replicas sharing a mesh sync by collective instead of message
 """
 
 from .. import frontend as Frontend
-from .. import backend as Backend
 from ..common import less_or_equal
 from ..utils.metrics import metrics
-
-
-def _backend_of(doc):
-    """The backend module a document was initialized with (oracle or
-    device — both expose the same change/patch protocol surface)."""
-    return doc._options.get('backend') or Backend
+from .doc_set import backend_of as _backend_of
 
 
 def clock_union(clock_map, doc_id, clock):
@@ -104,6 +98,10 @@ class Connection:
         clock_union(self._their_clock, doc_id, clock)
         clock_union(self._our_clock, doc_id, clock)
         metrics.bump('sync_snapshots_sent')
+        metrics.bump('sync_msgs_sent')
+        if metrics.active:
+            metrics.emit('sync_send', doc_id=doc_id, changes=0,
+                         snapshot=True)
         self._send_msg({'docId': doc_id, 'clock': dict(clock),
                         'snapshot': payload})
 

@@ -10,6 +10,12 @@ from .. import frontend as Frontend
 from .. import backend as Backend
 
 
+def backend_of(doc):
+    """The backend module a document was initialized with (oracle or
+    device — both expose the same change/patch protocol surface)."""
+    return doc._options.get('backend') or Backend
+
+
 class DocSet:
     def __init__(self):
         self.docs = {}
@@ -40,7 +46,7 @@ class DocSet:
             doc = Frontend.init({'backend': Backend})
         # dispatch on the document's own backend: a device-backed doc
         # (e.g. loaded from a packed snapshot) stays device-backed
-        backend = doc._options.get('backend') or Backend
+        backend = backend_of(doc)
         old_state = Frontend.get_backend_state(doc)
         new_state, patch = backend.apply_changes(old_state, changes)
         patch['state'] = new_state
