@@ -59,25 +59,32 @@ _p8 = ctypes.POINTER(ctypes.c_int8)
 def _bind(lib):
     lib.amwc_parse.argtypes = [ctypes.c_char_p, _i64]
     lib.amwc_parse.restype = ctypes.c_void_p
+    lib.amwc_parse_general.argtypes = [ctypes.c_char_p, _i64,
+                                       ctypes.c_char_p, _p64, _p32, _p8,
+                                       _i64]
+    lib.amwc_parse_general.restype = ctypes.c_void_p
     lib.amwc_error.argtypes = [ctypes.c_void_p]
     lib.amwc_error.restype = ctypes.c_char_p
     for name in ('amwc_n_docs', 'amwc_n_changes', 'amwc_n_ops',
                  'amwc_n_deps', 'amwc_n_values', 'amwc_n_actors',
                  'amwc_actors_bytes', 'amwc_n_keys', 'amwc_keys_bytes',
-                 'amwc_dup_keys'):
+                 'amwc_dup_keys', 'amwc_n_objs', 'amwc_objs_bytes'):
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_void_p]
         fn.restype = _i64
-    lib.amwc_fill_actors.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _p64]
-    lib.amwc_fill_actors.restype = None
-    lib.amwc_fill_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _p64]
-    lib.amwc_fill_keys.restype = None
+    for name in ('amwc_fill_actors', 'amwc_fill_keys', 'amwc_fill_objs'):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _p64]
+        fn.restype = None
     lib.amwc_fill_changes.argtypes = [ctypes.c_void_p] + [_p32] * 5
     lib.amwc_fill_changes.restype = None
     lib.amwc_fill_deps.argtypes = [ctypes.c_void_p, _p32, _p32]
     lib.amwc_fill_deps.restype = None
     lib.amwc_fill_ops.argtypes = [ctypes.c_void_p, _p8, _p32, _p32]
     lib.amwc_fill_ops.restype = None
+    lib.amwc_fill_ops_general.argtypes = [ctypes.c_void_p, _p32, _p8,
+                                          _p32, _p32]
+    lib.amwc_fill_ops_general.restype = None
     lib.amwc_fill_value_spans.argtypes = [ctypes.c_void_p, _p64, _p64]
     lib.amwc_fill_value_spans.restype = None
     lib.amwc_free.argtypes = [ctypes.c_void_p]
@@ -175,6 +182,61 @@ def _table(lib, h, n_fn, bytes_fn, fill_fn):
             for i in range(n)]
 
 
+def _extract_block(lib, h, data, general):
+    err = lib.amwc_error(h)
+    if err:
+        raise ValueError('wire parse failed: ' + err.decode('utf-8'))
+    n_docs = int(lib.amwc_n_docs(h))
+    dup_keys = bool(lib.amwc_dup_keys(h))
+    c = int(lib.amwc_n_changes(h))
+    n_ops = int(lib.amwc_n_ops(h))
+    n_deps = int(lib.amwc_n_deps(h))
+    n_vals = int(lib.amwc_n_values(h))
+
+    doc = np.empty(c, np.int32)
+    actor = np.empty(c, np.int32)
+    seq = np.empty(c, np.int32)
+    dep_ptr = np.empty(c + 1, np.int32)
+    op_ptr = np.empty(c + 1, np.int32)
+    lib.amwc_fill_changes(h, _ptr32(doc), _ptr32(actor), _ptr32(seq),
+                          _ptr32(dep_ptr), _ptr32(op_ptr))
+    dep_actor = np.empty(n_deps, np.int32)
+    dep_seq = np.empty(n_deps, np.int32)
+    lib.amwc_fill_deps(h, _ptr32(dep_actor), _ptr32(dep_seq))
+    action = np.empty(n_ops, np.int8)
+    key = np.empty(n_ops, np.int32)
+    value = np.empty(n_ops, np.int32)
+    lib.amwc_fill_ops(h, action.ctypes.data_as(_p8), _ptr32(key),
+                      _ptr32(value))
+    starts = np.empty(n_vals, np.int64)
+    ends = np.empty(n_vals, np.int64)
+    lib.amwc_fill_value_spans(h, starts.ctypes.data_as(_p64),
+                              ends.ctypes.data_as(_p64))
+
+    actors = _table(lib, h, lib.amwc_n_actors, lib.amwc_actors_bytes,
+                    lib.amwc_fill_actors)
+    keys = _table(lib, h, lib.amwc_n_keys, lib.amwc_keys_bytes,
+                  lib.amwc_fill_keys)
+    extra = {}
+    if general:
+        obj = np.empty(n_ops, np.int32)
+        key_kind = np.empty(n_ops, np.int8)
+        key_elem = np.empty(n_ops, np.int32)
+        elem = np.empty(n_ops, np.int32)
+        lib.amwc_fill_ops_general(h, _ptr32(obj),
+                                  key_kind.ctypes.data_as(_p8),
+                                  _ptr32(key_elem), _ptr32(elem))
+        extra = {'obj': obj, 'key_kind': key_kind, 'key_elem': key_elem,
+                 'elem': elem,
+                 'objs': _table(lib, h, lib.amwc_n_objs,
+                                lib.amwc_objs_bytes, lib.amwc_fill_objs)}
+
+    values = LazyValues(data, starts, ends)
+    return ChangeBlock(n_docs, doc, actor, seq, dep_ptr, dep_actor,
+                       dep_seq, op_ptr, action, key, value, actors, keys,
+                       values, dup_keys=dup_keys, **extra)
+
+
 def parse_change_block(data):
     """Parse the JSON text of per-document change lists into a
     :class:`~automerge_tpu.device.blocks.ChangeBlock` (native when the
@@ -189,47 +251,54 @@ def parse_change_block(data):
     if not h:
         raise MemoryError('wire codec allocation failed')
     try:
-        err = lib.amwc_error(h)
-        if err:
-            raise ValueError('wire parse failed: ' + err.decode('utf-8'))
-        n_docs = int(lib.amwc_n_docs(h))
-        dup_keys = bool(lib.amwc_dup_keys(h))
-        c = int(lib.amwc_n_changes(h))
-        n_ops = int(lib.amwc_n_ops(h))
-        n_deps = int(lib.amwc_n_deps(h))
-        n_vals = int(lib.amwc_n_values(h))
-
-        doc = np.empty(c, np.int32)
-        actor = np.empty(c, np.int32)
-        seq = np.empty(c, np.int32)
-        dep_ptr = np.empty(c + 1, np.int32)
-        op_ptr = np.empty(c + 1, np.int32)
-        lib.amwc_fill_changes(h, _ptr32(doc), _ptr32(actor), _ptr32(seq),
-                              _ptr32(dep_ptr), _ptr32(op_ptr))
-        dep_actor = np.empty(n_deps, np.int32)
-        dep_seq = np.empty(n_deps, np.int32)
-        lib.amwc_fill_deps(h, _ptr32(dep_actor), _ptr32(dep_seq))
-        action = np.empty(n_ops, np.int8)
-        key = np.empty(n_ops, np.int32)
-        value = np.empty(n_ops, np.int32)
-        lib.amwc_fill_ops(h, action.ctypes.data_as(_p8), _ptr32(key),
-                          _ptr32(value))
-        starts = np.empty(n_vals, np.int64)
-        ends = np.empty(n_vals, np.int64)
-        lib.amwc_fill_value_spans(h, starts.ctypes.data_as(_p64),
-                                  ends.ctypes.data_as(_p64))
-
-        actors = _table(lib, h, lib.amwc_n_actors, lib.amwc_actors_bytes,
-                        lib.amwc_fill_actors)
-        keys = _table(lib, h, lib.amwc_n_keys, lib.amwc_keys_bytes,
-                      lib.amwc_fill_keys)
+        return _extract_block(lib, h, data, general=False)
     finally:
         lib.amwc_free(h)
 
-    values = LazyValues(data, starts, ends)
-    return ChangeBlock(n_docs, doc, actor, seq, dep_ptr, dep_actor,
-                       dep_seq, op_ptr, action, key, value, actors, keys,
-                       values, dup_keys=dup_keys)
+
+def parse_general_block(data, store=None):
+    """Parse the JSON text of per-document change lists with the FULL op
+    schema (sequences, nested objects, links) into a general
+    :class:`~automerge_tpu.device.blocks.ChangeBlock`.
+
+    Key kinds resolve against the object types of ``store`` (a
+    :class:`~automerge_tpu.device.general.GeneralStore`) plus objects
+    created within the batch — exactly `store.encode_changes`, at C
+    speed. Falls back to the Python edge when the codec is unavailable.
+    """
+    if isinstance(data, str):
+        data = data.encode('utf-8')
+    lib = _load()
+    if lib is None:
+        if store is None:
+            from .device.general import GeneralStore
+            per_doc = json.loads(data.decode('utf-8'))
+            return GeneralStore(len(per_doc)).encode_changes(per_doc)
+        return store.encode_changes(json.loads(data.decode('utf-8')))
+
+    uuids = list(store.obj_uuid) if store is not None else []
+    types = list(store.obj_type) if store is not None else []
+    docs = list(store.obj_doc) if store is not None else []
+    encoded = [u.encode('utf-8') for u in uuids]
+    blob = b''.join(encoded)
+    offsets = np.zeros(len(uuids) + 1, np.int64)
+    if encoded:
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    type_arr = np.asarray(types, np.int8) if types else \
+        np.zeros(1, np.int8)
+    doc_arr = np.asarray(docs, np.int32) if docs else np.zeros(1, np.int32)
+
+    h = lib.amwc_parse_general(
+        data, len(data), blob, offsets.ctypes.data_as(_p64),
+        doc_arr.ctypes.data_as(_p32), type_arr.ctypes.data_as(_p8),
+        len(uuids))
+    if not h:
+        raise MemoryError('wire codec allocation failed')
+    try:
+        return _extract_block(lib, h, data, general=True)
+    finally:
+        lib.amwc_free(h)
 
 
 parseChangeBlock = parse_change_block
+parseGeneralBlock = parse_general_block

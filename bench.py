@@ -479,6 +479,24 @@ def bench_trace_replay(n_ops=180000, wire_ops=60000):
         f'({n_ops} keystrokes) in {t_gen * 1e3:.0f} ms -> '
         f'{total_ops / t_gen / 1e6:.2f}M ops/s, full protocol')
 
+    # the native codec on the same trace with the GENERAL op schema
+    from automerge_tpu import wire as _wire
+    if _wire.available():
+        import json as _json
+        js = _json.dumps([trace]).encode()
+        _wire.parse_general_block(js)                 # warm lib
+        t0 = time.perf_counter()
+        _wire.parse_general_block(js)
+        t_gnat = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        general.init_store(1).encode_changes(
+            _json.loads(js.decode()))
+        t_gpy = time.perf_counter() - t0
+        log(f'wire-parse[general codec]: {len(js) >> 20} MiB trace JSON '
+            f'(ins/set/del, elemIds) — native {t_gnat * 1e3:.0f} ms '
+            f'({len(js) / t_gnat / 1e6:.0f} MB/s), python '
+            f'{t_gpy * 1e3:.0f} ms -> {t_gpy / t_gnat:.1f}x')
+
 
 def bench_general_multidoc(n_docs=2048, list_ops=64):
     """The general engine on a MULTI-document mixed workload: every doc

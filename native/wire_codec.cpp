@@ -14,6 +14,14 @@
 //               "ops": [{"action": "set"|"del", "obj": ROOT_UUID,
 //                        "key": str, "value": any-json}], ...extras ignored}
 //
+// GENERAL mode (amwc_parse_general) accepts the FULL op schema —
+// makeMap/makeList/makeText, ins (with "elem"), set/del/link on any
+// object — and resolves each key's kind (string vs structured elemId)
+// in a second pass against the object types made in the batch plus a
+// caller-supplied table of already-known objects, mirroring
+// GeneralStore.encode_changes exactly (unknown targets keep string
+// keys: the queue-retry contract).
+//
 // Build: g++ -O2 -shared -fPIC -std=c++17 wire_codec.cpp -o libamwire.so
 
 #include <algorithm>
@@ -54,7 +62,38 @@ struct Parsed {
     int64_t n_docs = 0;
     bool dup_keys = false;   // some change assigns one key more than once
     std::string error;
+
+    // general mode (full op schema): per-op object/kind columns, the
+    // object uuid table (objs[0] = ROOT), raw strings awaiting pass 2 —
+    // ALL general-mode interning happens there, change by change in the
+    // Python encoder's exact walk order (change actor, deps, then each
+    // op's strings), so the emitted tables match encode_changes
+    // byte for byte. Object types are scoped per (doc, uuid), like the
+    // store's own object table.
+    bool general = false;
+    Interner objs;
+    std::vector<int32_t> obj;
+    std::vector<int8_t> key_kind;
+    std::vector<int32_t> key_elem;
+    std::vector<int32_t> elem;
+    std::vector<std::string> raw_key;
+    std::vector<std::string> raw_obj;       // per op, pass-2 interning
+    std::vector<std::string> raw_actor;     // per change
+    std::vector<std::string> raw_dep_actor; // per dep row
+    std::unordered_map<std::string, int8_t> made;  // "doc|uuid" -> type
 };
+
+std::string doc_obj_key(int32_t doc, const std::string& uuid) {
+    return std::to_string(doc) + "|" + uuid;
+}
+
+// action codes (match automerge_tpu.device.blocks)
+constexpr int8_t kSet = 0, kDel = 1, kIns = 2, kLink = 3;
+constexpr int8_t kMakeMap = 4, kMakeList = 5, kMakeText = 6;
+// key kinds
+constexpr int8_t kKeyStr = 0, kKeyElem = 1, kKeyHead = 2, kKeyNone = 3;
+// object types
+constexpr int8_t kTypeMap = 0, kTypeList = 1, kTypeText = 2;
 
 struct Cursor {
     const char* p;
@@ -221,12 +260,12 @@ struct Cursor {
     }
 };
 
-bool parse_op(Cursor& c, Parsed& out) {
+bool parse_op(Cursor& c, Parsed& out, int32_t doc_idx) {
     if (!c.lit('{')) return false;
     std::string field, action, obj, key;
     bool have_action = false, have_obj = false, have_key = false;
-    bool have_value = false;
-    int64_t vs = -1, ve = -1;
+    bool have_value = false, have_elem = false;
+    int64_t vs = -1, ve = -1, elem_v = 0;
     if (!c.peek('}')) {
         do {
             if (!c.str(field) || !c.lit(':')) return false;
@@ -242,6 +281,9 @@ bool parse_op(Cursor& c, Parsed& out) {
             } else if (field == "value") {
                 if (!c.skip_value(vs, ve)) return false;
                 have_value = true;
+            } else if (out.general && field == "elem") {
+                if (!c.integer(elem_v)) return false;
+                have_elem = true;
             } else {
                 int64_t s_, e_;
                 if (!c.skip_value(s_, e_)) return false;
@@ -250,27 +292,67 @@ bool parse_op(Cursor& c, Parsed& out) {
     }
     if (!c.lit('}')) return false;
 
-    if (!have_action || !have_obj || !have_key)
-        return c.fail("op requires action/obj/key");
-    if (obj != kRootId)
-        return c.fail("block path supports root-map fields only");
-    int8_t code;
-    if (action == "set") code = 0;
-    else if (action == "del") code = 1;
-    else return c.fail("block path supports set/del ops only, got '"
-                       + action + "'");
+    if (!have_action || !have_obj)
+        return c.fail("op requires action/obj");
 
-    out.action.push_back(code);
-    out.key.push_back(out.keys.intern(std::move(key)));
-    if (code == 0) {
-        // a set without "value" carries null (the dict edge's
-        // op.get('value')); a negative span start marks it
-        out.value.push_back(static_cast<int32_t>(out.vstart.size()));
-        out.vstart.push_back(have_value ? vs : -1);
-        out.vend.push_back(have_value ? ve : -1);
-    } else {
-        out.value.push_back(-1);
+    int8_t code;
+    if (action == "set") code = kSet;
+    else if (action == "del") code = kDel;
+    else if (out.general && action == "ins") code = kIns;
+    else if (out.general && action == "link") code = kLink;
+    else if (out.general && action == "makeMap") code = kMakeMap;
+    else if (out.general && action == "makeList") code = kMakeList;
+    else if (out.general && action == "makeText") code = kMakeText;
+    else if (out.general)
+        return c.fail("unknown op action '" + action + "'");
+    else
+        return c.fail("block path supports set/del ops only, got '"
+                      + action + "'");
+
+    auto push_value = [&](bool carries) {
+        if (carries) {
+            // a set/link without "value" carries null (the dict edge's
+            // op.get('value')); a negative span start marks it
+            out.value.push_back(static_cast<int32_t>(out.vstart.size()));
+            out.vstart.push_back(have_value ? vs : -1);
+            out.vend.push_back(have_value ? ve : -1);
+        } else {
+            out.value.push_back(-1);
+        }
+    };
+
+    if (!out.general) {
+        if (!have_key) return c.fail("op requires action/obj/key");
+        if (obj != kRootId)
+            return c.fail("block path supports root-map fields only");
+        out.action.push_back(code);
+        out.key.push_back(out.keys.intern(std::move(key)));
+        push_value(code == kSet);
+        return true;
     }
+
+    // general mode: strings stay raw; interning and key kinds resolve
+    // in pass 2 (walk order must match the Python encoder exactly)
+    if (code >= kMakeMap) {
+        auto& type = out.made[doc_obj_key(doc_idx, obj)];
+        type = static_cast<int8_t>(code - kMakeMap);
+    } else if (!have_key) {
+        return c.fail("op requires a key");
+    }
+    if (code == kIns && !have_elem)
+        return c.fail("ins op requires elem");
+    out.action.push_back(code);
+    out.obj.push_back(-1);
+    out.key.push_back(-1);
+    out.key_kind.push_back(kKeyNone);
+    out.key_elem.push_back(0);
+    // a stray "elem" member on non-ins ops is an ignored extra, like
+    // every other unknown field (the Python encoder writes 0 there)
+    out.elem.push_back(code == kIns ? static_cast<int32_t>(elem_v) : 0);
+    out.raw_obj.push_back(std::move(obj));
+    out.raw_key.push_back(code >= kMakeMap ? std::string()
+                                           : std::move(key));
+    push_value(code == kSet || code == kLink);
     return true;
 }
 
@@ -301,7 +383,13 @@ bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
                         int64_t ds;
                         if (!c.str(da) || !c.lit(':') || !c.integer(ds))
                             return false;
-                        deps_a.push_back(out.actors.intern(std::move(da)));
+                        if (out.general) {
+                            out.raw_dep_actor.push_back(std::move(da));
+                            deps_a.push_back(-1);
+                        } else {
+                            deps_a.push_back(
+                                out.actors.intern(std::move(da)));
+                        }
                         deps_s.push_back(static_cast<int32_t>(ds));
                     } while (c.peek(',') && c.lit(','));
                 }
@@ -311,13 +399,15 @@ bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
                 size_t op_start = out.action.size();
                 if (!c.peek(']')) {
                     do {
-                        if (!parse_op(c, out)) return false;
+                        if (!parse_op(c, out, doc_idx)) return false;
                     } while (c.peek(',') && c.lit(','));
                 }
                 if (!c.lit(']')) return false;
-                if (!out.dup_keys) {
+                if (!out.dup_keys && !out.general) {
                     // within-change duplicate-key detection (the flag the
-                    // Python edge computes during its walk too)
+                    // Python edge computes during its walk too; general
+                    // mode computes it in the kind-resolution pass,
+                    // where keys are no longer placeholders)
                     size_t k = out.action.size() - op_start;
                     if (k > 1) {
                         std::vector<int32_t> ks(
@@ -341,7 +431,12 @@ bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
         return c.fail("change requires actor, seq and deps");
 
     out.doc.push_back(doc_idx);
-    out.actor.push_back(out.actors.intern(std::move(actor_s)));
+    if (out.general) {
+        out.raw_actor.push_back(std::move(actor_s));
+        out.actor.push_back(-1);
+    } else {
+        out.actor.push_back(out.actors.intern(std::move(actor_s)));
+    }
     out.seq.push_back(static_cast<int32_t>(seq_v));
     for (size_t i = 0; i < deps_a.size(); i++) {
         out.dep_actor.push_back(deps_a[i]);
@@ -349,6 +444,123 @@ bool parse_change(Cursor& c, Parsed& out, int32_t doc_idx) {
     }
     out.dep_ptr.push_back(static_cast<int32_t>(out.dep_actor.size()));
     out.op_ptr.push_back(static_cast<int32_t>(out.action.size()));
+    return true;
+}
+
+bool parse_all(Cursor& c, Parsed& out) {
+    if (!c.lit('[')) return false;
+    int32_t doc_idx = 0;
+    if (!c.peek(']')) {
+        do {
+            if (!c.lit('[')) return false;
+            if (!c.peek(']')) {
+                do {
+                    if (!parse_change(c, out, doc_idx)) return false;
+                } while (c.peek(',') && c.lit(','));
+            }
+            if (!c.lit(']')) return false;
+            doc_idx++;
+        } while (c.peek(',') && c.lit(','));
+    }
+    if (!c.lit(']')) return false;
+    c.ws();
+    if (c.p != c.end) return c.fail("trailing data");
+    out.n_docs = doc_idx;
+    return true;
+}
+
+// pass 2 of general parsing: walk changes in order, interning exactly
+// as the Python encoder does (change actor, its deps, then each op's
+// strings), deciding every key's kind against the per-(doc, uuid) types
+// of objects made in the batch plus the caller-supplied known objects
+// (unknown targets keep string keys — the queue-retry contract), then
+// compute the per-change duplicate-field flag.
+bool resolve_general_kinds(
+        Parsed& out,
+        const std::unordered_map<std::string, int8_t>& known,
+        std::string& err) {
+    auto type_of = [&](int32_t doc, const std::string& uuid) -> int {
+        if (uuid == kRootId) return kTypeMap;
+        std::string k = doc_obj_key(doc, uuid);
+        auto it = out.made.find(k);
+        if (it != out.made.end()) return it->second;
+        auto kt = known.find(k);
+        if (kt != known.end()) return kt->second;
+        return -1;
+    };
+
+    for (size_t ci = 0; ci + 1 < out.op_ptr.size(); ci++) {
+        int32_t doc = out.doc[ci];
+        out.actor[ci] = out.actors.intern(std::move(out.raw_actor[ci]));
+        for (int32_t j = out.dep_ptr[ci]; j < out.dep_ptr[ci + 1]; j++)
+            out.dep_actor[j] = out.actors.intern(
+                std::move(out.raw_dep_actor[j]));
+        for (int32_t i = out.op_ptr[ci]; i < out.op_ptr[ci + 1]; i++) {
+            int8_t a = out.action[i];
+            out.obj[i] = out.objs.intern(std::string(out.raw_obj[i]));
+            if (a >= kMakeMap) continue;             // kKeyNone already
+            const std::string& key = out.raw_key[i];
+            int t = type_of(doc, out.raw_obj[i]);
+            bool as_elem = (t == kTypeList || t == kTypeText);
+            if (as_elem && key == "_head") {
+                if (a != kIns) {
+                    err = "assignment to _head";
+                    return false;
+                }
+                out.key_kind[i] = kKeyHead;
+            } else if (as_elem) {
+                auto pos = key.rfind(':');
+                if (pos == std::string::npos || pos + 1 >= key.size()) {
+                    err = "malformed element id '" + key + "'";
+                    return false;
+                }
+                int64_t ctr = 0;
+                for (size_t j = pos + 1; j < key.size(); j++) {
+                    char ch = key[j];
+                    if (ch < '0' || ch > '9') {
+                        err = "malformed element id '" + key + "'";
+                        return false;
+                    }
+                    ctr = ctr * 10 + (ch - '0');
+                    if (ctr > 0x7FFFFFFFLL) {
+                        err = "element counter out of range";
+                        return false;
+                    }
+                }
+                out.key_kind[i] = kKeyElem;
+                out.key[i] = out.actors.intern(key.substr(0, pos));
+                out.key_elem[i] = static_cast<int32_t>(ctr);
+            } else {
+                out.key_kind[i] = kKeyStr;
+                out.key[i] = out.keys.intern(std::string(key));
+            }
+        }
+    }
+
+    // duplicate-field detection per change over assignment ops (exact:
+    // (obj | kind) and (actor<<32|counter or key id) as a sorted pair)
+    std::vector<std::pair<uint64_t, uint64_t>> cells;
+    for (size_t ci = 0; ci + 1 < out.op_ptr.size() && !out.dup_keys;
+         ci++) {
+        cells.clear();
+        for (int32_t j = out.op_ptr[ci]; j < out.op_ptr[ci + 1]; j++) {
+            int8_t a = out.action[j];
+            if (a != kSet && a != kDel && a != kLink) continue;
+            uint64_t hi = (static_cast<uint64_t>(out.obj[j]) << 1)
+                        | (out.key_kind[j] == kKeyElem ? 1u : 0u);
+            uint64_t lo = out.key_kind[j] == kKeyElem
+                ? ((static_cast<uint64_t>(out.key[j]) << 32)
+                   | static_cast<uint32_t>(out.key_elem[j]))
+                : static_cast<uint64_t>(out.key[j]);
+            cells.emplace_back(hi, lo);
+        }
+        std::sort(cells.begin(), cells.end());
+        for (size_t k = 1; k < cells.size(); k++)
+            if (cells[k] == cells[k - 1]) {
+                out.dup_keys = true;
+                break;
+            }
+    }
     return true;
 }
 
@@ -360,30 +572,35 @@ void* amwc_parse(const char* buf, int64_t len) {
     auto* out = new (std::nothrow) Parsed();
     if (!out) return nullptr;
     Cursor c{buf, buf + len, buf, {}};
+    if (!parse_all(c, *out))
+        out->error = c.err.empty() ? "parse error" : c.err;
+    return out;
+}
 
-    bool ok = [&]() -> bool {
-        if (!c.lit('[')) return false;
-        int32_t doc_idx = 0;
-        if (!c.peek(']')) {
-            do {
-                if (!c.lit('[')) return false;
-                if (!c.peek(']')) {
-                    do {
-                        if (!parse_change(c, *out, doc_idx)) return false;
-                    } while (c.peek(',') && c.lit(','));
-                }
-                if (!c.lit(']')) return false;
-                doc_idx++;
-            } while (c.peek(',') && c.lit(','));
-        }
-        if (!c.lit(']')) return false;
-        c.ws();
-        if (c.p != c.end) return c.fail("trailing data");
-        out->n_docs = doc_idx;
-        return true;
-    }();
-
-    if (!ok) out->error = c.err.empty() ? "parse error" : c.err;
+void* amwc_parse_general(const char* buf, int64_t len,
+                         const char* kobj_bytes, const int64_t* kobj_off,
+                         const int32_t* kobj_docs,
+                         const int8_t* kobj_types, int64_t n_known) {
+    auto* out = new (std::nothrow) Parsed();
+    if (!out) return nullptr;
+    out->general = true;
+    out->objs.intern(std::string(kRootId));    // objs[0] = ROOT, always
+    std::unordered_map<std::string, int8_t> known;
+    known.reserve(static_cast<size_t>(n_known));
+    for (int64_t i = 0; i < n_known; i++)
+        known.emplace(
+            doc_obj_key(kobj_docs[i],
+                        std::string(kobj_bytes + kobj_off[i],
+                                    kobj_bytes + kobj_off[i + 1])),
+            kobj_types[i]);
+    Cursor c{buf, buf + len, buf, {}};
+    if (!parse_all(c, *out)) {
+        out->error = c.err.empty() ? "parse error" : c.err;
+        return out;
+    }
+    std::string err;
+    if (!resolve_general_kinds(*out, known, err))
+        out->error = err;
     return out;
 }
 
@@ -461,6 +678,24 @@ void amwc_fill_ops(void* h, int8_t* action, int32_t* key, int32_t* value) {
     std::memcpy(action, p->action.data(), p->action.size());
     std::memcpy(key, p->key.data(), p->key.size() * 4);
     std::memcpy(value, p->value.data(), p->value.size() * 4);
+}
+
+int64_t amwc_n_objs(void* h) {
+    return static_cast<Parsed*>(h)->objs.strings.size();
+}
+int64_t amwc_objs_bytes(void* h) {
+    return table_bytes(static_cast<Parsed*>(h)->objs);
+}
+void amwc_fill_objs(void* h, char* out, int64_t* offsets) {
+    fill_table(static_cast<Parsed*>(h)->objs, out, offsets);
+}
+void amwc_fill_ops_general(void* h, int32_t* obj, int8_t* key_kind,
+                           int32_t* key_elem, int32_t* elem) {
+    auto* p = static_cast<Parsed*>(h);
+    std::memcpy(obj, p->obj.data(), p->obj.size() * 4);
+    std::memcpy(key_kind, p->key_kind.data(), p->key_kind.size());
+    std::memcpy(key_elem, p->key_elem.data(), p->key_elem.size() * 4);
+    std::memcpy(elem, p->elem.data(), p->elem.size() * 4);
 }
 
 void amwc_fill_value_spans(void* h, int64_t* starts, int64_t* ends) {

@@ -193,3 +193,141 @@ class TestValueTable:
         with pytest.raises(IndexError):
             t[6]
         assert t[-1] == 'plain'
+
+
+class TestGeneralParse:
+    """Native GENERAL codec: full op schema, kinds resolved against the
+    store — differential against GeneralStore.encode_changes."""
+
+    def _rich_general(self):
+        from automerge_tpu import backend as Backend
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.text import Text
+        doc = Frontend.init({'backend': Backend})
+        doc = Frontend.set_actor_id(doc, 'author')
+        doc, _ = Frontend.change(doc, lambda d: d.update(
+            {'title': 'quote " é中', 'meta': {'v': [1, None, True]}}))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__(
+            'items', ['a', 'b']))
+        doc, _ = Frontend.change(doc, lambda d: d.__setitem__('t', Text()))
+        doc, _ = Frontend.change(doc, lambda d: d['t'].insert_at(
+            0, *'hi:x'))
+        doc, _ = Frontend.change(doc, lambda d: d['items'].__delitem__(0))
+        return Backend.get_changes_for_actor(
+            Frontend.get_backend_state(doc), 'author')
+
+    def test_matches_python_encoder_exactly(self):
+        from automerge_tpu.device import general
+        changes = self._rich_general()
+        ref = general.init_store(1).encode_changes([changes])
+        nat = wire.parse_general_block(json.dumps([changes]))
+        for f in ('doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
+                  'dep_seq', 'op_ptr', 'action', 'key', 'value', 'obj',
+                  'key_kind', 'key_elem', 'elem'):
+            np.testing.assert_array_equal(
+                getattr(nat, f), getattr(ref, f), err_msg=f)
+        assert nat.actors == ref.actors and nat.keys == ref.keys
+        assert nat.objs == ref.objs
+        assert list(nat.values) == list(ref.values)
+        assert nat.has_dup_keys() == ref.has_dup_keys() is False
+
+    def test_apply_equality_and_incremental_store_kinds(self):
+        from automerge_tpu import frontend as Frontend
+        from automerge_tpu.device import general
+        changes = self._rich_general()
+
+        def mat(gp):
+            d = Frontend.apply_patch(
+                Frontend.init('v'),
+                {'clock': {}, 'deps': {}, 'canUndo': False,
+                 'canRedo': False, 'diffs': gp.diffs(0)})
+            return ({k: (list(v) if type(v).__name__ == 'AmList' else
+                         ''.join(map(str, v))
+                         if type(v).__name__ == 'Text' else
+                         dict(v.items()) if hasattr(v, '_conflicts')
+                         else v) for k, v in d.items()})
+        s1 = general.init_store(1)
+        g1 = general.apply_general_block(
+            s1, s1.encode_changes([changes]))
+        s2 = general.init_store(1)
+        g2 = general.apply_general_block(
+            s2, wire.parse_general_block(json.dumps([changes])))
+        assert mat(g1) == mat(g2)
+
+        # incremental: later chunks resolve kinds against the STORE
+        s3 = general.init_store(1)
+        general.apply_general_block(s3, wire.parse_general_block(
+            json.dumps([changes[:3]]), store=s3))
+        blk2 = wire.parse_general_block(json.dumps([changes[3:]]),
+                                        store=s3)
+        assert 1 in set(blk2.key_kind.tolist())     # elem kinds resolved
+        g3 = general.apply_general_block(s3, blk2)
+        assert s3.queue == []
+
+    def test_dup_flag_both_edges(self):
+        from automerge_tpu.device import general
+        dup = [{'actor': 'x', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1},
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 2}]}]
+        nat = wire.parse_general_block(json.dumps([dup]))
+        ref = general.init_store(1).encode_changes([dup])
+        assert nat.has_dup_keys() is True and ref.has_dup_keys() is True
+
+    def test_general_errors(self):
+        with pytest.raises(ValueError, match='requires elem'):
+            wire.parse_general_block(
+                '[[{"actor":"a","seq":1,"deps":{},"ops":'
+                '[{"action":"ins","obj":"o1","key":"_head"}]}]]')
+        with pytest.raises(ValueError, match='unknown op action'):
+            wire.parse_general_block(
+                '[[{"actor":"a","seq":1,"deps":{},"ops":'
+                '[{"action":"zap","obj":"o1","key":"k"}]}]]')
+
+    def test_cross_doc_type_scoping_matches_python(self):
+        """Object types are per (doc, uuid): doc 1 referencing an object
+        created only in doc 0 keeps STRING keys on both edges (the
+        queue-retry contract)."""
+        from automerge_tpu.device import general
+        per_doc = [
+            [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': 'o1-uuid'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+                 'value': 'o1-uuid'}]}],
+            [{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': 'o1-uuid', 'key': 'a:1',
+                 'value': 9}]}],
+        ]
+        ref = general.init_store(2).encode_changes(per_doc)
+        nat = wire.parse_general_block(json.dumps(per_doc))
+        np.testing.assert_array_equal(nat.key_kind, ref.key_kind)
+        assert int(nat.key_kind[-1]) == 0        # STR, deferred
+
+    def test_actor_intern_order_matches_python(self):
+        """Interning follows the encoder's walk order exactly: change
+        actor, deps, then per-op elemId actors."""
+        from automerge_tpu.device import general
+        per_doc = [[
+            {'actor': 'b', 'seq': 1, 'deps': {'a': 1}, 'ops': [
+                {'action': 'makeText', 'obj': 'tt-uuid'},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 't',
+                 'value': 'tt-uuid'}]},
+            {'actor': 'b', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': 'tt-uuid', 'key': 'x:1',
+                 'value': 'c'}]},
+        ]]
+        ref = general.init_store(1).encode_changes(per_doc)
+        nat = wire.parse_general_block(json.dumps(per_doc))
+        assert nat.actors == ref.actors
+        np.testing.assert_array_equal(nat.key, ref.key)
+        np.testing.assert_array_equal(nat.actor, ref.actor)
+        np.testing.assert_array_equal(nat.dep_actor, ref.dep_actor)
+
+    def test_stray_elem_on_set_ignored(self):
+        from automerge_tpu.device import general
+        per_doc = [[{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1,
+             'elem': 5}]}]]
+        ref = general.init_store(1).encode_changes(per_doc)
+        nat = wire.parse_general_block(json.dumps(per_doc))
+        np.testing.assert_array_equal(nat.elem, ref.elem)
+        assert int(nat.elem[0]) == 0
