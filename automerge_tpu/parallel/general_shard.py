@@ -1,0 +1,80 @@
+"""Sharded sequence ordering for the general engine: dirty objects
+across the mesh.
+
+The general bulk engine's heavy device work is the per-dirty-object RGA
+ordering pass (:mod:`automerge_tpu.device.sequence` vmapped over the
+[K, m] job planes). Jobs are independent documents' insertion trees —
+embarrassingly parallel — so the job axis partitions over a device mesh
+with ``shard_map``: each chip orders its slice of the dirty objects,
+global length statistics reduce over the ICI with ``psum``, and the
+result is bit-identical to the single-chip vmap (equality-gated in the
+multichip dryrun and the virtual-mesh tests).
+
+This is the sp/dp axis for FULL documents (the flat-map engines shard in
+:mod:`.docset_engine`); a production multi-host deployment partitions
+GeneralStores per host and syncs via :mod:`automerge_tpu.sync` over DCN,
+with this module covering the chips within each host.
+"""
+
+from functools import lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..device.sequence import _rga_order
+from .mesh import DOC_AXIS, shard_docs
+
+
+def _rga_body(parent, elem, actor, visible, valid):
+    out = jax.vmap(_rga_order)(parent, elem, actor, visible, valid)
+    stats = {
+        'visible_total': jax.lax.psum(jnp.sum(out['length']), DOC_AXIS),
+        'jobs': jax.lax.psum(jnp.asarray(parent.shape[0]), DOC_AXIS),
+    }
+    return out, stats
+
+
+@lru_cache(maxsize=16)
+def _sharded_rga_fn(mesh):
+    spec = P(DOC_AXIS, None)
+    return jax.jit(shard_map(
+        _rga_body, mesh=mesh,
+        in_specs=(spec,) * 5,
+        out_specs=({'tree_pos': spec, 'vis_index': spec,
+                    'node_at_pos': spec, 'length': P(DOC_AXIS)},
+                   {'visible_total': P(), 'jobs': P()})))
+
+
+def sharded_rga_jobs(mesh, parent, elem, actor, visible, valid):
+    """Order a batch of insertion trees with the job axis sharded over
+    `mesh`. Pads the job axis to the mesh size; padded jobs are a lone
+    valid head node and order to nothing.
+
+    Returns (rga outputs for the REAL jobs, replicated stats).
+    """
+    n_dev = mesh.devices.size
+    k = parent.shape[0]
+    k_pad = -(-max(k, 1) // n_dev) * n_dev
+    if k_pad != k:
+        def pad_jobs(a, head_valid=False):
+            out = np.zeros((k_pad,) + a.shape[1:], a.dtype)
+            out[:k] = a
+            if head_valid:
+                out[k:, 0] = 1       # node 0 valid (a lone head)
+            return out
+        parent = pad_jobs(np.asarray(parent))
+        elem = pad_jobs(np.asarray(elem))
+        actor = pad_jobs(np.asarray(actor))
+        visible = pad_jobs(np.asarray(visible))
+        valid = pad_jobs(np.asarray(valid).astype(bool), head_valid=True)
+    placed = shard_docs(mesh, *(jnp.asarray(a) for a in
+                                (parent, elem, actor, visible, valid)))
+    out, stats = _sharded_rga_fn(mesh)(*placed)
+    out = {name: arr[:k] for name, arr in out.items()}
+    return out, {name: int(v) for name, v in stats.items()}

@@ -265,7 +265,7 @@ bool parse_op(Cursor& c, Parsed& out, int32_t doc_idx) {
     std::string field, action, obj, key;
     bool have_action = false, have_obj = false, have_key = false;
     bool have_value = false, have_elem = false;
-    int64_t vs = -1, ve = -1, elem_v = 0;
+    int64_t vs = -1, ve = -1, elem_v = 0, elem_s = -1, elem_e = -1;
     if (!c.peek('}')) {
         do {
             if (!c.str(field) || !c.lit(':')) return false;
@@ -282,7 +282,10 @@ bool parse_op(Cursor& c, Parsed& out, int32_t doc_idx) {
                 if (!c.skip_value(vs, ve)) return false;
                 have_value = true;
             } else if (out.general && field == "elem") {
-                if (!c.integer(elem_v)) return false;
+                // recorded as a span; parsed as an integer ONLY for ins
+                // ops (on other ops it is an ignored extra, and the op
+                // kind may not be known yet — field order is free)
+                if (!c.skip_value(elem_s, elem_e)) return false;
                 have_elem = true;
             } else {
                 int64_t s_, e_;
@@ -339,8 +342,16 @@ bool parse_op(Cursor& c, Parsed& out, int32_t doc_idx) {
     } else if (!have_key) {
         return c.fail("op requires a key");
     }
-    if (code == kIns && !have_elem)
-        return c.fail("ins op requires elem");
+    if (code == kIns) {
+        if (!have_elem)
+            return c.fail("ins op requires elem");
+        Cursor ec{c.base + elem_s, c.base + elem_e, c.base, {}};
+        if (!ec.integer(elem_v) || (ec.ws(), ec.p != ec.end)) {
+            c.err = ec.err.empty() ? "ins elem must be an integer"
+                                   : ec.err;
+            return false;
+        }
+    }
     out.action.push_back(code);
     out.obj.push_back(-1);
     out.key.push_back(-1);
@@ -482,10 +493,14 @@ bool resolve_general_kinds(
     auto type_of = [&](int32_t doc, const std::string& uuid) -> int {
         if (uuid == kRootId) return kTypeMap;
         std::string k = doc_obj_key(doc, uuid);
-        auto it = out.made.find(k);
-        if (it != out.made.end()) return it->second;
+        // STORE types take precedence over batch makes, matching
+        // GeneralStore.encode_changes.obj_type_of (a duplicate
+        // re-creation of a known object resolves against the store; the
+        // engine rejects the creation later either way)
         auto kt = known.find(k);
         if (kt != known.end()) return kt->second;
+        auto it = out.made.find(k);
+        if (it != out.made.end()) return it->second;
         return -1;
     };
 

@@ -220,3 +220,41 @@ class TestDeltaSync:
         state, shipped, _ = ici_sync.delta_sync_round(mesh, state,
                                                       window=64)
         assert shipped == 0
+
+
+class TestGeneralShard:
+    """General-engine sequence jobs sharded over the mesh: sharded ==
+    unsharded, padding path included."""
+
+    def test_sharded_rga_jobs_equal_unsharded(self):
+        from automerge_tpu.parallel.mesh import make_mesh
+        mesh8 = make_mesh(n_devices=8)
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from automerge_tpu.device.sequence import rga_order_batch
+        from automerge_tpu.parallel.general_shard import sharded_rga_jobs
+
+        rng = np.random.default_rng(5)
+        K, m = 11, 16                       # K does not divide the mesh
+        parent = np.zeros((K, m), np.int32)
+        for j in range(K):
+            parent[j, 1:] = (rng.random(m - 1)
+                             * np.arange(1, m)).astype(np.int32)
+        elem = np.tile(np.arange(m, dtype=np.int32), (K, 1))
+        actor = rng.integers(0, 3, size=(K, m)).astype(np.int32)
+        visible = rng.random((K, m)) < 0.8
+        visible[:, 0] = False
+        valid = np.ones((K, m), bool)
+
+        ref = jax.jit(rga_order_batch)(*(jnp.asarray(a) for a in
+                                         (parent, elem, actor, visible,
+                                          valid)))
+        out, stats = sharded_rga_jobs(mesh8, parent, elem, actor,
+                                      visible, valid)
+        for k in ('tree_pos', 'vis_index', 'node_at_pos', 'length'):
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.asarray(ref[k]), err_msg=k)
+        assert stats['visible_total'] == int(np.asarray(
+            ref['length']).sum())
+        assert stats['jobs'] == 16          # padded to the mesh

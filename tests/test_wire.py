@@ -331,3 +331,36 @@ class TestGeneralParse:
         nat = wire.parse_general_block(json.dumps(per_doc))
         np.testing.assert_array_equal(nat.elem, ref.elem)
         assert int(nat.elem[0]) == 0
+
+    def test_stray_nonint_elem_on_set_accepted_like_python(self):
+        from automerge_tpu.device import general
+        raw = ('[[{"actor":"a","seq":1,"deps":{},"ops":'
+               '[{"action":"set","obj":"%s","key":"k","value":1,'
+               '"elem":null}]}]]' % ROOT_ID)
+        nat = wire.parse_general_block(raw)
+        ref = general.init_store(1).encode_changes(json.loads(raw))
+        np.testing.assert_array_equal(nat.elem, ref.elem)
+        with pytest.raises(ValueError, match='integer|elem'):
+            wire.parse_general_block(
+                '[[{"actor":"a","seq":1,"deps":{},"ops":'
+                '[{"action":"ins","obj":"o","key":"_head",'
+                '"elem":null}]}]]')
+
+    def test_store_type_precedence_over_batch_make(self):
+        """A (doc, uuid) known to the STORE resolves kinds store-first,
+        on both edges (a duplicate re-creation cannot flip kinds)."""
+        from automerge_tpu.device import general
+        store = general.init_store(1)
+        mk = [[{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': 'uu-1'},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': 'uu-1'}]}]]
+        general.apply_general_block(store, store.encode_changes(mk))
+        dup_make = [[{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeMap', 'obj': 'uu-1'},
+            {'action': 'set', 'obj': 'uu-1', 'key': 'a:1',
+             'value': 9}]}]]
+        ref = store.encode_changes(dup_make)
+        nat = wire.parse_general_block(json.dumps(dup_make), store=store)
+        np.testing.assert_array_equal(nat.key_kind, ref.key_kind)
+        assert int(ref.key_kind[-1]) == 1       # ELEM: store type wins
