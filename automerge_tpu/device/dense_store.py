@@ -125,19 +125,20 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
 
 @partial(jax.jit, static_argnames=('n_fields', 'n_actors', 'seq_values',
                                    'f_pad'))
-def _apply_extract_kernel(eseq, eval_, m, change_doc, change_actor,
-                          change_seq, coo_row, coo_col, coo_val,
-                          op_counts, op_key, op_isdel_bits, op_value,
-                          n_ops, key_capacity, v_base, rank_plane,
-                          touched_mask, *, n_fields, n_actors,
-                          seq_values, f_pad):
+def _apply_extract_kernel(eseq, eval_, m, chg_i32, coo_i32, op_key,
+                          op_isdel_bits, op_value, n_ops, key_capacity,
+                          v_base, rank_plane, touched_mask, *, n_fields,
+                          n_actors, seq_values, f_pad):
     """Apply + patch extraction in ONE device program — a dense apply is
     a single dispatch, so each apply risks one link-latency spike, not
-    two (p99 on a jittery link is dominated by per-dispatch outliers)."""
+    two (p99 on a jittery link is dominated by per-dispatch outliers).
+    The change columns ride STACKED (`chg_i32` = [doc, actor, seq,
+    op_counts]; `coo_i32` = [row, col, val]) for the same reason: fewer
+    transfers, fewer spike opportunities."""
     new_eseq, new_eval, new_m = _apply_kernel.__wrapped__(
-        eseq, eval_, m, change_doc, change_actor, change_seq, coo_row,
-        coo_col, coo_val, op_counts, op_key, op_isdel_bits, op_value,
-        n_ops, key_capacity, v_base, n_fields=n_fields,
+        eseq, eval_, m, chg_i32[0], chg_i32[1], chg_i32[2], coo_i32[0],
+        coo_i32[1], coo_i32[2], chg_i32[3], op_key, op_isdel_bits,
+        op_value, n_ops, key_capacity, v_base, n_fields=n_fields,
         n_actors=n_actors, seq_values=seq_values)
     extracted = _extract_kernel.__wrapped__(
         new_eseq, new_eval, new_m, rank_plane, key_capacity,
@@ -563,12 +564,11 @@ class DenseMapStore:
         adm = st.admitted
         rows = np.flatnonzero(adm)
         c_pad = opts.pad_ops(max(len(rows), 1))
-        change_doc = np.zeros(c_pad, np.int32)
+        chg_i32 = np.zeros((4, c_pad), np.int32)
+        change_doc, change_actor, change_seq, op_counts = chg_i32
         change_doc[:len(rows)] = block.doc[rows]
-        change_actor = np.zeros(c_pad, np.int32)
         change_actor[:len(rows)] = self._slots_of(
             block.doc[rows], st.b_actor[rows], allocate=True)
-        change_seq = np.zeros(c_pad, np.int32)
         change_seq[:len(rows)] = block.seq[rows]
         # closure EXCEPTIONS in per-doc slot coordinates: the kernel
         # sets every change's own-actor entry to seq-1 itself, so only
@@ -589,13 +589,12 @@ class DenseMapStore:
                                      store_id[~own]).astype(np.int32)
             coo_val = Radm[nz_r[~own], nz_c[~own]].astype(np.int32)
         nnz_pad = opts.pad_ops(max(len(coo_row), 1))
-        pad_n = nnz_pad - len(coo_row)
-        coo_row = np.concatenate(
-            [coo_row, np.full(pad_n, c_pad, np.int32)])
-        coo_col = np.concatenate([coo_col, np.zeros(pad_n, np.int32)])
-        coo_val = np.concatenate([coo_val, np.zeros(pad_n, np.int32)])
+        coo_i32 = np.zeros((3, nnz_pad), np.int32)
+        coo_i32[0, :] = c_pad                       # padding rows drop
+        coo_i32[0, :len(coo_row)] = coo_row
+        coo_i32[1, :len(coo_col)] = coo_col
+        coo_i32[2, :len(coo_val)] = coo_val
 
-        op_counts = np.zeros(c_pad, np.int32)
         op_counts[:len(rows)] = np.diff(block.op_ptr)[rows]
         n_ops = len(st.oc)
         n_pad = opts.pad_ops(max(n_ops, 1))
@@ -631,10 +630,8 @@ class DenseMapStore:
         f_pad = opts.pad_segments(
             max(int(touched.sum()), min(4096, self.n_fields)))
         out = _apply_extract_kernel(
-            self.eseq, self.eval_, self.m, jnp.asarray(change_doc),
-            jnp.asarray(change_actor), jnp.asarray(change_seq),
-            jnp.asarray(coo_row), jnp.asarray(coo_col),
-            jnp.asarray(coo_val), jnp.asarray(op_counts),
+            self.eseq, self.eval_, self.m, jnp.asarray(chg_i32),
+            jnp.asarray(coo_i32),
             jnp.asarray(op_key), jnp.asarray(np.packbits(op_isdel)),
             op_value_dev, jnp.asarray(n_ops),
             jnp.asarray(self.key_capacity), jnp.asarray(v_base),

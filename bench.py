@@ -248,7 +248,18 @@ def bench_text_concurrent(n_chars=10000):
     t0 = time.perf_counter()
     Backend.apply_changes(Backend.init(), changes)
     t_host = time.perf_counter() - t0
-    return n_applied, t_dev, t_host
+
+    # the same config through the GENERAL bulk engine (block path);
+    # blocks are immutable, so one encode serves warmup and measurement
+    from automerge_tpu.device import general
+    store = general.init_store(1)
+    block = store.encode_changes([changes])
+    general.apply_general_block(store, block).block_until_ready()
+    store = general.init_store(1)
+    t0 = time.perf_counter()
+    general.apply_general_block(store, block).block_until_ready()
+    t_bulk = time.perf_counter() - t0
+    return n_applied, t_dev, t_host, t_bulk
 
 
 def bench_docset_sync(n_docs=100, iters=3, batch_docs=2000):
@@ -594,10 +605,11 @@ def main():
     t_card = bench_card_list()
     log(f'card-list-merge[config 1]: {t_card * 1e3:.2f} ms per 3-way merge')
 
-    n_text, t_text_dev, t_text_host = bench_text_concurrent()
+    n_text, t_text_dev, t_text_host, t_text_bulk = bench_text_concurrent()
     log(f'text-concurrent[config 2]: {n_text} ops device={t_text_dev:.3f}s '
         f'({n_text / t_text_dev / 1e3:.1f}k ops/s) '
-        f'host-oracle={t_text_host:.3f}s')
+        f'host-oracle={t_text_host:.3f}s '
+        f'general-bulk={t_text_bulk:.3f}s (apply-only)')
 
     (n_sdocs, n_msgs, t_sync3, n_bd, n_bmsgs, t_batch,
      t_eager_b) = bench_docset_sync()

@@ -347,8 +347,10 @@ bool parse_op(Cursor& c, Parsed& out, int32_t doc_idx) {
             return c.fail("ins op requires elem");
         Cursor ec{c.base + elem_s, c.base + elem_e, c.base, {}};
         if (!ec.integer(elem_v) || (ec.ws(), ec.p != ec.end)) {
-            c.err = ec.err.empty() ? "ins elem must be an integer"
-                                   : ec.err;
+            c.err = ec.err.empty()
+                ? ("ins elem must be an integer at byte "
+                   + std::to_string(elem_s))
+                : ec.err;
             return false;
         }
     }

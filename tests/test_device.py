@@ -401,3 +401,16 @@ class TestEngine:
         ]
         store = DocStore.from_changes([changes])
         assert store.materialize(0, ROOT_ID) == {'x': 1}
+
+
+class TestPallasDispatchRule:
+    def test_rule_matches_measured_ab(self):
+        """The auto-dispatch rule encodes the measured on-chip A/B:
+        pallas for large doc batches with few op tiles, xla otherwise."""
+        from automerge_tpu.device.engine import _pallas_wins
+        assert _pallas_wins(10240, 128, 8)       # 2.26x pallas win
+        assert _pallas_wins(1024, 128, 8)        # 1.5x pallas win
+        assert not _pallas_wins(8, 1024, 8)      # xla wins
+        assert not _pallas_wins(256, 512, 16)    # xla wins
+        assert not _pallas_wins(8, 128, 8)       # grid too small
+        assert not _pallas_wins(10240, 4096, 64)  # VMEM blown
