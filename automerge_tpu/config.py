@@ -57,6 +57,15 @@ class Options:
             v = getattr(self, name)
             if v is not None and v < 1:
                 raise ValueError(f'{name} must be >= 1, got {v}')
+        # bit-packed flag planes split at n >> 3 bytes and reshape(-1, 8):
+        # a pad that is not a multiple of 8 would fail opaquely inside the
+        # jitted program, so reject it at construction
+        for name in ('op_pad', 'node_pad'):
+            v = getattr(self, name)
+            if v is not None and v % 8:
+                raise ValueError(
+                    f'{name} must be a multiple of 8 (bit-packed flag '
+                    f'planes), got {v}')
 
     def pad_ops(self, n):
         """Op-axis size for a batch needing `n` rows."""

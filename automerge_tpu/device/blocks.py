@@ -168,6 +168,16 @@ class ValueTable:
         for seg in self._segs:
             yield from seg
 
+    def _mark(self):
+        """Opaque rollback token (see general._Txn)."""
+        return (len(self._segs), self._len)
+
+    def _restore(self, mark):
+        n_segs, n_len = mark
+        del self._segs[n_segs:]
+        del self._offsets[n_segs + 1:]
+        self._len = n_len
+
 
 def check_block_ranges(store, block):
     """Composite-key range guards shared by every block consumer."""

@@ -154,7 +154,9 @@ def _load():
         return None
     try:
         _LIB = _bind(ctypes.CDLL(so_path))
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale .so predating newer symbols (e.g. the
+        # general-schema entry points) — fall back as the warning promises
         warnings.warn(
             f'automerge_tpu: failed to load native wire codec from '
             f'{so_path}; falling back to the pure-Python parser.',

@@ -86,3 +86,13 @@ class TestOptionsThreading:
         changes = _changes_from_edits(lambda d: d.__setitem__('k', 1))
         results, stats = eng.apply_changes_batch([changes, changes])
         assert stats['ops_applied'] >= 2
+
+
+def test_bitpacked_pads_must_be_multiples_of_8():
+    from automerge_tpu.config import Options
+    import pytest
+    with pytest.raises(ValueError, match='multiple of 8'):
+        Options(op_pad=12)
+    with pytest.raises(ValueError, match='multiple of 8'):
+        Options(node_pad=10)
+    Options(op_pad=16, node_pad=8)        # multiples pass

@@ -278,3 +278,112 @@ class TestGeneralScope:
         with pytest.raises(ValueError, match='Duplicate list element'):
             general.apply_general_block(store,
                                         store.encode_changes([dup2]))
+
+
+class TestStoreIntactOnError:
+    """A malformed block must leave the store EXACTLY as before the
+    apply — admission merges (clock/log/queue/retained) roll back, so a
+    valid retry with the same (actor, seq) is NOT dropped as a
+    duplicate (r3 advisor finding: permanent data loss)."""
+
+    def _snapshot(self, store):
+        return (store.clock_of(0), list(store.queue),
+                len(store.l_key), len(store.retained),
+                len(store.actors), len(store.keys), len(store.values),
+                len(store.obj_uuid), store.pool.n_nodes)
+
+    def test_unknown_object_rolls_back_admission(self):
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text())]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        snap = self._snapshot(store)
+        # causally-ready change on an object that does not exist
+        bad = [{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': '99999999-9999-4999-8999-999999999999',
+             'key': 'x', 'value': 1}]}]
+        with pytest.raises(ValueError, match='unknown object'):
+            general.apply_general_block(store, store.encode_changes([bad]))
+        assert self._snapshot(store) == snap
+        # the same (actor, seq) with valid ops must now APPLY, not drop
+        retry = [{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 7}]}]
+        patch = general.apply_general_block(store,
+                                            store.encode_changes([retry]))
+        assert any(d.get('key') == 'x' and d.get('value') == 7
+                   for d in patch.diffs(0))
+        assert store.clock_of(0).get('b') == 1
+
+    def test_duplicate_elem_id_rolls_back(self):
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text()),
+                       lambda d: d['t'].insert_at(0, 'x')]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        snap = self._snapshot(store)
+        obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+        dup2 = [{'actor': 'c', 'seq': 1, 'deps': {'a': 2}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1}]}]
+        with pytest.raises(ValueError, match='Duplicate list element'):
+            general.apply_general_block(store,
+                                        store.encode_changes([dup2]))
+        assert self._snapshot(store) == snap
+        ok = [{'actor': 'c', 'seq': 1, 'deps': {'a': 2}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'c:1', 'value': 'y'}]}]
+        patch = general.apply_general_block(store,
+                                            store.encode_changes([ok]))
+        assert any(d.get('action') == 'insert' for d in patch.diffs(0))
+        assert store.clock_of(0).get('c') == 1
+
+    def test_duplicate_creation_rolls_back(self):
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text())]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        snap = self._snapshot(store)
+        obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+        bad = [{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 't2', 'value': obj}]}]
+        with pytest.raises(ValueError, match='Duplicate creation'):
+            general.apply_general_block(store, store.encode_changes([bad]))
+        assert self._snapshot(store) == snap
+
+    def test_insert_after_unknown_element_rolls_back_queue(self):
+        """The buffered queue survives a failed apply intact."""
+        store = general.init_store(1)
+        mk = _frontend_history(
+            ('a', [], [lambda d: d.__setitem__('t', Text())]))
+        general.apply_general_block(store, store.encode_changes([mk]))
+        # buffer one causally-unready change
+        waiting = [{'actor': 'w', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'later', 'value': 1}]}]
+        general.apply_general_block(store, store.encode_changes([waiting]))
+        assert len(store.queue) == 1
+        snap = self._snapshot(store)
+        obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+        bad = [{'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'ghost:9', 'elem': 1}]}]
+        with pytest.raises(ValueError, match='unknown element'):
+            general.apply_general_block(store, store.encode_changes([bad]))
+        assert self._snapshot(store) == snap
+        # the queued change still drains when its gap fills
+        fill = [{'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'first', 'value': 0}]}]
+        general.apply_general_block(store, store.encode_changes([fill]))
+        assert store.clock_of(0).get('w') == 2
+        assert not store.queue
+
+
+def test_make_on_root_uuid_reuses_single_row():
+    """A make op naming ROOT_ID must not orphan a second root row."""
+    store = general.init_store(1)
+    ch = [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeMap', 'obj': ROOT_ID},
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 1}]}]
+    general.apply_general_block(store, store.encode_changes([ch]))
+    assert store.obj_uuid.count(ROOT_ID) == 1
+    assert store.obj_of[(0, ROOT_ID)] == int(store._root_row[0])
+    assert store.doc_fields(0)[(ROOT_ID, 'x')] == [('a', 1)]
