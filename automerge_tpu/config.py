@@ -82,14 +82,22 @@ class Options:
 
     @staticmethod
     def _pad(fixed, n, name):
+        """Bucket `n` up to the next {2^k, 3*2^(k-1)} size (half-step
+        buckets: at most 2 compiled shapes per octave, and never more
+        than 33% padding waste — a plain pow2 wastes up to 100%, which
+        is real wire bytes on a slow host<->device link)."""
         if fixed is not None:
             if n > fixed:
                 raise ValueError(
                     f'batch needs {n} but {name} is fixed at {fixed}')
             return fixed
+        n = max(n, 1)
         p = 1
-        while p < max(n, 1):
+        while p < n:
             p <<= 1
+        half = (p >> 1) + (p >> 2)       # 3 * 2^(k-2), multiple of 8 for p>=32
+        if n <= half and half % 8 == 0:
+            return half
         return p
 
     def make_mesh(self):

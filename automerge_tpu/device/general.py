@@ -216,14 +216,15 @@ class _SeqPool:
 
     def sync(self):
         """Materialize the pending device visibility/order planes into
-        the host columns (once; idempotent)."""
+        the host columns (once; idempotent). The pending record carries
+        its GLOBAL row ids, so nodes appended since the planes were
+        produced do not shift the scatter targets."""
         if self._pending is None:
             return
-        planes, dirty, n_j, m_pad = self._pending
+        planes, rows, n_j, m_pad = self._pending
         self._pending = None
         vis, idx = planes.get()
-        rows, _ = self.rows_of_objs(dirty)
-        flat = _span_indices(np.arange(len(dirty), dtype=np.int64) * m_pad,
+        flat = _span_indices(np.arange(len(n_j), dtype=np.int64) * m_pad,
                              n_j)
         self.visible[rows] = vis.reshape(-1)[flat]
         self.vis_index[rows] = idx.reshape(-1)[flat].astype(np.int32)
@@ -293,6 +294,8 @@ class _Txn:
 
     def __init__(self, store):
         pool = store.pool
+        self.pending = store._pending_commit
+        self.pool_pending = pool._pending
         self.queue = list(store.queue)
         self.c_doc, self.c_actor = store.c_doc, store.c_actor
         self.c_seq = store.c_seq.copy()
@@ -314,6 +317,16 @@ class _Txn:
 
     def rollback(self, store):
         pool = store.pool
+        # restore the deferred-commit record alongside the entry refs:
+        # the store returns to "previous apply dispatched, uncommitted",
+        # and the (idempotent) commit replays on the next entry read
+        store._pending_commit = self.pending
+        # restore un-consumed device planes too: if this apply's
+        # pool.sync() drained them before the raise, the scatter landed
+        # in arrays the rollback is about to discard — the pending
+        # record's global rows stay valid for the restored arrays, so
+        # the sync simply replays on next demand
+        store.pool._pending = self.pool_pending
         store.queue = self.queue
         store.c_doc, store.c_actor, store.c_seq = (self.c_doc,
                                                    self.c_actor,
@@ -367,6 +380,53 @@ class GeneralStore(BlockStore):
         self.pool = _SeqPool()                   # all insertion trees
         self._root_row = np.full(n_docs, -1, np.int64)
         self._obj_arr_cache = (0, None, None)
+        # deferred survivor commit of the LAST apply: the entry update
+        # waits on a 33KB device fetch, so it is postponed until the
+        # next reader of the entry columns — host staging of block n+1
+        # overlaps device resolution of block n (the async
+        # frontend/backend overlap of SURVEY §2 P3, engine-side)
+        self._pending_commit = None
+
+    def _commit_pending(self):
+        """Fetch the pending apply's survivor bits and fold its entry
+        update into the store (idempotent; replayable after rollback)."""
+        pc = self._pending_commit
+        if pc is None:
+            return
+        self._pending_commit = None
+        n_rows = pc['n_rows']
+        surviving = np.unpackbits(np.asarray(
+            jax.device_get(pc['surv_u8_dev'])))[:n_rows].astype(bool)
+        s_rows = np.flatnonzero(surviving)
+        patch = pc['patch']
+        raw = patch._raw
+        if raw is not None:
+            raw['surviving'] = surviving
+            raw['s_rows'] = s_rows
+        cat, order = pc['cat'], pc['order']
+        if cat['link'].any():       # link bookkeeping: rare
+            _update_inbound(self, patch, pc['touched_fields'], surviving,
+                            pc['r_seg'], cat['link'][order],
+                            cat['value'][order], s_rows)
+        prior_mask = pc['prior_mask']
+        keep_e = ~prior_mask if len(prior_mask) else np.zeros(0, bool)
+        sel = order[s_rows]          # survivor rows, in cat coordinates
+        self.e_doc = np.concatenate([self.e_doc[keep_e],
+                                     cat['doc'][sel]])
+        self.e_obj = np.concatenate([self.e_obj[keep_e],
+                                     cat['obj'][sel]])
+        self.e_key = np.concatenate([self.e_key[keep_e],
+                                     cat['key'][sel]])
+        self.e_actor = np.concatenate([self.e_actor[keep_e],
+                                       cat['actor'][sel]])
+        self.e_seq = np.concatenate([self.e_seq[keep_e],
+                                     cat['seq'][sel]])
+        self.e_value = np.concatenate([self.e_value[keep_e],
+                                       cat['value'][sel]])
+        self.e_link = np.concatenate([self.e_link[keep_e],
+                                      cat['link'][sel]])
+        self.e_change = np.concatenate([self.e_change[keep_e],
+                                        cat['change'][sel]])
 
     # -- objects -------------------------------------------------------------
 
@@ -556,6 +616,7 @@ class GeneralStore(BlockStore):
     def doc_fields(self, d):
         """{(obj uuid, key string): [(actor, value), ...]} winner first —
         the test/inspection surface (general-key aware)."""
+        self._commit_pending()
         pool = self.pool
         out = {}
         for j in np.flatnonzero(self.e_doc == d):
@@ -663,41 +724,49 @@ def _unpack_bits(u8, n):
 
 
 @partial(jax.jit, static_argnames=('num_segments', 'a_pad'))
-def _fused_general(ops_i32, flags_u8, coo_row, coo_col, coo_val,
-                   seq_i32, seq_flags_u8, *, num_segments, a_pad):
+def _fused_general(ops_actor, ops_seq, ops_slot, flags_u8, n_rows,
+                   coo_row, coo_col, coo_val, seq_planes, seq_nj,
+                   seq_vis_u8, *, num_segments, a_pad):
     """Flat resolve + element visibility + RGA ordering for every dirty
     sequence, one device program (the block-path analogue of the per-doc
     backend's fused step).
 
-    Wire-lean inputs for the tunnel/PCIe edge: the int32 op planes ride
-    stacked ([4, n] seg/actor/seq/row_slot and [3, K, m]
-    parent/elem/actor), boolean masks ride bit-packed, and the clock
-    plane is REBUILT ON DEVICE — own-actor entries are always seq-1 (the
-    closure fold's final SET), so only the sparse cross-actor closure
-    entries ship, as COO triples. Survivors return bit-packed; the
-    winner/visibility/order outputs stay device-resident for lazy
-    fetching.
+    Wire-lean inputs for the tunnel/PCIe edge (the link bandwidth is the
+    binding constraint — see BENCH link_floor): rows arrive FIELD-SORTED
+    so segment ids are ONE boundary bit per row (cumsum on device);
+    actor slots and seq counters ride in the narrowest dtype that fits
+    (uint8/int16, upcast here); validity masks derive from row/node
+    counts instead of shipping; the clock plane is REBUILT ON DEVICE —
+    own-actor entries are always seq-1 (the closure fold's final SET),
+    so only the sparse cross-actor closure entries ship, as COO triples.
+    Survivors return bit-packed; the winner/visibility/order outputs
+    stay device-resident for lazy fetching.
     """
     from .merge import _resolve
     from .sequence import _rga_order_batched
-    seg_id, actor, seq, row_slot = (ops_i32[0], ops_i32[1], ops_i32[2],
-                                    ops_i32[3])
-    n = seg_id.shape[0]
+    n = ops_slot.shape[0]
     nb = n >> 3
-    is_del = _unpack_bits(flags_u8[:nb], n)
-    valid = _unpack_bits(flags_u8[nb:], n)
+    boundary = _unpack_bits(flags_u8[:nb], n)
+    is_del = _unpack_bits(flags_u8[nb:], n)
+    valid = jnp.arange(n) < n_rows
+    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    actor = ops_actor.astype(jnp.int32)
+    seq = ops_seq.astype(jnp.int32)
+    row_slot = ops_slot
 
     clock = jnp.zeros((n, a_pad), jnp.int32)
     clock = clock.at[jnp.arange(n), actor].set(seq - 1)
-    clock = clock.at[coo_row, coo_col].set(coo_val, mode='drop')
+    clock = clock.at[coo_row, coo_col.astype(jnp.int32)].set(
+        coo_val.astype(jnp.int32), mode='drop')
 
     out = _resolve(seg_id, actor, seq, clock, is_del, valid, num_segments)
 
-    s_parent, s_elem, s_actor = seq_i32[0], seq_i32[1], seq_i32[2]
+    s_parent = seq_planes[0].astype(jnp.int32)
+    s_elem = seq_planes[1].astype(jnp.int32)
+    s_actor = seq_planes[2].astype(jnp.int32)
     k, m = s_parent.shape
-    mb = (k * m) >> 3
-    s_prior_vis = _unpack_bits(seq_flags_u8[:mb], k * m).reshape(k, m)
-    s_valid = _unpack_bits(seq_flags_u8[mb:], k * m).reshape(k, m)
+    s_valid = jnp.arange(m, dtype=jnp.int32)[None, :] < seq_nj[:, None]
+    s_prior_vis = _unpack_bits(seq_vis_u8, k * m).reshape(k, m) & s_valid
 
     flat = jnp.where(row_slot >= 0, row_slot, k * m)
     vis_hit = jnp.zeros(k * m, bool).at[flat].max(
@@ -745,7 +814,10 @@ class GeneralPatch:
         self._ready = True       # empty patches need no device fetch
 
     def block_until_ready(self):
+        """Wait for the full apply: device program AND the deferred
+        entry commit (so timed one-shot applies pay everything)."""
         if self._raw is not None:
+            self.store._commit_pending()
             jax.block_until_ready(self._raw['winner_dev'])
         return self
 
@@ -755,13 +827,16 @@ class GeneralPatch:
         if self._ready:
             return
         self._ready = True
-        raw = self._raw
         store = self.store
+        store._commit_pending()      # survivors + entry fold, if pending
+        raw = self._raw
         F = len(self.f_obj)
         w_row = np.asarray(jax.device_get(raw['winner_dev']))[:F]
         surviving = raw['surviving']
-        r_value, r_actor, r_link = (raw['r_value'], raw['r_actor'],
-                                    raw['r_link'])
+        cat, rorder = raw['cat'], raw['order']
+        r_value = cat['value'][rorder]
+        r_actor = cat['actor'][rorder]
+        r_link = cat['link'][rorder]
         r_seg = raw['r_seg']
 
         has_winner = w_row >= 0
@@ -995,7 +1070,6 @@ def _apply_general(store, block, options, return_timing):
         block = _upgrade_to_general(block)
     t0 = time.perf_counter()
     pool = store.pool
-    pool.sync()                # materialize any prior pending planes
     st = _admit_and_stage(store, block)
     block = st.block
     keep, oc = st.keep, st.oc
@@ -1090,6 +1164,14 @@ def _apply_general(store, block, options, return_timing):
     o_node = np.full(len(o_act), -1, np.int64)   # local node of each op
     ins_objs = np.zeros(0, np.int64)
 
+    a_rows = np.flatnonzero(assign_mask)
+    if len(a_rows) == 0 and not len(ins_rows):
+        # make-only batch
+        _finish_empty(patch)
+        return (patch, {'admit': t1 - t0}) if return_timing else patch
+
+    # ---- ins prep: group by object, mint local node ids ----
+    g_rows = g_obj = g_actor = g_elem = local_new = None
     if len(ins_rows):
         i_obj = o_objrow[ins_rows]
         bad_t = obj_type_arr[i_obj] == _TYPE_MAP
@@ -1097,11 +1179,11 @@ def _apply_general(store, block, options, return_timing):
             bad_row = int(i_obj[np.flatnonzero(bad_t)[0]])
             raise ValueError('Insertion into non-sequence object '
                              + store.obj_uuid[bad_row])
-        order = np.argsort(i_obj, kind='stable')
-        g_rows = ins_rows[order]
-        g_obj = i_obj[order]
-        g_actor = st.o_actor[ins_rows][order]
-        g_elem = o_elem[ins_rows][order].astype(np.int64)
+        iord = np.argsort(i_obj, kind='stable')
+        g_rows = ins_rows[iord]
+        g_obj = i_obj[iord]
+        g_actor = st.o_actor[ins_rows][iord]
+        g_elem = o_elem[ins_rows][iord].astype(np.int64)
         run_start = np.concatenate([[True], g_obj[1:] != g_obj[:-1]])
         starts = np.flatnonzero(run_start)
         ins_objs = g_obj[starts]
@@ -1110,18 +1192,9 @@ def _apply_general(store, block, options, return_timing):
         within = np.arange(len(g_obj)) - np.repeat(starts, counts)
         local_new = np.repeat(n_old, counts) + within
         new_key = (g_actor.astype(np.int64) << 32) | g_elem
-        job_of = np.repeat(np.arange(len(ins_objs), dtype=np.int64),
-                           counts)
-
-        # existing nodes of the ins-dirty objects, as a lookup table
-        t_rows, t_counts = pool.rows_of_objs(ins_objs)
-        t_job = np.repeat(np.arange(len(ins_objs), dtype=np.int64),
-                          t_counts)
-        t_key = pool.node_keys(t_rows)
-        t_local = pool.local[t_rows].astype(np.int64)
 
         # parent keys (head = -1 sentinel -> node 0, no lookup)
-        kinds = o_kind[ins_rows][order]
+        kinds = o_kind[ins_rows][iord]
         p_key = np.full(len(g_rows), -1, np.int64)
         ek = kinds == _KEY_ELEM
         if ek.any():
@@ -1140,37 +1213,14 @@ def _apply_general(store, block, options, return_timing):
                     'List element insertion after unknown element '
                     + s_key)
             p_key[i] = (aid << 32) | int(ke)
+    else:
+        ins_objs = np.zeros(0, np.int64)
+        new_key = p_key = np.zeros(0, np.int64)
 
-        # one batched lookup: table = existing + new nodes; the dup flag
-        # covers both in-batch and vs-existing elemId duplicates
-        all_job = np.concatenate([t_job, job_of])
-        all_key = np.concatenate([t_key, new_key])
-        all_val = np.concatenate([t_local, local_new])
-        q_sel = p_key != -1
-        res, dup = _exact_lookup(all_job, all_key, all_val,
-                                 job_of[q_sel], p_key[q_sel],
-                                 len(ins_objs))
-        if dup:
-            raise ValueError('Duplicate list element ID')
-        parent_local = np.zeros(len(g_rows), np.int64)
-        parent_local[q_sel] = res
-        if (parent_local < 0).any():
-            raise ValueError(
-                'List element insertion after unknown element')
-
-        pool.append_batch(g_obj, local_new, parent_local, g_actor,
-                          g_elem)
-        o_node[g_rows] = local_new
-
-    # ---- assignment targets: packed field keys, batch-resolved ----
-    a_rows = np.flatnonzero(assign_mask)
-    if len(a_rows) == 0 and not len(ins_objs):
-        # make-only batch
-        _finish_empty(patch)
-        return (patch, {'admit': t1 - t0}) if return_timing else patch
-
+    # ---- assignment prep (kinds, late-bound elemIds) ----
     assign_objs = np.zeros(0, np.int64)
     o_field = np.zeros(len(o_act), np.int64)
+    e_sel = np.zeros(0, bool)
     if len(a_rows):
         kinds = o_kind[a_rows].copy()
         objr = o_objrow[a_rows]
@@ -1195,6 +1245,8 @@ def _apply_general(store, block, options, return_timing):
             t_actor[i] = aid
             t_elem[i] = int(ke)
         kinds[conv] = _KEY_ELEM
+        if (kinds == _KEY_HEAD).any():
+            raise ValueError('assignment to _head')
         s_sel = kinds == _KEY_STR
         fkey = np.zeros(len(a_rows), np.int64)
         if s_sel.any():
@@ -1203,32 +1255,76 @@ def _apply_general(store, block, options, return_timing):
         if e_sel.any():
             if not is_seq_obj[e_sel].all():
                 raise TypeError('Missing index entry for list element')
-            eobj = objr[e_sel]
-            assign_objs = np.unique(eobj)
-            ejob = np.searchsorted(assign_objs, eobj)
-            tgt_key = (t_actor[e_sel] << 32) | t_elem[e_sel]
-            t_rows, t_counts = pool.rows_of_objs(assign_objs)
-            t_job = np.repeat(np.arange(len(assign_objs), dtype=np.int64),
-                              t_counts)
-            nodes, _ = _exact_lookup(
-                t_job, pool.node_keys(t_rows),
-                pool.local[t_rows].astype(np.int64),
-                ejob, tgt_key, len(assign_objs))
-            if (nodes < 0).any():
-                raise TypeError('Missing index entry for list element')
-            elem_rows = a_rows[e_sel]
-            fkey[e_sel] = _ELEM_BIT | nodes
-            o_node[elem_rows] = nodes
-        if (kinds == _KEY_HEAD).any():
-            raise ValueError('assignment to _head')
-        o_field[a_rows] = (objr << 32) | fkey
+            assign_objs = np.unique(objr[e_sel])
 
     # dirty sequence objects: ins targets + element-assignment targets
     dirty = np.union1d(ins_objs, assign_objs).astype(np.int64)
 
+    # ---- ONE lookup over the union: table = every existing node of a
+    # dirty object + this batch's new nodes; queries = ins parents and
+    # assignment target elemIds together (one composite sort) ----
+    if len(dirty):
+        t_rows, t_counts = pool.rows_of_objs(dirty)
+        t_job = np.repeat(np.arange(len(dirty), dtype=np.int64),
+                          t_counts)
+        q_sel = p_key != -1
+        ins_job = np.searchsorted(dirty, g_obj) if len(ins_rows) else \
+            np.zeros(0, np.int64)
+        tgt_key = ((t_actor[e_sel] << 32) | t_elem[e_sel]) \
+            if e_sel.any() else np.zeros(0, np.int64)
+        ejob = np.searchsorted(dirty, objr[e_sel]) if e_sel.any() else \
+            np.zeros(0, np.int64)
+        n_pq = int(q_sel.sum())
+        res, dup = _exact_lookup(
+            np.concatenate([t_job, ins_job]),
+            np.concatenate([pool.node_keys(t_rows), new_key]),
+            np.concatenate([pool.local[t_rows].astype(np.int64),
+                            local_new if local_new is not None
+                            else np.zeros(0, np.int64)]),
+            np.concatenate([ins_job[q_sel], ejob]),
+            np.concatenate([p_key[q_sel], tgt_key]),
+            len(dirty))
+        if dup:
+            raise ValueError('Duplicate list element ID')
+        if len(ins_rows):
+            parent_local = np.zeros(len(g_rows), np.int64)
+            parent_local[q_sel] = res[:n_pq]
+            if (parent_local < 0).any():
+                raise ValueError(
+                    'List element insertion after unknown element')
+        if e_sel.any():
+            nodes = res[n_pq:]
+            if (nodes < 0).any():
+                raise TypeError('Missing index entry for list element')
+            fkey[e_sel] = _ELEM_BIT | nodes
+            o_node[a_rows[e_sel]] = nodes
+        if len(ins_rows):
+            pool.append_batch(g_obj, local_new, parent_local, g_actor,
+                              g_elem)
+            o_node[g_rows] = local_new
+    if len(a_rows):
+        o_field[a_rows] = (objr << 32) | fkey
+
+    # ---- deferred-commit point: everything ABOVE here is independent
+    # of the entry columns, so it ran while the PREVIOUS apply's device
+    # program was still in flight; now fold that apply in ----
+    store._commit_pending()
+
     # ---- touched fields + prior entries ----
+    # one int64 argsort serves BOTH the unique-field derivation and the
+    # field-sorted row order (with no priors they are the same sort)
     f_new = o_field[a_rows]
-    touched_fields, seg_new = np.unique(f_new, return_inverse=True)
+    order_new = np.argsort(f_new, kind='stable')
+    f_sorted = f_new[order_new]
+    n_new0 = len(f_sorted)
+    bnd_new = np.empty(n_new0, bool)
+    if n_new0:
+        bnd_new[0] = True
+        bnd_new[1:] = f_sorted[1:] != f_sorted[:-1]
+    touched_fields = f_sorted[bnd_new]
+    seg_sorted_new = np.cumsum(bnd_new) - 1
+    seg_new = np.empty(n_new0, np.int64)
+    seg_new[order_new] = seg_sorted_new
     e_field = (store.e_obj.astype(np.int64) << 32) | store.e_key
     if len(e_field):
         pos = np.minimum(np.searchsorted(touched_fields, e_field),
@@ -1250,21 +1346,48 @@ def _apply_general(store, block, options, return_timing):
     la = st.la
     A = opts.pad_actors(max(la.width, 1))
 
+    # canonical row order: FIELD-SORTED (segment-grouped) — the seg ids
+    # then ship as one boundary BIT per row, and every r_* column below
+    # (and the kernel's winner row ids) lives in these coordinates.
+    # With no prior rows the unique-inverse is already the sort.
     p_doc = store.e_doc[prior_rows]
-    seg_arr = np.zeros(n_pad, np.int32)
-    seg_arr[:n_new] = seg_new
-    seg_arr[n_new:n_rows] = seg_prior
-    actor_arr = np.zeros(n_pad, np.int32)
-    actor_arr[:n_new] = la.local_of(o_doc[a_rows], st.o_actor[a_rows])
-    actor_arr[n_new:n_rows] = la.local_of(p_doc,
-                                          store.e_actor[prior_rows])
-    seq_arr = np.zeros(n_pad, np.int32)
-    seq_arr[:n_new] = st.o_seq[a_rows]
-    seq_arr[n_new:n_rows] = store.e_seq[prior_rows]
+    if n_prior:
+        seg_cat = np.concatenate([seg_new, seg_prior]).astype(np.int32)
+        order = np.argsort(seg_cat, kind='stable')
+        r_seg = seg_cat[order]
+    else:
+        order = order_new                   # the field sort IS the order
+        r_seg = seg_sorted_new.astype(np.int32)
+    inv_order = np.empty(n_rows, np.int64)
+    inv_order[order] = np.arange(n_rows)
+    # per-CHANGE local actor slots, gathered per row (C << n ops)
+    chg_local = la.local_of(block.doc, st.b_actor) \
+        if block.n_changes else np.zeros(0, np.int32)
+    prior_local = la.local_of(p_doc, store.e_actor[prior_rows]) \
+        if n_prior else np.zeros(0, np.int32)
+    local_cat = np.concatenate([chg_local[oc[a_rows]], prior_local]) \
+        if n_prior else chg_local[oc[a_rows]]
+    seq_cat_store = np.concatenate(
+        [st.o_seq[a_rows], store.e_seq[prior_rows]]) if n_prior \
+        else st.o_seq[a_rows]
+    isdel_cat = np.concatenate(
+        [o_act[a_rows] == _DEL, np.zeros(n_prior, bool)]) if n_prior \
+        else (o_act[a_rows] == _DEL)
+
+    # narrowest dtypes that fit (each distinct signature compiles once)
+    a_dtype = np.uint8 if A <= 256 else np.int32
+    max_seq = int(seq_cat_store.max()) if n_rows else 0
+    s_dtype = np.int16 if max_seq < (1 << 15) else np.int32
+    actor_arr = np.zeros(n_pad, a_dtype)
+    actor_arr[:n_rows] = local_cat[order]
+    seq_arr = np.zeros(n_pad, s_dtype)
+    seq_arr[:n_rows] = seq_cat_store[order]
+    boundary = np.zeros(n_pad, bool)
+    if n_rows:
+        boundary[0] = True
+        boundary[1:n_rows] = r_seg[1:] != r_seg[:-1]
     del_arr = np.zeros(n_pad, bool)
-    del_arr[:n_new] = o_act[a_rows] == _DEL
-    valid_arr = np.zeros(n_pad, bool)
-    valid_arr[:n_rows] = True
+    del_arr[:n_rows] = isdel_cat[order]
 
     # clock exceptions as COO: clock[i, actor_i] = seq_i - 1 always (the
     # fold's final SET), so only cross-actor closure entries ship
@@ -1273,8 +1396,9 @@ def _apply_general(store, block, options, return_timing):
     if R.any():
         rows_clock = R[oc[a_rows]]
         nz_r, nz_c = np.nonzero(rows_clock)
-        own = nz_c == actor_arr[nz_r]
-        coo.append((nz_r[~own], nz_c[~own],
+        new_local = chg_local[oc[a_rows]]
+        own = nz_c == new_local[nz_r]
+        coo.append((inv_order[nz_r[~own]], nz_c[~own],
                     rows_clock[nz_r[~own], nz_c[~own]]))
     if n_prior:
         e_log = store.e_change[prior_rows]
@@ -1287,33 +1411,44 @@ def _apply_general(store, block, options, return_timing):
             doc_rep = np.repeat(p_doc, prior_counts)
             cols = la.local_of(doc_rep, store.l_dep_actor[idx])
             vals = store.l_dep_seq[idx]
-            own = cols == actor_arr[rows_rep]
+            own = cols == prior_local[rows_rep - n_new]
             # the own-column closure of a PRIOR entry is its seq-1 by
             # the same invariant, so dropping own rows stays exact
-            coo.append((rows_rep[~own], cols[~own], vals[~own]))
+            coo.append((inv_order[rows_rep[~own]], cols[~own],
+                        vals[~own]))
     if coo:
         coo_row = np.concatenate([c[0] for c in coo]).astype(np.int32)
-        coo_col = np.concatenate([c[1] for c in coo]).astype(np.int32)
-        coo_val = np.concatenate([c[2] for c in coo]).astype(np.int32)
+        coo_col_v = np.concatenate([c[1] for c in coo])
+        coo_val_v = np.concatenate([c[2] for c in coo])
     else:
-        coo_row = coo_col = coo_val = np.zeros(0, np.int32)
+        coo_row = np.zeros(0, np.int32)
+        coo_col_v = coo_val_v = np.zeros(0, np.int32)
+    c_dtype = np.int16 if (len(coo_val_v) == 0
+                           or int(coo_val_v.max()) < (1 << 15)) \
+        else np.int32
     nnz_pad = opts.pad_ops(max(len(coo_row), 1))
+    coo_col = np.zeros(nnz_pad, a_dtype)
+    coo_col[:len(coo_col_v)] = coo_col_v
+    coo_val = np.zeros(nnz_pad, c_dtype)
+    coo_val[:len(coo_val_v)] = coo_val_v
     coo_row = np.concatenate(
         [coo_row, np.full(nnz_pad - len(coo_row), n_pad, np.int32)])
-    coo_col = np.concatenate(
-        [coo_col, np.zeros(nnz_pad - len(coo_col), np.int32)])
-    coo_val = np.concatenate(
-        [coo_val, np.zeros(nnz_pad - len(coo_val), np.int32)])
 
     # ---- sequence job planes: whole-batch pool gathers ----
+    pool.sync()              # prior visibility must be current below
     K = max(len(dirty), 1)
     rows_flat, n_j = (pool.rows_of_objs(dirty) if len(dirty)
                       else (np.zeros(0, np.int64), np.zeros(0, np.int64)))
     m_pad = opts.pad_nodes(int(max(n_j.max() if len(n_j) else 1, 8)))
-    seq_i32 = np.zeros((3, K, m_pad), np.int32)
-    s_parent, s_elem, s_actor_rank = seq_i32
+    elem_max = int(pool.max_elem_of[dirty].max()) if len(dirty) else 0
+    p_dtype = np.int16 if (m_pad < (1 << 15)
+                           and elem_max < (1 << 15)
+                           and len(store.actors) < (1 << 15)) \
+        else np.int32
+    seq_planes = np.zeros((3, K, m_pad), p_dtype)
+    s_parent, s_elem, s_actor_rank = seq_planes
     s_prior_vis = np.zeros((K, m_pad), bool)
-    s_valid = np.zeros((K, m_pad), bool)
+    n_j_arr = np.zeros(K, np.int32)
     prev_vis_index = np.zeros(0, np.int32)
     if len(dirty):
         str_rank = store.actor_str_ranks()
@@ -1327,60 +1462,68 @@ def _apply_general(store, block, options, return_timing):
         ranks[real] = str_rank[cat_actor[real]]
         s_actor_rank.reshape(-1)[flat] = ranks
         s_prior_vis.reshape(-1)[flat] = pool.visible[rows_flat]
-        s_valid.reshape(-1)[flat] = True
+        n_j_arr[:] = n_j
         prev_vis_index = pool.vis_index[rows_flat].copy()
 
-    # per-row (job, node) slots
-    row_slot = np.full(n_pad, -1, np.int64)
+    # per-row (job, node) slots, in the field-sorted coordinates
+    row_slot = np.full(n_pad, -1, np.int32)
     if len(dirty):
+        slot_cat = np.full(n_rows, -1, np.int64)
         dirty_lookup = np.full(len(store.obj_uuid), -1, np.int64)
         dirty_lookup[dirty] = np.arange(K)
         if n_new:
             loc = dirty_lookup[o_objrow[a_rows]]
             nd = o_node[a_rows]
-            row_slot[:n_new] = np.where((loc >= 0) & (nd >= 0),
+            slot_cat[:n_new] = np.where((loc >= 0) & (nd >= 0),
                                         loc * m_pad + nd, -1)
         if n_prior:
             p_loc = dirty_lookup[store.e_obj[prior_rows]]
             p_elem_key = store.e_key[prior_rows]
             p_node = np.where(p_elem_key & _ELEM_BIT,
                               p_elem_key & 0x7FFFFFFF, -1)
-            row_slot[n_new:n_rows] = np.where(
+            slot_cat[n_new:n_rows] = np.where(
                 (p_loc >= 0) & (p_node >= 0), p_loc * m_pad + p_node, -1)
+        row_slot[:n_rows] = slot_cat[order]
     t2 = time.perf_counter()
 
-    flags_u8 = np.concatenate([np.packbits(del_arr),
-                               np.packbits(valid_arr)])
-    seq_flags_u8 = np.concatenate([np.packbits(s_prior_vis),
-                                   np.packbits(s_valid)])
-    ops_i32 = np.stack([seg_arr, actor_arr, seq_arr,
-                        row_slot.astype(np.int32)])
+    flags_u8 = np.concatenate([np.packbits(boundary),
+                               np.packbits(del_arr)])
     surv_u8_dev, winner_dev, visible_dev, vis_index_dev = _fused_general(
-        jnp.asarray(ops_i32), jnp.asarray(flags_u8),
-        jnp.asarray(coo_row), jnp.asarray(coo_col), jnp.asarray(coo_val),
-        jnp.asarray(seq_i32), jnp.asarray(seq_flags_u8),
+        jnp.asarray(actor_arr), jnp.asarray(seq_arr),
+        jnp.asarray(row_slot), jnp.asarray(flags_u8),
+        jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
+        jnp.asarray(coo_col), jnp.asarray(coo_val),
+        jnp.asarray(seq_planes), jnp.asarray(n_j_arr),
+        jnp.asarray(np.packbits(s_prior_vis)),
         num_segments=S, a_pad=A)
-    # the ONLY eager fetch: bit-packed survivors (the authoritative
-    # store update needs them; everything else stays device-resident)
-    surviving = np.unpackbits(
-        np.asarray(jax.device_get(surv_u8_dev)))[:n_rows].astype(bool)
     t3 = time.perf_counter()
 
-    # ---- unpack: store update (+ lazy patch wiring) ----
-    r_value = np.concatenate(
-        [st.o_value[a_rows], store.e_value[prior_rows]])
-    r_actor = np.concatenate(
-        [st.o_actor[a_rows], store.e_actor[prior_rows]])
-    r_seq = np.concatenate([st.o_seq[a_rows], store.e_seq[prior_rows]])
-    r_link = np.concatenate([o_act[a_rows] == _LINK,
-                             store.e_link[prior_rows]])
-    r_change = np.concatenate([st.cmap[oc[a_rows]].astype(np.int32),
-                               store.e_change[prior_rows]])
-    r_doc = np.concatenate([o_doc[a_rows], p_doc])
-    r_obj = np.concatenate([o_objrow[a_rows].astype(np.int32),
-                            store.e_obj[prior_rows]])
-    r_key = np.concatenate([o_field[a_rows] & 0xFFFFFFFF,
-                            store.e_key[prior_rows]])
+    # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
+    # `cat` holds the UNPERMUTED row columns plus `order` (the
+    # field-sorted permutation matching the kernel's winner row ids);
+    # consumers gather lazily — commit fetches only the survivor rows,
+    # conflict columns materialize on first diff read. Nothing blocks
+    # here: the 33KB survivor fetch and the entry update wait in
+    # _pending_commit until the next entry reader (usually the next
+    # apply's prior-entry match), so host staging of block n+1 overlaps
+    # this block's device program.
+    def _cat(new_part, prior_part):
+        return np.concatenate([new_part, prior_part]) if n_prior \
+            else np.asarray(new_part)
+
+    cat = {
+        'value': _cat(st.o_value[a_rows], store.e_value[prior_rows]),
+        'link': _cat(o_act[a_rows] == _LINK, store.e_link[prior_rows]),
+        'actor': _cat(st.o_actor[a_rows], store.e_actor[prior_rows]),
+        'doc': _cat(o_doc[a_rows], p_doc),
+        'seq': seq_cat_store,
+        'change': _cat(st.cmap[oc[a_rows]].astype(np.int32),
+                       store.e_change[prior_rows]),
+        'obj': _cat(o_objrow[a_rows].astype(np.int32),
+                    store.e_obj[prior_rows]),
+        'key': _cat(o_field[a_rows] & 0xFFFFFFFF,
+                    store.e_key[prior_rows]),
+    }
 
     f_obj = (touched_fields >> 32).astype(np.int32)
     patch.f_obj = f_obj
@@ -1388,42 +1531,26 @@ def _apply_general(store, block, options, return_timing):
         else np.zeros(0, np.int32)
     patch.f_key = touched_fields & 0xFFFFFFFF
     patch.f_kind = (patch.f_key & _ELEM_BIT) != 0
-    s_rows = np.flatnonzero(surviving)
-    r_seg = seg_arr[:n_rows]
-
-    # inbound maintenance for link fields (rare; python over link rows)
-    _update_inbound(store, patch, touched_fields, surviving, r_seg,
-                    r_link, r_value, s_rows)
-
-    # store entry update
-    keep_e = ~prior_mask if len(prior_mask) else np.zeros(0, bool)
-    store.e_doc = np.concatenate([store.e_doc[keep_e], r_doc[s_rows]])
-    store.e_obj = np.concatenate([store.e_obj[keep_e], r_obj[s_rows]])
-    store.e_key = np.concatenate([store.e_key[keep_e], r_key[s_rows]])
-    store.e_actor = np.concatenate([store.e_actor[keep_e],
-                                    r_actor[s_rows]])
-    store.e_seq = np.concatenate([store.e_seq[keep_e], r_seq[s_rows]])
-    store.e_value = np.concatenate([store.e_value[keep_e],
-                                    r_value[s_rows]])
-    store.e_link = np.concatenate([store.e_link[keep_e],
-                                   r_link[s_rows]])
-    store.e_change = np.concatenate([store.e_change[keep_e],
-                                     r_change[s_rows]])
 
     # ---- lazy wiring: winner columns, conflicts, sequence edits ----
     planes = None
     if len(dirty):
         planes = _DevPlanes(visible_dev, vis_index_dev)
-        pool._pending = (planes, dirty, n_j, m_pad)
+        pool._pending = (planes, rows_flat, n_j, m_pad)
     patch._raw = {
-        'winner_dev': winner_dev, 'surviving': surviving,
-        'r_value': r_value, 'r_actor': r_actor, 'r_link': r_link,
-        'r_seg': r_seg, 's_rows': s_rows, 'planes': planes,
+        'winner_dev': winner_dev, 'surviving': None,   # set at commit
+        'cat': cat, 'order': order,
+        'r_seg': r_seg, 's_rows': None, 'planes': planes,
         'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat,
         'prev_vis_index': prev_vis_index,
         'gained_objs': set(ins_objs.tolist()),
     }
     patch._ready = False
+    store._pending_commit = {
+        'surv_u8_dev': surv_u8_dev, 'n_rows': n_rows,
+        'prior_mask': prior_mask, 'touched_fields': touched_fields,
+        'r_seg': r_seg, 'cat': cat, 'order': order, 'patch': patch,
+    }
     t4 = time.perf_counter()
 
     metrics.bump('general_batches')

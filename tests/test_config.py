@@ -22,7 +22,9 @@ class TestOptions:
         o = Options()
         assert o.pad_ops(5) == 8
         assert o.pad_actors(1) == 1
-        assert o.pad_segments(17) == 32
+        assert o.pad_segments(17) == 24    # half-step bucket (3 * 2^3)
+        assert o.pad_segments(25) == 32
+        assert o.pad_ops(137217) == 196608  # 3 * 2^16, multiple of 8
 
     def test_fixed_pad_is_respected_and_checked(self):
         o = Options(op_pad=64, actor_pad=8)

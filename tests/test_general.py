@@ -387,3 +387,29 @@ def test_make_on_root_uuid_reuses_single_row():
     assert store.obj_uuid.count(ROOT_ID) == 1
     assert store.obj_of[(0, ROOT_ID)] == int(store._root_row[0])
     assert store.doc_fields(0)[(ROOT_ID, 'x')] == [('a', 1)]
+
+
+def test_rollback_preserves_pending_visibility_planes():
+    """A raise after the pool drained its pending device planes must
+    not lose the previous apply's visibility (r4 review finding)."""
+    from automerge_tpu.config import Options
+    store = general.init_store(1)
+    mk = _frontend_history(
+        ('a', [], [lambda d: d.__setitem__('t', Text()),
+                   lambda d: d['t'].insert_at(0, 'x', 'y')]))
+    general.apply_general_block(store, store.encode_changes([mk]))
+    obj = next(u for u in store.obj_uuid if u != ROOT_ID)
+    # planes of the first apply are still device-pending; this apply
+    # grows the tree past the fixed node_pad and raises mid-staging
+    grow = [{'actor': 'b', 'seq': 1, 'deps': {'a': 2}, 'ops': sum(
+        ([{'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 10 + i},
+          {'action': 'set', 'obj': obj, 'key': f'b:{10 + i}',
+           'value': 'z'}] for i in range(8)), [])}]
+    with pytest.raises(ValueError, match='node_pad'):
+        general.apply_general_block(store, store.encode_changes([grow]),
+                                    options=Options(node_pad=8))
+    store.pool.sync()
+    rows, n = store.pool.rows_of_objs(
+        np.asarray([store.obj_of[(0, obj)]], np.int64))
+    assert list(store.pool.visible[rows]) == [False, True, True]
+    assert list(store.pool.vis_index[rows]) == [-1, 0, 1]
