@@ -148,8 +148,11 @@ class ValueTable:
             for seg in items._segs:
                 self.extend(seg)
             return
-        else:
+        elif type(items) is not list:
             items = list(items)
+        # plain lists append as shared segments without copying (block
+        # value tables are immutable once built; a million-value block
+        # would otherwise pay a full list copy per apply)
         if not len(items):
             return
         self._segs.append(items)
@@ -553,15 +556,23 @@ class BlockStore:
         self.e_seq = z32
         self.e_value = z32                    # store value row (-1: none)
         self.e_change = z32                   # change-log row (closure ref)
-        # vector clocks: rows sorted by (doc << 32 | actor)
+        # vector clocks: rows sorted by (doc << 32 | actor); c_pure marks
+        # chains whose transitive closure is OWN-ONLY ({actor: seq-1}) —
+        # such closures are implicit (every consumer reconstructs the own
+        # entry), so pure chains skip the closure fold and store zero
+        # log entries. Purity is an optimization hint: a False for an
+        # actually-pure chain only costs a no-op gather, never
+        # correctness.
         self.c_doc = z32
         self.c_actor = z32
         self.c_seq = z32
+        self.c_pure = np.zeros(0, bool)
         # applied-change log (append order) + closure CSR per change;
         # l_order keeps a sorted view over l_key for lookups
         self.l_key = np.zeros(0, np.int64)
         self.l_order = np.zeros(0, np.int64)
         self._l_sorted = np.zeros(0, np.int64)   # cache: l_key[l_order]
+        self._l_pending = []    # appended-but-unmerged (keys, base) chunks
         self.l_dep_ptr = np.zeros(1, np.int32)
         self.l_dep_actor = z32
         self.l_dep_seq = z32
@@ -612,33 +623,53 @@ class BlockStore:
         return np.where(table[pos] == probe, self.c_seq[pos], 0) \
             .astype(np.int32)
 
-    def clock_merge(self, doc, actor, seq):
-        """Scatter-max (doc, actor, seq) rows into the sorted clock table."""
+    def clock_merge(self, doc, actor, seq, pure=None):
+        """Scatter-max (doc, actor, seq) rows into the sorted clock
+        table; `pure` carries the chain-purity flag of each row (the
+        max-seq row's purity wins per key; None = impure)."""
         if len(doc) == 0:
             return
+        if pure is None:
+            pure = np.zeros(len(doc), bool)
         key_new = (doc.astype(np.int64) << 32) | actor
         order = np.argsort(key_new, kind='stable')
-        key_new, seq = key_new[order], seq[order]
-        # max seq per distinct key (segmented max over equal-key runs)
+        key_new, seq, pure = key_new[order], seq[order], pure[order]
+        # max seq per distinct key (segmented max over equal-key runs);
+        # purity rides in the low bit so the max picks the winner's flag
         seg_start = np.concatenate([[True], key_new[1:] != key_new[:-1]])
-        seg_max = np.maximum.reduceat(seq, np.flatnonzero(seg_start))
+        packed = (seq.astype(np.int64) << 1) | pure
+        seg_max = np.maximum.reduceat(packed, np.flatnonzero(seg_start))
         key_new = key_new[seg_start]
-        seq = seg_max
+        seq = (seg_max >> 1).astype(np.int32)
+        pure = (seg_max & 1).astype(bool)
         table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
         pos = np.minimum(np.searchsorted(table, key_new),
                          max(len(table) - 1, 0))
         hit = (table[pos] == key_new) if len(table) else \
             np.zeros(len(key_new), bool)
         if hit.any():
+            adv = seq[hit] > self.c_seq[pos[hit]]
             np.maximum.at(self.c_seq, pos[hit], seq[hit])
+            self.c_pure[pos[hit][adv]] = pure[hit][adv]
         if (~hit).any():
             all_key = np.concatenate([table, key_new[~hit]])
             all_seq = np.concatenate([self.c_seq, seq[~hit]])
+            all_pure = np.concatenate([self.c_pure, pure[~hit]])
             order = np.argsort(all_key, kind='stable')
             all_key, all_seq = all_key[order], all_seq[order]
             self.c_doc = (all_key >> 32).astype(np.int32)
             self.c_actor = (all_key & 0xFFFFFFFF).astype(np.int32)
             self.c_seq = all_seq.astype(np.int32)
+            self.c_pure = all_pure[order]
+
+    def clock_pure_lookup(self, doc, actor):
+        """Chain purity per (doc, actor) pair (False on miss)."""
+        if len(self.c_doc) == 0 or len(doc) == 0:
+            return np.zeros(len(doc), bool)
+        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        probe = (doc.astype(np.int64) << 32) | actor
+        pos = np.minimum(np.searchsorted(table, probe), len(table) - 1)
+        return np.where(table[pos] == probe, self.c_pure[pos], False)
 
     def clock_of(self, d):
         lo, hi = np.searchsorted(self.c_doc, [d, d + 1])
@@ -659,9 +690,27 @@ class BlockStore:
                 for k, v in out.items()}
 
     def log_sorted_keys(self):
-        """l_key in sorted order (cached; rebuilt only if the cache went
-        stale, e.g. after a snapshot load set l_order directly)."""
-        if len(self._l_sorted) != len(self.l_key):
+        """l_key in sorted order. The sorted view merges lazily: appends
+        park in ``_l_pending`` and fold in here, on DEMAND — pure chain
+        streams never consult the log during admission, so they skip the
+        O(log-size) merge every apply."""
+        if self._l_pending:
+            pend_keys = np.concatenate(
+                [k for k, b in self._l_pending])
+            pend_rows = np.concatenate(
+                [b + np.arange(len(k), dtype=np.int64)
+                 for k, b in self._l_pending])
+            self._l_pending = []
+            order_p = np.argsort(pend_keys, kind='stable')
+            pend_sorted = pend_keys[order_p]
+            if len(self._l_sorted) != len(self.l_order):
+                self._l_sorted = self.l_key[self.l_order]
+            pos = np.searchsorted(self._l_sorted, pend_sorted)
+            self.l_order = np.insert(self.l_order, pos,
+                                     pend_rows[order_p])
+            self._l_sorted = np.insert(self._l_sorted, pos, pend_sorted)
+        elif len(self._l_sorted) != len(self.l_order):
+            # stale cache (e.g. a snapshot load set l_order directly)
             self._l_sorted = self.l_key[self.l_order]
         return self._l_sorted
 
@@ -815,7 +864,6 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
     in_key = store.change_key(doc, b_actor, seq)
     in_order = np.argsort(in_key, kind='stable')
     in_sorted = in_key[in_order]
-    log_sorted = store.log_sorted_keys()        # stable during admission
 
     dep_change = np.repeat(np.arange(C, dtype=np.int64),
                            np.diff(block.dep_ptr))
@@ -839,7 +887,10 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         if in_hit.any():
             dest[out_idx[in_hit]] = R[src[in_hit]]
         rest = ~in_hit
-        if rest.any() and len(log_sorted):
+        if not rest.any():
+            return
+        log_sorted = store.log_sorted_keys()  # lazy merge, on demand
+        if len(log_sorted):
             lpos = np.minimum(np.searchsorted(log_sorted,
                                               sources_key[rest]),
                               len(log_sorted) - 1)
@@ -854,7 +905,7 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
                                    store.l_dep_actor[idx])
                 dest[tgt_rep, cols] = store.l_dep_seq[idx]
 
-    def accumulate_closures(ready, ext):
+    def accumulate_closures(ready, ext, pure):
         """The reference's transitiveDeps fold, vectorized for one wave
         (op_set.js:29-37): for each ready change, deps are folded IN
         ORDER (own seq-1 appended last) as merge-max of the dep's
@@ -871,7 +922,9 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         R[s] = elementwise-max(D_s, R[s-1]) with R[s][own] = s-1.
         """
         rdep = ready[dep_change] if len(dep_change) else np.zeros(0, bool)
-        start = ready & ~ext
+        # pure chains (own-only closure) skip the fold entirely: their R
+        # row stays zero and every consumer reconstructs own = seq-1
+        start = ready & ~ext & ~pure
         rows_start = np.flatnonzero(start)
         prev = seq[rows_start] - 1
         has_prev = prev > 0
@@ -933,6 +986,15 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         R[uniq] = np.maximum(R[uniq], reduced)
         R[t_change, t_actor] = np.maximum(           # the SET override
             t_seq, S[np.arange(n_r), t_actor])
+
+    # changes with any LIVE listed dep can never be chain-pure
+    has_deps = np.zeros(C, bool)
+    if len(dep_change):
+        live0 = dep_seq > 0
+        dstart0 = np.flatnonzero(np.concatenate(
+            [[True], dep_change[1:] != dep_change[:-1]]))
+        has_deps[dep_change[dstart0]] = \
+            np.logical_or.reduceat(live0, dstart0)
 
     duplicate = store.clock_lookup(doc, b_actor) >= seq
     # a duplicate must MATCH what was applied (op_set.js:243-248); check
@@ -999,7 +1061,26 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
         ext = np.zeros(C, bool)
         ext[in_order[ext_s]] = True
 
-        accumulate_closures(ready, ext)
+        # ---- chain purity, per sorted row: pure iff no live deps, and
+        # the run start inherits purity (seq 1, or a pure clock chain);
+        # one impure element poisons the rest of its run ----
+        idxC = np.arange(C)
+        start_s = ready_s & brk
+        start_imp = np.zeros(C, bool)
+        pos_s = np.flatnonzero(start_s)
+        if len(pos_s):
+            rows0 = in_order[pos_s]
+            start_imp[pos_s] = np.where(
+                seq[rows0] == 1, False,
+                ~store.clock_pure_lookup(doc[rows0], b_actor[rows0]))
+        base_imp = (has_deps[in_order] | start_imp) & ready_s
+        run_first = np.maximum.accumulate(np.where(brk, idxC, -1))
+        last_imp = np.maximum.accumulate(np.where(base_imp, idxC, -1))
+        impure_s = ready_s & (last_imp >= run_first)
+        pure = np.zeros(C, bool)
+        pure[in_order[ready_s & ~impure_s]] = True
+
+        accumulate_closures(ready, ext, pure)
         if ext_s.any():
             # segmented prefix max along runs (Hillis–Steele doubling),
             # then the exact own-seq SET (the fold's last step)
@@ -1017,12 +1098,14 @@ def _admit_block(store, block, b_actor, dep_actor_store, la):
                 step <<= 1
             rows_ext = in_order[ext_s]
             R[rows_ext] = Rs[ext_s]
-            R[rows_ext, b_local[rows_ext]] = seq[rows_ext] - 1
+            imp_ext = rows_ext[~pure[rows_ext]]
+            R[imp_ext, b_local[imp_ext]] = seq[imp_ext] - 1
 
         admitted |= ready
         pending &= ~ready
         adm_waves.append(in_order[ready_s])
-        store.clock_merge(doc[ready], b_actor[ready], seq[ready])
+        store.clock_merge(doc[ready], b_actor[ready], seq[ready],
+                          pure=pure[ready])
 
     adm_order = np.concatenate(adm_waves) if adm_waves else \
         np.zeros(0, np.int64)
@@ -1047,20 +1130,15 @@ def _log_append(store, in_key, admitted, R, doc, la):
     np.cumsum(counts, out=ptr_new)
     la_actor = la.store_of(doc[adm[nz_r]], nz_c).astype(np.int32)
     la_seq = Radm[nz_r, nz_c]
-    old_sorted = store.log_sorted_keys()
     new_keys = in_key[adm]
     store.l_key = np.concatenate([store.l_key, new_keys])
     store.l_dep_ptr = np.concatenate([
         store.l_dep_ptr, store.l_dep_ptr[-1] + ptr_new])
     store.l_dep_actor = np.concatenate([store.l_dep_actor, la_actor])
     store.l_dep_seq = np.concatenate([store.l_dep_seq, la_seq])
-    # merge the (sorted) new keys into the sorted view instead of
-    # re-sorting the whole log every apply
-    new_order = np.argsort(new_keys, kind='stable')
-    new_sorted = new_keys[new_order]
-    pos = np.searchsorted(old_sorted, new_sorted)
-    store.l_order = np.insert(store.l_order, pos, new_order + base)
-    store._l_sorted = np.insert(old_sorted, pos, new_sorted)
+    # the sorted view merges lazily on the next log LOOKUP
+    # (log_sorted_keys) — pure chain streams never pay it
+    store._l_pending.append((new_keys, base))
     return cmap
 
 
@@ -1341,11 +1419,15 @@ def apply_block(store, block, options=None, return_timing=False):
                         - store.l_dep_ptr[e_log])
         prior_nnz = int(prior_counts.sum())
     r_any = bool(R.any())
-    if r_any or prior_nnz:
+    max_new_seq = int(o_seq.max()) if n_new else 0
+    if r_any or prior_nnz or max_new_seq > 1:
         clock_arr = np.zeros((n_pad, A), np.int32)
         if r_any:
             new_clocks = R[oc]
             clock_arr[:n_new, :new_clocks.shape[1]] = new_clocks
+        # the own-actor entry is IMPLICIT (always seq-1): pure chains
+        # carry all-zero R rows, so reconstruct it here for every new op
+        clock_arr[np.arange(n_new), actor_arr[:n_new]] = o_seq - 1
         if prior_nnz:
             idx = _span_indices(store.l_dep_ptr[e_log], prior_counts)
             rows_rep = np.repeat(np.arange(n_new, n_rows), prior_counts)

@@ -69,15 +69,23 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
     """One block apply: expand change columns to op rows ON DEVICE, then
     scatter-maxes into the resident planes.
 
-    Wire-lean inputs: the del mask arrives bit-packed (uint8, unpacked
-    here); with ``seq_values`` the value refs are not shipped at all —
-    set ops reference values sequentially from ``v_base`` (the layout
+    Wire-lean inputs: the change columns arrive in the narrowest dtype
+    that fits (int16 docs/seqs, uint8 slots/counts — upcast here); the
+    del mask arrives bit-packed (uint8, unpacked here); with
+    ``seq_values`` the value refs are not shipped at all — set ops
+    reference values sequentially from ``v_base`` (the layout
     ChangeBlock.from_changes and the workload generators produce), so the
     refs are a cumulative sum computed on device; and the closure clock
     plane is REBUILT ON DEVICE — a change's own-actor entry is always
     seq-1 (the transitiveDeps fold ends with that SET), so only the
     sparse cross-actor closure entries ship, as COO triples.
     """
+    change_doc = change_doc.astype(jnp.int32)
+    change_actor = change_actor.astype(jnp.int32)
+    change_seq = change_seq.astype(jnp.int32)
+    op_counts = op_counts.astype(jnp.int32)
+    coo_col = coo_col.astype(jnp.int32)
+    coo_val = coo_val.astype(jnp.int32)
     n_pad = op_key.shape[0]
     c_pad = change_doc.shape[0]
     change_clock = jnp.zeros((c_pad, n_actors), jnp.int32)
@@ -125,21 +133,25 @@ def _apply_kernel(eseq, eval_, m, change_doc, change_actor, change_seq,
 
 @partial(jax.jit, static_argnames=('n_fields', 'n_actors', 'seq_values',
                                    'f_pad'))
-def _apply_extract_kernel(eseq, eval_, m, chg_i32, coo_i32, op_key,
+def _apply_extract_kernel(eseq, eval_, m, chg_doc, chg_actor, chg_seq,
+                          chg_counts, coo_row, coo_col, coo_val, op_key,
                           op_isdel_bits, op_value, n_ops, key_capacity,
-                          v_base, rank_plane, touched_mask, *, n_fields,
+                          v_base, rank_plane, touched_bits, *, n_fields,
                           n_actors, seq_values, f_pad):
     """Apply + patch extraction in ONE device program — a dense apply is
     a single dispatch, so each apply risks one link-latency spike, not
     two (p99 on a jittery link is dominated by per-dispatch outliers).
-    The change columns ride STACKED (`chg_i32` = [doc, actor, seq,
-    op_counts]; `coo_i32` = [row, col, val]) for the same reason: fewer
-    transfers, fewer spike opportunities."""
+    Change columns arrive in narrow dtypes and the touched-field mask
+    bit-packed — wire bytes per 1M-op apply drop ~3x, which is what p99
+    rides on when the link bandwidth degrades."""
     new_eseq, new_eval, new_m = _apply_kernel.__wrapped__(
-        eseq, eval_, m, chg_i32[0], chg_i32[1], chg_i32[2], coo_i32[0],
-        coo_i32[1], coo_i32[2], chg_i32[3], op_key, op_isdel_bits,
+        eseq, eval_, m, chg_doc, chg_actor, chg_seq, coo_row,
+        coo_col, coo_val, chg_counts, op_key, op_isdel_bits,
         op_value, n_ops, key_capacity, v_base, n_fields=n_fields,
         n_actors=n_actors, seq_values=seq_values)
+    i = jnp.arange(n_fields)
+    touched_mask = ((touched_bits[i >> 3] >> (7 - (i & 7))) & 1) \
+        .astype(bool)
     extracted = _extract_kernel.__wrapped__(
         new_eseq, new_eval, new_m, rank_plane, key_capacity,
         touched_mask, f_pad=f_pad)
@@ -181,8 +193,8 @@ class DensePatch:
     """Patches from one dense apply, as device arrays; host
     materialization (`to_patch_block` / `diffs`) is lazy."""
 
-    def __init__(self, store, fidx, winner_slot, winner_value, alive,
-                 values):
+    def __init__(self, store, fidx=None, winner_slot=None,
+                 winner_value=None, alive=None, values=None):
         self._store = store
         self.fidx = fidx
         self.winner_slot = winner_slot
@@ -190,8 +202,21 @@ class DensePatch:
         self.alive = alive
         self.values = values          # [f_pad, A] value refs per slot
         self._block = None
+        self._event = None            # set by the async applier
+        self._error = None
+
+    def _resolve_async(self, outs):
+        (self.fidx, self.winner_slot, self.winner_value, self.alive,
+         self.values) = outs
+
+    def _wait(self):
+        if self._event is not None:
+            self._event.wait()
+            if self._error is not None:
+                raise self._error
 
     def block_until_ready(self):
+        self._wait()
         jax.block_until_ready(self.winner_value)
         return self
 
@@ -199,6 +224,7 @@ class DensePatch:
         """Fetch + reshape into a host :class:`~.blocks.PatchBlock`."""
         if self._block is not None:
             return self._block
+        self._wait()
         store = self._store
         fidx = np.asarray(self.fidx)
         live = fidx >= 0
@@ -279,6 +305,10 @@ class DenseMapStore:
                     f'{n_docs} docs do not divide over '
                     f'{mesh.devices.size} devices')
             self._sharding = NamedSharding(mesh, PartitionSpec(axis, None))
+        self._applier = None          # lazy device-phase worker thread
+        self._jobs = None
+        self._last_async = None
+        self._async_error = None      # first device-phase failure (fatal)
         self._alloc_planes()
         self._init_slots()
 
@@ -306,6 +336,11 @@ class DenseMapStore:
             self.m = jax.device_put(self.m, self._sharding)
 
     def reset(self):
+        try:
+            self.drain()
+        except RuntimeError:
+            pass          # reset discards the diverged planes anyway
+        self._async_error = None
         self._alloc_planes()
         self.host = _blocks.BlockStore(self.n_docs,
                                        retain_log=self.retain_log)
@@ -377,6 +412,7 @@ class DenseMapStore:
     def _extract(self, mask):
         """Device patch extraction over a boolean field mask (shared by
         apply_block and extract_all)."""
+        self.drain()
         f_pad = self.options.pad_segments(max(int(mask.sum()), 1))
         fidx, w_slot, w_value, alive, values = _extract_kernel(
             self.eseq, self.eval_, self.m, self._rank_plane_dev(),
@@ -387,6 +423,7 @@ class DenseMapStore:
     def extract_all(self):
         """Patch covering every populated field — materializes the whole
         store (the dense analogue of getPatch, backend/index.js:201-207)."""
+        self.drain()
         populated = np.asarray((self.eseq != 0).any(axis=1))
         return self._extract(populated)
 
@@ -401,7 +438,9 @@ class DenseMapStore:
         metadata that keeps future causal checks exact)."""
         import io
         import json
+        self.drain()
         host = self.host
+        host.log_sorted_keys()     # fold pending appends into l_order
         meta = {'format': 'automerge-tpu-dense-snapshot@1',
                 'n_docs': self.n_docs,
                 'key_capacity': self.key_capacity,
@@ -464,6 +503,9 @@ class DenseMapStore:
             host.c_doc = z['c_doc']
             host.c_actor = z['c_actor']
             host.c_seq = z['c_seq']
+            # purity is an optimization hint; resumed chains re-derive
+            # it conservatively (False costs a no-op closure gather)
+            host.c_pure = np.zeros(len(host.c_doc), bool)
             host.l_key = z['l_key']
             host.l_order = z['l_order']
             host.l_dep_ptr = z['l_dep_ptr']
@@ -533,9 +575,13 @@ class DenseMapStore:
                 f'document {bad} would need {int(total[bad])} actor '
                 f'slots, exceeding actor_capacity={self.actor_capacity}')
 
-    def apply_block(self, block, return_timing=False):
-        """Apply a :class:`~.blocks.ChangeBlock`; returns a
-        :class:`DensePatch` (device-resident; materialize lazily)."""
+    def _stage_block(self, block):
+        """Host phase of one apply: admission + wire-lean column
+        packing. Returns (numpy kernel args, static kwargs) for
+        :func:`_apply_extract_kernel` — the device phase (transfer +
+        dispatch + plane swap) runs separately, either inline
+        (:meth:`apply_block`) or on the applier thread
+        (:meth:`apply_block_async`)."""
         import time
         host = self.host
         opts = self.options
@@ -560,16 +606,27 @@ class DenseMapStore:
         block = st.block
         t1 = time.perf_counter()
 
-        # ---- compress + ship change columns ----
+        # ---- compress + ship change columns (narrowest dtypes) ----
         adm = st.admitted
         rows = np.flatnonzero(adm)
         c_pad = opts.pad_ops(max(len(rows), 1))
-        chg_i32 = np.zeros((4, c_pad), np.int32)
-        change_doc, change_actor, change_seq, op_counts = chg_i32
-        change_doc[:len(rows)] = block.doc[rows]
-        change_actor[:len(rows)] = self._slots_of(
+        n_chg = len(rows)
+        max_seq = int(block.seq[rows].max()) if n_chg else 0
+        d_dtype = np.int16 if self.n_docs < (1 << 15) else np.int32
+        a_dtype = np.uint8 if self.actor_capacity <= 256 else np.int32
+        s_dtype = np.int16 if max_seq < (1 << 15) else np.int32
+        counts = np.diff(block.op_ptr)[rows] if n_chg else \
+            np.zeros(0, np.int32)
+        k_dtype = np.uint8 if (n_chg == 0 or int(counts.max()) < 256) \
+            else np.int32
+        change_doc = np.zeros(c_pad, d_dtype)
+        change_actor = np.zeros(c_pad, a_dtype)
+        change_seq = np.zeros(c_pad, s_dtype)
+        op_counts = np.zeros(c_pad, k_dtype)
+        change_doc[:n_chg] = block.doc[rows]
+        change_actor[:n_chg] = self._slots_of(
             block.doc[rows], st.b_actor[rows], allocate=True)
-        change_seq[:len(rows)] = block.seq[rows]
+        change_seq[:n_chg] = block.seq[rows]
         # closure EXCEPTIONS in per-doc slot coordinates: the kernel
         # sets every change's own-actor entry to seq-1 itself, so only
         # the sparse cross-actor closure entries ship (zero for fully
@@ -589,13 +646,19 @@ class DenseMapStore:
                                      store_id[~own]).astype(np.int32)
             coo_val = Radm[nz_r[~own], nz_c[~own]].astype(np.int32)
         nnz_pad = opts.pad_ops(max(len(coo_row), 1))
-        coo_i32 = np.zeros((3, nnz_pad), np.int32)
-        coo_i32[0, :] = c_pad                       # padding rows drop
-        coo_i32[0, :len(coo_row)] = coo_row
-        coo_i32[1, :len(coo_col)] = coo_col
-        coo_i32[2, :len(coo_val)] = coo_val
+        coo_row_p = np.full(nnz_pad, c_pad, np.int32)  # padding rows drop
+        coo_row_p[:len(coo_row)] = coo_row
+        coo_col_p = np.zeros(nnz_pad, a_dtype)
+        coo_col_p[:len(coo_col)] = coo_col
+        # closure seqs can reference PRIOR history beyond this block's
+        # own seq range — bound the dtype by the actual values
+        v_dtype = np.int16 if (len(coo_val) == 0
+                               or int(coo_val.max()) < (1 << 15)) \
+            else np.int32
+        coo_val_p = np.zeros(nnz_pad, v_dtype)
+        coo_val_p[:len(coo_val)] = coo_val
 
-        op_counts[:len(rows)] = np.diff(block.op_ptr)[rows]
+        op_counts[:n_chg] = counts
         n_ops = len(st.oc)
         n_pad = opts.pad_ops(max(n_ops, 1))
         key_dtype = np.uint8 if self.key_capacity <= 256 else np.int32
@@ -612,15 +675,12 @@ class DenseMapStore:
                                      v_base + int((~is_del).sum()),
                                      dtype=np.int32)))
         if seq_values:
-            op_value_dev = jnp.zeros(1, jnp.int32)     # unused placeholder
+            op_value = np.zeros(1, np.int32)           # unused placeholder
         else:
             op_value = np.full(n_pad, -1, np.int32)
             op_value[:n_ops] = st.o_value
-            op_value_dev = jnp.asarray(op_value)
-        t2 = time.perf_counter()
 
-        # touched fields (host, pre-dispatch) -> ONE fused device call:
-        # apply + patch extraction
+        # touched fields (host, pre-dispatch), bit-packed for the wire
         touched = np.zeros(self.n_fields, bool)
         fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
         touched[fk] = True
@@ -629,22 +689,123 @@ class DenseMapStore:
         # pow2 would recompile per touched-count bucket)
         f_pad = opts.pad_segments(
             max(int(touched.sum()), min(4096, self.n_fields)))
-        out = _apply_extract_kernel(
-            self.eseq, self.eval_, self.m, jnp.asarray(chg_i32),
-            jnp.asarray(coo_i32),
-            jnp.asarray(op_key), jnp.asarray(np.packbits(op_isdel)),
-            op_value_dev, jnp.asarray(n_ops),
-            jnp.asarray(self.key_capacity), jnp.asarray(v_base),
-            self._rank_plane_dev(), jnp.asarray(touched),
-            n_fields=self.n_fields, n_actors=A, seq_values=seq_values,
-            f_pad=f_pad)
+        t2 = time.perf_counter()
+        args = (change_doc, change_actor, change_seq, op_counts,
+                coo_row_p, coo_col_p, coo_val_p, op_key,
+                np.packbits(op_isdel), op_value, np.int32(n_ops),
+                np.int32(self.key_capacity), np.int32(v_base),
+                self._rank_plane_dev(), np.packbits(touched))
+        statics = dict(n_fields=self.n_fields, n_actors=A,
+                       seq_values=seq_values, f_pad=f_pad)
+        metrics.bump('dense_batches')
+        metrics.bump('dense_ops', n_ops)
+        return args, statics, (t0, t1, t2)
+
+    def apply_block(self, block, return_timing=False):
+        """Apply a :class:`~.blocks.ChangeBlock`; returns a
+        :class:`DensePatch` (device-resident; materialize lazily)."""
+        import time
+        self.drain()
+        args, statics, (t0, t1, t2) = self._stage_block(block)
+        out = _apply_extract_kernel(self.eseq, self.eval_, self.m,
+                                    *args, **statics)
         self.eseq, self.eval_, self.m = out[:3]
         patch = DensePatch(self, *out[3:])
         t3 = time.perf_counter()
-
-        metrics.bump('dense_batches')
-        metrics.bump('dense_ops', n_ops)
         if return_timing:
             return patch, {'admit': t1 - t0, 'pack': t2 - t1,
                            'dispatch': t3 - t2}
         return patch
+
+    def apply_block_async(self, block):
+        """Apply with the device phase (H2D transfer + dispatch + plane
+        swap) on the store's applier thread: the caller's next host
+        staging overlaps this block's transfers and device program —
+        the frontend/backend overlap the reference's split anticipates
+        (frontend/index.js:91-104), engine-side. Returns a
+        :class:`DensePatch` whose reads wait for the device phase.
+
+        Host staging stays on the calling thread (store host state is
+        single-writer); successive async applies are serialized by the
+        applier queue. Synchronous readers (:meth:`apply_block`,
+        :meth:`extract_all`, :meth:`reset`, :meth:`save_snapshot`)
+        drain the queue first."""
+        import threading
+        if self._async_error is not None:
+            raise RuntimeError(
+                'a previous async apply failed on device; the device '
+                'planes no longer match the host clock/log — restore '
+                'from a snapshot or rebuild the store') \
+                from self._async_error
+        args, statics, _ = self._stage_block(block)
+        patch = DensePatch(self)
+        patch._event = threading.Event()
+
+        def job():
+            try:
+                if self._async_error is not None:
+                    # a predecessor failed: the planes are behind the
+                    # host clock/log; refuse rather than diverge further
+                    raise RuntimeError(
+                        'skipped: a previous async apply failed') \
+                        from self._async_error
+                out = _apply_extract_kernel(self.eseq, self.eval_,
+                                            self.m, *args, **statics)
+                self.eseq, self.eval_, self.m = out[:3]
+                patch._resolve_async(out[3:])
+            except BaseException as e:       # surfaced on drain/reads
+                patch._error = e
+                if self._async_error is None:
+                    self._async_error = e
+            finally:
+                patch._event.set()
+
+        self._submit(job)
+        self._last_async = patch
+        return patch
+
+    def _submit(self, job):
+        if self._applier is None:
+            import queue
+            import threading
+            self._jobs = queue.Queue()
+
+            def run():
+                while True:
+                    j = self._jobs.get()
+                    if j is None:
+                        return
+                    j()
+
+            self._applier = threading.Thread(target=run, daemon=True)
+            self._applier.start()
+        self._jobs.put(job)
+
+    def drain(self):
+        """Wait for any in-flight async applies (device-phase order is
+        the applier queue order, so waiting on the last one suffices).
+        Raises the FIRST async failure — a failed device phase leaves
+        the planes behind the already-committed host clock/log, which
+        only a snapshot restore or rebuild can reconcile."""
+        p = self._last_async
+        if p is not None:
+            self._last_async = None
+            if p._event is not None:
+                p._event.wait()
+        if self._async_error is not None:
+            raise RuntimeError(
+                'an async apply failed on device; the planes are behind '
+                'the committed host clock/log — reset() or restore from '
+                'a snapshot') from self._async_error
+
+    def close(self):
+        """Stop the applier thread (after draining). The store remains
+        usable synchronously; a later apply_block_async restarts it."""
+        try:
+            self.drain()
+        finally:
+            if self._applier is not None:
+                self._jobs.put(None)
+                self._applier.join()
+                self._applier = None
+                self._jobs = None

@@ -299,8 +299,10 @@ class _Txn:
         self.queue = list(store.queue)
         self.c_doc, self.c_actor = store.c_doc, store.c_actor
         self.c_seq = store.c_seq.copy()
+        self.c_pure = store.c_pure.copy()
         self.log = (store.l_key, store.l_order, store._l_sorted,
-                    store.l_dep_ptr, store.l_dep_actor, store.l_dep_seq)
+                    list(store._l_pending), store.l_dep_ptr,
+                    store.l_dep_actor, store.l_dep_seq)
         self.n_retained = len(store.retained)
         self.n_actors = len(store.actors)
         self.n_keys = len(store.keys)
@@ -331,8 +333,9 @@ class _Txn:
         store.c_doc, store.c_actor, store.c_seq = (self.c_doc,
                                                    self.c_actor,
                                                    self.c_seq)
-        (store.l_key, store.l_order, store._l_sorted, store.l_dep_ptr,
-         store.l_dep_actor, store.l_dep_seq) = self.log
+        store.c_pure = self.c_pure
+        (store.l_key, store.l_order, store._l_sorted, store._l_pending,
+         store.l_dep_ptr, store.l_dep_actor, store.l_dep_seq) = self.log
         del store.retained[self.n_retained:]
         store._body_index_cache = (0, None)
         for s in store.actors[self.n_actors:]:

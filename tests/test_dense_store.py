@@ -222,3 +222,65 @@ class TestDenseDifferential:
                 _doc_from_diffs(host_pb.diffs(d))._conflicts
             assert dict(_doc_from_diffs(dense_pb.diffs(d)).items()) == \
                 dict(_doc_from_diffs(host_pb.diffs(d)).items())
+
+
+def test_async_applier_matches_sync_stream():
+    """apply_block_async pipelines the device phase on a worker thread;
+    results must equal the synchronous path exactly."""
+    from automerge_tpu.device.dense_store import DenseMapStore
+    from automerge_tpu.device.workloads import gen_block_workload
+    blocks = [gen_block_workload(n_docs=8, n_actors=3, ops_per_change=4,
+                                 n_keys=8, seed=k, seq0=k + 1)
+              for k in range(4)]
+    sync = DenseMapStore(8, key_capacity=8, actor_capacity=4)
+    pipe = DenseMapStore(8, key_capacity=8, actor_capacity=4)
+    sync_patches = [sync.apply_block(b) for b in blocks]
+    async_patches = [pipe.apply_block_async(b) for b in blocks]
+    pipe.drain()
+    for sp, ap in zip(sync_patches, async_patches):
+        pa, pb = sp.to_patch_block(), ap.to_patch_block()
+        for d in range(8):
+            assert pa.diffs(d) == pb.diffs(d)
+    fa, fb = sync.extract_all(), pipe.extract_all()
+    for d in range(8):
+        assert fa.diffs(d) == fb.diffs(d)
+    # a sync apply after async ones drains implicitly and stays correct
+    more = gen_block_workload(n_docs=8, n_actors=3, ops_per_change=4,
+                              n_keys=8, seed=9, seq0=5)
+    pipe.apply_block_async(more)
+    sync.apply_block(more)
+    fa, fb = sync.extract_all(), pipe.extract_all()
+    for d in range(8):
+        assert fa.diffs(d) == fb.diffs(d)
+
+
+def test_async_failure_is_loud_and_close_stops_worker():
+    """A failed async device phase poisons the store until reset();
+    close() stops the applier thread."""
+    from automerge_tpu.device import dense_store as ds
+    from automerge_tpu.device.dense_store import DenseMapStore
+    from automerge_tpu.device.workloads import gen_block_workload
+    import pytest
+    store = DenseMapStore(8, key_capacity=8, actor_capacity=4)
+    blk = gen_block_workload(n_docs=8, n_actors=2, ops_per_change=2,
+                             n_keys=8)
+    orig = ds._apply_extract_kernel
+    ds._apply_extract_kernel = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError('boom'))
+    try:
+        p = store.apply_block_async(blk)
+        p._event.wait()                # job ran (and failed) for sure
+    finally:
+        ds._apply_extract_kernel = orig
+    with pytest.raises(RuntimeError):
+        p.block_until_ready()
+    with pytest.raises(RuntimeError, match='reset'):
+        store.drain()
+    with pytest.raises(RuntimeError, match='previous async'):
+        store.apply_block_async(blk)
+    store.reset()                      # legitimate recovery path
+    p2 = store.apply_block_async(blk)
+    p2.block_until_ready()
+    assert p2.to_patch_block().n_fields > 0
+    store.close()
+    assert store._applier is None
