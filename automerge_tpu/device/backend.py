@@ -102,7 +102,8 @@ class DeviceBackendState(SharedChangeLog):
 
     __slots__ = ('objects', 'fields', 'states', 'state_lens', 'clock',
                  'deps', 'queue', 'history', 'history_len', '_owned',
-                 'log_truncated', 'undo_pos', 'undo_stack', 'redo_stack')
+                 'log_truncated', 'undo_pos', 'undo_stack', 'redo_stack',
+                 'link_fields')
 
     def __init__(self):
         self.objects = {ROOT_ID: _ObjRecord(None)}
@@ -121,6 +122,7 @@ class DeviceBackendState(SharedChangeLog):
         self.undo_pos = 0
         self.undo_stack = []     # per local change: list of inverse ops
         self.redo_stack = []
+        self.link_fields = set()  # fields currently holding link entries
 
     def clone(self):
         new = DeviceBackendState.__new__(DeviceBackendState)
@@ -138,6 +140,7 @@ class DeviceBackendState(SharedChangeLog):
         new.undo_pos = self.undo_pos
         new.undo_stack = list(self.undo_stack)
         new.redo_stack = list(self.redo_stack)
+        new.link_fields = set(self.link_fields)
         return new
 
     def _writable(self, object_id):
@@ -146,6 +149,15 @@ class DeviceBackendState(SharedChangeLog):
             self.objects[object_id] = self.objects[object_id].clone()
             self._owned.add(object_id)
         return self.objects[object_id]
+
+    def rebuild_link_fields(self):
+        """Recompute the link-field registry from ``fields`` — every
+        path that writes field entries DIRECTLY (snapshot restore,
+        TextBlock bridging) must call this, or the link-free fast path
+        in _update_fields would skip inbound maintenance."""
+        self.link_fields = {
+            f for f, entries in self.fields.items()
+            if any(e['action'] == 'link' for e in entries)}
 
 
 def init(_actor_id=None):
@@ -228,10 +240,11 @@ class _DocWork:
                  'touched_by_obj', 'survivors', 'ins_dirty',
                  'changes_meta', 'row_field', 'row_entry', 'row_change',
                  'row_seg', 'row_node', 'row_objloc', 'row_is_del',
-                 'n_new')
+                 'n_new', 'has_links')
 
     def __init__(self, state):
         self.state = state
+        self.has_links = False    # any link op staged this batch
         self.create_diffs = []
         self.touched = []         # (obj, key) in first-touch order
         self.dirty_seq = []       # sequence obj ids needing re-ordering
@@ -258,6 +271,22 @@ def _stage_changes(work, admitted):
     seg_of = {}                  # field -> segment id (first-touch order)
     dirty_of = {}                # seq obj -> index into dirty_seq
     objects = state.objects
+    # bound-method locals: this loop runs per OP and dominates the host
+    # side of interactive text batches
+    touched_append = work.touched.append
+    row_field_append = work.row_field.append
+    row_entry_append = work.row_entry.append
+    row_change_append = work.row_change.append
+    row_seg_append = work.row_seg.append
+    row_node_append = work.row_node.append
+    row_objloc_append = work.row_objloc.append
+    row_is_del_append = work.row_is_del.append
+    seg_of_get = seg_of.get
+    objects_get = objects.get
+    ins_obj = None               # last ins target's bound caches
+    ins_node_of = ins_nodes_append = ins_parent_append = None
+    ins_elem_append = ins_actor_append = None
+    ins_n = 0
     for ci, (change, all_deps) in enumerate(admitted):
         actor, seq = change['actor'], change['seq']
         work.changes_meta.append((actor, seq, all_deps))
@@ -265,7 +294,7 @@ def _stage_changes(work, admitted):
             action = op['action']
             if action in ('set', 'del', 'link'):
                 obj = op['obj']
-                rec = objects.get(obj)
+                rec = objects_get(obj)
                 if rec is None:
                     raise ValueError('Modification of unknown object ' + obj)
                 key = op['key']
@@ -282,46 +311,58 @@ def _stage_changes(work, admitted):
                 else:
                     node = jl = -1
                 field = (obj, key)
-                seg = seg_of.get(field)
+                seg = seg_of_get(field)
                 if seg is None:
                     seg = seg_of[field] = len(work.touched)
-                    work.touched.append(field)
+                    touched_append(field)
                     work.touched_by_obj.setdefault(obj, []).append(key)
-                work.row_field.append(field)
-                work.row_entry.append(
+                if action == 'link':
+                    work.has_links = True
+                row_field_append(field)
+                row_entry_append(
                     {'actor': actor, 'seq': seq, 'all_deps': all_deps,
                      'action': action, 'value': op.get('value')})
-                work.row_change.append(ci)
-                work.row_seg.append(seg)
-                work.row_node.append(node)
-                work.row_objloc.append(jl)
-                work.row_is_del.append(action == 'del')
+                row_change_append(ci)
+                row_seg_append(seg)
+                row_node_append(node)
+                row_objloc_append(jl)
+                row_is_del_append(action == 'del')
             elif action == 'ins':
                 obj = op['obj']
-                if obj not in objects:
-                    raise ValueError('Modification of unknown object ' + obj)
-                rec = state._writable(obj)
-                if not rec.is_sequence():
-                    raise ValueError(
-                        'Insertion into non-sequence object ' + obj)
+                if obj != ins_obj:           # per-object bound caches
+                    if obj not in objects:
+                        raise ValueError(
+                            'Modification of unknown object ' + obj)
+                    rec = state._writable(obj)
+                    if not rec.is_sequence():
+                        raise ValueError(
+                            'Insertion into non-sequence object ' + obj)
+                    ins_obj = obj
+                    ins_node_of = rec.node_of
+                    ins_nodes_append = rec.nodes.append
+                    ins_parent_append = rec.node_parent.append
+                    ins_elem_append = rec.node_elem.append
+                    ins_actor_append = rec.node_actor.append
+                    ins_n = len(rec.nodes)
+                    work.ins_dirty.add(obj)
+                    if obj not in dirty_of:
+                        dirty_of[obj] = len(work.dirty_seq)
+                        work.dirty_seq.append(obj)
                 elem = op['elem']
                 elem_id = f'{actor}:{elem}'
-                if elem_id in rec.node_of:
+                if elem_id in ins_node_of:
                     raise ValueError('Duplicate list element ID ' + elem_id)
-                parent = rec.node_of.get(op['key'])
+                parent = ins_node_of.get(op['key'])
                 if parent is None:
                     raise ValueError(
                         'List element insertion after unknown element '
                         + str(op['key']))
-                rec.node_of[elem_id] = len(rec.nodes)
-                rec.nodes.append(elem_id)
-                rec.node_parent.append(parent)
-                rec.node_elem.append(elem)
-                rec.node_actor.append(actor)
-                work.ins_dirty.add(obj)
-                if obj not in dirty_of:
-                    dirty_of[obj] = len(work.dirty_seq)
-                    work.dirty_seq.append(obj)
+                ins_node_of[elem_id] = ins_n
+                ins_n += 1
+                ins_nodes_append(elem_id)
+                ins_parent_append(parent)
+                ins_elem_append(elem)
+                ins_actor_append(actor)
             elif action in _MAKE_KIND:
                 obj = op['obj']
                 if obj in state.objects:
@@ -496,27 +537,42 @@ def _update_fields(work, surviving_row):
     for j in np.flatnonzero(surviving_row[:work.n_rows]):
         survivors_by_field[row_field[j]].append(row_entry[j])
 
+    # link bookkeeping only runs when links are in play at all — a text
+    # session touches thousands of fields per batch, none of them links
+    links_possible = work.has_links or state.link_fields
+    fields = state.fields
+    fields_get = fields.get
+    work_survivors = work.survivors
     for field in work.touched:
-        before = state.fields.get(field, ())
         survivors = survivors_by_field[field]
         if len(survivors) > 1:
             survivors.sort(key=lambda e: e['actor'], reverse=True)
 
-        # inbound maintenance: link refs that dropped out leave the target,
-        # new surviving links join it (op_set.js:194-208).
-        gone = [e for e in before if e not in survivors and e['action'] == 'link']
-        for e in gone:
-            if e['value'] in state.objects:
-                target = state._writable(e['value'])
-                target.inbound = [r for r in target.inbound if r != field]
-        for e in survivors:
-            if e['action'] == 'link':
-                target = state._writable(e['value'])
-                if field not in target.inbound:
-                    target.inbound.append(field)
+        if links_possible:
+            before = fields_get(field, ())
+            # inbound maintenance: link refs that dropped out leave the
+            # target, new surviving links join it (op_set.js:194-208).
+            gone = [e for e in before
+                    if e not in survivors and e['action'] == 'link']
+            for e in gone:
+                if e['value'] in state.objects:
+                    target = state._writable(e['value'])
+                    target.inbound = [r for r in target.inbound
+                                      if r != field]
+            has_link = False
+            for e in survivors:
+                if e['action'] == 'link':
+                    has_link = True
+                    target = state._writable(e['value'])
+                    if field not in target.inbound:
+                        target.inbound.append(field)
+            if has_link:
+                state.link_fields.add(field)
+            else:
+                state.link_fields.discard(field)
 
-        state.fields[field] = tuple(survivors)
-        work.survivors[field] = survivors
+        fields[field] = tuple(survivors)
+        work_survivors[field] = survivors
 
 
 def _get_path(state, object_id):

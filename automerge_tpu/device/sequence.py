@@ -138,6 +138,8 @@ def _rga_order_mxu(parent, elem, actor, visible, valid):
     traffic is cheap; :func:`_rga_order_batched` picks the variant by
     static shape."""
     K, n = parent.shape
+    visible = visible.astype(bool)       # uint8 0/1 planes are welcome,
+    valid = valid.astype(bool)           # but cumsums must see bool
     idx = jnp.arange(n, dtype=jnp.int32)[None, :]
     rowi = jnp.arange(K, dtype=jnp.int32)[:, None]
     rounds = _ceil_log2(n) + 1

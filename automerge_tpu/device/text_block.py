@@ -274,6 +274,7 @@ class TextReplay:
                 for s in range(1, top + 1)]
             state.state_lens[a] = top
         state.log_truncated = True
+        state.rebuild_link_fields()
         return state
 
     def to_doc(self, actor_id=None):

@@ -27,6 +27,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
+from ..device.merge import _resolve
 from ..device.sequence import _rga_order
 from .mesh import DOC_AXIS, shard_docs
 
@@ -49,6 +50,143 @@ def _sharded_rga_fn(mesh):
         out_specs=({'tree_pos': spec, 'vis_index': spec,
                     'node_at_pos': spec, 'length': P(DOC_AXIS)},
                    {'visible_total': P(), 'jobs': P()})))
+
+
+def sharded_general_step(mesh, ops_actor, ops_seq, ops_slot, boundary,
+                         is_del, valid, coo_row, coo_col, coo_val,
+                         seq_planes, seq_nj, seq_prior_vis, *,
+                         num_segments, a_pad):
+    """The general engine's FUSED step (field resolution + element
+    visibility + RGA ordering) over a device mesh: assignment ROWS
+    shard across chips for the resolve phase, the per-object visibility
+    contributions reduce over the ICI (pmax), and the dirty-object JOBS
+    shard for the ordering phase — dp over ops and over documents'
+    objects, in one two-phase program.
+
+    Inputs are exactly the wire-lean staged planes
+    :func:`automerge_tpu.device.general._fused_general` consumes (rows
+    FIELD-SORTED, so row ranges partition cleanly); outputs are
+    bit-identical to the single-device program — the multichip dryrun
+    gates on that equality with real staged blocks.
+    """
+    n_dev = mesh.devices.size
+    boundary = np.asarray(boundary).astype(bool)
+    valid = np.asarray(valid).astype(bool)
+    n = len(boundary)
+    K, m = seq_planes[0].shape
+
+    # host split: row ranges SNAPPED to segment boundaries (rows are
+    # field-sorted), so no segment straddles a shard — each shard's
+    # resolve is then collective-free, and the per-segment winners
+    # combine with one pmax
+    bpos = np.flatnonzero(boundary)
+    targets = (np.arange(1, n_dev) * n) // n_dev
+    cuts = bpos[np.minimum(np.searchsorted(bpos, targets),
+                           max(len(bpos) - 1, 0))] if len(bpos) else \
+        np.zeros(n_dev - 1, np.int64)
+    starts = np.concatenate([[0], cuts])
+    ends = np.concatenate([cuts, [n]])
+    n_shard = int(np.maximum(ends - starts, 1).max())
+    seg_base = np.cumsum(boundary)[np.maximum(starts - 1, 0)] \
+        .astype(np.int32)
+    seg_base[0] = 0
+
+    def shardify(a, fill=0):
+        out = np.full((n_dev, n_shard) + a.shape[1:], fill, a.dtype)
+        for s, (lo, hi) in enumerate(zip(starts, ends)):
+            out[s, :hi - lo] = a[lo:hi]
+        return out
+
+    s_actor_r = shardify(np.asarray(ops_actor))
+    s_seq_r = shardify(np.asarray(ops_seq))
+    s_slot_r = shardify(np.asarray(ops_slot), fill=-1)
+    s_bnd_r = shardify(boundary)
+    s_del_r = shardify(np.asarray(is_del).astype(bool))
+    s_val_r = shardify(valid)
+    # COO rows land in their owning shard, in local coordinates
+    coo_row = np.asarray(coo_row)
+    live = coo_row < n
+    shard_of = np.searchsorted(ends, coo_row, side='right')
+    shard_of = np.minimum(shard_of, n_dev - 1)
+    nnz_shard = max(int(np.bincount(shard_of[live],
+                                    minlength=n_dev).max())
+                    if live.any() else 0, 1)
+    c_row = np.full((n_dev, nnz_shard), n_shard, np.int32)
+    c_col = np.zeros((n_dev, nnz_shard), np.asarray(coo_col).dtype)
+    c_val = np.zeros((n_dev, nnz_shard), np.asarray(coo_val).dtype)
+    for s in range(n_dev):
+        sel = live & (shard_of == s)
+        cnt = int(sel.sum())
+        c_row[s, :cnt] = coo_row[sel] - starts[s]
+        c_col[s, :cnt] = np.asarray(coo_col)[sel]
+        c_val[s, :cnt] = np.asarray(coo_val)[sel]
+    row_starts = starts.astype(np.int32)
+
+    shard_spec = P(DOC_AXIS)
+    rep = P()
+
+    def phase_a(actor_l, seq_l, slot_l, bnd_l, del_l, val_l, base_l,
+                start_l, cr, cc, cv):
+        actor32 = actor_l[0].astype(jnp.int32)
+        seq32 = seq_l[0].astype(jnp.int32)
+        bnd = bnd_l[0]
+        val = val_l[0]
+        seg_id = base_l[0] + jnp.cumsum(bnd.astype(jnp.int32)) - 1
+        seg_id = jnp.maximum(seg_id, 0)          # padding-only prefixes
+        nl = actor32.shape[0]
+        clock = jnp.zeros((nl, a_pad), jnp.int32)
+        clock = clock.at[jnp.arange(nl), actor32].set(seq32 - 1)
+        clock = clock.at[cr[0], cc[0].astype(jnp.int32)].set(
+            cv[0].astype(jnp.int32), mode='drop')
+        out = _resolve(seg_id, actor32, seq32, clock, del_l[0], val,
+                       num_segments)
+        # winner ids are LOCAL row indexes; lift to global coordinates
+        winner = jnp.where(out['winner'] >= 0,
+                           out['winner'] + start_l[0], -1)
+        winner = jax.lax.pmax(winner, DOC_AXIS)
+        # per-object visibility contributions reduce over the ICI
+        flat = jnp.where(slot_l[0] >= 0, slot_l[0], K * m)
+        vis_hit = jnp.zeros(K * m, bool).at[flat].max(
+            out['surviving'], mode='drop')
+        touched = jnp.zeros(K * m, bool).at[flat].max(val, mode='drop')
+        vis_hit = jax.lax.pmax(vis_hit.astype(jnp.int32), DOC_AXIS)
+        touched = jax.lax.pmax(touched.astype(jnp.int32), DOC_AXIS)
+        return (out['surviving'][None], winner, vis_hit.astype(bool),
+                touched.astype(bool))
+
+    fa = jax.jit(shard_map(
+        phase_a, mesh=mesh,
+        in_specs=(shard_spec,) * 11,
+        out_specs=(shard_spec, rep, rep, rep)))
+    surviving, winner, vis_hit, touched = fa(
+        jnp.asarray(s_actor_r), jnp.asarray(s_seq_r),
+        jnp.asarray(s_slot_r), jnp.asarray(s_bnd_r),
+        jnp.asarray(s_del_r), jnp.asarray(s_val_r),
+        jnp.asarray(seg_base), jnp.asarray(row_starts),
+        jnp.asarray(c_row), jnp.asarray(c_col), jnp.asarray(c_val))
+
+    # reassemble the row-sharded survivors into flat row order
+    surv2 = np.asarray(surviving)
+    surviving_flat = np.zeros(n, bool)
+    for s, (lo, hi) in enumerate(zip(starts, ends)):
+        surviving_flat[lo:hi] = surv2[s, :hi - lo]
+
+    s_parent, s_elem, s_actor = (np.asarray(seq_planes[0]),
+                                 np.asarray(seq_planes[1]),
+                                 np.asarray(seq_planes[2]))
+    s_valid = (np.arange(m, dtype=np.int32)[None, :]
+               < np.asarray(seq_nj)[:, None])
+    visible = (np.where(
+        np.asarray(touched).reshape(K, m),
+        np.asarray(vis_hit).reshape(K, m),
+        np.asarray(seq_prior_vis).astype(bool)) & s_valid).astype(bool)
+    ordered, _ = sharded_rga_jobs(
+        mesh, s_parent.astype(np.int32), s_elem.astype(np.int32),
+        s_actor.astype(np.int32), visible, s_valid)
+    return {'surviving': surviving_flat,
+            'winner': np.asarray(winner),
+            'visible': visible,
+            'vis_index': np.asarray(ordered['vis_index'])}
 
 
 def sharded_rga_jobs(mesh, parent, elem, actor, visible, valid):

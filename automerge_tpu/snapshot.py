@@ -93,6 +93,7 @@ def restore_state(payload):
 
     state.fields = {(obj, key): tuple(entries)
                     for obj, key, entries in payload['fields']}
+    state.rebuild_link_fields()
     state.clock = dict(payload['clock'])
     state.deps = dict(payload['deps'])
     state.queue = list(payload['queue'])

@@ -1,0 +1,128 @@
+"""The frontend/backend split with a LIVE worker thread: local edits
+stay optimistic while the backend answers asynchronously; lagging
+patches reconcile through the request queue + OT (the architecture the
+reference split anticipates, frontend/index.js:91-104, CHANGELOG
+"moved to a background thread")."""
+
+import time
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import backend as DeviceBackend
+from automerge_tpu.frontend.worker import BackendWorker
+
+
+def mat(doc):
+    def conv(obj):
+        name = type(obj).__name__
+        if name == 'AmList':
+            return [conv(v) for v in obj]
+        if name == 'Text':
+            return ''.join(str(c) for c in obj)
+        if hasattr(obj, '_conflicts'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+def pump(doc, worker, until_empty=True, timeout=10.0):
+    """Apply worker patches to the split-mode doc until its request
+    queue drains."""
+    deadline = time.time() + timeout
+    while True:
+        for patch in worker.poll_patches(timeout=0.05):
+            doc = Frontend.apply_patch(doc, patch)
+        if not until_empty or not doc._state['requests']:
+            return doc
+        if time.time() > deadline:
+            raise TimeoutError('request queue never drained')
+
+
+@pytest.mark.parametrize('backend', [Backend, DeviceBackend],
+                         ids=['oracle', 'device'])
+def test_live_worker_concurrent_edits_and_remote_changes(backend):
+    worker = BackendWorker(backend)
+    doc = Frontend.init('aaaa-ui')
+
+    # a remote peer's history, prepared synchronously
+    remote = Frontend.init({'backend': Backend})
+    remote = Frontend.set_actor_id(remote, 'zzzz-remote')
+    remote, _ = Frontend.change(
+        remote, lambda d: d.__setitem__('remote_key', 'remote'))
+    remote_changes = Backend.get_changes_for_actor(
+        Frontend.get_backend_state(remote), 'zzzz-remote')
+
+    # three local edits fired WITHOUT waiting for the backend, with the
+    # remote delivery racing the second one — the worker answers in
+    # queue order while the UI thread keeps editing optimistically
+    doc, r1 = Frontend.change(doc, lambda d: d.__setitem__('a', 1))
+    worker.submit_request(r1)
+    doc, r2 = Frontend.change(doc, lambda d: d.__setitem__('b', 2))
+    worker.submit_request(r2)
+    worker.submit_changes(remote_changes)
+    doc, r3 = Frontend.change(doc, lambda d: d.update(
+        {'a': 10, 'c': 3}))
+    # optimistic view holds ALL local edits before any patch came back
+    assert mat(doc) == {'a': 10, 'b': 2, 'c': 3}
+    worker.submit_request(r3)
+
+    doc = pump(doc, worker)
+    assert mat(doc) == {'a': 10, 'b': 2, 'c': 3,
+                        'remote_key': 'remote'}
+
+    # the worker's log replays to the same document (convergence)
+    changes = worker.get_changes({})
+    st, _ = Backend.apply_changes(Backend.init(), changes)
+    viewer = Frontend.apply_patch(Frontend.init('viewer'),
+                                  Backend.get_patch(st))
+    assert mat(viewer) == mat(doc)
+    worker.close()
+
+
+def test_lagging_patch_reconciles_pending_requests():
+    """A patch for request 1 lands while requests 2 and 3 are still
+    pending: the frontend's OT replays them on top (the genuinely
+    concurrent version of test_frontend_concurrency's simulation)."""
+    worker = BackendWorker(Backend)
+    doc = Frontend.init('bbbb-ui')
+    doc, r1 = Frontend.change(doc, lambda d: d.__setitem__('k', 'one'))
+    worker.submit_request(r1)
+    patches = worker.drain()          # backend answered request 1...
+    doc, r2 = Frontend.change(doc, lambda d: d.__setitem__('k', 'two'))
+    doc, r3 = Frontend.change(doc, lambda d: d.__setitem__('j', 'x'))
+    assert len(doc._state['requests']) == 3   # r1's patch not seen yet
+    for p in patches:                 # ...which lands only NOW
+        doc = Frontend.apply_patch(doc, p)
+    # pending local edits survived the lagging patch
+    assert mat(doc) == {'k': 'two', 'j': 'x'}
+    assert len(doc._state['requests']) == 2
+    worker.submit_request(r2)
+    worker.submit_request(r3)
+    doc = pump(doc, worker)
+    assert mat(doc) == {'k': 'two', 'j': 'x'}
+    assert not doc._state['requests']
+    worker.close()
+
+
+def test_worker_error_surfaces_on_drain():
+    worker = BackendWorker(Backend)
+    worker.submit_changes([{'actor': 'x', 'seq': 1, 'deps': {},
+                            'ops': [{'action': 'frobnicate',
+                                     'obj': ROOT_ID, 'key': 'k'}]}])
+    with pytest.raises(Exception):
+        worker.drain()
+    worker.close()
+
+
+def test_worker_callback_mode_streams_patches():
+    got = []
+    worker = BackendWorker(Backend, on_patch=got.append)
+    doc = Frontend.init('cccc-ui')
+    doc, r1 = Frontend.change(doc, lambda d: d.__setitem__('x', 1))
+    worker.submit_request(r1)
+    worker.drain()
+    assert len(got) == 1 and got[0]['actor'] == 'cccc-ui'
+    worker.close()

@@ -251,3 +251,29 @@ class TestDenseSnapshot:
     def test_rejects_garbage(self):
         with pytest.raises(Exception):
             DenseMapStore.load_snapshot(b'not a snapshot')
+
+
+def test_restored_state_keeps_link_bookkeeping():
+    """A snapshot-restored state must keep maintaining inbound links
+    when later batches carry no link ops (r4 review finding: the
+    link-free fast path trusted a registry restore didn't rebuild)."""
+    from automerge_tpu import frontend as Frontend
+    from automerge_tpu import snapshot
+    from automerge_tpu.device import backend as DeviceBackend
+    from automerge_tpu.common import ROOT_ID
+
+    doc = Frontend.init({'backend': DeviceBackend, 'actorId': 'link-a'})
+    doc, _ = Frontend.change(doc, lambda d: d.__setitem__('k', {'x': 1}))
+    snap = snapshot.save_snapshot(doc)
+    doc2 = snapshot.load_snapshot(snap)
+    state = Frontend.get_backend_state(doc2)
+    # causally overwrite the link with a plain scalar (no link ops in
+    # the batch, so only the registry can trigger inbound maintenance)
+    state, _ = DeviceBackend.apply_changes(state, [{
+        'actor': 'link-a', 'seq': 2, 'deps': {},
+        'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                 'value': 'scalar'}]}])
+    # the orphaned map object must have lost its inbound ref
+    obj = next(o for o, rec in state.objects.items()
+               if o != ROOT_ID)
+    assert state.objects[obj].inbound == []
