@@ -537,9 +537,11 @@ def _update_fields(work, surviving_row):
     for j in np.flatnonzero(surviving_row[:work.n_rows]):
         survivors_by_field[row_field[j]].append(row_entry[j])
 
-    # link bookkeeping only runs when links are in play at all — a text
-    # session touches thousands of fields per batch, none of them links
-    links_possible = work.has_links or state.link_fields
+    # link bookkeeping only runs for fields where links are in play — a
+    # text session touches thousands of fields per batch, none of them
+    # links, even when the document ROOT holds link fields
+    batch_links = work.has_links
+    link_fields = state.link_fields
     fields = state.fields
     fields_get = fields.get
     work_survivors = work.survivors
@@ -548,7 +550,7 @@ def _update_fields(work, surviving_row):
         if len(survivors) > 1:
             survivors.sort(key=lambda e: e['actor'], reverse=True)
 
-        if links_possible:
+        if batch_links or field in link_fields:
             before = fields_get(field, ())
             # inbound maintenance: link refs that dropped out leave the
             # target, new surviving links join it (op_set.js:194-208).

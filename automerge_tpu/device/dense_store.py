@@ -659,54 +659,65 @@ class DenseMapStore:
         coo_val_p[:len(coo_val)] = coo_val
 
         op_counts[:n_chg] = counts
+        rank_plane = self._rank_plane_dev()
         n_ops = len(st.oc)
         n_pad = opts.pad_ops(max(n_ops, 1))
         key_dtype = np.uint8 if self.key_capacity <= 256 else np.int32
-        op_key = np.zeros(n_pad, key_dtype)
-        op_key[:n_ops] = st.o_key
-        is_del = st.o_action == _DEL
-        op_isdel = np.zeros(n_pad, bool)
-        op_isdel[:n_ops] = is_del
-        # wire-lean fast path: sequential value refs reconstruct on device
-        v_base = int(st.o_value[~is_del][0]) if (~is_del).any() else 0
-        seq_values = bool(
-            np.array_equal(st.o_value[~is_del],
-                           np.arange(v_base,
-                                     v_base + int((~is_del).sum()),
-                                     dtype=np.int32)))
-        if seq_values:
-            op_value = np.zeros(1, np.int32)           # unused placeholder
-        else:
-            op_value = np.full(n_pad, -1, np.int32)
-            op_value[:n_ops] = st.o_value
-
-        # touched fields (host, pre-dispatch), bit-packed for the wire
-        touched = np.zeros(self.n_fields, bool)
-        fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
-        touched[fk] = True
-        # floor the extract bucket at 4096 so sparse ticks share ONE
-        # compile of the fused kernel (f_pad is static; an unfloored
-        # pow2 would recompile per touched-count bucket)
-        f_pad = opts.pad_segments(
-            max(int(touched.sum()), min(4096, self.n_fields)))
         t2 = time.perf_counter()
-        args = (change_doc, change_actor, change_seq, op_counts,
-                coo_row_p, coo_col_p, coo_val_p, op_key,
-                np.packbits(op_isdel), op_value, np.int32(n_ops),
-                np.int32(self.key_capacity), np.int32(v_base),
-                self._rank_plane_dev(), np.packbits(touched))
-        statics = dict(n_fields=self.n_fields, n_actors=A,
-                       seq_values=seq_values, f_pad=f_pad)
+
+        def finish_pack():
+            # PURE reads of the (now-immutable) staged columns + fresh
+            # array builds: safe to run on the applier thread, so a
+            # pipelined caller's main thread pays only the state-
+            # mutating phase above (admission, slots, rank plane)
+            op_key = np.zeros(n_pad, key_dtype)
+            op_key[:n_ops] = st.o_key
+            is_del = st.o_action == _DEL
+            op_isdel = np.zeros(n_pad, bool)
+            op_isdel[:n_ops] = is_del
+            # wire-lean fast path: sequential value refs reconstruct
+            # on device
+            v_base = int(st.o_value[~is_del][0]) if (~is_del).any() \
+                else 0
+            seq_values = bool(
+                np.array_equal(st.o_value[~is_del],
+                               np.arange(v_base,
+                                         v_base + int((~is_del).sum()),
+                                         dtype=np.int32)))
+            if seq_values:
+                op_value = np.zeros(1, np.int32)    # unused placeholder
+            else:
+                op_value = np.full(n_pad, -1, np.int32)
+                op_value[:n_ops] = st.o_value
+            # touched fields, bit-packed for the wire
+            touched = np.zeros(self.n_fields, bool)
+            fk = st.o_doc.astype(np.int64) * self.key_capacity + st.o_key
+            touched[fk] = True
+            # floor the extract bucket at 4096 so sparse ticks share
+            # ONE compile of the fused kernel (f_pad is static; an
+            # unfloored pow2 would recompile per touched-count bucket)
+            f_pad = opts.pad_segments(
+                max(int(touched.sum()), min(4096, self.n_fields)))
+            args = (change_doc, change_actor, change_seq, op_counts,
+                    coo_row_p, coo_col_p, coo_val_p, op_key,
+                    np.packbits(op_isdel), op_value, np.int32(n_ops),
+                    np.int32(self.key_capacity), np.int32(v_base),
+                    rank_plane, np.packbits(touched))
+            statics = dict(n_fields=self.n_fields, n_actors=A,
+                           seq_values=seq_values, f_pad=f_pad)
+            return args, statics
+
         metrics.bump('dense_batches')
         metrics.bump('dense_ops', n_ops)
-        return args, statics, (t0, t1, t2)
+        return finish_pack, (t0, t1, t2)
 
     def apply_block(self, block, return_timing=False):
         """Apply a :class:`~.blocks.ChangeBlock`; returns a
         :class:`DensePatch` (device-resident; materialize lazily)."""
         import time
         self.drain()
-        args, statics, (t0, t1, t2) = self._stage_block(block)
+        finish_pack, (t0, t1, t2) = self._stage_block(block)
+        args, statics = finish_pack()
         out = _apply_extract_kernel(self.eseq, self.eval_, self.m,
                                     *args, **statics)
         self.eseq, self.eval_, self.m = out[:3]
@@ -737,7 +748,7 @@ class DenseMapStore:
                 'planes no longer match the host clock/log — restore '
                 'from a snapshot or rebuild the store') \
                 from self._async_error
-        args, statics, _ = self._stage_block(block)
+        finish_pack, _ = self._stage_block(block)
         patch = DensePatch(self)
         patch._event = threading.Event()
 
@@ -749,6 +760,7 @@ class DenseMapStore:
                     raise RuntimeError(
                         'skipped: a previous async apply failed') \
                         from self._async_error
+                args, statics = finish_pack()
                 out = _apply_extract_kernel(self.eseq, self.eval_,
                                             self.m, *args, **statics)
                 self.eseq, self.eval_, self.m = out[:3]

@@ -83,21 +83,24 @@ class BackendWorker:
             raise self._error
         return out
 
+    def _wait_idle(self, timeout):
+        if not self._idle.wait(timeout):
+            raise TimeoutError('backend worker did not drain')
+        if self._error is not None:
+            raise self._error
+
     def drain(self, timeout=10.0):
         """Wait until every queued item has been processed; returns the
         patches produced meanwhile."""
-        patches = []
-        if not self._idle.wait(timeout):
-            raise TimeoutError('backend worker did not drain')
-        patches.extend(self.poll_patches())
-        if self._error is not None:
-            raise self._error
-        return patches
+        self._wait_idle(timeout)
+        return self.poll_patches()
 
-    def get_changes(self, have_deps):
-        """Changes a peer with clock `have_deps` lacks (drains first —
-        the log must include everything submitted)."""
-        self.drain()
+    def get_changes(self, have_deps, timeout=10.0):
+        """Changes a peer with clock `have_deps` lacks (waits for the
+        queue to drain first — the log must include everything
+        submitted — WITHOUT consuming queued patches: the frontend
+        still needs them to reconcile its request queue)."""
+        self._wait_idle(timeout)
         return self._backend.get_missing_changes(self._state, have_deps)
 
     def close(self):

@@ -228,7 +228,13 @@ def bench_rga_ab(jnp, K=2048, m=128, n_real=66, k=20, reps=3):
 
     gather = jax.jit(lambda *a: jax.vmap(_rga_order)(*a))
     mxu = jax.jit(_rga_order_mxu)
-    return run(gather), run(mxu)
+    t_gather, t_mxu = run(gather), run(mxu)
+    t_pallas = None
+    if jax.default_backend() == 'tpu':
+        from automerge_tpu.device.pallas_sequence import (
+            rga_order_batch_pallas)
+        t_pallas = run(rga_order_batch_pallas)
+    return t_gather, t_mxu, t_pallas
 
 
 def bench_card_list(iters=20):
@@ -703,12 +709,17 @@ def main():
             f'{max(t_xla, t_pal) / min(t_xla, t_pal):.2f}x '
             f'(auto-dispatch backed by this A/B)')
 
-    t_gat, t_mxu = bench_rga_ab(jnp)
-    log(f'rga-kernel[mxu-onehot vs gather, amortized 2048x128]: '
-        f'gather {t_gat * 1e3:.1f} ms, mxu {t_mxu * 1e3:.1f} ms -> '
-        f'{"mxu" if t_mxu < t_gat else "gather"} '
-        f'{max(t_gat, t_mxu) / min(t_gat, t_mxu):.2f}x (auto-dispatch: '
-        f'the one-hot matmul rides the MXU for trees <= 512 nodes)')
+    t_gat, t_mxu, t_rpal = bench_rga_ab(jnp)
+    pal_txt = f', pallas {t_rpal * 1e3:.1f} ms' if t_rpal else ''
+    timed = [(t_gat, 'gather'), (t_mxu, 'mxu')] + \
+        ([(t_rpal, 'pallas')] if t_rpal else [])
+    timed.sort()
+    best, name = timed[0]
+    log(f'rga-kernel[3-way A/B, amortized 2048x128]: '
+        f'gather {t_gat * 1e3:.1f} ms, mxu-onehot {t_mxu * 1e3:.1f} ms'
+        f'{pal_txt} -> {name} wins, {t_gat / best:.2f}x over gather '
+        f'(auto-dispatch runs the mxu schedule for trees <= 512 nodes; '
+        f'runner-up this run: {timed[1][1]})')
 
     t_card = bench_card_list()
     log(f'card-list-merge[config 1]: {t_card * 1e3:.2f} ms per 3-way merge')
@@ -753,9 +764,9 @@ def main():
         f'{t_log_load / max(t_snap_load, 1e-9):.0f}x faster resume')
 
     n_nodes, t_order = bench_text_order(jnp, rga_order)
-    log(f'text-order: {n_nodes} elems device-resident in '
-        f'{t_order * 1e3:.2f} ms (~{t_floor * 1e3:.0f} ms link floor) '
-        f'-> {n_nodes / t_order / 1e6:.1f}M elems/s')
+    log(f'text-order: {n_nodes} elems device-resident, '
+        f'{t_order * 1e3:.2f} ms amortized -> '
+        f'{n_nodes / t_order / 1e6:.1f}M elems/s')
 
     bench_trace_replay()
 

@@ -126,3 +126,18 @@ def test_worker_callback_mode_streams_patches():
     worker.drain()
     assert len(got) == 1 and got[0]['actor'] == 'cccc-ui'
     worker.close()
+
+
+def test_get_changes_does_not_consume_patches():
+    """get_changes waits for the queue but must NOT eat queued patches
+    (the frontend still needs them to drain its request queue)."""
+    worker = BackendWorker(Backend)
+    doc = Frontend.init('dddd-ui')
+    doc, r1 = Frontend.change(doc, lambda d: d.__setitem__('x', 1))
+    worker.submit_request(r1)
+    changes = worker.get_changes({})
+    assert len(changes) == 1
+    doc = pump(doc, worker)           # the patch is still available
+    assert not doc._state['requests']
+    assert mat(doc) == {'x': 1}
+    worker.close()
