@@ -77,25 +77,6 @@ _ELEM_BIT = np.int64(1) << 31
 _HEAD_KEY = np.int64(-1) << 32        # pool key of a head node (actor -1)
 
 
-class _DevPlanes:
-    """Shared lazy fetch of one apply's device-resident visibility/order
-    planes (ONE D2H for all consumers, on first demand)."""
-
-    __slots__ = ('visible_dev', 'vis_index_dev', '_host')
-
-    def __init__(self, visible_dev, vis_index_dev):
-        self.visible_dev = visible_dev
-        self.vis_index_dev = vis_index_dev
-        self._host = None
-
-    def get(self):
-        if self._host is None:
-            vis, idx = jax.device_get((self.visible_dev,
-                                       self.vis_index_dev))
-            self._host = (np.asarray(vis), np.asarray(idx))
-        return self._host
-
-
 class _SeqPool:
     """ALL sequence objects' insertion trees, pooled into store-level
     node columns (the batch-vectorized replacement for per-object
@@ -112,16 +93,22 @@ class _SeqPool:
     searchsorted: per-object views, elemId resolution tables and RGA
     job planes are all single vectorized gathers.
 
-    After an apply, visibility planes stay ON DEVICE (``_pending``)
-    until first host demand (``sync``) — an apply-only pipeline never
-    pays the D2H. Appends come in whole-batch calls (obj-grouped,
-    local-ascending), merged into the position index with one
-    searchsorted + insert.
+    The trees are DEVICE-RESIDENT between applies (``mirror``): the
+    node columns live in HBM in POSITION order (obj-major, so every
+    object's nodes are one contiguous slice), each apply ships only the
+    NEW nodes plus their insert positions, and the fused program
+    rebuilds the order, gathers its own job planes, and scatters the
+    updated visibility back — a growing collab session ships O(block)
+    bytes per apply, not O(total tree). The host visibility columns
+    materialize lazily from the mirror (``sync``), so an apply-only
+    pipeline never pays a D2H. Appends come in whole-batch calls
+    (obj-grouped, local-ascending), merged into the position index with
+    one searchsorted + insert.
     """
 
     __slots__ = ('obj', 'local', 'parent', 'actor', 'elemc', 'visible',
                  'vis_index', 'pos_sorted', 'pos_row', 'n_of',
-                 'max_elem_of', '_pending')
+                 'max_elem_of', 'mirror', '_epoch', '_host_epoch')
 
     def __init__(self):
         z32 = np.zeros(0, np.int32)
@@ -136,7 +123,11 @@ class _SeqPool:
         self.pos_row = np.zeros(0, np.int64)
         self.n_of = np.zeros(0, np.int64)        # per OBJECT row
         self.max_elem_of = np.zeros(0, np.int64)
-        self._pending = None     # (planes, dirty objs, n_j, m_pad)
+        # device mirror: {'cap', 'n', 'parent', 'elemc', 'actor',
+        # 'visible', 'vis_index' (device arrays, POS order), 'rank_n'}
+        self.mirror = None
+        self._epoch = 0          # bumped per apply that dirtied trees
+        self._host_epoch = 0     # host visible/vis_index currency
 
     @property
     def n_nodes(self):
@@ -215,19 +206,23 @@ class _SeqPool:
             self.elemc[rows].astype(np.int64)
 
     def sync(self):
-        """Materialize the pending device visibility/order planes into
-        the host columns (once; idempotent). The pending record carries
-        its GLOBAL row ids, so nodes appended since the planes were
-        produced do not shift the scatter targets."""
-        if self._pending is None:
+        """Materialize the device mirror's visibility/order into the
+        host columns (once per apply epoch; idempotent). The mirror is
+        pos-ordered; ``pos_row`` maps it back to global row coords.
+        Nodes appended since the mirror's last apply keep their
+        initial (hidden) host state — the mirror rows cover exactly
+        the first ``mirror['n']`` positions."""
+        if self._host_epoch == self._epoch or self.mirror is None:
             return
-        planes, rows, n_j, m_pad = self._pending
-        self._pending = None
-        vis, idx = planes.get()
-        flat = _span_indices(np.arange(len(n_j), dtype=np.int64) * m_pad,
-                             n_j)
-        self.visible[rows] = vis.reshape(-1)[flat]
-        self.vis_index[rows] = idx.reshape(-1)[flat].astype(np.int32)
+        self._host_epoch = self._epoch
+        n = self.mirror['n']
+        vis, idx = jax.device_get((self.mirror['visible'][:n],
+                                   self.mirror['vis_index'][:n]))
+        # the mirror's OWN pos_row snapshot: appends since the apply
+        # (e.g. single obj_row creates) must not shift the mapping
+        rows = self.mirror['pos_row'][:n]
+        self.visible[rows] = np.asarray(vis)
+        self.vis_index[rows] = np.asarray(idx)
 
 
 def _exact_lookup(t_obj, t_key, t_val, q_obj, q_key, n_objs):
@@ -295,7 +290,8 @@ class _Txn:
     def __init__(self, store):
         pool = store.pool
         self.pending = store._pending_commit
-        self.pool_pending = pool._pending
+        self.pool_mirror = pool.mirror
+        self.pool_epochs = (pool._epoch, pool._host_epoch)
         self.queue = list(store.queue)
         self.c_doc, self.c_actor = store.c_doc, store.c_actor
         self.c_seq = store.c_seq.copy()
@@ -323,12 +319,13 @@ class _Txn:
         # the store returns to "previous apply dispatched, uncommitted",
         # and the (idempotent) commit replays on the next entry read
         store._pending_commit = self.pending
-        # restore un-consumed device planes too: if this apply's
-        # pool.sync() drained them before the raise, the scatter landed
-        # in arrays the rollback is about to discard — the pending
-        # record's global rows stay valid for the restored arrays, so
-        # the sync simply replays on next demand
-        store.pool._pending = self.pool_pending
+        # the device mirror is only replaced AFTER the (raise-free)
+        # dispatch, but restore it — and the sync epochs — anyway so a
+        # partially-staged apply leaves the resident state exactly as
+        # found (an intervening pool.sync() was committed-state
+        # materialization and stays correct under the restored refs)
+        store.pool.mirror = self.pool_mirror
+        store.pool._epoch, store.pool._host_epoch = self.pool_epochs
         store.queue = self.queue
         store.c_doc, store.c_actor, store.c_seq = (self.c_doc,
                                                    self.c_actor,
@@ -726,27 +723,64 @@ def _unpack_bits(u8, n):
     return ((u8[i >> 3] >> (7 - (i & 7))) & 1).astype(bool)
 
 
-@partial(jax.jit, static_argnames=('num_segments', 'a_pad'))
-def _fused_general(ops_actor, ops_seq, ops_slot, flags_u8, n_rows,
-                   coo_row, coo_col, coo_val, seq_planes, seq_nj,
-                   seq_vis_u8, *, num_segments, a_pad):
-    """Flat resolve + element visibility + RGA ordering for every dirty
-    sequence, one device program (the block-path analogue of the per-doc
-    backend's fused step).
+@partial(jax.jit, static_argnames=('num_segments', 'a_pad', 'm_pad'))
+def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
+                            m_visidx, d_parent, d_elemc, d_actor, d_pos,
+                            n_old, job_start, job_n, rank_table,
+                            ops_actor, ops_seq, ops_slot, flags_u8,
+                            n_rows, coo_row, coo_col, coo_val, *,
+                            num_segments, a_pad, m_pad):
+    """One apply of the general engine against DEVICE-RESIDENT trees:
+    fold this apply's new nodes into the pos-ordered mirror, gather the
+    dirty objects' job planes from it, resolve every touched field,
+    derive element visibility, re-order every dirty sequence, and
+    scatter the new visibility back into the mirror — one program.
 
-    Wire-lean inputs for the tunnel/PCIe edge (the link bandwidth is the
-    binding constraint — see BENCH link_floor): rows arrive FIELD-SORTED
-    so segment ids are ONE boundary bit per row (cumsum on device);
-    actor slots and seq counters ride in the narrowest dtype that fits
-    (uint8/int16, upcast here); validity masks derive from row/node
-    counts instead of shipping; the clock plane is REBUILT ON DEVICE —
-    own-actor entries are always seq-1 (the closure fold's final SET),
-    so only the sparse cross-actor closure entries ship, as COO triples.
-    Survivors return bit-packed; the winner/visibility/order outputs
-    stay device-resident for lazy fetching.
+    Wire-lean inputs (the link is the binding constraint): only NEW
+    nodes ship (columns + insert positions; a growing collab session
+    pays O(block), not O(tree)); rows arrive FIELD-SORTED so segment
+    ids are ONE boundary bit per row; actor slots/seq counters ride the
+    narrowest dtype that fits; validity masks derive from counts; the
+    clock plane is rebuilt on device from sparse COO exceptions (the
+    own-actor entry is always seq-1). Outputs: the updated mirror
+    columns (resident), bit-packed survivors, the per-field winner, and
+    the prior/new visibility+order planes (device-resident for lazy
+    patch materialization).
     """
     from .merge import _resolve
     from .sequence import _rga_order_batched
+    cap = m_parent.shape[0]
+
+    # ---- fold the new nodes in (pos-order preserving insert) ----
+    i = jnp.arange(cap, dtype=jnp.int32)
+    cnt = jnp.searchsorted(d_pos, i, side='right').astype(jnp.int32)
+    tgt_old = jnp.where(i < n_old, i + cnt, cap)
+    tgt_new = d_pos + jnp.arange(d_pos.shape[0], dtype=jnp.int32)
+
+    def fold(col, dcol, fill):
+        out = jnp.full((cap,), fill, col.dtype)
+        out = out.at[tgt_old].set(col, mode='drop')
+        return out.at[tgt_new].set(dcol.astype(col.dtype), mode='drop')
+
+    parent_p = fold(m_parent, d_parent, 0)
+    elemc_p = fold(m_elemc, d_elemc, 0)
+    actor_p = fold(m_actor, d_actor, -1)
+    visible_p = fold(m_visible, jnp.zeros_like(d_parent, bool), False)
+    visidx_p = fold(m_visidx, jnp.full_like(d_parent, -1), -1)
+
+    # ---- job planes gathered from the resident columns: an object's
+    # nodes are one contiguous pos slice, local-ascending ----
+    l = jnp.arange(m_pad, dtype=jnp.int32)
+    pos_mat = job_start[:, None] + l[None, :]
+    valid_plane = l[None, :] < job_n[:, None]
+    pos_c = jnp.minimum(jnp.where(valid_plane, pos_mat, 0), cap - 1)
+    s_parent = jnp.take(parent_p, pos_c)
+    s_elem = jnp.take(elemc_p, pos_c)
+    s_rank = jnp.take(rank_table, jnp.take(actor_p, pos_c) + 1)
+    prior_vis = jnp.take(visible_p, pos_c) & valid_plane
+    prior_idx = jnp.where(valid_plane, jnp.take(visidx_p, pos_c), -1)
+
+    # ---- field resolution ----
     n = ops_slot.shape[0]
     nb = n >> 3
     boundary = _unpack_bits(flags_u8[:nb], n)
@@ -755,38 +789,39 @@ def _fused_general(ops_actor, ops_seq, ops_slot, flags_u8, n_rows,
     seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     actor = ops_actor.astype(jnp.int32)
     seq = ops_seq.astype(jnp.int32)
-    row_slot = ops_slot
-
     clock = jnp.zeros((n, a_pad), jnp.int32)
     clock = clock.at[jnp.arange(n), actor].set(seq - 1)
     clock = clock.at[coo_row, coo_col.astype(jnp.int32)].set(
         coo_val.astype(jnp.int32), mode='drop')
-
     out = _resolve(seg_id, actor, seq, clock, is_del, valid, num_segments)
 
-    s_parent = seq_planes[0].astype(jnp.int32)
-    s_elem = seq_planes[1].astype(jnp.int32)
-    s_actor = seq_planes[2].astype(jnp.int32)
-    k, m = s_parent.shape
-    s_valid = jnp.arange(m, dtype=jnp.int32)[None, :] < seq_nj[:, None]
-    s_prior_vis = _unpack_bits(seq_vis_u8, k * m).reshape(k, m) & s_valid
-
-    flat = jnp.where(row_slot >= 0, row_slot, k * m)
-    vis_hit = jnp.zeros(k * m, bool).at[flat].max(
+    # ---- element visibility + RGA ordering ----
+    k = job_start.shape[0]
+    flat = jnp.where(ops_slot >= 0, ops_slot, k * m_pad)
+    vis_hit = jnp.zeros(k * m_pad, bool).at[flat].max(
         out['surviving'], mode='drop')
-    touched = jnp.zeros(k * m, bool).at[flat].max(valid, mode='drop')
-    visible = jnp.where(touched.reshape(k, m), vis_hit.reshape(k, m),
-                        s_prior_vis)
-    visible = visible & s_valid
+    touched = jnp.zeros(k * m_pad, bool).at[flat].max(valid, mode='drop')
+    visible = jnp.where(touched.reshape(k, m_pad),
+                        vis_hit.reshape(k, m_pad), prior_vis)
+    visible = visible & valid_plane
+    ordered = _rga_order_batched(s_parent, s_elem, s_rank, visible,
+                                 valid_plane)
 
-    ordered = _rga_order_batched(s_parent, s_elem, s_actor, visible,
-                                 s_valid)
+    # ---- scatter the new visibility/order back into the mirror ----
+    scatter_pos = jnp.where(valid_plane, pos_mat, cap).reshape(-1)
+    visible_p = visible_p.at[scatter_pos].set(visible.reshape(-1),
+                                              mode='drop')
+    visidx_p = visidx_p.at[scatter_pos].set(
+        ordered['vis_index'].reshape(-1), mode='drop')
+
     # survivors return bit-packed (MSB-first, np.unpackbits-compatible)
     surv_u8 = jnp.sum(
         out['surviving'].reshape(-1, 8).astype(jnp.uint8)
         * (jnp.uint8(1) << (7 - jnp.arange(8, dtype=jnp.uint8))),
         axis=1, dtype=jnp.uint8)
-    return surv_u8, out['winner'], visible, ordered['vis_index']
+    return (parent_p, elemc_p, actor_p, visible_p, visidx_p,
+            surv_u8, out['winner'], prior_vis, visible, prior_idx,
+            ordered['vis_index'])
 
 
 # -- apply -------------------------------------------------------------------
@@ -864,17 +899,18 @@ class GeneralPatch:
         self.s_value = r_value[loser_rows]
         self.s_link = r_link[loser_rows]
 
-        # sequence edit columns per dirty object (pool gathers)
-        planes = raw['planes']
+        # sequence edit columns per dirty object: the prior AND new
+        # visibility/order planes come back from the fused program as
+        # device-resident outputs — ONE fetch here, no host mirror sync
+        planes = raw['vis_planes']
         if planes is not None:
             pool = store.pool
-            pool.sync()                     # commit this apply's planes
-            vis, idx = planes.get()
+            pv, nv, pi, ni = [np.asarray(x)
+                              for x in jax.device_get(planes)]
             dirty, n_j = raw['dirty'], raw['dirty_n']
             rows_flat = raw['rows_flat']
             row_start = np.zeros(len(dirty) + 1, np.int64)
             np.cumsum(n_j, out=row_start[1:])
-            prev_flat = raw['prev_vis_index']
             gained = raw['gained_objs']
             elem_fi = np.flatnonzero(self.f_kind)
             ef_obj = self.f_obj[elem_fi] if len(elem_fi) else \
@@ -883,10 +919,10 @@ class GeneralPatch:
                 if len(elem_fi) else np.zeros(0, np.int64)
             for ji, obj_row in enumerate(dirty.tolist()):
                 n = int(n_j[ji])
-                new_vis = vis[ji, :n]
-                new_idx = idx[ji, :n].astype(np.int32)
-                prev_idx = prev_flat[row_start[ji]:row_start[ji] + n]
-                was_vis = prev_idx >= 0
+                new_vis = nv[ji, :n]
+                new_idx = ni[ji, :n].astype(np.int32)
+                prev_idx = pi[ji, :n].astype(np.int32)
+                was_vis = pv[ji, :n]
                 rows = rows_flat[row_start[ji]:row_start[ji] + n]
                 lo, hi = np.searchsorted(ef_obj, [obj_row, obj_row + 1])
                 my_nodes = ef_node[lo:hi]
@@ -1437,36 +1473,76 @@ def _apply_general(store, block, options, return_timing):
     coo_row = np.concatenate(
         [coo_row, np.full(nnz_pad - len(coo_row), n_pad, np.int32)])
 
-    # ---- sequence job planes: whole-batch pool gathers ----
-    pool.sync()              # prior visibility must be current below
+    # ---- device-resident trees: ship only this apply's NEW nodes ----
     K = max(len(dirty), 1)
     rows_flat, n_j = (pool.rows_of_objs(dirty) if len(dirty)
                       else (np.zeros(0, np.int64), np.zeros(0, np.int64)))
     m_pad = opts.pad_nodes(int(max(n_j.max() if len(n_j) else 1, 8)))
-    elem_max = int(pool.max_elem_of[dirty].max()) if len(dirty) else 0
-    p_dtype = np.int16 if (m_pad < (1 << 15)
-                           and elem_max < (1 << 15)
-                           and len(store.actors) < (1 << 15)) \
-        else np.int32
-    seq_planes = np.zeros((3, K, m_pad), p_dtype)
-    s_parent, s_elem, s_actor_rank = seq_planes
-    s_prior_vis = np.zeros((K, m_pad), bool)
+    n_total = pool.n_nodes
+    mir = pool.mirror
+    if mir is None:
+        # first resident apply: EVERY node is this apply's delta — the
+        # mirror materializes on device with zero extra wire bytes
+        cap = opts.pad_nodes(max(n_total, 8))
+        m_cols = (jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.int32),
+                  jnp.full(cap, -1, jnp.int32), jnp.zeros(cap, bool),
+                  jnp.full(cap, -1, jnp.int32))
+        n_old = 0
+    elif mir['cap'] < n_total:
+        # capacity growth ON DEVICE (2x headroom so block-sized growth
+        # amortizes): pad each resident column; nothing ships
+        cap = opts.pad_nodes(max(2 * mir['cap'], n_total))
+
+        def grow(col, fill):
+            return jnp.concatenate(
+                [col, jnp.full(cap - mir['cap'], fill, col.dtype)])
+
+        m_cols = (grow(mir['parent'], 0), grow(mir['elemc'], 0),
+                  grow(mir['actor'], -1), grow(mir['visible'], False),
+                  grow(mir['vis_index'], -1))
+        n_old = mir['n']
+    else:
+        cap = mir['cap']
+        m_cols = (mir['parent'], mir['elemc'], mir['actor'],
+                  mir['visible'], mir['vis_index'])
+        n_old = mir['n']
+
+    new_glob = np.arange(n_old, n_total, dtype=np.int64)
+    d_n = len(new_glob)
+    d_pad = opts.pad_nodes(max(d_n, 8))
+    keys = (pool.obj[new_glob].astype(np.int64) << 32) | \
+        pool.local[new_glob]
+    final_pos = np.searchsorted(pool.pos_sorted, keys)
+    ordp = np.argsort(final_pos, kind='stable')
+
+    def dcol(col):
+        out = np.zeros(d_pad, np.int32)
+        out[:d_n] = col[new_glob][ordp]
+        return out
+
+    d_parent = dcol(pool.parent)
+    d_elemc = dcol(pool.elemc)
+    d_actor = dcol(pool.actor)
+    d_pos = np.full(d_pad, cap, np.int32)
+    d_pos[:d_n] = final_pos[ordp] - np.arange(d_n)
+    n_old_dev = np.int32(n_old)
+
+    # actor -> string-rank table, re-shipped only when the table grew
+    n_act = len(store.actors)
+    if mir is None or mir.get('rank_n') != n_act:
+        rt = np.zeros(opts.pad_actors(n_act + 1), np.int32)
+        rt[1:n_act + 1] = store.actor_str_ranks()
+        rank_table_dev = jnp.asarray(rt)
+    else:
+        rank_table_dev = mir['rank_table']
+
+    # job table: each dirty object's contiguous pos slice
+    job_start = np.zeros(K, np.int32)
     n_j_arr = np.zeros(K, np.int32)
-    prev_vis_index = np.zeros(0, np.int32)
     if len(dirty):
-        str_rank = store.actor_str_ranks()
-        flat = _span_indices(np.arange(K, dtype=np.int64) * m_pad, n_j)
-        s_parent.reshape(-1)[flat] = pool.parent[rows_flat]
-        s_elem.reshape(-1)[flat] = pool.elemc[rows_flat]
-        # rank by actor string order (op_set.js:371-377); head actor -1
-        cat_actor = pool.actor[rows_flat]
-        ranks = np.zeros(len(cat_actor), np.int64)
-        real = cat_actor >= 0
-        ranks[real] = str_rank[cat_actor[real]]
-        s_actor_rank.reshape(-1)[flat] = ranks
-        s_prior_vis.reshape(-1)[flat] = pool.visible[rows_flat]
+        job_start[:] = np.searchsorted(pool.pos_sorted,
+                                       dirty << np.int64(32))
         n_j_arr[:] = n_j
-        prev_vis_index = pool.vis_index[rows_flat].copy()
 
     # per-row (job, node) slots, in the field-sorted coordinates
     row_slot = np.full(n_pad, -1, np.int32)
@@ -1491,14 +1567,25 @@ def _apply_general(store, block, options, return_timing):
 
     flags_u8 = np.concatenate([np.packbits(boundary),
                                np.packbits(del_arr)])
-    surv_u8_dev, winner_dev, visible_dev, vis_index_dev = _fused_general(
+    outs = _fused_general_resident(
+        *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
+        jnp.asarray(d_actor), jnp.asarray(d_pos), n_old_dev,
+        jnp.asarray(job_start), jnp.asarray(n_j_arr), rank_table_dev,
         jnp.asarray(actor_arr), jnp.asarray(seq_arr),
         jnp.asarray(row_slot), jnp.asarray(flags_u8),
         jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
         jnp.asarray(coo_col), jnp.asarray(coo_val),
-        jnp.asarray(seq_planes), jnp.asarray(n_j_arr),
-        jnp.asarray(np.packbits(s_prior_vis)),
-        num_segments=S, a_pad=A)
+        num_segments=S, a_pad=A, m_pad=m_pad)
+    pool.mirror = {
+        'cap': cap, 'n': n_total,
+        'parent': outs[0], 'elemc': outs[1], 'actor': outs[2],
+        'visible': outs[3], 'vis_index': outs[4],
+        'rank_n': n_act, 'rank_table': rank_table_dev,
+        'pos_row': pool.pos_row,     # replaced-on-append: a stable ref
+    }
+    pool._epoch += 1
+    surv_u8_dev, winner_dev = outs[5], outs[6]
+    vis_planes = outs[7:11] if len(dirty) else None
     t3 = time.perf_counter()
 
     # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
@@ -1536,16 +1623,11 @@ def _apply_general(store, block, options, return_timing):
     patch.f_kind = (patch.f_key & _ELEM_BIT) != 0
 
     # ---- lazy wiring: winner columns, conflicts, sequence edits ----
-    planes = None
-    if len(dirty):
-        planes = _DevPlanes(visible_dev, vis_index_dev)
-        pool._pending = (planes, rows_flat, n_j, m_pad)
     patch._raw = {
         'winner_dev': winner_dev, 'surviving': None,   # set at commit
         'cat': cat, 'order': order,
-        'r_seg': r_seg, 's_rows': None, 'planes': planes,
+        'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
         'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat,
-        'prev_vis_index': prev_vis_index,
         'gained_objs': set(ins_objs.tolist()),
     }
     patch._ready = False
