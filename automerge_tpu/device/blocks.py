@@ -142,6 +142,14 @@ class ValueTable:
         return self._len
 
     def extend(self, items):
+        """Append ``items`` as one segment.
+
+        OWNERSHIP TRANSFER: a plain ``list`` argument is stored as a
+        shared segment WITHOUT copying — the caller must not mutate it
+        afterwards (block value tables are immutable once built; a
+        million-value block would otherwise pay a full list copy per
+        apply). Pass any other iterable to get a private copy.
+        """
         if isinstance(items, LazyValues):
             items = items.compacted()
         elif isinstance(items, ValueTable):
@@ -150,9 +158,6 @@ class ValueTable:
             return
         elif type(items) is not list:
             items = list(items)
-        # plain lists append as shared segments without copying (block
-        # value tables are immutable once built; a million-value block
-        # would otherwise pay a full list copy per apply)
         if not len(items):
             return
         self._segs.append(items)

@@ -911,7 +911,7 @@ class GeneralPatch:
             rows_flat = raw['rows_flat']
             row_start = np.zeros(len(dirty) + 1, np.int64)
             np.cumsum(n_j, out=row_start[1:])
-            gained = raw['gained_objs']
+            gained = raw['gained_max_elem']
             elem_fi = np.flatnonzero(self.f_kind)
             ef_obj = self.f_obj[elem_fi] if len(elem_fi) else \
                 np.zeros(0, np.int32)
@@ -939,8 +939,7 @@ class GeneralPatch:
                 set_nodes = set_nodes[np.argsort(new_idx[set_nodes],
                                                  kind='stable')]
                 self.seq_edits[obj_row] = {
-                    'max_elem': int(pool.max_elem_of[obj_row])
-                    if obj_row in gained else None,
+                    'max_elem': gained.get(obj_row),
                     'removes': rm_old,
                     'ins_nodes': ins_nodes, 'ins_idx': new_idx[ins_nodes],
                     'set_nodes': set_nodes, 'set_idx': new_idx[set_nodes],
@@ -1628,7 +1627,12 @@ def _apply_general(store, block, options, return_timing):
         'cat': cat, 'order': order,
         'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
         'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat,
-        'gained_objs': set(ins_objs.tolist()),
+        # per-object maxElem SNAPSHOT at apply time: a pipelined reader
+        # may materialize this patch after apply N+1 has grown the pool,
+        # and the reference reports the per-apply maxElem
+        # (/root/reference/backend/op_set.js:118-125)
+        'gained_max_elem': {int(o): int(pool.max_elem_of[o])
+                            for o in ins_objs.tolist()},
     }
     patch._ready = False
     store._pending_commit = {

@@ -123,7 +123,8 @@ def _mxu_gather2(val_a, val_b, idx, m):
         .astype(jnp.float32)
     both = jnp.stack([val_a, val_b], axis=-1)         # [K, m, 2]
     g = jnp.einsum('jik,jkc->jic', onehot, both,
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
     return g[..., 0], g[..., 1]
 
 

@@ -87,9 +87,11 @@ def sharded_general_step(mesh, ops_actor, ops_seq, ops_slot, boundary,
     starts = np.concatenate([[0], cuts])
     ends = np.concatenate([cuts, [n]])
     n_shard = int(np.maximum(ends - starts, 1).max())
-    seg_base = np.cumsum(boundary)[np.maximum(starts - 1, 0)] \
+    # boundaries strictly BEFORE each start (a snapped start of row 0 has
+    # zero preceding boundaries even though boundary[0] is set)
+    seg_base = np.where(
+        starts > 0, np.cumsum(boundary)[np.maximum(starts - 1, 0)], 0) \
         .astype(np.int32)
-    seg_base[0] = 0
 
     def shardify(a, fill=0):
         out = np.full((n_dev, n_shard) + a.shape[1:], fill, a.dtype)
