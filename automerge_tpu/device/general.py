@@ -108,7 +108,8 @@ class _SeqPool:
 
     __slots__ = ('obj', 'local', 'parent', 'actor', 'elemc', 'visible',
                  'vis_index', 'pos_sorted', 'pos_row', 'n_of',
-                 'max_elem_of', 'mirror', '_epoch', '_host_epoch')
+                 'max_elem_of', 'max_tree', 'max_elem', 'mirror',
+                 '_epoch', '_host_epoch')
 
     def __init__(self):
         z32 = np.zeros(0, np.int32)
@@ -123,6 +124,8 @@ class _SeqPool:
         self.pos_row = np.zeros(0, np.int64)
         self.n_of = np.zeros(0, np.int64)        # per OBJECT row
         self.max_elem_of = np.zeros(0, np.int64)
+        self.max_tree = 0        # pool-wide max n_of (packed-fmt guard)
+        self.max_elem = 0        # pool-wide max elemc (packed-fmt guard)
         # device mirror: {'cap', 'n', 'parent', 'elemc', 'actor',
         # 'visible', 'vis_index' (device arrays, POS order), 'rank_n'}
         self.mirror = None
@@ -168,6 +171,7 @@ class _SeqPool:
         self._append(rows.astype(np.int32), z, z,
                      np.full(len(rows), -1, np.int32), z)
         self.n_of[rows] = 1
+        self.max_tree = max(self.max_tree, 1)
 
     def append_batch(self, obj, local, parent_local, actor, elemc):
         """Append new nodes, whole batch: `obj` ascending, `local`
@@ -184,6 +188,8 @@ class _SeqPool:
         self.n_of[uo] = local[ends] + 1
         seg_max = np.maximum.reduceat(elemc, starts)
         self.max_elem_of[uo] = np.maximum(self.max_elem_of[uo], seg_max)
+        self.max_tree = max(self.max_tree, int(local[ends].max()) + 1)
+        self.max_elem = max(self.max_elem, int(seg_max.max()))
 
     def rows_of_objs(self, objs):
         """(global rows, node counts): all nodes of `objs`, grouped in
@@ -216,8 +222,13 @@ class _SeqPool:
             return
         self._host_epoch = self._epoch
         n = self.mirror['n']
-        vis, idx = jax.device_get((self.mirror['visible'][:n],
-                                   self.mirror['vis_index'][:n]))
+        if self.mirror.get('fmt') == 'packed':
+            # ONE 4B/node fetch; the vis word host-unpacks for free
+            w2 = np.asarray(jax.device_get(self.mirror['w2'][:n]))
+            vis, idx = unpack_w2_word(w2)
+        else:
+            vis, idx = jax.device_get((self.mirror['visible'][:n],
+                                       self.mirror['vis_index'][:n]))
         # the mirror's OWN pos_row snapshot: appends since the apply
         # (e.g. single obj_row creates) must not shift the mapping
         rows = self.mirror['pos_row'][:n]
@@ -311,7 +322,8 @@ class _Txn:
         self.pool_cols = (pool.obj, pool.local, pool.parent, pool.actor,
                           pool.elemc, pool.visible, pool.vis_index,
                           pool.pos_sorted, pool.pos_row)
-        self.pool_n = (pool.n_of.copy(), pool.max_elem_of.copy())
+        self.pool_n = (pool.n_of.copy(), pool.max_elem_of.copy(),
+                       pool.max_tree, pool.max_elem)
 
     def rollback(self, store):
         pool = store.pool
@@ -356,7 +368,8 @@ class _Txn:
         (pool.obj, pool.local, pool.parent, pool.actor, pool.elemc,
          pool.visible, pool.vis_index, pool.pos_sorted,
          pool.pos_row) = self.pool_cols
-        pool.n_of, pool.max_elem_of = self.pool_n
+        (pool.n_of, pool.max_elem_of, pool.max_tree,
+         pool.max_elem) = self.pool_n
 
 
 class GeneralStore(BlockStore):
@@ -824,6 +837,258 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
             ordered['vis_index'])
 
 
+# -- packed fused step -------------------------------------------------------
+#
+# The wire-packed variant of the resident program: the binding costs at
+# block scale are (a) tunnel H2D bytes and per-array transfer overhead,
+# (b) the count of million-element gathers/scatters on device (~4ns/elem
+# on v5e, ~100x an elementwise op). So the mirror packs into TWO int32
+# words per node, every staged input rides ONE uint8 buffer (sliced +
+# bitcast on device — elementwise, fuses), the field resolution rides
+# segmented associative scans instead of segment_max scatters, and the
+# small-tree RGA one-hots run in bf16 (exact: all values <= 256).
+#
+#   W1 = parent << 16 | (rank+1)      rank = actor string rank; head = 0
+#   W2 = visible << 30 | (vis_index+1) << 15 | elemc
+#
+# Guards (host checks; the unpacked `_fused_general_resident` is the
+# fallback and the semantic reference): tree size <= 16384 nodes,
+# elemc < 32768, actor count < 65535, seq < 32768, coo seq < 32768.
+
+_W2_ELEM = 0x7FFF
+_W2_VIS_SHIFT = 30
+_W2_IDX_SHIFT = 15
+
+_NO_REMAP = np.zeros(1, np.int32)     # placeholder when has_remap=False
+
+
+def unpack_vis_word(v_u32):
+    """Host-side unpack of the packed vis output plane
+    (`_fused_general_packed`'s vis_packed, viewed as uint32):
+    (prior_vis, visible, prior_idx, new_idx)."""
+    pv = (v_u32 >> 31).astype(bool)
+    nv = ((v_u32 >> _W2_VIS_SHIFT) & 1).astype(bool)
+    pi = (((v_u32 >> _W2_IDX_SHIFT) & _W2_ELEM).astype(np.int64) - 1)
+    ni = (v_u32 & _W2_ELEM).astype(np.int64) - 1
+    return pv, nv, pi, ni
+
+
+def unpack_w2_word(w2):
+    """Host-side unpack of a mirror W2 word: (visible, vis_index)."""
+    vis = ((w2 >> _W2_VIS_SHIFT) & 1).astype(bool)
+    idx = (((w2 >> _W2_IDX_SHIFT) & _W2_ELEM) - 1).astype(np.int32)
+    return vis, idx
+
+# test/dryrun hook: called once per apply with the staged planes and the
+# fused outputs (whichever variant ran) — the sharded-step equality
+# gates consume this instead of monkeypatching a program symbol
+_STAGE_CAPTURE = None
+
+
+def _wire_sizes(d_pad, n_pad, K, nnz_pad):
+    """Total byte count of the single staged wire buffer. Section
+    offsets are not centralized: the host packing loop in
+    `_apply_general` and the device slicing in `_fused_general_packed`
+    must list the sections in THIS order (4-byte-aligned first):
+    i32: w1_new[d_pad] d_pos[d_pad] row_slot[n_pad] coo_row[nnz_pad]
+         job_start[K] job_n[K]
+    i16: w2e[d_pad] seq[n_pad] coo_val[nnz_pad]
+    u8:  actor[n_pad] flags[2*(n_pad>>3)] coo_col[nnz_pad]
+    """
+    i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
+    i16_n = d_pad + n_pad + nnz_pad
+    u8_n = n_pad + 2 * (n_pad >> 3) + nnz_pad
+    return 4 * i32_n + 2 * i16_n + u8_n
+
+
+@partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
+                                   'm_pad', 'has_remap', 'has_old'))
+def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
+                          sizes, num_segments, a_pad, m_pad, has_remap,
+                          has_old):
+    """One apply against the PACKED device-resident mirror. Outputs:
+    (w1', w2', surv_u8, winner[S], vis_packed[K, m_pad]) where
+    vis_packed = prior_vis<<31 | visible<<30 | (prior_idx+1)<<15
+    | (new_idx+1) — the host unpacks via a uint32 view."""
+    from .merge import _resolve_sorted
+    from .sequence import _rga_order_batched
+    d_pad, n_pad, K, nnz_pad = sizes
+    cap = w1m.shape[0]
+    nb = n_pad >> 3
+
+    # ONE bitcast per dtype section, then slices (static offsets)
+    i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
+    i16_n = d_pad + n_pad + nnz_pad
+    i32v = jax.lax.bitcast_convert_type(
+        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+    i16v = jax.lax.bitcast_convert_type(
+        wire[4 * i32_n:4 * i32_n + 2 * i16_n].reshape(i16_n, 2),
+        jnp.int16)
+    u8v = wire[4 * i32_n + 2 * i16_n:]
+
+    def cut(vec, state, cnt):
+        o = state[0]
+        state[0] = o + cnt
+        return vec[o:o + cnt]
+
+    s32, s16, s8 = [0], [0], [0]
+    w1d = cut(i32v, s32, d_pad)
+    d_pos = cut(i32v, s32, d_pad)
+    row_slot = cut(i32v, s32, n_pad)
+    coo_row = cut(i32v, s32, nnz_pad)
+    job_start = cut(i32v, s32, K)
+    job_n = cut(i32v, s32, K)
+    w2e = cut(i16v, s16, d_pad).astype(jnp.int32)
+    seq = cut(i16v, s16, n_pad).astype(jnp.int32)
+    coo_val = cut(i16v, s16, nnz_pad).astype(jnp.int32)
+    actor = cut(u8v, s8, n_pad).astype(jnp.int32)
+    flags_u8 = cut(u8v, s8, 2 * nb)
+    coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+
+    if has_remap:
+        w1m = (w1m & ~0xFFFF) | jnp.take(rank_remap, w1m & 0xFFFF) \
+            .astype(jnp.int32)
+
+    # ---- fold the new nodes into the pos-ordered mirror ----
+    # cnt(i) = #new nodes at positions <= i. d_pos is sorted, so this
+    # is one scatter-max + cummax instead of a searchsorted (a 19-round
+    # binary-search gather at block scale, ~65 ms measured vs ~5).
+    tgt_new = d_pos + jnp.arange(d_pad, dtype=jnp.int32)
+    if has_old:
+        i = jnp.arange(cap, dtype=jnp.int32)
+        cnt = jax.lax.cummax(
+            jnp.zeros(cap, jnp.int32).at[d_pos].max(
+                jnp.arange(1, d_pad + 1, dtype=jnp.int32), mode='drop'))
+        tgt_old = jnp.where(i < n_old, i + cnt, cap)
+
+        def fold(col, dcol):
+            out = jnp.zeros((cap,), jnp.int32)
+            out = out.at[tgt_old].set(col, mode='drop')
+            return out.at[tgt_new].set(dcol, mode='drop')
+    else:
+        # first resident apply: the mirror is empty, nothing merges
+        def fold(col, dcol):
+            return jnp.zeros((cap,), jnp.int32) \
+                .at[tgt_new].set(dcol, mode='drop')
+
+    w1f = fold(w1m, w1d)
+    w2f = fold(w2m, w2e)             # new nodes: hidden, vis word = elemc
+
+    # ---- job planes ----
+    l = jnp.arange(m_pad, dtype=jnp.int32)
+    pos_mat = job_start[:, None] + l[None, :]
+    valid_plane = l[None, :] < job_n[:, None]
+    pos_c = jnp.minimum(jnp.where(valid_plane, pos_mat, 0), cap - 1)
+    w1p = jnp.take(w1f, pos_c)
+    w2p = jnp.take(w2f, pos_c)
+    s_parent = w1p >> 16
+    s_rank = w1p & 0xFFFF            # rank+1 — same order as rank
+    s_elem = w2p & _W2_ELEM
+    prior_vis = ((w2p >> _W2_VIS_SHIFT) & 1).astype(bool) & valid_plane
+    prior_idx = jnp.where(valid_plane,
+                          ((w2p >> _W2_IDX_SHIFT) & _W2_ELEM) - 1, -1)
+
+    # ---- field resolution (scan-based; rows arrive field-sorted) ----
+    boundary = _unpack_bits(flags_u8[:nb], n_pad)
+    is_del = _unpack_bits(flags_u8[nb:], n_pad)
+    valid = jnp.arange(n_pad) < n_rows
+    clock = jnp.where(
+        actor[:, None] == jnp.arange(a_pad, dtype=jnp.int32)[None, :],
+        (seq - 1)[:, None], 0)
+    clock = clock.at[coo_row, coo_col].set(coo_val, mode='drop')
+    out = _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
+                          num_segments)
+
+    # ---- element visibility: ONE packed scatter (valid<<1|surviving:
+    # max over {0,2,3} recovers both bits) ----
+    flat = jnp.where(row_slot >= 0, row_slot, K * m_pad)
+    packed = (valid.astype(jnp.uint8) << 1) | \
+        out['surviving'].astype(jnp.uint8)
+    grid = jnp.zeros(K * m_pad + 1, jnp.uint8).at[flat].max(
+        packed, mode='drop')[:K * m_pad].reshape(K, m_pad)
+    touched = grid >= 2
+    vis_hit = grid == 3
+    visible = jnp.where(touched, vis_hit, prior_vis) & valid_plane
+
+    ordered = _rga_order_batched(s_parent, s_elem, s_rank, visible,
+                                 valid_plane)
+    new_idx = ordered['vis_index']
+
+    # ---- scatter the updated vis word back (one scatter) ----
+    w2n = (visible.astype(jnp.int32) << _W2_VIS_SHIFT) | \
+        ((new_idx + 1) << _W2_IDX_SHIFT) | s_elem
+    scatter_pos = jnp.where(valid_plane, pos_mat, cap).reshape(-1)
+    w2f = w2f.at[scatter_pos].set(w2n.reshape(-1), mode='drop')
+
+    surv_u8 = jnp.sum(
+        out['surviving'].reshape(-1, 8).astype(jnp.uint8)
+        * (jnp.uint8(1) << (7 - jnp.arange(8, dtype=jnp.uint8))),
+        axis=1, dtype=jnp.uint8)
+    vis_packed = (prior_vis.astype(jnp.int32) << 31) | \
+        (visible.astype(jnp.int32) << 30) | \
+        ((prior_idx + 1) << _W2_IDX_SHIFT) | (new_idx + 1)
+    return w1f, w2f, surv_u8, out['winner'], vis_packed
+
+
+@jax.jit
+def _mirror_pack(parent, elemc, actor, visible, visidx, rank_table):
+    """cols -> packed mirror (format upgrade when the guards pass)."""
+    rank1 = jnp.take(rank_table, actor + 1) + 1
+    rank1 = jnp.where(actor < 0, 0, rank1)
+    w1 = (parent << 16) | rank1
+    w2 = (visible.astype(jnp.int32) << _W2_VIS_SHIFT) | \
+        ((visidx + 1) << _W2_IDX_SHIFT) | elemc
+    return w1, w2
+
+
+@jax.jit
+def _mirror_unpack(w1, w2, rank_to_actor):
+    """packed -> cols mirror (format downgrade before a fallback
+    apply). `rank_to_actor[rank+1]` = actor id (-1 at 0/head)."""
+    parent = w1 >> 16
+    actor = jnp.take(rank_to_actor, w1 & 0xFFFF)
+    elemc = w2 & _W2_ELEM
+    visible = ((w2 >> _W2_VIS_SHIFT) & 1).astype(bool)
+    visidx = ((w2 >> _W2_IDX_SHIFT) & _W2_ELEM) - 1
+    return parent, elemc, actor, visible, visidx
+
+
+def _rank_table(store, opts):
+    """actor-id -> string-rank device table, 1-BASED (slot 0 is the
+    head sentinel) — the layout `_mirror_pack`/the cols program index
+    with `actor + 1`."""
+    n_act = len(store.actors)
+    rt = np.zeros(opts.pad_actors(n_act + 1), np.int32)
+    rt[1:n_act + 1] = store.actor_str_ranks()
+    return jnp.asarray(rt)
+
+
+def _mirror_convert(mir, to_packed, store, opts):
+    """Convert a resident mirror between the packed and cols formats
+    (a store crossing a packed-variant guard mid-stream — e.g. a tree
+    growing past 16384 nodes). One elementwise device program plus a
+    small-table gather; same cap/n/pos_row."""
+    n_act = len(store.actors)
+    ranks = np.asarray(store.actor_str_ranks())
+    if to_packed:
+        w1, w2 = _mirror_pack(mir['parent'], mir['elemc'], mir['actor'],
+                              mir['visible'], mir['vis_index'],
+                              _rank_table(store, opts))
+        return {'fmt': 'packed', 'cap': mir['cap'], 'n': mir['n'],
+                'w1': w1, 'w2': w2, 'ranks': ranks.copy(),
+                'pos_row': mir['pos_row']}
+    old_ranks = mir['ranks']
+    inv = np.full(opts.pad_actors(len(old_ranks) + 2), -1, np.int32)
+    inv[old_ranks + 1] = np.arange(len(old_ranks))
+    parent, elemc, actor, visible, visidx = _mirror_unpack(
+        mir['w1'], mir['w2'], jnp.asarray(inv))
+    return {'fmt': 'cols', 'cap': mir['cap'], 'n': mir['n'],
+            'parent': parent, 'elemc': elemc, 'actor': actor,
+            'visible': visible, 'vis_index': visidx,
+            'rank_n': n_act, 'rank_table': _rank_table(store, opts),
+            'pos_row': mir['pos_row']}
+
+
 # -- apply -------------------------------------------------------------------
 
 class GeneralPatch:
@@ -905,8 +1170,12 @@ class GeneralPatch:
         planes = raw['vis_planes']
         if planes is not None:
             pool = store.pool
-            pv, nv, pi, ni = [np.asarray(x)
-                              for x in jax.device_get(planes)]
+            if raw.get('vis_fmt') == 'packed':
+                pv, nv, pi, ni = unpack_vis_word(
+                    np.asarray(jax.device_get(planes)).view(np.uint32))
+            else:
+                pv, nv, pi, ni = [np.asarray(x)
+                                  for x in jax.device_get(planes)]
             dirty, n_j = raw['dirty'], raw['dirty_n']
             rows_flat = raw['rows_flat']
             row_start = np.zeros(len(dirty) + 1, np.int64)
@@ -1478,32 +1747,34 @@ def _apply_general(store, block, options, return_timing):
                       else (np.zeros(0, np.int64), np.zeros(0, np.int64)))
     m_pad = opts.pad_nodes(int(max(n_j.max() if len(n_j) else 1, 8)))
     n_total = pool.n_nodes
+    n_act = len(store.actors)
+
+    # variant pick: the packed program (2-word mirror, one wire buffer,
+    # scan resolve — the block-scale fast path) wherever its bit-field
+    # guards hold; `_fused_general_resident` is the fallback and the
+    # semantic reference (huge single trees, wide actor sets)
+    use_packed = (pool.max_tree <= (1 << 14)
+                  and pool.max_elem < (1 << 15)
+                  and n_act < 65535
+                  and a_dtype is np.uint8 and s_dtype is np.int16
+                  and c_dtype is np.int16)
     mir = pool.mirror
+    if mir is not None and (mir.get('fmt', 'cols') == 'packed') \
+            != use_packed:
+        mir = pool.mirror = _mirror_convert(mir, use_packed, store, opts)
+
     if mir is None:
         # first resident apply: EVERY node is this apply's delta — the
         # mirror materializes on device with zero extra wire bytes
         cap = opts.pad_nodes(max(n_total, 8))
-        m_cols = (jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.int32),
-                  jnp.full(cap, -1, jnp.int32), jnp.zeros(cap, bool),
-                  jnp.full(cap, -1, jnp.int32))
         n_old = 0
     elif mir['cap'] < n_total:
         # capacity growth ON DEVICE (2x headroom so block-sized growth
         # amortizes): pad each resident column; nothing ships
         cap = opts.pad_nodes(max(2 * mir['cap'], n_total))
-
-        def grow(col, fill):
-            return jnp.concatenate(
-                [col, jnp.full(cap - mir['cap'], fill, col.dtype)])
-
-        m_cols = (grow(mir['parent'], 0), grow(mir['elemc'], 0),
-                  grow(mir['actor'], -1), grow(mir['visible'], False),
-                  grow(mir['vis_index'], -1))
         n_old = mir['n']
     else:
         cap = mir['cap']
-        m_cols = (mir['parent'], mir['elemc'], mir['actor'],
-                  mir['visible'], mir['vis_index'])
         n_old = mir['n']
 
     new_glob = np.arange(n_old, n_total, dtype=np.int64)
@@ -1524,16 +1795,6 @@ def _apply_general(store, block, options, return_timing):
     d_actor = dcol(pool.actor)
     d_pos = np.full(d_pad, cap, np.int32)
     d_pos[:d_n] = final_pos[ordp] - np.arange(d_n)
-    n_old_dev = np.int32(n_old)
-
-    # actor -> string-rank table, re-shipped only when the table grew
-    n_act = len(store.actors)
-    if mir is None or mir.get('rank_n') != n_act:
-        rt = np.zeros(opts.pad_actors(n_act + 1), np.int32)
-        rt[1:n_act + 1] = store.actor_str_ranks()
-        rank_table_dev = jnp.asarray(rt)
-    else:
-        rank_table_dev = mir['rank_table']
 
     # job table: each dirty object's contiguous pos slice
     job_start = np.zeros(K, np.int32)
@@ -1566,25 +1827,125 @@ def _apply_general(store, block, options, return_timing):
 
     flags_u8 = np.concatenate([np.packbits(boundary),
                                np.packbits(del_arr)])
-    outs = _fused_general_resident(
-        *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
-        jnp.asarray(d_actor), jnp.asarray(d_pos), n_old_dev,
-        jnp.asarray(job_start), jnp.asarray(n_j_arr), rank_table_dev,
-        jnp.asarray(actor_arr), jnp.asarray(seq_arr),
-        jnp.asarray(row_slot), jnp.asarray(flags_u8),
-        jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
-        jnp.asarray(coo_col), jnp.asarray(coo_val),
-        num_segments=S, a_pad=A, m_pad=m_pad)
-    pool.mirror = {
-        'cap': cap, 'n': n_total,
-        'parent': outs[0], 'elemc': outs[1], 'actor': outs[2],
-        'visible': outs[3], 'vis_index': outs[4],
-        'rank_n': n_act, 'rank_table': rank_table_dev,
-        'pos_row': pool.pos_row,     # replaced-on-append: a stable ref
-    }
+    if use_packed:
+        ranks = np.asarray(store.actor_str_ranks())
+        if mir is None:
+            w1m = jnp.zeros(cap, jnp.int32)
+            w2m = jnp.zeros(cap, jnp.int32)
+            remap_dev, has_remap = _NO_REMAP, False
+        else:
+            if mir['cap'] < n_total:
+                pad = cap - mir['cap']
+                w1m = jnp.concatenate(
+                    [mir['w1'], jnp.zeros(pad, jnp.int32)])
+                w2m = jnp.concatenate(
+                    [mir['w2'], jnp.zeros(pad, jnp.int32)])
+            else:
+                w1m, w2m = mir['w1'], mir['w2']
+            old_ranks = mir['ranks']
+            if np.array_equal(old_ranks, ranks[:len(old_ranks)]):
+                remap_dev, has_remap = _NO_REMAP, False
+            else:
+                # existing actors shifted rank (new actors landed in
+                # the sorted order): remap the mirror's rank field
+                rm = np.zeros(opts.pad_actors(len(old_ranks) + 2),
+                              np.int32)
+                rm[old_ranks + 1] = \
+                    ranks[:len(old_ranks)].astype(np.int32) + 1
+                remap_dev, has_remap = jnp.asarray(rm), True
+
+        rank1_new = np.where(
+            d_actor >= 0, ranks[np.maximum(d_actor, 0)] + 1, 0) \
+            .astype(np.int32)
+        w1_new = (d_parent << 16) | rank1_new
+
+        sizes = (d_pad, n_pad, K, nnz_pad)
+        wire = np.empty(_wire_sizes(*sizes), np.uint8)
+        o = 0
+        for arr, width in ((w1_new, 4), (d_pos, 4), (row_slot, 4),
+                           (coo_row, 4), (job_start, 4), (n_j_arr, 4)):
+            nb_ = width * len(arr)
+            wire[o:o + nb_].view(np.int32)[:] = arr
+            o += nb_
+        for arr in (d_elemc, seq_arr, coo_val):
+            nb_ = 2 * len(arr)
+            wire[o:o + nb_].view(np.int16)[:] = arr
+            o += nb_
+        for arr in (actor_arr, flags_u8, coo_col):
+            wire[o:o + len(arr)] = arr.view(np.uint8)
+            o += len(arr)
+        assert o == len(wire)
+
+        outs = _fused_general_packed(
+            w1m, w2m, jnp.asarray(wire), np.int32(n_old),
+            jnp.asarray(np.int32(n_rows)), remap_dev,
+            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
+            has_remap=has_remap, has_old=n_old > 0)
+        pool.mirror = {
+            'fmt': 'packed', 'cap': cap, 'n': n_total,
+            'w1': outs[0], 'w2': outs[1], 'ranks': ranks.copy(),
+            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
+        }
+        surv_u8_dev, winner_dev = outs[2], outs[3]
+        vis_planes = outs[4] if len(dirty) else None
+        vis_fmt = 'packed'
+    else:
+        if mir is None:
+            m_cols = (jnp.zeros(cap, jnp.int32),
+                      jnp.zeros(cap, jnp.int32),
+                      jnp.full(cap, -1, jnp.int32),
+                      jnp.zeros(cap, bool),
+                      jnp.full(cap, -1, jnp.int32))
+        elif mir['cap'] < n_total:
+            def grow(col, fill):
+                return jnp.concatenate(
+                    [col, jnp.full(cap - mir['cap'], fill, col.dtype)])
+
+            m_cols = (grow(mir['parent'], 0), grow(mir['elemc'], 0),
+                      grow(mir['actor'], -1),
+                      grow(mir['visible'], False),
+                      grow(mir['vis_index'], -1))
+        else:
+            m_cols = (mir['parent'], mir['elemc'], mir['actor'],
+                      mir['visible'], mir['vis_index'])
+
+        # actor -> string-rank table, re-shipped only when it grew
+        if mir is None or mir.get('rank_n') != n_act:
+            rank_table_dev = _rank_table(store, opts)
+        else:
+            rank_table_dev = mir['rank_table']
+
+        outs = _fused_general_resident(
+            *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
+            jnp.asarray(d_actor), jnp.asarray(d_pos), np.int32(n_old),
+            jnp.asarray(job_start), jnp.asarray(n_j_arr),
+            rank_table_dev,
+            jnp.asarray(actor_arr), jnp.asarray(seq_arr),
+            jnp.asarray(row_slot), jnp.asarray(flags_u8),
+            jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
+            jnp.asarray(coo_col), jnp.asarray(coo_val),
+            num_segments=S, a_pad=A, m_pad=m_pad)
+        pool.mirror = {
+            'fmt': 'cols', 'cap': cap, 'n': n_total,
+            'parent': outs[0], 'elemc': outs[1], 'actor': outs[2],
+            'visible': outs[3], 'vis_index': outs[4],
+            'rank_n': n_act, 'rank_table': rank_table_dev,
+            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
+        }
+        surv_u8_dev, winner_dev = outs[5], outs[6]
+        vis_planes = outs[7:11] if len(dirty) else None
+        vis_fmt = 'cols'
     pool._epoch += 1
-    surv_u8_dev, winner_dev = outs[5], outs[6]
-    vis_planes = outs[7:11] if len(dirty) else None
+    if _STAGE_CAPTURE is not None:
+        _STAGE_CAPTURE({
+            'ops_actor': actor_arr, 'ops_seq': seq_arr,
+            'ops_slot': row_slot, 'flags_u8': flags_u8,
+            'n_rows': n_rows, 'coo_row': coo_row, 'coo_col': coo_col,
+            'coo_val': coo_val, 'num_segments': S, 'a_pad': A,
+            'm_pad': m_pad, 'surv_u8': surv_u8_dev,
+            'winner': winner_dev, 'vis_fmt': vis_fmt,
+            'vis_planes': vis_planes, 'variant':
+                'packed' if use_packed else 'cols'})
     t3 = time.perf_counter()
 
     # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
@@ -1624,7 +1985,7 @@ def _apply_general(store, block, options, return_timing):
     # ---- lazy wiring: winner columns, conflicts, sequence edits ----
     patch._raw = {
         'winner_dev': winner_dev, 'surviving': None,   # set at commit
-        'cat': cat, 'order': order,
+        'cat': cat, 'order': order, 'vis_fmt': vis_fmt,
         'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
         'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat,
         # per-object maxElem SNAPSHOT at apply time: a pipelined reader

@@ -67,6 +67,98 @@ def _resolve(seg_id, actor, seq, clock, is_del, valid, num_segments):
             'seg_max_actor': seg_max_actor}
 
 
+def _seg_scan_max(flags, vals):
+    """Inclusive SEGMENTED cummax along axis 0: ``flags[i]`` marks the
+    first row of a segment; rows only see rows of their own segment.
+    Associative combine: a right block that starts fresh discards the
+    left block's running max."""
+    def op(a, c):
+        af, av = a
+        cf, cv = c
+        return af | cf, jnp.where(cf, cv, jnp.maximum(av, cv))
+
+    _, out = jax.lax.associative_scan(op, (flags, vals), axis=0)
+    return out
+
+
+def _seg_row_max(boundary, vals):
+    """Per-row max of ``vals`` over the row's whole (contiguous)
+    segment: forward + backward segmented scans. ``vals`` is [n] or
+    [n, C] (columns reduce independently)."""
+    b = boundary if vals.ndim == 1 else \
+        jnp.broadcast_to(boundary[:, None], vals.shape)
+    fwd = _seg_scan_max(b, vals)
+    b_rev = jnp.concatenate([boundary[1:], jnp.ones(1, bool)])[::-1]
+    br = b_rev if vals.ndim == 1 else \
+        jnp.broadcast_to(b_rev[:, None], vals.shape)
+    bwd = _seg_scan_max(br, vals[::-1])[::-1]
+    return jnp.maximum(fwd, bwd)
+
+
+def _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
+                    num_segments):
+    """`_resolve` for rows already SORTED by segment (the general
+    engine's field-sorted staging): contiguous segments are marked by
+    one boundary bit per row, and both segment reductions ride
+    associative scans instead of scatters — on TPU a segmented cummax
+    is ~5x cheaper than `segment_max` at the million-row scale.
+
+    Bit-identical semantics to `_resolve` (same superseded rule, same
+    actor-descending winner with min-index tie-break). Returns the same
+    dict; `winner`/`seg_max_actor` materialize to [S] with one scatter
+    at the boundary rows."""
+    n = actor.shape[0]
+
+    # scan 1: clock-column maxima AND the surviving-actor maximum ride
+    # one [n, A+1] scan (independent per-column maxima)... except
+    # `surviving` depends on the clock maxima, so the actor reduction
+    # genuinely sequences after: two scan pairs total.
+    masked_clock = jnp.where(valid[:, None], clock, -1)
+    seen_cols = _seg_row_max(boundary, masked_clock)          # [n, A]
+    seen = jnp.take_along_axis(seen_cols, actor[:, None], axis=1)[:, 0]
+    superseded = seen >= seq
+    surviving = valid & ~superseded & ~is_del
+
+    # scan 2: winner = surviving row with max actor rank, min row index
+    # on ties — (actor, n-1-idx) reduce as two int32 columns in one
+    # scan with a lexicographic combine (int64 packing would need x64).
+    idx = jnp.arange(n, dtype=jnp.int32)
+    a_score = jnp.where(surviving, actor, -1)
+    i_score = jnp.where(surviving, n - 1 - idx, -1)
+
+    def lex_op(a, c):
+        af, aa, ai = a
+        cf, ca, ci = c
+        take_c = cf | (ca > aa) | ((ca == aa) & (ci > ai))
+        return (af | cf,
+                jnp.where(cf, ca, jnp.maximum(aa, ca)),
+                jnp.where(take_c, ci, ai))
+
+    b = boundary
+    _, fa, fi = jax.lax.associative_scan(lex_op, (b, a_score, i_score),
+                                         axis=0)
+    b_rev = jnp.concatenate([b[1:], jnp.ones(1, bool)])[::-1]
+    _, ba, bi = jax.lax.associative_scan(
+        lex_op, (b_rev, a_score[::-1], i_score[::-1]), axis=0)
+    ba, bi = ba[::-1], bi[::-1]
+    pick_b = (ba > fa) | ((ba == fa) & (bi > fi))
+    seg_max_actor_row = jnp.maximum(fa, ba)
+    best_i = jnp.where(pick_b, bi, fi)
+    winner_row = jnp.where(seg_max_actor_row >= 0, (n - 1) - best_i, -1)
+
+    # [S] materialization: one scatter at the boundary rows
+    seg_of = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    tgt = jnp.where(boundary, seg_of, num_segments)
+    winner = jnp.full((num_segments,), -1, jnp.int32) \
+        .at[tgt].set(winner_row, mode='drop')
+    # empty (padding) segments match _resolve's segment_max identity
+    seg_max_actor = jnp.full((num_segments,), jnp.iinfo(jnp.int32).min,
+                             jnp.int32).at[tgt].set(seg_max_actor_row,
+                                                    mode='drop')
+    return {'surviving': surviving, 'winner': winner,
+            'seg_max_actor': seg_max_actor}
+
+
 @partial(jax.jit, static_argnames=('num_segments',))
 def resolve_assignments(seg_id, actor, seq, clock, is_del, valid, *, num_segments):
     """Resolve a batch of assignment ops grouped by field.

@@ -117,7 +117,23 @@ def _mxu_gather2(val_a, val_b, idx, m):
     plane, as a one-hot matmul — the pointer-doubling gathers ride the
     MXU (systolic array) instead of the scalar gather path, which is the
     TPU bottleneck of the doubling loops (~6 ms per [2048, 128] gather
-    round measured through XLA's native gather)."""
+    round measured through XLA's native gather).
+
+    When every gathered value AND every index is <= 256 the one-hot and
+    the operands are exact in bfloat16 (8-bit mantissa: all integers up
+    to 2^8), so the matmul runs at native MXU width with half the HBM
+    traffic for the [K, m, m] one-hot plane; otherwise f32 operands at
+    Precision.HIGHEST (default TPU matmul precision rounds f32 inputs
+    to bf16, which corrupts node indexes > 256 — r4 advisor, measured
+    3992/4000 wrong orderings at m=500)."""
+    if m <= 257:  # values/indexes <= 256: exact in bf16 (2^8)
+        onehot = (idx[:, :, None] ==
+                  jnp.arange(m, dtype=jnp.int32)[None, None, :]) \
+            .astype(jnp.bfloat16)
+        both = jnp.stack([val_a, val_b], axis=-1).astype(jnp.bfloat16)
+        g = jnp.einsum('jik,jkc->jic', onehot, both,
+                       preferred_element_type=jnp.float32)
+        return g[..., 0], g[..., 1]
     onehot = (idx[:, :, None] ==
               jnp.arange(m, dtype=jnp.int32)[None, None, :]) \
         .astype(jnp.float32)
@@ -174,9 +190,12 @@ def _rga_order_mxu(parent, elem, actor, visible, valid):
         .astype(jnp.float32)
     for _ in range(rounds):
         climb, _ = _mxu_gather2(climb, climb, climb.astype(jnp.int32), n)
-    climb = climb.astype(jnp.int32)
-    up = jnp.where(jnp.take_along_axis(has_sib, climb, axis=1),
-                   jnp.take_along_axis(next_sibling, climb, axis=1), -1)
+    # the two `up` lookups ride the same one-hot matmul as the rounds
+    # (a take_along_axis pair costs ~2x one fused gather2 at this shape)
+    sibv, sibf = _mxu_gather2(next_sibling.astype(jnp.float32),
+                              has_sib.astype(jnp.float32),
+                              climb.astype(jnp.int32), n)
+    up = jnp.where(sibf > 0.5, sibv.astype(jnp.int32), -1)
     succ = jnp.where(first_child >= 0, first_child, up)
     succ = jnp.where(valid, succ, -1)
 
@@ -199,12 +218,16 @@ def _rga_order_mxu(parent, elem, actor, visible, valid):
         rowi, jnp.where(on_chain, tree_pos, 0)].set(
         jnp.where(on_chain, jnp.broadcast_to(idx, (K, n)), 0),
         mode='drop')
-    vis_ordered = jnp.where(
-        jnp.take_along_axis(on_chain, node_at_pos, axis=1),
-        jnp.take_along_axis(visible, node_at_pos, axis=1), False)
-    vis_rank = jnp.cumsum(vis_ordered, axis=1) - vis_ordered
-    vis_index = jnp.take_along_axis(vis_rank, tree_pos, axis=1) \
-        .astype(jnp.int32)
+    # visibility in position order SCATTERS directly (off-chain rows
+    # contribute False via max), and the rank maps back through one
+    # fused gather2 — replacing three take_along_axis passes
+    vis_ordered = jnp.zeros((K, n), bool).at[
+        rowi, jnp.where(on_chain, tree_pos, 0)].max(
+        visible & on_chain, mode='drop')
+    vis_rank = (jnp.cumsum(vis_ordered, axis=1) - vis_ordered) \
+        .astype(jnp.float32)
+    vis_index, _ = _mxu_gather2(vis_rank, vis_rank, tree_pos, n)
+    vis_index = vis_index.astype(jnp.int32)
     vis_index = jnp.where(visible & on_chain, vis_index, -1)
     return {'tree_pos': tree_pos, 'vis_index': vis_index,
             'node_at_pos': node_at_pos,

@@ -218,3 +218,74 @@ def sharded_rga_jobs(mesh, parent, elem, actor, visible, valid):
     out, stats = _sharded_rga_fn(mesh)(*placed)
     out = {name: arr[:k] for name, arr in out.items()}
     return out, {name: int(v) for name, v in stats.items()}
+
+
+def sharded_step_from_capture(mesh, store, patch, captured):
+    """Re-run a captured general apply through the sharded step and
+    return (sharded outputs, fused reference outputs) for equality
+    gating.
+
+    `captured` is the dict the engine hands to
+    ``general._STAGE_CAPTURE`` (staged wire planes + the fused
+    program's outputs, whichever variant ran); the job planes rebuild
+    HOST-side from the pool, whose host visibility columns are still
+    the PRE-apply state (the mirror has not been synced). Shared by the
+    multichip dryrun (``__graft_entry__``) and the CPU-mesh tests.
+    """
+    from ..device import general
+    from ..device.blocks import _span_indices
+
+    ops_slot = captured['ops_slot']
+    n_pad = len(ops_slot)
+    bits = np.unpackbits(captured['flags_u8'])
+    bnd = bits[:n_pad].astype(bool)
+    isdel = bits[n_pad:2 * n_pad].astype(bool)
+    vmask = np.arange(n_pad) < int(captured['n_rows'])
+
+    raw = patch._raw
+    dirty, n_j = raw['dirty'], raw['dirty_n']
+    rows_flat = raw['rows_flat']
+    mj = captured['m_pad']
+    Kj = max(len(dirty), 1)
+    pool = store.pool
+    seq_planes = np.zeros((3, Kj, mj), np.int32)
+    prior_vis = np.zeros((Kj, mj), bool)
+    if len(dirty):
+        flat = _span_indices(np.arange(Kj, dtype=np.int64) * mj, n_j)
+        seq_planes[0].reshape(-1)[flat] = pool.parent[rows_flat]
+        seq_planes[1].reshape(-1)[flat] = pool.elemc[rows_flat]
+        ranks = np.zeros(len(rows_flat), np.int64)
+        real = pool.actor[rows_flat] >= 0
+        ranks[real] = store.actor_str_ranks()[pool.actor[rows_flat][real]]
+        seq_planes[2].reshape(-1)[flat] = ranks
+        prior_vis.reshape(-1)[flat] = pool.visible[rows_flat]
+    n_j_arr = np.zeros(Kj, np.int32)
+    n_j_arr[:len(n_j)] = n_j
+
+    sharded = sharded_general_step(
+        mesh, captured['ops_actor'], captured['ops_seq'], ops_slot,
+        bnd, isdel, vmask, captured['coo_row'], captured['coo_col'],
+        captured['coo_val'], seq_planes, n_j_arr, prior_vis,
+        num_segments=captured['num_segments'],
+        a_pad=captured['a_pad'])
+
+    if captured['vis_planes'] is None:     # no dirty sequence objects
+        vis_ref = np.zeros((Kj, mj), bool)
+        idx_ref = np.full((Kj, mj), -1, np.int64)
+    elif captured['vis_fmt'] == 'packed':
+        _, vis_ref, _, idx_ref = general.unpack_vis_word(
+            np.asarray(jax.device_get(captured['vis_planes']))
+            .view(np.uint32))
+    else:
+        pl = [np.asarray(x)
+              for x in jax.device_get(captured['vis_planes'])]
+        vis_ref, idx_ref = pl[1], pl[3].astype(np.int64)
+    fused = {
+        'surviving': np.unpackbits(np.asarray(
+            jax.device_get(captured['surv_u8']))).astype(bool)[:n_pad],
+        'winner': np.asarray(jax.device_get(captured['winner'])),
+        'visible': vis_ref,
+        'vis_index': np.asarray(idx_ref, np.int64),
+    }
+    sharded['vis_index'] = np.asarray(sharded['vis_index'], np.int64)
+    return sharded, fused

@@ -451,3 +451,114 @@ def test_resident_mirror_stream_matches_oracle():
     # host inspection after the stream (lazy mirror sync)
     fields = store.doc_fields(0)
     assert any(k[1].startswith('ra:') for k in fields)
+
+
+class TestPackedVariantFallback:
+    """The packed wire program has bit-field guards (tree size, elemc,
+    actor widths); crossing one mid-stream must convert the resident
+    mirror and route to the cols fallback — and back — without any
+    semantic drift (r5 review finding: these paths had no coverage)."""
+
+    def _mat_store(self, patches):
+        return _apply_diff_lists([p.diffs(0) for p in patches])
+
+    def test_elemc_guard_packed_to_cols_and_exact(self):
+        obj = '00000000-0000-4000-8000-00000000fb01'
+        c1 = {'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'w:1', 'value': 'a'},
+        ]}
+        # elem 40000 crosses the elemc < 2^15 packed guard
+        c2 = {'actor': 'w', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'w:1', 'elem': 40000},
+            {'action': 'set', 'obj': obj, 'key': 'w:40000',
+             'value': 'b'},
+        ]}
+        c3 = {'actor': 'w', 'seq': 3, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'w:40000',
+             'elem': 40001},
+            {'action': 'set', 'obj': obj, 'key': 'w:40001',
+             'value': 'c'},
+            {'action': 'del', 'obj': obj, 'key': 'w:1'},
+        ]}
+        store = general.init_store(1)
+        p1 = general.apply_general_block(store, store.encode_changes(
+            [[c1]]))
+        assert store.pool.mirror['fmt'] == 'packed'
+        p2 = general.apply_general_block(store, store.encode_changes(
+            [[c2]]))
+        assert store.pool.mirror['fmt'] == 'cols'
+        p3 = general.apply_general_block(store, store.encode_changes(
+            [[c3]]))
+        got = _mat_doc(self._mat_store([p1, p2, p3]))
+        assert got == _via_oracle([c1, c2, c3])
+
+    def test_wide_actor_block_routes_to_cols(self):
+        # 300 actors on one doc -> local actor slots exceed uint8 ->
+        # the cols fallback runs (and stays: local actor width is
+        # store-persistent), with oracle-equal results
+        obj = '00000000-0000-4000-8000-00000000fb02'
+        mk = {'actor': 'a-000', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'a-000:1',
+             'value': 'base'},
+        ]}
+        wide = [{'actor': f'a-{i:03d}', 'seq': 1 if i else 2,
+                 'deps': {'a-000': 1}, 'ops': [
+                     {'action': 'set', 'obj': ROOT_ID,
+                      'key': f'k{i % 7}', 'value': i}]}
+                for i in range(300)]
+        wide[0]['actor'] = 'a-000'
+        store = general.init_store(1)
+        p1 = general.apply_general_block(store, store.encode_changes(
+            [[mk] + wide]))
+        assert store.pool.mirror['fmt'] == 'cols'
+        c2 = {'actor': 'zz', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'a-000:1', 'elem': 2},
+            {'action': 'set', 'obj': obj, 'key': 'zz:2',
+             'value': 'tail'},
+        ]}
+        p2 = general.apply_general_block(store, store.encode_changes(
+            [[c2]]))
+        got = _mat_doc(self._mat_store([p1, p2]))
+        assert got == _via_oracle([mk] + wide + [c2])
+
+    def test_cols_to_packed_conversion_roundtrip(self):
+        # the cols -> packed direction: downgrade the live mirror by
+        # hand (the guards that force cols are store-persistent, so
+        # the engine only re-packs after an explicit downgrade), then
+        # a narrow apply must convert back and stay exact
+        from automerge_tpu.device.engine import as_options
+        obj = '00000000-0000-4000-8000-00000000fb03'
+        c1 = {'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'w:1', 'value': 'x'},
+            {'action': 'ins', 'obj': obj, 'key': 'w:1', 'elem': 2},
+            {'action': 'set', 'obj': obj, 'key': 'w:2', 'value': 'y'},
+        ]}
+        store = general.init_store(1)
+        p1 = general.apply_general_block(store, store.encode_changes(
+            [[c1]]))
+        assert store.pool.mirror['fmt'] == 'packed'
+        store.pool.mirror = general._mirror_convert(
+            store.pool.mirror, False, store, as_options(None))
+        assert store.pool.mirror['fmt'] == 'cols'
+        c2 = {'actor': 'v', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': 'w:1', 'elem': 3},
+            {'action': 'set', 'obj': obj, 'key': 'v:3', 'value': 'z'},
+            {'action': 'del', 'obj': obj, 'key': 'w:2'},
+        ]}
+        p2 = general.apply_general_block(store, store.encode_changes(
+            [[c2]]))
+        assert store.pool.mirror['fmt'] == 'packed'
+        got = _mat_doc(self._mat_store([p1, p2]))
+        assert got == _via_oracle([c1, c2])

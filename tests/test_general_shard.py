@@ -15,7 +15,8 @@ from jax.sharding import Mesh
 
 from automerge_tpu.common import ROOT_ID
 from automerge_tpu.device import general
-from automerge_tpu.parallel.general_shard import sharded_general_step
+from automerge_tpu.parallel.general_shard import (
+    sharded_general_step, sharded_step_from_capture)
 
 
 def _mesh():
@@ -26,73 +27,21 @@ def _mesh():
 
 
 def _captured_apply(per_doc_changes, n_docs):
-    """Apply through the general engine while capturing the fused
-    program's staged input planes and raw outputs."""
+    """Apply through the general engine while capturing the staged
+    planes and fused outputs (whichever program variant ran)."""
     captured = {}
-    orig = general._fused_general_resident
-
-    def capture(*args, **kw):
-        captured['args'] = [np.asarray(a) for a in args]
-        captured['kw'] = dict(kw)
-        out = orig(*args, **kw)
-        captured['out'] = [np.asarray(o) for o in out]
-        return out
-
     store = general.init_store(n_docs)
-    general._fused_general_resident = capture
+    general._STAGE_CAPTURE = captured.update
     try:
         patch = general.apply_general_block(
             store, store.encode_changes(per_doc_changes))
     finally:
-        general._fused_general_resident = orig
+        general._STAGE_CAPTURE = None
     return store, patch, captured
 
 
 def _run_sharded(mesh, store, patch, captured):
-    """Re-run the captured staged planes through the sharded two-phase
-    program; returns (sharded outputs, fused reference outputs)."""
-    args, kw = captured['args'], captured['kw']
-    (ops_actor, ops_seq, ops_slot, flags_u8, n_rows, coo_row, coo_col,
-     coo_val) = args[13:21]
-    n_pad = len(ops_slot)
-    bits = np.unpackbits(flags_u8)
-    bnd = bits[:n_pad].astype(bool)
-    isdel = bits[n_pad:2 * n_pad].astype(bool)
-    vmask = np.arange(n_pad) < int(n_rows)
-
-    raw = patch._raw
-    dirty, n_j = raw['dirty'], raw['dirty_n']
-    rows_flat = raw['rows_flat']
-    mj = kw['m_pad']
-    Kj = max(len(dirty), 1)
-    pool = store.pool
-    seq_planes = np.zeros((3, Kj, mj), np.int32)
-    prior_vis = np.zeros((Kj, mj), bool)
-    if len(dirty):
-        from automerge_tpu.device.blocks import _span_indices
-        flat = _span_indices(np.arange(Kj, dtype=np.int64) * mj, n_j)
-        seq_planes[0].reshape(-1)[flat] = pool.parent[rows_flat]
-        seq_planes[1].reshape(-1)[flat] = pool.elemc[rows_flat]
-        ranks = np.zeros(len(rows_flat), np.int64)
-        real = pool.actor[rows_flat] >= 0
-        ranks[real] = store.actor_str_ranks()[pool.actor[rows_flat][real]]
-        seq_planes[2].reshape(-1)[flat] = ranks
-        prior_vis.reshape(-1)[flat] = pool.visible[rows_flat]
-    n_j_arr = np.zeros(Kj, np.int32)
-    n_j_arr[:len(n_j)] = n_j
-
-    sharded = sharded_general_step(
-        mesh, ops_actor, ops_seq, ops_slot, bnd, isdel, vmask,
-        coo_row, coo_col, coo_val, seq_planes, n_j_arr, prior_vis,
-        num_segments=kw['num_segments'], a_pad=kw['a_pad'])
-    fused = {
-        'surviving': np.unpackbits(
-            captured['out'][5]).astype(bool)[:n_pad],
-        'winner': captured['out'][6],
-        'visible': captured['out'][8],
-        'vis_index': captured['out'][10],
-    }
-    return sharded, fused
+    return sharded_step_from_capture(mesh, store, patch, captured)
 
 
 def _assert_equal(sharded, fused):
@@ -111,8 +60,8 @@ def test_single_segment_row0_start():
                  'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'x',
                           'value': i}]} for i in range(16)]]
     store, patch, captured = _captured_apply(per_doc, 1)
-    bits = np.unpackbits(captured['args'][16])
-    n_pad = len(captured['args'][15])
+    n_pad = len(captured['ops_slot'])
+    bits = np.unpackbits(captured['flags_u8'])
     bnd = bits[:n_pad].astype(bool)
     assert bnd.sum() == 1 and np.flatnonzero(bnd)[0] == 0
     sharded, fused = _run_sharded(mesh, store, patch, captured)
@@ -133,5 +82,5 @@ def test_fewer_segments_than_shards():
     sharded, fused = _run_sharded(mesh, store, patch, captured)
     _assert_equal(sharded, fused)
     assert (np.asarray(sharded['winner'])[
-        :int(np.unpackbits(captured['args'][16])[
-            :len(captured['args'][15])].sum())] >= 0).all()
+        :int(np.unpackbits(captured['flags_u8'])[
+            :len(captured['ops_slot'])].sum())] >= 0).all()
