@@ -1387,14 +1387,22 @@ def _apply_general(store, block, options, return_timing):
         _finish_empty(patch)
         return (patch, {'admit': t1 - t0}) if return_timing else patch
 
-    # ---- admitted op columns ----
+    # ---- admitted op columns (no copies when every row is kept —
+    # the common fully-admitted block saves 5 full-column passes) ----
     o_act = st.o_action
     o_doc = st.o_doc
-    o_obj_blk = block.obj[keep]
-    o_kind = block.key_kind[keep]
-    o_key_raw = block.key[keep]
-    o_key_elem = block.key_elem[keep]
-    o_elem = block.elem[keep]
+    if keep.all():
+        o_obj_blk = block.obj
+        o_kind = block.key_kind
+        o_key_raw = block.key
+        o_key_elem = block.key_elem
+        o_elem = block.elem
+    else:
+        o_obj_blk = block.obj[keep]
+        o_kind = block.key_kind[keep]
+        o_key_raw = block.key[keep]
+        o_key_elem = block.key_elem[keep]
+        o_elem = block.elem[keep]
 
     # ---- object creation, whole batch (make ops + missing roots) ----
     make_rows = np.flatnonzero(o_act >= _MAKE_MAP)
@@ -1486,11 +1494,19 @@ def _apply_general(store, block, options, return_timing):
             bad_row = int(i_obj[np.flatnonzero(bad_t)[0]])
             raise ValueError('Insertion into non-sequence object '
                              + store.obj_uuid[bad_row])
-        iord = np.argsort(i_obj, kind='stable')
-        g_rows = ins_rows[iord]
-        g_obj = i_obj[iord]
-        g_actor = st.o_actor[ins_rows][iord]
-        g_elem = o_elem[ins_rows][iord].astype(np.int64)
+        if len(i_obj) > 1 and (i_obj[1:] >= i_obj[:-1]).all():
+            # block emitted docs/objects in order (the common case):
+            # the stable object grouping is the identity
+            g_rows = ins_rows
+            g_obj = i_obj
+            g_actor = st.o_actor[ins_rows]
+            g_elem = o_elem[ins_rows].astype(np.int64)
+        else:
+            iord = np.argsort(i_obj, kind='stable')
+            g_rows = ins_rows[iord]
+            g_obj = i_obj[iord]
+            g_actor = st.o_actor[ins_rows][iord]
+            g_elem = o_elem[ins_rows][iord].astype(np.int64)
         run_start = np.concatenate([[True], g_obj[1:] != g_obj[:-1]])
         starts = np.flatnonzero(run_start)
         ins_objs = g_obj[starts]
@@ -1501,7 +1517,7 @@ def _apply_general(store, block, options, return_timing):
         new_key = (g_actor.astype(np.int64) << 32) | g_elem
 
         # parent keys (head = -1 sentinel -> node 0, no lookup)
-        kinds = o_kind[ins_rows][iord]
+        kinds = o_kind[g_rows]
         p_key = np.full(len(g_rows), -1, np.int64)
         ek = kinds == _KEY_ELEM
         if ek.any():
@@ -1567,40 +1583,163 @@ def _apply_general(store, block, options, return_timing):
     # dirty sequence objects: ins targets + element-assignment targets
     dirty = np.union1d(ins_objs, assign_objs).astype(np.int64)
 
-    # ---- ONE lookup over the union: table = every existing node of a
-    # dirty object + this batch's new nodes; queries = ins parents and
-    # assignment target elemIds together (one composite sort) ----
+    # ---- elemId resolution: peephole first, tables for the rest ----
+    # The overwhelmingly common shapes are SEQUENTIAL: an ins whose
+    # parent is the elemId minted by the nearest PRECEDING ins of the
+    # same object (collaborative typing), and a set/del whose target
+    # was minted by the op immediately before it in the same change.
+    # Both resolve with one vectorized compare; only the residue pays
+    # a sorted-table lookup, and the dup check rides the same sorted
+    # key arrays. (Replaces a whole-union composite sort that cost
+    # ~70 ms per 1M-op block.)
     if len(dirty):
-        t_rows, t_counts = pool.rows_of_objs(dirty)
-        t_job = np.repeat(np.arange(len(dirty), dtype=np.int64),
-                          t_counts)
         q_sel = p_key != -1
-        ins_job = np.searchsorted(dirty, g_obj) if len(ins_rows) else \
-            np.zeros(0, np.int64)
-        tgt_key = ((t_actor[e_sel] << 32) | t_elem[e_sel]) \
-            if e_sel.any() else np.zeros(0, np.int64)
-        ejob = np.searchsorted(dirty, objr[e_sel]) if e_sel.any() else \
-            np.zeros(0, np.int64)
-        n_pq = int(q_sel.sum())
-        res, dup = _exact_lookup(
-            np.concatenate([t_job, ins_job]),
-            np.concatenate([pool.node_keys(t_rows), new_key]),
-            np.concatenate([pool.local[t_rows].astype(np.int64),
-                            local_new if local_new is not None
-                            else np.zeros(0, np.int64)]),
-            np.concatenate([ins_job[q_sel], ejob]),
-            np.concatenate([p_key[q_sel], tgt_key]),
-            len(dirty))
-        if dup:
-            raise ValueError('Duplicate list element ID')
         if len(ins_rows):
+            o_node[g_rows] = local_new     # minted ids, pre-validation
+            # peephole A: parent == previous ins of the same object
+            # (g is object-grouped, block-order within an object)
+            matchA = np.zeros(len(g_rows), bool)
+            if len(g_rows) > 1:
+                matchA[1:] = (g_obj[1:] == g_obj[:-1]) & \
+                    (p_key[1:] == new_key[:-1])
+            matchA &= q_sel
             parent_local = np.zeros(len(g_rows), np.int64)
-            parent_local[q_sel] = res[:n_pq]
-            if (parent_local < 0).any():
-                raise ValueError(
-                    'List element insertion after unknown element')
+            mA = np.flatnonzero(matchA)
+            parent_local[mA] = local_new[mA - 1]
+        else:
+            matchA = np.zeros(0, bool)
+            parent_local = np.zeros(0, np.int64)
+
         if e_sel.any():
-            nodes = res[n_pq:]
+            # peephole B: target minted by the immediately preceding
+            # kept op (same object, an ins) — o_node already holds the
+            # minted local ids
+            er = a_rows[e_sel]
+            tgt_key = (t_actor[e_sel] << 32) | t_elem[e_sel]
+            prev_r = er - 1
+            okB = prev_r >= 0
+            pr = np.maximum(prev_r, 0)
+            okB &= (o_act[pr] == _INS) & (o_objrow[pr] == objr[e_sel])
+            prev_key = (st.o_actor[pr].astype(np.int64) << 32) | \
+                o_elem[pr].astype(np.int64)
+            matchB = okB & (prev_key == tgt_key)
+            nodes = np.full(len(er), -1, np.int64)
+            nodes[matchB] = o_node[pr[matchB]]
+        else:
+            tgt_key = np.zeros(0, np.int64)
+            matchB = np.zeros(0, bool)
+            nodes = np.zeros(0, np.int64)
+
+        residA = q_sel & ~matchA
+        residB = ~matchB if e_sel.any() else np.zeros(0, bool)
+        need_dup = len(ins_rows) > 0
+        if need_dup or residA.any() or (e_sel.any() and residB.any()):
+            ins_job = np.searchsorted(dirty, g_obj) \
+                if len(ins_rows) else np.zeros(0, np.int64)
+            t_rows, t_counts = pool.rows_of_objs(dirty)
+            t_keys = pool.node_keys(t_rows)
+            # shift keys >= 0 (head sentinel -> 0) and pack (job, key)
+            # into one int64 when it fits; else the union fallback
+            jb = max(int(np.ceil(np.log2(max(len(dirty), 2)))), 1)
+            new_k1 = new_key + 1
+            t_k1 = np.where(t_keys == _HEAD_KEY, 0, t_keys + 1)
+            # the overflow guard must cover QUERY keys too (an unknown
+            # elemId with a huge key would otherwise alias into another
+            # job's packed range instead of raising — r5 review)
+            kmax = max(int(new_k1.max()) if len(new_k1) else 0,
+                       int(t_k1.max()) if len(t_k1) else 0,
+                       int(p_key[residA].max()) + 1
+                       if residA.any() else 0,
+                       int(tgt_key[residB].max()) + 1
+                       if len(residB) and residB.any() else 0)
+            if kmax < (1 << (63 - jb)):
+                t_job = np.repeat(np.arange(len(dirty),
+                                            dtype=np.int64), t_counts)
+                new_comp = (ins_job << (63 - jb)) | new_k1
+                old_comp = (t_job << (63 - jb)) | t_k1
+                ordo = np.argsort(old_comp, kind='stable') \
+                    if (residA.any() or (len(residB)
+                                         and residB.any())) else None
+                old_comp_s = old_comp[ordo] if ordo is not None \
+                    else np.sort(old_comp)
+                ordn = np.argsort(new_comp, kind='stable')
+                new_comp_s = new_comp[ordn]
+                if need_dup:
+                    if len(new_comp_s) > 1 and \
+                            (new_comp_s[1:] == new_comp_s[:-1]).any():
+                        raise ValueError('Duplicate list element ID')
+                    pos = np.searchsorted(old_comp_s, new_comp_s)
+                    pos = np.minimum(pos, max(len(old_comp_s) - 1, 0))
+                    if len(old_comp_s) and \
+                            (old_comp_s[pos] == new_comp_s).any():
+                        raise ValueError('Duplicate list element ID')
+
+                def lookup(job, key):
+                    """(job, key) -> local id, -1 miss: new first,
+                    then the pool's existing nodes."""
+                    comp = (job << (63 - jb)) | (key + 1)
+                    out = np.full(len(comp), -1, np.int64)
+                    if len(new_comp_s):
+                        p = np.minimum(
+                            np.searchsorted(new_comp_s, comp),
+                            len(new_comp_s) - 1)
+                        hit = new_comp_s[p] == comp
+                        out[hit] = local_new[ordn[p[hit]]]
+                    miss = out < 0
+                    if miss.any() and len(old_comp_s):
+                        p = np.minimum(
+                            np.searchsorted(old_comp_s, comp[miss]),
+                            len(old_comp_s) - 1)
+                        hit = old_comp_s[p] == comp[miss]
+                        mi = np.flatnonzero(miss)
+                        out[mi[hit]] = pool.local[
+                            t_rows[ordo[p[hit]]]]
+                    return out
+
+                if residA.any():
+                    got = lookup(ins_job[residA], p_key[residA])
+                    if (got < 0).any():
+                        raise ValueError(
+                            'List element insertion after unknown '
+                            'element')
+                    parent_local[residA] = got
+                if e_sel.any() and residB.any():
+                    ejob = np.searchsorted(dirty, objr[e_sel])
+                    got = lookup(ejob[residB], tgt_key[residB])
+                    if (got < 0).any():
+                        raise TypeError(
+                            'Missing index entry for list element')
+                    nodes[residB] = got
+            else:
+                # wide keys: the whole-union composite lookup (exact;
+                # overwrites the peephole results with equal values)
+                t_job = np.repeat(np.arange(len(dirty),
+                                            dtype=np.int64), t_counts)
+                ejob = np.searchsorted(dirty, objr[e_sel]) \
+                    if e_sel.any() else np.zeros(0, np.int64)
+                n_pq = int(q_sel.sum())
+                res, dup = _exact_lookup(
+                    np.concatenate([t_job, ins_job]),
+                    np.concatenate([t_keys, new_key]),
+                    np.concatenate([pool.local[t_rows]
+                                    .astype(np.int64),
+                                    local_new if local_new is not None
+                                    else np.zeros(0, np.int64)]),
+                    np.concatenate([ins_job[q_sel], ejob]),
+                    np.concatenate([p_key[q_sel], tgt_key]),
+                    len(dirty))
+                if dup:
+                    raise ValueError('Duplicate list element ID')
+                if len(ins_rows):
+                    parent_local[q_sel] = res[:n_pq]
+                    if (parent_local < 0).any():
+                        raise ValueError(
+                            'List element insertion after unknown '
+                            'element')
+                if e_sel.any():
+                    nodes = res[n_pq:]
+
+        if e_sel.any():
             if (nodes < 0).any():
                 raise TypeError('Missing index entry for list element')
             fkey[e_sel] = _ELEM_BIT | nodes
@@ -1608,7 +1747,6 @@ def _apply_general(store, block, options, return_timing):
         if len(ins_rows):
             pool.append_batch(g_obj, local_new, parent_local, g_actor,
                               g_elem)
-            o_node[g_rows] = local_new
     if len(a_rows):
         o_field[a_rows] = (objr << 32) | fkey
 
@@ -1783,18 +1921,23 @@ def _apply_general(store, block, options, return_timing):
     keys = (pool.obj[new_glob].astype(np.int64) << 32) | \
         pool.local[new_glob]
     final_pos = np.searchsorted(pool.pos_sorted, keys)
-    ordp = np.argsort(final_pos, kind='stable')
+    if d_n > 1 and not (final_pos[1:] >= final_pos[:-1]).all():
+        ordp = np.argsort(final_pos, kind='stable')
+        final_pos = final_pos[ordp]
+    else:
+        ordp = None     # appends landed in pos order (common)
 
     def dcol(col):
         out = np.zeros(d_pad, np.int32)
-        out[:d_n] = col[new_glob][ordp]
+        new = col[new_glob]
+        out[:d_n] = new if ordp is None else new[ordp]
         return out
 
     d_parent = dcol(pool.parent)
     d_elemc = dcol(pool.elemc)
     d_actor = dcol(pool.actor)
     d_pos = np.full(d_pad, cap, np.int32)
-    d_pos[:d_n] = final_pos[ordp] - np.arange(d_n)
+    d_pos[:d_n] = final_pos - np.arange(d_n)
 
     # job table: each dirty object's contiguous pos slice
     job_start = np.zeros(K, np.int32)
@@ -1957,23 +2100,27 @@ def _apply_general(store, block, options, return_timing):
     # _pending_commit until the next entry reader (usually the next
     # apply's prior-entry match), so host staging of block n+1 overlaps
     # this block's device program.
-    def _cat(new_part, prior_part):
-        return np.concatenate([new_part, prior_part]) if n_prior \
-            else np.asarray(new_part)
-
-    cat = {
-        'value': _cat(st.o_value[a_rows], store.e_value[prior_rows]),
-        'link': _cat(o_act[a_rows] == _LINK, store.e_link[prior_rows]),
-        'actor': _cat(st.o_actor[a_rows], store.e_actor[prior_rows]),
-        'doc': _cat(o_doc[a_rows], p_doc),
-        'seq': seq_cat_store,
-        'change': _cat(st.cmap[oc[a_rows]].astype(np.int32),
-                       store.e_change[prior_rows]),
-        'obj': _cat(o_objrow[a_rows].astype(np.int32),
-                    store.e_obj[prior_rows]),
-        'key': _cat(o_field[a_rows] & 0xFFFFFFFF,
-                    store.e_key[prior_rows]),
-    }
+    # columns build LAZILY on first access (8 half-million-row gathers
+    # + concatenates off the dispatch path — the commit or a diff read
+    # pays them, overlapping the device program). The e_* refs snapshot
+    # NOW: the store's entry columns are replaced (never mutated) at
+    # commit, so the captured arrays stay the pre-commit state.
+    e_snap = (store.e_value, store.e_link, store.e_actor,
+              store.e_change, store.e_obj, store.e_key)
+    cat = _LazyCat({
+        'value': lambda: (st.o_value[a_rows], e_snap[0][prior_rows]),
+        'link': lambda: (o_act[a_rows] == _LINK,
+                         e_snap[1][prior_rows]),
+        'actor': lambda: (st.o_actor[a_rows], e_snap[2][prior_rows]),
+        'doc': lambda: (o_doc[a_rows], p_doc),
+        'seq': lambda: (seq_cat_store, None),
+        'change': lambda: (st.cmap[oc[a_rows]].astype(np.int32),
+                           e_snap[3][prior_rows]),
+        'obj': lambda: (o_objrow[a_rows].astype(np.int32),
+                        e_snap[4][prior_rows]),
+        'key': lambda: (o_field[a_rows] & 0xFFFFFFFF,
+                        e_snap[5][prior_rows]),
+    }, n_prior)
 
     f_obj = (touched_fields >> 32).astype(np.int32)
     patch.f_obj = f_obj
@@ -2009,6 +2156,36 @@ def _apply_general(store, block, options, return_timing):
         return patch, {'admit': t1 - t0, 'pack': t2 - t1,
                        'device': t3 - t2, 'unpack': t4 - t3}
     return patch
+
+
+class _LazyCat:
+    """The apply's row-column dict, built per key on FIRST access:
+    `thunks[k]()` returns (new_part, prior_part); prior_part of None
+    means the column is already concatenated."""
+
+    __slots__ = ('_thunks', '_n_prior', '_cols')
+
+    def __init__(self, thunks, n_prior):
+        self._thunks = thunks
+        self._n_prior = n_prior
+        self._cols = {}
+
+    def __getitem__(self, k):
+        c = self._cols.get(k)
+        if c is None:
+            new_part, prior_part = self._thunks[k]()
+            if prior_part is None:
+                c = np.asarray(new_part)
+            elif self._n_prior:
+                c = np.concatenate([new_part, prior_part])
+            else:
+                c = np.asarray(new_part)
+            self._cols[k] = c
+            # drop the thunk: its closure pins the whole staged block
+            # (st + op columns); once every column is built the apply's
+            # working set becomes collectable
+            self._thunks[k] = None
+        return c
 
 
 def _finish_empty(patch):
