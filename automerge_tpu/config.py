@@ -41,6 +41,14 @@ class Options:
     """
 
     kernel: str = 'auto'
+    # bulk-ingestion routing: DeviceBackend.apply_changes on a FRESH
+    # document routes batches of at least this many ops through the
+    # general bulk engine (one fused block apply) instead of the
+    # per-change staging loop. Threshold from the measured crossover on
+    # the config-2 interactive benchmark (~20k-op merges: per-doc
+    # ~0.29s, bulk ~0.15s; sub-1k batches favor per-doc staging).
+    # None disables routing.
+    bulk_route_min_ops: Optional[int] = 3000
     n_devices: Optional[int] = None
     op_pad: Optional[int] = None
     seg_pad: Optional[int] = None

@@ -829,7 +829,26 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
 
 def apply_changes(state, changes, kernel=None, options=None):
     """Single-document facade matching Backend.apply_changes
-    (backend/index.js:161-163)."""
+    (backend/index.js:161-163).
+
+    Bulk ingests auto-route to the general block engine: a fresh
+    document receiving >= ``Options.bulk_route_min_ops`` ops in one
+    call (a clone, a resync, a large merge) takes ONE fused block apply
+    instead of the per-change staging loop, and continues on the
+    general-backed state for subsequent applies; local changes and
+    undo/redo convert back to this per-doc state
+    (:mod:`.general_backend`)."""
+    from . import general_backend as _gb
+    opts = _engine.as_options(options, kernel)
+    if isinstance(state, _gb.GeneralBackendState):
+        return _gb.apply_changes(state, changes, options=opts)
+    thr = opts.bulk_route_min_ops
+    if thr is not None and not state.clock and not state.queue \
+            and state.undo_pos == 0 and not state.redo_stack:
+        changes = list(changes)      # sizing must not consume iterators
+        n_ops = sum(len(c.get('ops', ())) for c in changes)
+        if n_ops >= thr:
+            return _gb.apply_changes(_gb.init(), changes, options=opts)
     new_states, patches = apply_changes_batch([state], [changes],
                                               kernel=kernel, options=options)
     return new_states[0], patches[0]
@@ -917,6 +936,11 @@ def _redo(state, request, kernel=None, options=None):
 def apply_local_change(state, request, kernel=None, options=None):
     """Apply one local change request, recording undo history
     (backend/index.js:173-195)."""
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        # local edits continue on the per-doc state (undo capture is
+        # per-field staging); the conversion replays once and caches
+        state = _gb.to_device_state(state)
     if not isinstance(request.get('actor'), str) or not isinstance(request.get('seq'), int):
         raise TypeError('Change request requires `actor` and `seq` properties')
     if request['seq'] <= state.clock.get(request['actor'], 0):
@@ -950,6 +974,9 @@ def get_patch(state):
     """Whole-document patch from empty (backend/index.js:201-207): create
     diffs child-first, then field sets / element inserts, so the frontend
     can resolve links."""
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        return _gb.get_patch(state)
     diffs = []
     emitted = set()
     # one pass over the field table, then per-object lookups are O(fields-of)
@@ -1008,6 +1035,9 @@ def get_patch(state):
 
 def get_missing_changes(state, have_deps):
     """Changes a peer with clock `have_deps` lacks (op_set.js:327-334)."""
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        return _gb.get_missing_changes(state, have_deps)
     all_deps = transitive_deps(state, dict(have_deps))
     changes = []
     for actor in state.states:
@@ -1022,6 +1052,9 @@ def get_missing_changes(state, have_deps):
 
 
 def get_changes_for_actor(state, for_actor, after_seq=0):
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        return _gb.get_changes_for_actor(state, for_actor, after_seq)
     lst, n = state.actor_states(for_actor)
     out = []
     for entry in lst[after_seq:n]:
@@ -1035,6 +1068,9 @@ def get_changes_for_actor(state, for_actor, after_seq=0):
 
 def get_missing_deps(state):
     """Unmet dependencies of the buffered changes (op_set.js:347-358)."""
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        return _gb.get_missing_deps(state)
     missing = {}
     for change in state.queue:
         deps = dict(change['deps'])

@@ -400,16 +400,20 @@ class GeneralStore(BlockStore):
         # frontend/backend overlap of SURVEY §2 P3, engine-side)
         self._pending_commit = None
 
-    def _commit_pending(self):
+    def _commit_pending(self, _surv_u8=None):
         """Fetch the pending apply's survivor bits and fold its entry
-        update into the store (idempotent; replayable after rollback)."""
+        update into the store (idempotent; replayable after rollback).
+        ``_surv_u8`` lets a reader that already fetched the survivor
+        bytes (batched into its own round trip) pass them in."""
         pc = self._pending_commit
         if pc is None:
             return
         self._pending_commit = None
         n_rows = pc['n_rows']
         surviving = np.unpackbits(np.asarray(
-            jax.device_get(pc['surv_u8_dev'])))[:n_rows].astype(bool)
+            _surv_u8 if _surv_u8 is not None
+            else jax.device_get(pc['surv_u8_dev'])))[:n_rows] \
+            .astype(bool)
         s_rows = np.flatnonzero(surviving)
         patch = pc['patch']
         raw = patch._raw
@@ -480,7 +484,8 @@ class GeneralStore(BlockStore):
 
     # -- encode (the dict edge) ---------------------------------------------
 
-    def encode_changes(self, changes_per_doc, extra_types=None):
+    def encode_changes(self, changes_per_doc, extra_types=None,
+                       n_docs=None):
         """Encode reference-format dict changes into a general
         :class:`~.blocks.ChangeBlock`, resolving key kinds against this
         store's object types (plus objects created within the batch, and
@@ -491,6 +496,10 @@ class GeneralStore(BlockStore):
         necessarily causally unready — the creation has not arrived)
         encode with string keys; such changes buffer in the queue and
         re-encode on retry, when the creation is known.
+
+        ``n_docs`` widens the block's document space beyond
+        ``len(changes_per_doc)`` (a sparse tick touching few documents
+        of a large store need not materialize one list per document).
         """
         actors, actor_of = [], {}
         keys, key_of = [], {}
@@ -594,7 +603,7 @@ class GeneralStore(BlockStore):
                 op_ptr.append(len(action))
 
         return ChangeBlock(
-            len(changes_per_doc),
+            n_docs if n_docs is not None else len(changes_per_doc),
             np.asarray(doc, np.int32), np.asarray(actor, np.int32),
             np.asarray(seq, np.int32), np.asarray(dep_ptr, np.int32),
             np.asarray(dep_actor, np.int32), np.asarray(dep_seq, np.int32),
@@ -852,7 +861,7 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
 #   W2 = visible << 30 | (vis_index+1) << 15 | elemc
 #
 # Guards (host checks; the unpacked `_fused_general_resident` is the
-# fallback and the semantic reference): tree size <= 16384 nodes,
+# fallback and the semantic reference): tree size <= 32767 nodes,
 # elemc < 32768, actor count < 65535, seq < 32768, coo seq < 32768.
 
 _W2_ELEM = 0x7FFF
@@ -1066,7 +1075,7 @@ def _rank_table(store, opts):
 def _mirror_convert(mir, to_packed, store, opts):
     """Convert a resident mirror between the packed and cols formats
     (a store crossing a packed-variant guard mid-stream — e.g. a tree
-    growing past 16384 nodes). One elementwise device program plus a
+    growing past 32767 nodes). One elementwise device program plus a
     small-table gather; same cap/n/pos_row."""
     n_act = len(store.actors)
     ranks = np.asarray(store.actor_str_ranks())
@@ -1131,10 +1140,24 @@ class GeneralPatch:
             return
         self._ready = True
         store = self.store
-        store._commit_pending()      # survivors + entry fold, if pending
         raw = self._raw
         F = len(self.f_obj)
-        w_row = np.asarray(jax.device_get(raw['winner_dev']))[:F]
+        # ONE device_get for everything this read needs — each fetch
+        # pays a full link round trip (~100 ms floor on the tunnel).
+        # When the pending commit is THIS apply's, its survivor bytes
+        # join the same trip.
+        pc = store._pending_commit
+        own_pc = pc is not None and pc.get('patch') is self
+        fetch = [raw['winner_dev']]
+        if raw['vis_planes'] is not None:
+            fetch.append(raw['vis_planes'])
+        if own_pc:
+            fetch.append(pc['surv_u8_dev'])
+        fetched = jax.device_get(tuple(fetch))
+        w_row = np.asarray(fetched[0])[:F]
+        fetched_planes = fetched[1] if raw['vis_planes'] is not None \
+            else None
+        store._commit_pending(_surv_u8=fetched[-1] if own_pc else None)
         surviving = raw['surviving']
         cat, rorder = raw['cat'], raw['order']
         r_value = cat['value'][rorder]
@@ -1167,15 +1190,14 @@ class GeneralPatch:
         # sequence edit columns per dirty object: the prior AND new
         # visibility/order planes come back from the fused program as
         # device-resident outputs — ONE fetch here, no host mirror sync
-        planes = raw['vis_planes']
+        planes = fetched_planes
         if planes is not None:
             pool = store.pool
             if raw.get('vis_fmt') == 'packed':
                 pv, nv, pi, ni = unpack_vis_word(
-                    np.asarray(jax.device_get(planes)).view(np.uint32))
+                    np.asarray(planes).view(np.uint32))
             else:
-                pv, nv, pi, ni = [np.asarray(x)
-                                  for x in jax.device_get(planes)]
+                pv, nv, pi, ni = [np.asarray(x) for x in planes]
             dirty, n_j = raw['dirty'], raw['dirty_n']
             rows_flat = raw['rows_flat']
             row_start = np.zeros(len(dirty) + 1, np.int64)
@@ -1891,7 +1913,7 @@ def _apply_general(store, block, options, return_timing):
     # scan resolve — the block-scale fast path) wherever its bit-field
     # guards hold; `_fused_general_resident` is the fallback and the
     # semantic reference (huge single trees, wide actor sets)
-    use_packed = (pool.max_tree <= (1 << 14)
+    use_packed = (pool.max_tree <= 0x7FFF
                   and pool.max_elem < (1 << 15)
                   and n_act < 65535
                   and a_dtype is np.uint8 and s_dtype is np.int16

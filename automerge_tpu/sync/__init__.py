@@ -10,8 +10,9 @@ TPU path for whole-DocSet merges lives in
 from .doc_set import DocSet
 from .device_doc_set import DeviceDocSet
 from .dense_doc_set import DenseDocSet
+from .general_doc_set import GeneralDocSet
 from .watchable_doc import WatchableDoc
 from .connection import Connection, BatchingConnection
 
-__all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'WatchableDoc',
-           'Connection', 'BatchingConnection']
+__all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
+           'WatchableDoc', 'Connection', 'BatchingConnection']

@@ -47,11 +47,17 @@ class DeviceDocSet(DocSet):
         """Apply `{doc_id: [change, ...]}` across documents; every
         device-routed document resolves in ONE batched device pass.
         Returns `{doc_id: new_doc}` and fires handlers per document."""
+        from ..device import general_backend as _gb
         device_ids, device_states, device_changes = [], [], []
+        general_ids = []
         oracle_ids = []
         for doc_id, changes in changes_by_doc.items():
             doc = self.docs.get(doc_id)
             state = Frontend.get_backend_state(doc) if doc is not None else None
+            if isinstance(state, _gb.GeneralBackendState):
+                # bulk-routed doc (a large ingest): its own fused apply
+                general_ids.append(doc_id)
+                continue
             on_device = state is None or isinstance(
                 state, DeviceBackend.DeviceBackendState)
             if doc_id in self._oracle_docs or not on_device:
@@ -64,6 +70,15 @@ class DeviceDocSet(DocSet):
                 device_changes.append(changes)
 
         out = {}
+        for doc_id in general_ids:
+            state, patch = DeviceBackend.apply_changes(
+                self._device_state(doc_id), changes_by_doc[doc_id],
+                options=self.options)
+            doc = self.docs[doc_id]
+            patch['state'] = state
+            doc = Frontend.apply_patch(doc, patch)
+            self.set_doc(doc_id, doc)
+            out[doc_id] = doc
         if device_ids:
             new_states, patches = DeviceBackend.apply_changes_batch(
                 device_states, device_changes, options=self.options)
@@ -92,8 +107,10 @@ class DeviceDocSet(DocSet):
         doc = self.docs.get(doc_id)
         if doc is None:
             raise KeyError(doc_id)
+        from ..device import general_backend as _gb
         state = Frontend.get_backend_state(doc)
-        if isinstance(state, DeviceBackend.DeviceBackendState):
+        if isinstance(state, (DeviceBackend.DeviceBackendState,
+                              _gb.GeneralBackendState)):
             self._oracle_docs.discard(doc_id)
             return doc
         changes = Backend.get_missing_changes(state, {})

