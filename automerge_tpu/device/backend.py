@@ -936,11 +936,9 @@ def _redo(state, request, kernel=None, options=None):
 def apply_local_change(state, request, kernel=None, options=None):
     """Apply one local change request, recording undo history
     (backend/index.js:173-195)."""
-    from . import general_backend as _gb
-    if isinstance(state, _gb.GeneralBackendState):
-        # local edits continue on the per-doc state (undo capture is
-        # per-field staging); the conversion replays once and caches
-        state = _gb.to_device_state(state)
+    # GeneralBackendState participates natively: its `fields` view
+    # serves the undo capture, apply_changes routes to the bulk
+    # engine, and the token carries the undo/redo stacks
     if not isinstance(request.get('actor'), str) or not isinstance(request.get('seq'), int):
         raise TypeError('Change request requires `actor` and `seq` properties')
     if request['seq'] <= state.clock.get(request['actor'], 0):

@@ -445,6 +445,114 @@ class GeneralStore(BlockStore):
         self.e_change = np.concatenate([self.e_change[keep_e],
                                         cat['change'][sel]])
 
+    # -- packed snapshot -----------------------------------------------------
+
+    def save_snapshot(self):
+        """Serialize the WHOLE store — entries, object table, pooled
+        insertion trees (host-synced visibility), clock, closure CSR,
+        interned tables, causal buffer — to bytes. Resume is
+        replay-free (O(state)); change bodies are dropped, so a
+        resumed store serves peers forward from here only (same
+        contract as the dense-store snapshot and the per-doc
+        device snapshot — SURVEY §5 checkpoint/resume)."""
+        import io
+        import json as _json2
+        self._commit_pending()
+        self.pool.sync()
+        self.log_sorted_keys()       # fold pending appends into l_order
+        pool = self.pool
+        meta = {'format': 'automerge-tpu-general-snapshot@1',
+                'n_docs': self.n_docs,
+                'retain_log': self.retain_log,
+                'actors': self.actors, 'keys': self.keys,
+                'values': list(self.values), 'queue': self.queue,
+                'obj_uuid': self.obj_uuid, 'obj_doc': self.obj_doc,
+                'obj_type': self.obj_type,
+                'obj_inbound': {str(k): v for k, v in
+                                self.obj_inbound.items()}}
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            e_doc=self.e_doc, e_obj=self.e_obj, e_key=self.e_key,
+            e_actor=self.e_actor, e_seq=self.e_seq,
+            e_value=self.e_value, e_link=self.e_link,
+            e_change=self.e_change,
+            c_doc=self.c_doc, c_actor=self.c_actor, c_seq=self.c_seq,
+            l_key=self.l_key, l_order=self.l_order,
+            l_dep_ptr=self.l_dep_ptr, l_dep_actor=self.l_dep_actor,
+            l_dep_seq=self.l_dep_seq,
+            root_row=self._root_row,
+            p_obj=pool.obj, p_local=pool.local, p_parent=pool.parent,
+            p_actor=pool.actor, p_elemc=pool.elemc,
+            p_visible=pool.visible, p_vis_index=pool.vis_index,
+            p_pos_sorted=pool.pos_sorted, p_pos_row=pool.pos_row,
+            p_n_of=pool.n_of, p_max_elem_of=pool.max_elem_of,
+            meta=np.frombuffer(_json2.dumps(meta).encode(),
+                               dtype=np.uint8))
+        return buf.getvalue()
+
+    @classmethod
+    def load_snapshot(cls, data):
+        """Rebuild a store from :meth:`save_snapshot` bytes — no
+        replay; the device mirror re-materializes lazily on the next
+        apply (zero extra wire bytes: the first resident apply ships
+        every node as its own delta)."""
+        import io
+        import json as _json2
+        with np.load(io.BytesIO(data)) as z:
+            meta = _json2.loads(bytes(z['meta']).decode())
+            if meta.get('format') != \
+                    'automerge-tpu-general-snapshot@1':
+                raise ValueError('not a general-store snapshot')
+            store = cls(meta['n_docs'],
+                        retain_log=meta.get('retain_log', True))
+            store.actors = list(meta['actors'])
+            store.actor_of = {a: i for i, a in
+                              enumerate(store.actors)}
+            store.keys = list(meta['keys'])
+            store.key_of = {k: i for i, k in enumerate(store.keys)}
+            store.values = ValueTable()
+            store.values.extend(meta['values'])
+            store.queue = [(d, ch) for d, ch in meta['queue']]
+            store.obj_uuid = list(meta['obj_uuid'])
+            store.obj_doc = list(meta['obj_doc'])
+            store.obj_type = list(meta['obj_type'])
+            store.obj_of = {(d, u): i for i, (d, u) in enumerate(
+                zip(store.obj_doc, store.obj_uuid))}
+            store.obj_inbound = {
+                int(k): [(r, key) for r, key in v]
+                for k, v in meta['obj_inbound'].items()}
+            for name in ('e_doc', 'e_obj', 'e_key', 'e_actor',
+                         'e_seq', 'e_value', 'e_link', 'e_change',
+                         'c_doc', 'c_actor', 'c_seq',
+                         'l_key', 'l_order', 'l_dep_ptr',
+                         'l_dep_actor', 'l_dep_seq'):
+                setattr(store, name, z[name])
+            # purity is an optimization hint; resumed chains re-derive
+            # it conservatively
+            store.c_pure = np.zeros(len(store.c_doc), bool)
+            store._root_row = z['root_row']
+            pool = store.pool
+            pool.obj = z['p_obj']
+            pool.local = z['p_local']
+            pool.parent = z['p_parent']
+            pool.actor = z['p_actor']
+            pool.elemc = z['p_elemc']
+            pool.visible = z['p_visible']
+            pool.vis_index = z['p_vis_index']
+            pool.pos_sorted = z['p_pos_sorted']
+            pool.pos_row = z['p_pos_row']
+            pool.n_of = z['p_n_of']
+            pool.max_elem_of = z['p_max_elem_of']
+            pool.max_tree = int(pool.n_of.max()) if len(pool.n_of) \
+                else 0
+            pool.max_elem = int(pool.elemc.max()) \
+                if len(pool.elemc) else 0
+            # change bodies are not serialized: peers sync forward
+            # from here, not across the snapshot boundary
+            store.log_truncated = True
+        return store
+
     # -- objects -------------------------------------------------------------
 
     def obj_arrays(self):

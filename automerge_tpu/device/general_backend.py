@@ -18,10 +18,13 @@ common linear case. Reads that the sync protocol performs on old
 tokens (``clock``, ``get_missing_changes``) are served exactly from
 the append-only retained log filtered by the token clock.
 
-Undo/redo and local-change requests convert (once, lazily) to the
-per-doc :class:`~.backend.DeviceBackendState` and continue there — the
-bulk engine is the ingestion path, exactly like `DocSet.applyChanges`
-vs per-doc edits in the reference (src/doc_set.js:25-33).
+Local changes and undo/redo run NATIVELY on the token: inverse-op
+capture reads the store columns through the ``fields`` view (the same
+surface `backend._capture_undo_ops` stages against), and the token
+carries the undo/redo stacks — so a document ingested at bulk scale
+keeps the full per-doc surface without ever converting. The per-doc
+conversion (:func:`to_device_state`) remains available for callers
+that want the staged representation.
 """
 
 import numpy as np
@@ -38,12 +41,8 @@ class GeneralBackendState:
     """Persistent-token view of a one-document general store."""
 
     __slots__ = ('store', '_version', 'clock', 'deps', '_all_deps',
-                 '_device_state')
-
-    # per-doc backend attribute surface (no local-change history here;
-    # undo/redo live on the converted per-doc state)
-    undo_pos = 0
-    redo_stack = ()
+                 '_device_state', 'undo_pos', 'undo_stack',
+                 'redo_stack')
 
     def __init__(self, store, version, clock, deps, all_deps):
         self.store = store
@@ -52,9 +51,73 @@ class GeneralBackendState:
         self.deps = deps
         self._all_deps = all_deps      # (actor, seq) -> transitive deps
         self._device_state = None
+        self.undo_pos = 0
+        self.undo_stack = []
+        self.redo_stack = []
 
     def _is_current(self):
         return self._version == getattr(self.store, '_gb_version', 0)
+
+    @property
+    def fields(self):
+        """Read-only (obj uuid, key) -> surviving entries view — the
+        surface the per-doc undo capture reads
+        (`backend._field_ops_or_del`), served from the store columns."""
+        return _FieldsView(self)
+
+
+class _FieldsView:
+    """Lazy field lookup over the general store's entry columns:
+    ``get((obj_uuid, key))`` returns the field's surviving entries,
+    winner first, as the per-doc backend's entry dicts. O(doc entries)
+    per lookup — local-change undo capture touches a handful of
+    fields."""
+
+    __slots__ = ('_state',)
+
+    def __init__(self, state):
+        self._state = state
+
+    def get(self, field, default=()):
+        state = self._state
+        store = state.store
+        store._commit_pending()
+        obj_uuid, key = field
+        row = store.obj_of.get((0, obj_uuid))
+        if row is None:
+            return default
+        if store.is_seq(row):
+            # elemId key 'actor:counter' -> local node index
+            actor_s, _, counter = str(key).rpartition(':')
+            aid = store.actor_of.get(actor_s, -1)
+            if aid < 0 or not counter.isdigit():
+                return default
+            pool = store.pool
+            prows, _ = pool.rows_of_objs(np.asarray([row]))
+            hit = np.flatnonzero((pool.actor[prows] == aid)
+                                 & (pool.elemc[prows] == int(counter)))
+            if not len(hit):
+                return default
+            fkey = _ELEM_BIT | int(pool.local[prows[hit[0]]])
+        else:
+            kid = store.key_of.get(key)
+            if kid is None:
+                return default
+            fkey = kid
+        js = np.flatnonzero((store.e_obj == row)
+                            & (store.e_key == fkey))
+        if not len(js):
+            return default
+        # winner ordering through the one shared rule
+        by = doc_fields_sorted(store, 0, rows=js.tolist())
+        entries = next(iter(by.values()))
+        out = []
+        for j in entries:
+            v = store.e_value[j]
+            out.append({'action': 'link' if store.e_link[j] else 'set',
+                        'actor': store.actors[store.e_actor[j]],
+                        'value': store.values[v] if v >= 0 else None})
+        return out
 
 
 def init():
@@ -68,8 +131,14 @@ def _fork(state):
     store (applying to a held snapshot — the rare path). Causally
     buffered changes carry over: they were delivered, just not yet
     ready (dropping them would silently lose data — r5 review)."""
-    changes = [c for c in state.store.get_missing_changes(0, {})
-               if c['seq'] <= state.clock.get(c['actor'], 0)]
+    try:
+        changes = [c for c in state.store.get_missing_changes(0, {})
+                   if c['seq'] <= state.clock.get(c['actor'], 0)]
+    except ValueError as err:
+        raise ValueError(
+            'cannot branch from a stale token of a snapshot-resumed '
+            'store: its pre-resume history is not replayable — '
+            'continue from the newest token instead') from err
     changes += [c for _, c in state.store.queue]
     new = init()
     if changes:
@@ -121,6 +190,7 @@ def apply_changes(state, changes, options=None):
     """applyChanges through the bulk engine; returns
     (new token, reference-format patch)."""
     changes = list(changes)      # consumed more than once below
+    orig = state                 # undo history survives a stale fork
     if not state._is_current():
         state = _fork(state)
     store = state.store
@@ -138,8 +208,15 @@ def apply_changes(state, changes, options=None):
     store._gb_version = state._version + 1
     new = GeneralBackendState(store, store._gb_version, clock, deps,
                               all_deps_tab)
+    # local-change history carries across remote applies (the per-doc
+    # backend and the reference both keep it) — from the CALLER's
+    # token, which a stale fork must not reset
+    new.undo_pos = orig.undo_pos
+    new.undo_stack = orig.undo_stack
+    new.redo_stack = orig.redo_stack
     patch = {'clock': dict(clock), 'deps': dict(deps),
-             'canUndo': False, 'canRedo': False,
+             'canUndo': new.undo_pos > 0,
+             'canRedo': bool(new.redo_stack),
              'diffs': _LazyDiffs(gpatch)}
     return new, patch
 
@@ -262,7 +339,8 @@ def get_patch(state):
     diffs = []
     if root < 0:
         return {'clock': dict(state.clock), 'deps': dict(state.deps),
-                'canUndo': False, 'canRedo': False, 'diffs': diffs}
+                'canUndo': state.undo_pos > 0,
+                'canRedo': bool(state.redo_stack), 'diffs': diffs}
 
     by_field = doc_fields_sorted(store, 0)
 
@@ -344,7 +422,8 @@ def get_patch(state):
 
     emit_object(root)
     return {'clock': dict(state.clock), 'deps': dict(state.deps),
-            'canUndo': False, 'canRedo': False, 'diffs': diffs}
+            'canUndo': state.undo_pos > 0,
+            'canRedo': bool(state.redo_stack), 'diffs': diffs}
 
 
 def _conflicts(store, js):

@@ -114,10 +114,71 @@ def restore_state(payload):
     return state
 
 
+GENERAL_FORMAT = 'automerge-tpu-general-doc-snapshot@1'
+
+
+def _snapshot_general(state):
+    """GeneralBackendState -> JSON string: the packed store bytes plus
+    the token's protocol state (clock, dep frontier, closure table,
+    undo/redo)."""
+    import base64
+    from .device import general_backend as _gb
+    if not state._is_current():
+        # a held old token must snapshot ITS history, not the store's
+        # newer content (r5 review: clock/content divergence)
+        fork = _gb._fork(state)
+        fork.undo_pos = state.undo_pos
+        fork.undo_stack = state.undo_stack
+        fork.redo_stack = state.redo_stack
+        state = fork
+    store_bytes = state.store.save_snapshot()
+    return _json.dumps({
+        'format': GENERAL_FORMAT,
+        'store': base64.b64encode(store_bytes).decode('ascii'),
+        'clock': state.clock,
+        'deps': state.deps,
+        'all_deps': [[a, s, d] for (a, s), d in
+                     state._all_deps.items()],
+        'undo_pos': state.undo_pos,
+        'undo_stack': state.undo_stack,
+        'redo_stack': state.redo_stack,
+    })
+
+
+def _restore_general(payload, actor_id=None):
+    import base64
+    from .device import general as _general
+    from .device import general_backend as _gb
+    store = _general.GeneralStore.load_snapshot(
+        base64.b64decode(payload['store']))
+    store._gb_version = 0
+    state = _gb.GeneralBackendState(
+        store, 0, dict(payload['clock']), dict(payload['deps']),
+        {(a, s): d for a, s, d in payload['all_deps']})
+    state.undo_pos = payload.get('undo_pos', 0)
+    state.undo_stack = [list(ops) for ops
+                        in payload.get('undo_stack', [])]
+    state.redo_stack = [list(ops) for ops
+                        in payload.get('redo_stack', [])]
+    options = {'backend': DeviceBackend}
+    if actor_id is not None:
+        options['actorId'] = actor_id
+    doc = Frontend.init(options)
+    patch = _gb.get_patch(state)
+    patch['state'] = state
+    return Frontend.apply_patch(doc, patch)
+
+
 def save_snapshot(doc):
     """Serialize a device-backed document's packed state (the fast-resume
-    artifact; `save()` remains the archival change log)."""
+    artifact; `save()` remains the archival change log). Covers both
+    the per-doc device backend and bulk-routed
+    (:class:`~.device.general_backend.GeneralBackendState`)
+    documents."""
+    from .device.general_backend import GeneralBackendState
     state = Frontend.get_backend_state(doc)
+    if isinstance(state, GeneralBackendState):
+        return _snapshot_general(state)
     if not isinstance(state, DeviceBackendState):
         raise TypeError(
             'save_snapshot requires a device-backed document; host-oracle '
@@ -127,7 +188,10 @@ def save_snapshot(doc):
 
 def load_snapshot(data, actor_id=None):
     """Materialize a document from a packed snapshot in O(state)."""
-    state = restore_state(_json.loads(data))
+    payload = _json.loads(data)
+    if payload.get('format') == GENERAL_FORMAT:
+        return _restore_general(payload, actor_id=actor_id)
+    state = restore_state(payload)
     options = {'backend': DeviceBackend}
     if actor_id is not None:
         options['actorId'] = actor_id

@@ -146,23 +146,39 @@ class TestBulkRouting:
         actors = {c['actor'] for c in back}
         assert 'writer-8' not in actors and 'writer-9' not in actors
 
-    def test_local_change_converts_and_undoes(self):
+    def test_local_change_native_undo_redo(self):
+        """Local changes and undo/redo run NATIVELY on the general
+        state (inverse-op capture over the store columns — r4 VERDICT
+        #5); no conversion to the per-doc backend."""
         changes = _writer_changes()
         s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
                                            changes, options=ROUTE)
+        root = '00000000-0000-0000-0000-000000000000'
         req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
                'deps': dict(s.deps),
-               'ops': [{'action': 'set',
-                        'obj': '00000000-0000-0000-0000-000000000000',
-                        'key': 'mine', 'value': 1}]}
-        s2, p2 = DeviceBackend.apply_local_change(s, req)
+               'ops': [{'action': 'set', 'obj': root, 'key': 'mine',
+                        'value': 1},
+                       {'action': 'set', 'obj': root, 'key': 'meta',
+                        'value': 'overwritten'}]}
+        s2, p2 = DeviceBackend.apply_local_change(s, req,
+                                                  options=ROUTE)
+        assert isinstance(s2, GB.GeneralBackendState)
         assert p2['canUndo'] is True
         doc = _mat(_doc_from_patch(DeviceBackend.get_patch(s2)))
-        assert doc['mine'] == 1 and doc['meta'] == {'v': 1}
+        assert doc['mine'] == 1 and doc['meta'] == 'overwritten'
         undo = {'requestType': 'undo', 'actor': 'me', 'seq': 2}
-        s3, _ = DeviceBackend.apply_local_change(s2, undo)
+        s3, p3 = DeviceBackend.apply_local_change(s2, undo,
+                                                  options=ROUTE)
+        assert isinstance(s3, GB.GeneralBackendState)
         doc3 = _mat(_doc_from_patch(DeviceBackend.get_patch(s3)))
         assert 'mine' not in doc3
+        assert doc3['meta'] == {'v': 1}      # old field value restored
+        assert p3['canRedo'] is True
+        redo = {'requestType': 'redo', 'actor': 'me', 'seq': 3}
+        s4, _ = DeviceBackend.apply_local_change(s3, redo,
+                                                 options=ROUTE)
+        doc4 = _mat(_doc_from_patch(DeviceBackend.get_patch(s4)))
+        assert doc4['mine'] == 1 and doc4['meta'] == 'overwritten'
 
     def test_causal_buffering_through_route(self):
         changes = _writer_changes()
@@ -231,3 +247,209 @@ def test_iterator_changes_not_consumed_by_routing():
                                          iter(changes),
                                          options=NO_ROUTE)
     assert p['clock'] == p2['clock']
+
+
+class TestGeneralSnapshots:
+    def test_general_doc_snapshot_roundtrip(self):
+        from automerge_tpu import snapshot as SNAP
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s)
+        p['state'] = s
+        front = Frontend.apply_patch(front, p)
+        blob = SNAP.save_snapshot(front)
+        doc2 = SNAP.load_snapshot(blob)
+        assert _mat(doc2) == _mat(front)
+        # resumed state keeps working: a new remote change lands
+        st2 = Frontend.get_backend_state(doc2)
+        assert isinstance(st2, GB.GeneralBackendState)
+        late = {'actor': 'writer-9', 'seq': 1, 'deps': {'base': 1},
+                'ops': [{'action': 'set',
+                         'obj': '00000000-0000-0000-0000-000000000000',
+                         'key': 'late', 'value': 1}]}
+        st3, _ = DeviceBackend.apply_changes(st2, [late],
+                                             options=ROUTE)
+        doc3 = _mat(_doc_from_patch(DeviceBackend.get_patch(st3)))
+        assert doc3['late'] == 1
+        # truncated log: a from-zero peer cannot be served changes
+        with pytest.raises(ValueError):
+            DeviceBackend.get_missing_changes(st3, {})
+
+    def test_undo_survives_snapshot(self):
+        from automerge_tpu import snapshot as SNAP
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        root = '00000000-0000-0000-0000-000000000000'
+        req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
+               'deps': dict(s.deps),
+               'ops': [{'action': 'set', 'obj': root, 'key': 'k',
+                        'value': 'v'}]}
+        s2, _ = DeviceBackend.apply_local_change(s, req, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s2)
+        p['state'] = s2
+        front = Frontend.apply_patch(front, p)
+        doc2 = SNAP.load_snapshot(SNAP.save_snapshot(front))
+        st2 = Frontend.get_backend_state(doc2)
+        undo = {'requestType': 'undo', 'actor': 'me', 'seq': 2}
+        st3, _ = DeviceBackend.apply_local_change(st2, undo,
+                                                  options=ROUTE)
+        doc3 = _mat(_doc_from_patch(DeviceBackend.get_patch(st3)))
+        assert 'k' not in doc3
+
+    def test_general_docset_snapshot_roundtrip(self):
+        from automerge_tpu.sync.general_doc_set import GeneralDocSet
+        from automerge_tpu.common import ROOT_ID
+        n = 40
+        ds = GeneralDocSet(n)
+        per = {}
+        for i in range(n):
+            obj = f'00000000-0000-4000-8000-{i:012x}'
+            ops = [{'action': 'makeList', 'obj': obj},
+                   {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+                    'value': obj},
+                   {'action': 'ins', 'obj': obj, 'key': '_head',
+                    'elem': 1},
+                   {'action': 'set', 'obj': obj, 'key': f'w{i}:1',
+                    'value': i},
+                   {'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+                    'value': i}]
+            per[f'doc{i}'] = [{'actor': f'w{i}', 'seq': 1, 'deps': {},
+                               'ops': ops}]
+        ds.apply_changes_batch(per)
+        blob = ds.save_snapshot()
+        ds2 = GeneralDocSet.load_snapshot(blob)
+        for i in range(n):
+            got = ds2.materialize(f'doc{i}')
+            assert got == {'l': [i], 'n': i}
+        # resumed set keeps applying new batches
+        ds2.apply_changes_batch({
+            'doc0': [{'actor': 'w0', 'seq': 2, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'post',
+                 'value': True}]}]})
+        assert ds2.materialize('doc0')['post'] is True
+
+    def test_connection_serves_general_snapshot(self):
+        """A lagging peer behind a truncated general log receives the
+        packed snapshot through the normal Connection flow."""
+        from automerge_tpu import snapshot as SNAP
+        from automerge_tpu.sync import DocSet, Connection
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s)
+        p['state'] = s
+        front = Frontend.apply_patch(front, p)
+        resumed = SNAP.load_snapshot(SNAP.save_snapshot(front))
+
+        a, b = DocSet(), DocSet()
+        a.set_doc('d', resumed)
+        msgs_a, msgs_b = [], []
+        ca = Connection(a, msgs_a.append)
+        cb = Connection(b, msgs_b.append)
+        ca.open()
+        cb.open()
+        hops = 0
+        while msgs_a or msgs_b:
+            hops += 1
+            assert hops < 30
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        assert _mat(b.get_doc('d')) == _mat(front)
+
+
+class TestGeneralTokenEdges:
+    def test_stale_token_snapshot_is_consistent(self):
+        """save_snapshot of a held OLD token must capture that token's
+        history, not newer store content (r5 review)."""
+        from automerge_tpu import snapshot as SNAP
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s)
+        p['state'] = s
+        front = Frontend.apply_patch(front, p)
+        late = {'actor': 'wa', 'seq': 1, 'deps': {'base': 1},
+                'ops': [{'action': 'set',
+                         'obj': '00000000-0000-0000-0000-000000000000',
+                         'key': 'late', 'value': 99}]}
+        DeviceBackend.apply_changes(s, [late], options=ROUTE)
+        doc2 = SNAP.load_snapshot(SNAP.save_snapshot(front))
+        got = _mat(doc2)
+        assert 'late' not in got
+        assert 'wa' not in Frontend.get_backend_state(doc2).clock
+
+    def test_undo_flags_survive_resume(self):
+        from automerge_tpu import snapshot as SNAP
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        root = '00000000-0000-0000-0000-000000000000'
+        req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
+               'deps': dict(s.deps),
+               'ops': [{'action': 'set', 'obj': root, 'key': 'k',
+                        'value': 'v'}]}
+        s2, _ = DeviceBackend.apply_local_change(s, req, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s2)
+        p['state'] = s2
+        front = Frontend.apply_patch(front, p)
+        doc2 = SNAP.load_snapshot(SNAP.save_snapshot(front))
+        st = Frontend.get_backend_state(doc2)
+        assert DeviceBackend.get_patch(st)['canUndo'] is True
+
+    def test_undo_history_survives_stale_fork(self):
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        root = '00000000-0000-0000-0000-000000000000'
+        req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
+               'deps': dict(s.deps),
+               'ops': [{'action': 'set', 'obj': root, 'key': 'k',
+                        'value': 'v'}]}
+        s2, _ = DeviceBackend.apply_local_change(s, req, options=ROUTE)
+        r1 = {'actor': 'wb', 'seq': 1, 'deps': {'base': 1},
+              'ops': [{'action': 'set', 'obj': root, 'key': 'b1',
+                       'value': 1}]}
+        r2 = {'actor': 'wc', 'seq': 1, 'deps': {'base': 1},
+              'ops': [{'action': 'set', 'obj': root, 'key': 'c1',
+                       'value': 2}]}
+        DeviceBackend.apply_changes(s2, [r1], options=ROUTE)
+        s4, p4 = DeviceBackend.apply_changes(s2, [r2], options=ROUTE)
+        assert p4['canUndo'] is True
+        undo = {'requestType': 'undo', 'actor': 'me', 'seq': 2}
+        s5, _ = DeviceBackend.apply_local_change(s4, undo,
+                                                 options=ROUTE)
+        doc = _mat(_doc_from_patch(DeviceBackend.get_patch(s5)))
+        assert 'k' not in doc and doc['c1'] == 2
+
+    def test_stale_token_after_resume_raises_clearly(self):
+        from automerge_tpu import snapshot as SNAP
+        changes = _writer_changes()
+        s, _ = DeviceBackend.apply_changes(DeviceBackend.init(),
+                                           changes, options=ROUTE)
+        front = Frontend.init({'backend': DeviceBackend})
+        p = DeviceBackend.get_patch(s)
+        p['state'] = s
+        front = Frontend.apply_patch(front, p)
+        doc2 = SNAP.load_snapshot(SNAP.save_snapshot(front))
+        st = Frontend.get_backend_state(doc2)
+        root = '00000000-0000-0000-0000-000000000000'
+        r1 = {'actor': 'wb', 'seq': 1, 'deps': {'base': 1},
+              'ops': [{'action': 'set', 'obj': root, 'key': 'b1',
+                       'value': 1}]}
+        r2 = {'actor': 'wc', 'seq': 1, 'deps': {'base': 1},
+              'ops': [{'action': 'set', 'obj': root, 'key': 'c1',
+                       'value': 2}]}
+        DeviceBackend.apply_changes(st, [r1], options=ROUTE)
+        with pytest.raises(ValueError, match='stale token'):
+            DeviceBackend.apply_changes(st, [r2], options=ROUTE)
