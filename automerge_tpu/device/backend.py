@@ -938,7 +938,12 @@ def apply_local_change(state, request, kernel=None, options=None):
     (backend/index.js:173-195)."""
     # GeneralBackendState participates natively: its `fields` view
     # serves the undo capture, apply_changes routes to the bulk
-    # engine, and the token carries the undo/redo stacks
+    # engine, and the token carries the undo/redo stacks. A STALE
+    # token forks FIRST so the capture reads exactly its lineage
+    # (the shared columns may hold newer changes — r5 review).
+    from . import general_backend as _gb
+    if isinstance(state, _gb.GeneralBackendState):
+        state = _gb.current_token(state)
     if not isinstance(request.get('actor'), str) or not isinstance(request.get('seq'), int):
         raise TypeError('Change request requires `actor` and `seq` properties')
     if request['seq'] <= state.clock.get(request['actor'], 0):

@@ -551,7 +551,61 @@ class GeneralStore(BlockStore):
             # change bodies are not serialized: peers sync forward
             # from here, not across the snapshot boundary
             store.log_truncated = True
+            # the device mirror must carry the RESTORED visibility: the
+            # lazy first-apply path treats a None mirror as an empty
+            # store and would re-stage every node hidden (r5 review:
+            # silent loss of pre-resume list/text elements)
+            store._materialize_mirror()
         return store
+
+    def _materialize_mirror(self):
+        """Build the device-resident mirror from the HOST pool columns
+        (pos-ordered) — the resume counterpart of the fused programs'
+        incremental mirror updates."""
+        pool = self.pool
+        n = pool.n_nodes
+        if n == 0:
+            return
+        opts = _engine.as_options(None)
+        cap = opts.pad_nodes(max(n, 8))
+        rows = pool.pos_row.astype(np.int64)
+        n_act = len(self.actors)
+        use_packed = (pool.max_tree <= 0x7FFF
+                      and pool.max_elem < (1 << 15)
+                      and n_act < 65535)
+        if use_packed:
+            ranks = np.asarray(self.actor_str_ranks())
+            actor = pool.actor[rows]
+            rank1 = np.where(actor >= 0,
+                             ranks[np.maximum(actor, 0)] + 1, 0) \
+                .astype(np.int32)
+            w1 = np.zeros(cap, np.int32)
+            w1[:n] = (pool.parent[rows].astype(np.int32) << 16) | rank1
+            w2 = np.zeros(cap, np.int32)
+            w2[:n] = (pool.visible[rows].astype(np.int32)
+                      << _W2_VIS_SHIFT) | \
+                ((pool.vis_index[rows].astype(np.int32) + 1)
+                 << _W2_IDX_SHIFT) | pool.elemc[rows]
+            self.pool.mirror = {
+                'fmt': 'packed', 'cap': cap, 'n': n,
+                'w1': jnp.asarray(w1), 'w2': jnp.asarray(w2),
+                'ranks': ranks.copy(), 'pos_row': pool.pos_row}
+        else:
+            def col(src, fill, dtype):
+                out = np.full(cap, fill, dtype)
+                out[:n] = src[rows]
+                return jnp.asarray(out)
+
+            self.pool.mirror = {
+                'fmt': 'cols', 'cap': cap, 'n': n,
+                'parent': col(pool.parent, 0, np.int32),
+                'elemc': col(pool.elemc, 0, np.int32),
+                'actor': col(pool.actor, -1, np.int32),
+                'visible': col(pool.visible, False, bool),
+                'vis_index': col(pool.vis_index, -1, np.int32),
+                'rank_n': n_act,
+                'rank_table': _rank_table(self, opts),
+                'pos_row': pool.pos_row}
 
     # -- objects -------------------------------------------------------------
 

@@ -146,6 +146,20 @@ def _fork(state):
     return new
 
 
+def current_token(state):
+    """The state itself if it is the store's newest token, else a fork
+    of its lineage (undo history carried over) — readers that must see
+    EXACTLY the token's history (undo capture, snapshots) call this
+    before touching the shared columns."""
+    if state._is_current():
+        return state
+    fork = _fork(state)
+    fork.undo_pos = state.undo_pos
+    fork.undo_stack = state.undo_stack
+    fork.redo_stack = state.redo_stack
+    return fork
+
+
 def _advance_deps(deps, all_deps_tab, applied, pre_clock):
     """Fold the applied changes into the dependency frontier, in causal
     order, with the oracle's transitive-closure rule
@@ -332,9 +346,13 @@ def get_patch(state):
     store._commit_pending()
     store.pool.sync()
     if not state._is_current():
-        # historical token: replay through the per-doc backend
+        # historical token: replay through the per-doc backend; the
+        # undo flags are the TOKEN's (the replayed state has none)
         from . import backend as DeviceBackend
-        return DeviceBackend.get_patch(to_device_state(state))
+        p = DeviceBackend.get_patch(to_device_state(state))
+        p['canUndo'] = state.undo_pos > 0
+        p['canRedo'] = bool(state.redo_stack)
+        return p
     root = int(store._root_row[0]) if len(store._root_row) else -1
     diffs = []
     if root < 0:

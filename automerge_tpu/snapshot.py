@@ -123,14 +123,9 @@ def _snapshot_general(state):
     undo/redo)."""
     import base64
     from .device import general_backend as _gb
-    if not state._is_current():
-        # a held old token must snapshot ITS history, not the store's
-        # newer content (r5 review: clock/content divergence)
-        fork = _gb._fork(state)
-        fork.undo_pos = state.undo_pos
-        fork.undo_stack = state.undo_stack
-        fork.redo_stack = state.redo_stack
-        state = fork
+    # a held old token must snapshot ITS history, not the store's
+    # newer content (r5 review: clock/content divergence)
+    state = _gb.current_token(state)
     store_bytes = state.store.save_snapshot()
     return _json.dumps({
         'format': GENERAL_FORMAT,

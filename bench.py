@@ -35,6 +35,11 @@ from automerge_tpu.device.workloads import (  # noqa: E402
     gen_docset_workload, gen_block_workload)
 
 
+def jnp_reshape_first(arr):
+    """First element of a device array as a [1] slice (tiny fetch)."""
+    return arr.reshape(-1)[:1]
+
+
 def bench_e2e_dense(iters=200, stream_k=8):
     """Headline: 1M wire ops across 10k docs through DenseMapStore.
 
@@ -75,9 +80,17 @@ def bench_e2e_dense(iters=200, stream_k=8):
         return [gen_block_workload(seed=k, seq0=k + 1)
                 for k in range(stream_k)]
 
+    def barrier():
+        # block_until_ready can return EARLY through the tunnel (a
+        # measured trap); a 1-element device_get is the only honest
+        # completion barrier — sync-each pays it per apply, the
+        # pipeline once per stream
+        np.asarray(jnp_reshape_first(store.eseq))
+
     def run_stream(stream, pipelined):
         store.reset()
         jax.block_until_ready(store.eseq)
+        barrier()
         t0 = time.perf_counter()
         last = None
         for blk in stream:
@@ -86,7 +99,10 @@ def bench_e2e_dense(iters=200, stream_k=8):
             else:
                 last = store.apply_block(blk)
                 last.block_until_ready()
+                barrier()
         last.block_until_ready()
+        store.drain()
+        barrier()
         return (time.perf_counter() - t0) / stream_k
 
     store.reset()

@@ -453,3 +453,74 @@ class TestGeneralTokenEdges:
         DeviceBackend.apply_changes(st, [r1], options=ROUTE)
         with pytest.raises(ValueError, match='stale token'):
             DeviceBackend.apply_changes(st, [r2], options=ROUTE)
+
+
+def test_sequence_survives_resume_and_new_applies():
+    """Post-resume applies must keep pre-resume list/text elements:
+    the restored mirror carries visibility (r5 review: the lazy
+    first-apply path wiped it)."""
+    from automerge_tpu import snapshot as SNAP
+    changes = _writer_changes()          # text doc, 3 writers
+    s, _ = DeviceBackend.apply_changes(DeviceBackend.init(), changes,
+                                       options=ROUTE)
+    front = Frontend.init({'backend': DeviceBackend})
+    p = DeviceBackend.get_patch(s)
+    p['state'] = s
+    front = Frontend.apply_patch(front, p)
+    before = _mat(front)['text']
+    doc2 = SNAP.load_snapshot(SNAP.save_snapshot(front))
+    st = Frontend.get_backend_state(doc2)
+    # insert one more char into the restored text
+    text_obj = None
+    for (d, uuid), row in st.store.obj_of.items():
+        if st.store.is_seq(row):
+            text_obj = uuid
+    last_elem = 1
+    late = {'actor': 'writer-0', 'seq': 2, 'deps': {},
+            'ops': [{'action': 'ins', 'obj': text_obj,
+                     'key': '_head', 'elem': 999},
+                    {'action': 'set', 'obj': text_obj,
+                     'key': 'writer-0:999', 'value': 'Z'}]}
+    st2, _ = DeviceBackend.apply_changes(st, [late], options=ROUTE)
+    got = _mat(_doc_from_patch(DeviceBackend.get_patch(st2)))['text']
+    assert got == 'Z' + before, (got, before)
+
+
+def test_stale_token_undo_capture_reads_own_lineage():
+    """Undo capture on a stale token must not leak values from
+    changes outside the token's history (r5 review)."""
+    changes = _writer_changes()
+    root = '00000000-0000-0000-0000-000000000000'
+    s, _ = DeviceBackend.apply_changes(DeviceBackend.init(), changes,
+                                       options=ROUTE)
+    r1 = {'actor': 'zz', 'seq': 1, 'deps': {'base': 1},
+          'ops': [{'action': 'set', 'obj': root, 'key': 'x',
+                   'value': 'FROM-R1'}]}
+    DeviceBackend.apply_changes(s, [r1], options=ROUTE)   # s now stale
+    req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
+           'deps': dict(s.deps),
+           'ops': [{'action': 'set', 'obj': root, 'key': 'x',
+                    'value': 'MINE'}]}
+    s2, _ = DeviceBackend.apply_local_change(s, req, options=ROUTE)
+    undo = {'requestType': 'undo', 'actor': 'me', 'seq': 2}
+    s3, _ = DeviceBackend.apply_local_change(s2, undo, options=ROUTE)
+    doc = _mat(_doc_from_patch(DeviceBackend.get_patch(s3)))
+    assert 'x' not in doc, doc.get('x')
+
+
+def test_stale_get_patch_reports_token_undo_flags():
+    changes = _writer_changes()
+    root = '00000000-0000-0000-0000-000000000000'
+    s, _ = DeviceBackend.apply_changes(DeviceBackend.init(), changes,
+                                       options=ROUTE)
+    req = {'requestType': 'change', 'actor': 'me', 'seq': 1,
+           'deps': dict(s.deps),
+           'ops': [{'action': 'set', 'obj': root, 'key': 'k',
+                    'value': 1}]}
+    s2, _ = DeviceBackend.apply_local_change(s, req, options=ROUTE)
+    r1 = {'actor': 'zz', 'seq': 1, 'deps': {'base': 1},
+          'ops': [{'action': 'set', 'obj': root, 'key': 'y',
+                   'value': 2}]}
+    DeviceBackend.apply_changes(s2, [r1], options=ROUTE)  # s2 stale
+    p = DeviceBackend.get_patch(s2)
+    assert p['canUndo'] is True
