@@ -84,3 +84,50 @@ def test_fewer_segments_than_shards():
     assert (np.asarray(sharded['winner'])[
         :int(np.unpackbits(captured['flags_u8'])[
             :len(captured['ops_slot'])].sum())] >= 0).all()
+
+
+def test_block_scale_sharded_equality():
+    """VERDICT r4 #10: the sharded general step at BLOCK scale —
+    >=100k field-sorted rows, hundreds of thousands of nodes, sharded
+    8 ways with non-dividing segment boundaries — bit-identical to the
+    single-device fused program. (The dryrun gates toy shapes; padding
+    and boundary-snap bugs only surface here.)"""
+    mesh = _mesh()
+    n_docs, list_ops = 1024, 122
+    per_doc = []
+    for d in range(n_docs):
+        obj = f'00000000-0000-4000-8000-{d:012x}'
+        ops1 = [{'action': 'makeList', 'obj': obj},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+                 'value': obj}]
+        prev = '_head'
+        for i in range(list_ops // 2):
+            ops1.append({'action': 'ins', 'obj': obj, 'key': prev,
+                         'elem': i + 1})
+            prev = f'w0-{d}:{i + 1}'
+            ops1.append({'action': 'set', 'obj': obj, 'key': prev,
+                         'value': i})
+        ops2 = []
+        for i in range(list_ops // 2, list_ops):
+            ops2.append({'action': 'ins', 'obj': obj, 'key': prev,
+                         'elem': i + 1})
+            prev = f'w1-{d}:{i + 1}'
+            ops2.append({'action': 'set', 'obj': obj, 'key': prev,
+                         'value': i})
+        ops2.append({'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+                     'value': d})
+        # concurrent second writer: conflicts + deletes in the mix
+        ops3 = [{'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+                 'value': -d},
+                {'action': 'del', 'obj': ROOT_ID,
+                 'key': 'meta' if d % 3 else 'other'}]
+        per_doc.append([
+            {'actor': f'w0-{d}', 'seq': 1, 'deps': {}, 'ops': ops1},
+            {'actor': f'w1-{d}', 'seq': 1, 'deps': {f'w0-{d}': 1},
+             'ops': ops2},
+            {'actor': f'zz-{d}', 'seq': 1, 'deps': {}, 'ops': ops3}])
+    store, patch, captured = _captured_apply(per_doc, n_docs)
+    n_rows = int(captured['n_rows'])
+    assert n_rows >= 100_000, n_rows
+    sharded, fused = _run_sharded(mesh, store, patch, captured)
+    _assert_equal(sharded, fused)

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""Generate CONFORMANCE.md: the per-case parity manifest between the
+reference test suites (/root/reference/test/*.js) and this repo's tests.
+
+Every reference case must resolve to one of:
+  ported   — a direct repo counterpart (cited)
+  covered  — behavior pinned by the cited repo test(s), different shape
+  adapted  — JS-idiom surface with a Python-idiom equivalent (cited)
+  replaced — subsystem implemented differently; cited differential
+             tests pin the equivalent contract
+  skipped  — consciously not carried, with the reason
+
+The generator fails if any case is unmapped — zero unexplained gaps.
+Mappings are per-describe with per-case overrides (matched on the
+case title).
+"""
+
+import os
+import re
+import sys
+from pathlib import Path
+
+REF = Path(os.environ.get('AUTOMERGE_REFERENCE',
+                          '/root/reference')) / 'test'
+OUT = Path(__file__).resolve().parent.parent / 'CONFORMANCE.md'
+
+FILES = ['test.js', 'backend_test.js', 'frontend_test.js',
+         'proxies_test.js', 'connection_test.js', 'skip_list_test.js',
+         'text_test.js', 'test_uuid.js', 'watchable_doc_test.js']
+
+# -- mapping table -----------------------------------------------------------
+# key: (file, describe path). Values: (status, where, note).
+# `cases` overrides individual case titles within the group.
+
+GROUPS = {
+    ('test.js', 'Automerge / sequential use:'): dict(
+        status='ported', where='tests/test_integration.py, '
+        'tests/test_integration_ext.py'),
+    ('test.js', 'Automerge / sequential use: / changes'): dict(
+        status='ported', where='tests/test_integration.py '
+        '(noop/read-write/frozen-root), tests/test_integration_ext.py '
+        '(grouping, forking, messages, conflict-resolving writes)',
+        cases={
+            'should work with Object.assign merges': (
+                'adapted', 'tests/test_proxies.py (dict update())',
+                'JS Object.assign is the dict-update idiom in Python'),
+            'should sanity-check arguments': (
+                'covered', 'tests/test_frontend.py (request '
+                'validation), tests/test_integration.py '
+                '(rejects_invalid_keys/unsupported_values)', ''),
+            'should not allow nested change blocks': (
+                'adapted', 'automerge_tpu/frontend/context.py',
+                'the Python facade passes an explicit mutable proxy '
+                'into change(); re-entrant blocks are unrepresentable '
+                'rather than guarded'),
+        }),
+    ('test.js', 'Automerge / sequential use: / emptyChange()'): dict(
+        status='ported', where='tests/test_integration.py '
+        '(test_empty_change_incorporates_deps), '
+        'tests/test_integration_ext.py '
+        '(test_empty_change_references_dependencies)'),
+    ('test.js', 'Automerge / sequential use: / root object'): dict(
+        status='ported', where='tests/test_integration.py (root '
+        'property set/delete/type-change, key validation, unsupported '
+        'datatypes)',
+        cases={
+            'should follow JS delete behavior': (
+                'adapted', 'tests/test_integration_ext.py '
+                '(test_delete_missing_key_is_noop)',
+                'Python del semantics; the JS-specific return-value '
+                'behavior has no Python counterpart'),
+        }),
+    ('test.js', 'Automerge / sequential use: / nested maps'): dict(
+        status='ported', where='tests/test_integration.py (nested '
+        'maps), tests/test_integration_ext.py (object ids, replace, '
+        'primitive<->map, shared references, deletion)'),
+    ('test.js', 'Automerge / sequential use: / lists'): dict(
+        status='ported', where='tests/test_integration.py (lists), '
+        'tests/test_integration_ext.py (out-by-one, out-of-range, '
+        'nested lists, replacement, type changes, depth, sharing)',
+        cases={
+            'should only allow numeric indexes': (
+                'ported', 'tests/test_proxies.py '
+                '(list index type errors)', ''),
+        }),
+    ('test.js', 'Automerge / concurrent use'): dict(
+        status='ported', where='tests/test_integration.py '
+        '(concurrent use block), tests/test_integration_ext.py '
+        '(conflicting list element)'),
+    ('test.js', 'Automerge / concurrent use / multiple insertions at '
+     'the same list position'): dict(
+        status='ported', where='tests/test_integration.py (insertion '
+        'by greater/lesser actor id, causality), '
+        'tests/test_integration_ext.py (regardless of actor id)'),
+    ('test.js', 'Automerge / Automerge.undo()'): dict(
+        status='ported', where='tests/test_integration.py (undo '
+        'block), tests/test_integration_ext.py (undo only local, '
+        'object creation/link deletion/list element), '
+        'tests/test_device_undo.py (device backend differential)'),
+    ('test.js', 'Automerge / Automerge.redo()'): dict(
+        status='ported', where='tests/test_integration.py (redo '
+        'chain), tests/test_integration_ext.py (winding history, '
+        'concurrent redo corners), tests/test_device_undo.py'),
+    ('test.js', 'Automerge / saving and loading'): dict(
+        status='ported', where='tests/test_integration.py '
+        '(round trip, history preservation, edit-after-load), '
+        'tests/test_integration_ext.py (new actor id, conflicts '
+        'reconstituted)',
+        note='the serialization FORMAT differs by design: a JSON '
+        'change log instead of transit-JS (documented in README; '
+        'wire changes are compatible, save files are not)'),
+    ('test.js', 'Automerge / history API'): dict(
+        status='ported', where='tests/test_integration.py (history '
+        'with messages/snapshots, merged history), '
+        'tests/test_integration_ext.py (empty history)'),
+    ('test.js', 'Automerge / .diff()'): dict(
+        status='ported', where='tests/test_integration.py (diff '
+        'between versions, identical docs, diverged), '
+        'tests/test_integration_ext.py (list ins/del by index, '
+        'object creation info, modified-object path)'),
+    ('test.js', 'Automerge / changes API'): dict(
+        status='ported', where='tests/test_integration.py (get/apply '
+        'changes, out-of-order buffering), '
+        'tests/test_integration_ext.py (empty doc/changes, '
+        'incremental changes)'),
+
+    ('backend_test.js', 'Backend / incremental diffs'): dict(
+        status='ported', where='tests/test_backend.py'),
+    ('backend_test.js', 'Backend / applyLocalChange()'): dict(
+        status='ported', where='tests/test_backend.py'),
+    ('backend_test.js', 'Backend / getPatch()'): dict(
+        status='ported', where='tests/test_backend.py'),
+    ('backend_test.js', 'Backend / getChangesForActor()'): dict(
+        status='ported', where='tests/test_backend.py'),
+
+    ('frontend_test.js', 'Frontend'): dict(
+        status='ported', where='tests/test_frontend.py'),
+    ('frontend_test.js', 'Frontend / performing changes'): dict(
+        status='ported', where='tests/test_frontend.py, '
+        'tests/test_frontend_concurrency.py'),
+    ('frontend_test.js', 'Frontend / backend concurrency'): dict(
+        status='ported', where='tests/test_frontend_concurrency.py'),
+    ('frontend_test.js', 'Frontend / applying patches'): dict(
+        status='ported', where='tests/test_frontend_concurrency.py'),
+
+    ('proxies_test.js', 'Automerge proxy API / root object'): dict(
+        status='ported', where='tests/test_proxies.py'),
+    ('proxies_test.js', 'Automerge proxy API / list object'): dict(
+        status='ported', where='tests/test_proxies.py'),
+    ('proxies_test.js', 'Automerge proxy API / list object / should '
+     'support standard read-only methods'): dict(
+        status='adapted', where='tests/test_proxies.py',
+        note='the 19 JS Array read methods map to the Python '
+        'container protocols (len/iter/slicing/index/count/"in"); '
+        'JS-only surface (toString, entries(), etc.) has no Python '
+        'counterpart and is consciously not emulated'),
+    ('proxies_test.js', 'Automerge proxy API / list object / should '
+     'support standard mutation methods'): dict(
+        status='adapted', where='tests/test_proxies.py',
+        note='push/pop/shift/unshift/splice/fill map to '
+        'append/pop/insert/del/slice-assign; covered as the Python '
+        'list mutation surface'),
+
+    ('connection_test.js', 'Automerge.Connection'): dict(
+        status='ported', where='tests/test_connection.py (message '
+        'DSL: advertise/request/merge/duplicates), '
+        'tests/test_general_sync.py (same adversities over '
+        'general-backed docs)'),
+
+    ('skip_list_test.js', 'SkipList'): dict(
+        status='replaced', where='tests/test_native.py, '
+        'native/seq_index.cpp',
+        note='the reference keeps list order in a probabilistic '
+        'skip list; this framework keeps it in a C++ COW order '
+        'index + the device RGA kernel. The black-box contract '
+        '(indexOf/length/keyOf/get/set/insert/remove/iteration) is '
+        'pinned by differential tests against a shadow list, '
+        'including the property-based random-program suite; the '
+        "reference's 7 'internal structure' cases (level "
+        'distributions, tower shapes) test skip-list internals that '
+        'have no counterpart in a COW array index'),
+
+    ('text_test.js', 'Automerge.Text'): dict(
+        status='ported', where='tests/test_text.py'),
+
+    ('test_uuid.js', 'uuid / default implementation'): dict(
+        status='ported', where='tests/test_watchable_uuid.py'),
+    ('test_uuid.js', 'uuid / custom implementation'): dict(
+        status='ported', where='tests/test_watchable_uuid.py'),
+
+    ('watchable_doc_test.js', 'Automerge.WatchableDoc'): dict(
+        status='ported', where='tests/test_watchable_uuid.py'),
+}
+
+
+def extract(path):
+    src = (REF / path).read_text()
+    stack, cases = [], []
+    for m in re.finditer(
+            r"^(\s*)(describe|it)\((?:'((?:[^'\\]|\\.)*)'"
+            r'|"((?:[^"\\]|\\.)*)")', src, re.M):
+        depth = len(m.group(1)) // 2
+        title = m.group(3) if m.group(3) is not None else m.group(4)
+        stack = stack[:depth]
+        if m.group(2) == 'describe':
+            stack.append(title)
+        else:
+            cases.append((' / '.join(stack), title))
+    return cases
+
+
+def lookup(fname, group, title):
+    g = GROUPS.get((fname, group))
+    if g is None:
+        # longest matching prefix (e.g. the whole SkipList block)
+        best = None
+        for (f, gp), v in GROUPS.items():
+            if f == fname and (group == gp
+                               or group.startswith(gp + ' / ')):
+                if best is None or len(gp) > len(best[0]):
+                    best = (gp, v)
+        if best is None:
+            return None
+        g = best[1]
+    o = g.get('cases', {}).get(title)
+    if o:
+        return o
+    return (g['status'], g['where'], g.get('note', ''))
+
+
+def group_info(fname, group):
+    """The mapping entry for a group — exact key or longest prefix
+    (subgroups inherit their parent block's status/citation)."""
+    g = GROUPS.get((fname, group))
+    if g is not None:
+        return g
+    best = None
+    for (f, gp), v in GROUPS.items():
+        if f == fname and group.startswith(gp + ' / '):
+            if best is None or len(gp) > len(best[0]):
+                best = (gp, v)
+    return best[1] if best else None
+
+
+def main():
+    if not REF.is_dir():
+        sys.exit(f'reference test suite not found at {REF} — point '
+                 f'AUTOMERGE_REFERENCE at the reference checkout')
+    lines = ['# Conformance parity manifest',
+             '',
+             'Every test case in the reference suites '
+             '(`/root/reference/test/*.js`) mapped to this '
+             "repo's tests. Regenerate with "
+             '`python tools/gen_conformance.py`.',
+             '',
+             'Counting note: the reference holds **260** actual '
+             '`it(...)` cases (anchored count). The oft-quoted 410 '
+             'comes from substring-matching `it(` — which also '
+             'matches every call to `init(`.',
+             '',
+             'Statuses: **ported** (direct counterpart) · **covered** '
+             '(behavior pinned by the cited tests) · **adapted** '
+             '(JS idiom carried as its Python equivalent) · '
+             '**replaced** (subsystem redesigned; equivalent contract '
+             'pinned differentially) · **skipped** (consciously not '
+             'carried, reason given).',
+             '']
+    total, unmapped = 0, []
+    tally = {}
+    for fname in FILES:
+        cases = extract(fname)
+        total += len(cases)
+        lines.append(f'## {fname} ({len(cases)} cases)')
+        lines.append('')
+        last_group = None
+        for group, title in cases:
+            res = lookup(fname, group, title)
+            if res is None:
+                unmapped.append((fname, group, title))
+                continue
+            status, where, note = res
+            tally[status] = tally.get(status, 0) + 1
+            if group != last_group:
+                g = group_info(fname, group)
+                lines.append(f'### {group}')
+                if g:
+                    lines.append(f'*{g["status"]}* — {g["where"]}')
+                    if g.get('note'):
+                        lines.append(f'  — {g["note"]}')
+                lines.append('')
+                last_group = group
+            mark = {'ported': 'x', 'covered': 'x', 'adapted': '~',
+                    'replaced': '~', 'skipped': ' '}[status]
+            extra = ''
+            ov = (GROUPS.get((fname, group)) or {}) \
+                .get('cases', {}).get(title)
+            if ov:
+                extra = f' — *{status}*: {ov[1]}' + \
+                    (f' ({ov[2]})' if ov[2] else '')
+            lines.append(f'- [{mark}] {title}{extra}')
+        lines.append('')
+    if unmapped:
+        sys.exit('UNMAPPED cases:\n' + '\n'.join(
+            f'  {f} :: {g} :: {t}' for f, g, t in unmapped))
+    counts = ', '.join(f'{v} {k}' for k, v in sorted(tally.items()))
+    lines.insert(4, f'**{total} cases: {counts}. Zero unmapped.**')
+    lines.insert(5, '')
+    OUT.write_text('\n'.join(lines) + '\n')
+    print(f'wrote {OUT} ({total} cases: {counts})')
+
+
+if __name__ == '__main__':
+    main()
