@@ -907,6 +907,42 @@ def _unpack_bits(u8, n):
     return ((u8[i >> 3] >> (7 - (i & 7))) & 1).astype(bool)
 
 
+# shared staging idioms of the two fused programs (packed + cols) —
+# one definition so the variants stay in lockstep by construction
+
+def _insert_counts(d_pos, cap):
+    """cnt[i] = #new nodes at insert positions <= i, for non-decreasing
+    d_pos (cap-padded): one scatter-max + cummax — a searchsorted here
+    is a 19-round binary-search gather at block scale (~65 ms)."""
+    return jax.lax.cummax(
+        jnp.zeros(cap, jnp.int32).at[d_pos].max(
+            jnp.arange(1, d_pos.shape[0] + 1, dtype=jnp.int32),
+            mode='drop'))
+
+
+def _build_clock(actor, seq, a_pad, coo_row, coo_col, coo_val):
+    """Dense [n, a_pad] closure clock: the own-actor entry is always
+    seq-1 (elementwise — no scatter), cross-actor exceptions overlay
+    from COO."""
+    clock = jnp.where(
+        actor[:, None] == jnp.arange(a_pad, dtype=jnp.int32)[None, :],
+        (seq - 1)[:, None], 0)
+    return clock.at[coo_row, coo_col.astype(jnp.int32)].set(
+        coo_val.astype(jnp.int32), mode='drop')
+
+
+def _vis_grid(row_slot, valid, surviving, k, m_pad):
+    """(touched, vis_hit) planes from the per-row slots with ONE packed
+    scatter: max over {0, 2, 3} of valid<<1|surviving recovers both
+    bits (surviving implies valid)."""
+    flat = jnp.where(row_slot >= 0, row_slot, k * m_pad)
+    packed = (valid.astype(jnp.uint8) << 1) | \
+        surviving.astype(jnp.uint8)
+    grid = jnp.zeros(k * m_pad + 1, jnp.uint8).at[flat].max(
+        packed, mode='drop')[:k * m_pad].reshape(k, m_pad)
+    return grid >= 2, grid == 3
+
+
 @partial(jax.jit, static_argnames=('num_segments', 'a_pad', 'm_pad'))
 def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
                             m_visidx, d_parent, d_elemc, d_actor, d_pos,
@@ -931,13 +967,13 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
     the prior/new visibility+order planes (device-resident for lazy
     patch materialization).
     """
-    from .merge import _resolve
+    from .merge import _resolve_sorted
     from .sequence import _rga_order_batched
     cap = m_parent.shape[0]
 
     # ---- fold the new nodes in (pos-order preserving insert) ----
     i = jnp.arange(cap, dtype=jnp.int32)
-    cnt = jnp.searchsorted(d_pos, i, side='right').astype(jnp.int32)
+    cnt = _insert_counts(d_pos, cap)
     tgt_old = jnp.where(i < n_old, i + cnt, cap)
     tgt_new = d_pos + jnp.arange(d_pos.shape[0], dtype=jnp.int32)
 
@@ -964,30 +1000,23 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
     prior_vis = jnp.take(visible_p, pos_c) & valid_plane
     prior_idx = jnp.where(valid_plane, jnp.take(visidx_p, pos_c), -1)
 
-    # ---- field resolution ----
+    # ---- field resolution (scan-based; rows arrive field-sorted) ----
     n = ops_slot.shape[0]
     nb = n >> 3
     boundary = _unpack_bits(flags_u8[:nb], n)
     is_del = _unpack_bits(flags_u8[nb:], n)
     valid = jnp.arange(n) < n_rows
-    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     actor = ops_actor.astype(jnp.int32)
     seq = ops_seq.astype(jnp.int32)
-    clock = jnp.zeros((n, a_pad), jnp.int32)
-    clock = clock.at[jnp.arange(n), actor].set(seq - 1)
-    clock = clock.at[coo_row, coo_col.astype(jnp.int32)].set(
-        coo_val.astype(jnp.int32), mode='drop')
-    out = _resolve(seg_id, actor, seq, clock, is_del, valid, num_segments)
+    clock = _build_clock(actor, seq, a_pad, coo_row, coo_col, coo_val)
+    out = _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
+                          num_segments)
 
     # ---- element visibility + RGA ordering ----
     k = job_start.shape[0]
-    flat = jnp.where(ops_slot >= 0, ops_slot, k * m_pad)
-    vis_hit = jnp.zeros(k * m_pad, bool).at[flat].max(
-        out['surviving'], mode='drop')
-    touched = jnp.zeros(k * m_pad, bool).at[flat].max(valid, mode='drop')
-    visible = jnp.where(touched.reshape(k, m_pad),
-                        vis_hit.reshape(k, m_pad), prior_vis)
-    visible = visible & valid_plane
+    touched, vis_hit = _vis_grid(ops_slot, valid, out['surviving'],
+                                 k, m_pad)
+    visible = jnp.where(touched, vis_hit, prior_vis) & valid_plane
     ordered = _rga_order_batched(s_parent, s_elem, s_rank, visible,
                                  valid_plane)
 
@@ -1023,7 +1052,7 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
 #   W2 = visible << 30 | (vis_index+1) << 15 | elemc
 #
 # Guards (host checks; the unpacked `_fused_general_resident` is the
-# fallback and the semantic reference): tree size <= 32767 nodes,
+# fallback for wider shapes): tree size <= 32767 nodes,
 # elemc < 32768, actor count < 65535, seq < 32768, coo seq < 32768.
 
 _W2_ELEM = 0x7FFF
@@ -1121,15 +1150,10 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
             .astype(jnp.int32)
 
     # ---- fold the new nodes into the pos-ordered mirror ----
-    # cnt(i) = #new nodes at positions <= i. d_pos is sorted, so this
-    # is one scatter-max + cummax instead of a searchsorted (a 19-round
-    # binary-search gather at block scale, ~65 ms measured vs ~5).
     tgt_new = d_pos + jnp.arange(d_pad, dtype=jnp.int32)
     if has_old:
         i = jnp.arange(cap, dtype=jnp.int32)
-        cnt = jax.lax.cummax(
-            jnp.zeros(cap, jnp.int32).at[d_pos].max(
-                jnp.arange(1, d_pad + 1, dtype=jnp.int32), mode='drop'))
+        cnt = _insert_counts(d_pos, cap)
         tgt_old = jnp.where(i < n_old, i + cnt, cap)
 
         def fold(col, dcol):
@@ -1163,22 +1187,13 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
     boundary = _unpack_bits(flags_u8[:nb], n_pad)
     is_del = _unpack_bits(flags_u8[nb:], n_pad)
     valid = jnp.arange(n_pad) < n_rows
-    clock = jnp.where(
-        actor[:, None] == jnp.arange(a_pad, dtype=jnp.int32)[None, :],
-        (seq - 1)[:, None], 0)
-    clock = clock.at[coo_row, coo_col].set(coo_val, mode='drop')
+    clock = _build_clock(actor, seq, a_pad, coo_row, coo_col, coo_val)
     out = _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
                           num_segments)
 
-    # ---- element visibility: ONE packed scatter (valid<<1|surviving:
-    # max over {0,2,3} recovers both bits) ----
-    flat = jnp.where(row_slot >= 0, row_slot, K * m_pad)
-    packed = (valid.astype(jnp.uint8) << 1) | \
-        out['surviving'].astype(jnp.uint8)
-    grid = jnp.zeros(K * m_pad + 1, jnp.uint8).at[flat].max(
-        packed, mode='drop')[:K * m_pad].reshape(K, m_pad)
-    touched = grid >= 2
-    vis_hit = grid == 3
+    # ---- element visibility ----
+    touched, vis_hit = _vis_grid(row_slot, valid, out['surviving'],
+                                 K, m_pad)
     visible = jnp.where(touched, vis_hit, prior_vis) & valid_plane
 
     ordered = _rga_order_batched(s_parent, s_elem, s_rank, visible,
@@ -2071,10 +2086,14 @@ def _apply_general(store, block, options, return_timing):
     n_total = pool.n_nodes
     n_act = len(store.actors)
 
-    # variant pick: the packed program (2-word mirror, one wire buffer,
-    # scan resolve — the block-scale fast path) wherever its bit-field
-    # guards hold; `_fused_general_resident` is the fallback and the
-    # semantic reference (huge single trees, wide actor sets)
+    # variant pick: the packed program (2-word mirror, one wire buffer)
+    # wherever its bit-field guards hold; `_fused_general_resident` is
+    # the fallback (huge single trees, wide actor sets). Both share the
+    # staging idioms (_insert_counts/_build_clock/_vis_grid and the
+    # scan resolve) — the cross-check for those is the host oracle and
+    # the sharded-step equality gates, while the fallback remains the
+    # independent check of the packed mirror FORMAT (bit fields, wire
+    # layout, dtype narrowing).
     use_packed = (pool.max_tree <= 0x7FFF
                   and pool.max_elem < (1 << 15)
                   and n_act < 65535

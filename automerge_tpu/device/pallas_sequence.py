@@ -29,6 +29,21 @@ anchored to the head's distance) — vis_index/length are bit-identical to
 Layout: node axis padded to a multiple of 128 lanes (m <= 512 is the
 intended regime, matching the MXU variant's dispatch bound); jobs ride
 the grid in blocks of 8.
+
+Why the one-hot schedules STOP at m ~= 512 (measured, r5): every
+doubling round must materialize a [*, m, m] one-hot — O(m^2) VPU
+compares — before the MXU sees it. On a v5e, ONE such build at
+[64, 4096] costs ~45 ms while the ENTIRE gather-variant pipeline
+(26 rounds, all phases) runs in ~123 ms; at [8, 16384] one build is
+~31 ms vs ~66 ms for the whole gather pipeline. Any one-hot schedule
+— Pallas-tiled or XLA — is therefore >= ~10x WORSE than the gather
+variant for m >= ~2048, and the crossover sits near the MXU
+variant's m <= 512 bound. Scalar in-kernel pointer chasing is no
+rescue either: ~m * 2log2(m) dependent scalar loads put a 180k-node
+tree at best near the gather variant's time, with none of its
+batching. The 3-way dispatch in `sequence._rga_order_batched` (and
+the A/B the bench captures) encodes exactly this measured boundary;
+large single trees ride the gather variant by design, not omission.
 """
 
 from functools import partial

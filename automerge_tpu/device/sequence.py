@@ -239,7 +239,14 @@ def _rga_order_batched(parent, elem, actor, visible, valid):
     """Batched RGA over [K, m] job planes: MXU one-hot doubling when the
     one-hot plane is small enough to be cheap traffic, vmapped gather
     doubling otherwise. Shapes are static under jit, so the pick is a
-    plain Python branch; both variants are integer-exact equal."""
+    plain Python branch; both variants are integer-exact equal.
+
+    The m <= 512 bound is the MEASURED crossover, not a limitation:
+    every one-hot round costs O(m^2) VPU compares, and past m ~= 2048
+    a single one-hot build exceeds a third of the whole gather
+    pipeline (see pallas_sequence module docstring for the numbers).
+    Large single trees (long text documents) are gather-scheduled by
+    design."""
     K, m = parent.shape
     if m <= 512 and K * m * m <= (1 << 28):
         return _rga_order_mxu(parent, elem, actor, visible, valid)
