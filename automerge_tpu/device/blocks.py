@@ -172,6 +172,20 @@ class ValueTable:
         seg = _bisect.bisect_right(self._offsets, i) - 1
         return self._segs[seg][i - self._offsets[seg]]
 
+    def take(self, idx):
+        """Values at `idx` (int array; -1 -> None) as a list — ONE
+        vectorized segment search instead of a bisect per item (the
+        diff-emission hot path reads tens of thousands per patch)."""
+        idx = np.asarray(idx, np.int64)
+        offs = np.asarray(self._offsets, np.int64)
+        segs = np.searchsorted(offs, np.maximum(idx, 0),
+                               side='right') - 1
+        within = np.maximum(idx, 0) - offs[segs]
+        stabs = self._segs
+        return [None if i < 0 else stabs[s][w]
+                for i, s, w in zip(idx.tolist(), segs.tolist(),
+                                   within.tolist())]
+
     def __iter__(self):
         for seg in self._segs:
             yield from seg

@@ -1416,6 +1416,15 @@ class GeneralPatch:
                     'node_elemc': pool.elemc[rows],
                 }
 
+    def _plain_mask(self, fis):
+        """Fields whose payload is a bare value (no link flag, no
+        conflict entries) — the ONE definition of what the vectorized
+        emit fast path may skip; `_field_payload` is its per-field
+        counterpart and any new payload-shaping field flag must join
+        this mask."""
+        return ~(self.f_link[fis]
+                 | (self.s_ptr[fis + 1] > self.s_ptr[fis]))
+
     def _field_payload(self, fi):
         """(value, link, conflicts) of field fi from the patch columns."""
         value = self.values[self.f_value[fi]] if self.f_value[fi] >= 0 \
@@ -1513,31 +1522,36 @@ class GeneralPatch:
                           'path': path})
         field_at = ed['field_at']
         node_actor, node_elemc = ed['node_actor'], ed['node_elemc']
-        for node, idx in zip(ed['ins_nodes'].tolist(),
-                             ed['ins_idx'].tolist()):
-            value, link, conflicts = self._field_payload(
-                int(field_at[node]))
-            edit = {'action': 'insert', 'type': tname, 'obj': obj_uuid,
-                    'index': int(idx),
-                    'elemId': (f'{store.actors[node_actor[node]]}:'
-                               f'{int(node_elemc[node])}'),
-                    'value': value, 'path': path}
-            if link:
-                edit['link'] = True
-            if conflicts:
-                edit['conflicts'] = conflicts
-            diffs.append(edit)
-        for node, idx in zip(ed['set_nodes'].tolist(),
-                             ed['set_idx'].tolist()):
-            value, link, conflicts = self._field_payload(
-                int(field_at[node]))
-            edit = {'action': 'set', 'type': tname, 'obj': obj_uuid,
-                    'index': int(idx), 'value': value, 'path': path}
-            if link:
-                edit['link'] = True
-            if conflicts:
-                edit['conflicts'] = conflicts
-            diffs.append(edit)
+        actors = store.actors
+
+        def emit(nodes, idxs, action, with_elem_id):
+            """Edits for one node batch: winner values fetched with ONE
+            vectorized ValueTable pass; the rare link/conflict rows
+            fall back to the per-field payload."""
+            fis = field_at[nodes]
+            vals = self.values.take(self.f_value[fis])
+            plain = self._plain_mask(fis)
+            for k, (node, idx) in enumerate(zip(nodes.tolist(),
+                                                idxs.tolist())):
+                if plain[k]:
+                    value, link, conflicts = vals[k], False, None
+                else:
+                    value, link, conflicts = self._field_payload(
+                        int(fis[k]))
+                edit = {'action': action, 'type': tname,
+                        'obj': obj_uuid, 'index': int(idx),
+                        'value': value, 'path': path}
+                if with_elem_id:
+                    edit['elemId'] = (f'{actors[node_actor[node]]}:'
+                                      f'{int(node_elemc[node])}')
+                if link:
+                    edit['link'] = True
+                if conflicts:
+                    edit['conflicts'] = conflicts
+                diffs.append(edit)
+
+        emit(ed['ins_nodes'], ed['ins_idx'], 'insert', True)
+        emit(ed['set_nodes'], ed['set_idx'], 'set', False)
         return diffs
 
     def clock_of(self, d):
