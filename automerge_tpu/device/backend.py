@@ -768,9 +768,20 @@ def apply_changes_batch(states, changes_per_doc, kernel=None, options=None):
       oracle's per-op diff stream: applying either stream to a frontend
       yields the same doc).
     """
+    from . import general_backend as _gb
     opts = _engine.as_options(options, kernel)
     works = []
-    for state, changes in zip(states, changes_per_doc):
+    for i, (state, changes) in enumerate(zip(states, changes_per_doc)):
+        if isinstance(state, _gb.GeneralBackendState):
+            # a bulk-auto-routed document's token is served by the
+            # general engine, not the per-doc staging loop — routing it
+            # here would die deep inside _stage_changes with an opaque
+            # AttributeError (r5 review: the auto-routing type leak)
+            raise TypeError(
+                f'states[{i}] is a GeneralBackendState (bulk-routed '
+                f'document); apply through apply_changes / '
+                f'general_backend.apply_changes, not '
+                f'apply_changes_batch')
         state = state.clone()
         admitted = _admit_changes(state, changes)
         work = _DocWork(state)
@@ -841,14 +852,23 @@ def apply_changes(state, changes, kernel=None, options=None):
     from . import general_backend as _gb
     opts = _engine.as_options(options, kernel)
     if isinstance(state, _gb.GeneralBackendState):
-        return _gb.apply_changes(state, changes, options=opts)
+        new_state, patch = _gb.apply_changes(state, changes,
+                                             options=opts)
+        patch['diffs'] = list(patch['diffs'])    # facade: plain list
+        return new_state, patch
     thr = opts.bulk_route_min_ops
     if thr is not None and not state.clock and not state.queue \
             and state.undo_pos == 0 and not state.redo_stack:
         changes = list(changes)      # sizing must not consume iterators
         n_ops = sum(len(c.get('ops', ())) for c in changes)
         if n_ops >= thr:
-            return _gb.apply_changes(_gb.init(), changes, options=opts)
+            new_state, patch = _gb.apply_changes(_gb.init(), changes,
+                                                 options=opts)
+            # the public facade promises a PLAIN diff list —
+            # json.dumps(patch) and `diffs + [...]` must work on an
+            # auto-routed result exactly as on the per-doc path
+            patch['diffs'] = list(patch['diffs'])
+            return new_state, patch
     new_states, patches = apply_changes_batch([state], [changes],
                                               kernel=kernel, options=options)
     return new_states[0], patches[0]

@@ -52,6 +52,8 @@ Undo/redo and local-change requests stay per-document
 reference (src/doc_set.js:25-33), at block scale.
 """
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -109,9 +111,13 @@ class _SeqPool:
     __slots__ = ('obj', 'local', 'parent', 'actor', 'elemc', 'visible',
                  'vis_index', 'pos_sorted', 'pos_row', 'n_of',
                  'max_elem_of', 'max_tree', 'max_elem', 'mirror',
-                 '_epoch', '_host_epoch')
+                 '_epoch', '_host_epoch', '_lock')
 
     def __init__(self):
+        # host lock shared with the owning store: serializes the apply
+        # host phase, the deferred commit and this sync against patch
+        # extraction running on another thread (apply_general_block_async)
+        self._lock = threading.RLock()
         z32 = np.zeros(0, np.int32)
         self.obj = z32
         self.local = z32
@@ -218,22 +224,24 @@ class _SeqPool:
         Nodes appended since the mirror's last apply keep their
         initial (hidden) host state — the mirror rows cover exactly
         the first ``mirror['n']`` positions."""
-        if self._host_epoch == self._epoch or self.mirror is None:
-            return
-        self._host_epoch = self._epoch
-        n = self.mirror['n']
-        if self.mirror.get('fmt') == 'packed':
-            # ONE 4B/node fetch; the vis word host-unpacks for free
-            w2 = np.asarray(jax.device_get(self.mirror['w2'][:n]))
-            vis, idx = unpack_w2_word(w2)
-        else:
-            vis, idx = jax.device_get((self.mirror['visible'][:n],
-                                       self.mirror['vis_index'][:n]))
-        # the mirror's OWN pos_row snapshot: appends since the apply
-        # (e.g. single obj_row creates) must not shift the mapping
-        rows = self.mirror['pos_row'][:n]
-        self.visible[rows] = np.asarray(vis)
-        self.vis_index[rows] = np.asarray(idx)
+        with self._lock:
+            if self._host_epoch == self._epoch or self.mirror is None:
+                return
+            self._host_epoch = self._epoch
+            n = self.mirror['n']
+            if self.mirror.get('fmt') == 'packed':
+                # ONE 4B/node fetch; the vis word host-unpacks for free
+                w2 = np.asarray(jax.device_get(self.mirror['w2'][:n]))
+                vis, idx = unpack_w2_word(w2)
+            else:
+                vis, idx = jax.device_get(
+                    (self.mirror['visible'][:n],
+                     self.mirror['vis_index'][:n]))
+            # the mirror's OWN pos_row snapshot: appends since the apply
+            # (e.g. single obj_row creates) must not shift the mapping
+            rows = self.mirror['pos_row'][:n]
+            self.visible[rows] = np.asarray(vis)
+            self.vis_index[rows] = np.asarray(idx)
 
 
 def _exact_lookup(t_obj, t_key, t_val, q_obj, q_key, n_objs):
@@ -391,6 +399,7 @@ class GeneralStore(BlockStore):
         self.obj_type = []
         self.obj_inbound = {}                    # row -> [(parent_row, key)]
         self.pool = _SeqPool()                   # all insertion trees
+        self._host_lock = self.pool._lock        # one lock, store-wide
         self._root_row = np.full(n_docs, -1, np.int64)
         self._obj_arr_cache = (0, None, None)
         # deferred survivor commit of the LAST apply: the entry update
@@ -405,6 +414,10 @@ class GeneralStore(BlockStore):
         update into the store (idempotent; replayable after rollback).
         ``_surv_u8`` lets a reader that already fetched the survivor
         bytes (batched into its own round trip) pass them in."""
+        with self._host_lock:
+            return self._commit_pending_locked(_surv_u8)
+
+    def _commit_pending_locked(self, _surv_u8=None):
         pc = self._pending_commit
         if pc is None:
             return
@@ -570,9 +583,19 @@ class GeneralStore(BlockStore):
         cap = opts.pad_nodes(max(n, 8))
         rows = pool.pos_row.astype(np.int64)
         n_act = len(self.actors)
-        use_packed = (pool.max_tree <= 0x7FFF
-                      and pool.max_elem < (1 << 15)
-                      and n_act < 65535)
+        # per-doc actor-slot width from the clock rows (sorted by doc):
+        # the apply-time pick packs actor slots into uint8, so a store
+        # whose widest document exceeds 256 actors must start on the
+        # cols format instead of building a packed mirror the first
+        # apply immediately downgrades
+        if len(self.c_doc):
+            starts = np.searchsorted(self.c_doc,
+                                     np.arange(self.n_docs + 1))
+            a_width = int(np.diff(starts).max())
+        else:
+            a_width = 1
+        use_packed = _packed_mirror_guard(
+            pool, n_act, opts.pad_actors(max(a_width, 1)))
         if use_packed:
             ranks = np.asarray(self.actor_str_ranks())
             actor = pool.actor[rows]
@@ -1084,6 +1107,25 @@ def unpack_w2_word(w2):
 # gates consume this instead of monkeypatching a program symbol
 _STAGE_CAPTURE = None
 
+# native-staging switch: None = auto (use the C++ stager when the
+# library loads and the block is fully admitted), False = numpy only,
+# True = REQUIRE native (tests: fail loudly instead of silently
+# falling back)
+_NATIVE_STAGING = None
+
+
+def _packed_mirror_guard(pool, n_act, a_pad=None):
+    """The packed 2-word mirror format's bit-field bounds — ONE
+    definition shared by the apply-time variant pick and the resume-
+    time `_materialize_mirror`, so a store the apply path would
+    immediately downgrade (e.g. >256 per-doc actors) never builds a
+    packed mirror it cannot keep. ``a_pad`` is the padded per-doc
+    actor-slot width when known (must fit the uint8 staging dtype)."""
+    return (pool.max_tree <= 0x7FFF
+            and pool.max_elem < (1 << 15)
+            and n_act < 65535
+            and (a_pad is None or a_pad <= 256))
+
 
 def _wire_sizes(d_pad, n_pad, K, nnz_pad):
     """Total byte count of the single staged wire buffer. Section
@@ -1322,19 +1364,34 @@ class GeneralPatch:
         # ONE device_get for everything this read needs — each fetch
         # pays a full link round trip (~100 ms floor on the tunnel).
         # When the pending commit is THIS apply's, its survivor bytes
-        # join the same trip.
-        pc = store._pending_commit
-        own_pc = pc is not None and pc.get('patch') is self
+        # join the same trip. The fetch itself runs OUTSIDE the host
+        # lock (device handles are immutable) so an async apply keeps
+        # staging while this thread waits on the link; only the commit
+        # and the pool-ref capture lock, briefly.
+        with store._host_lock:
+            pc = store._pending_commit
+            own_pc = pc is not None and pc.get('patch') is self
+            surv_dev = pc['surv_u8_dev'] if own_pc else None
         fetch = [raw['winner_dev']]
         if raw['vis_planes'] is not None:
             fetch.append(raw['vis_planes'])
         if own_pc:
-            fetch.append(pc['surv_u8_dev'])
+            fetch.append(surv_dev)
         fetched = jax.device_get(tuple(fetch))
         w_row = np.asarray(fetched[0])[:F]
         fetched_planes = fetched[1] if raw['vis_planes'] is not None \
             else None
-        store._commit_pending(_surv_u8=fetched[-1] if own_pc else None)
+        if own_pc:
+            with store._host_lock:
+                # re-check under the lock: an async apply may have
+                # committed OUR pending while we waited on the fetch and
+                # installed ITS OWN — feeding it our survivor bytes
+                # would fold the wrong mask into the entry columns
+                if store._pending_commit is pc:
+                    store._commit_pending_locked(_surv_u8=fetched[-1])
+        # else: this patch's commit already ran — the pending apply (if
+        # any) is a LATER one and committing it here would block on ITS
+        # device program for no benefit
         surviving = raw['surviving']
         cat, rorder = raw['cat'], raw['order']
         r_value = cat['value'][rorder]
@@ -1370,13 +1427,15 @@ class GeneralPatch:
         planes = fetched_planes
         if planes is not None:
             pool = store.pool
+            with store._host_lock:
+                pool_actor, pool_elemc = pool.actor, pool.elemc
             if raw.get('vis_fmt') == 'packed':
                 pv, nv, pi, ni = unpack_vis_word(
                     np.asarray(planes).view(np.uint32))
             else:
                 pv, nv, pi, ni = [np.asarray(x) for x in planes]
             dirty, n_j = raw['dirty'], raw['dirty_n']
-            rows_flat = raw['rows_flat']
+            rows_flat = raw['rows_flat']()
             row_start = np.zeros(len(dirty) + 1, np.int64)
             np.cumsum(n_j, out=row_start[1:])
             gained = raw['gained_max_elem']
@@ -1412,8 +1471,8 @@ class GeneralPatch:
                     'ins_nodes': ins_nodes, 'ins_idx': new_idx[ins_nodes],
                     'set_nodes': set_nodes, 'set_idx': new_idx[set_nodes],
                     'field_at': field_at,
-                    'node_actor': pool.actor[rows],
-                    'node_elemc': pool.elemc[rows],
+                    'node_actor': pool_actor[rows],
+                    'node_elemc': pool_elemc[rows],
                 }
 
     def _plain_mask(self, fis):
@@ -1451,6 +1510,10 @@ class GeneralPatch:
         pool = store.pool
         path = []
         seen = set()
+        with store._host_lock:
+            return self._path_locked(store, pool, obj_row, path, seen)
+
+    def _path_locked(self, store, pool, obj_row, path, seen):
         while store.obj_uuid[obj_row] != ROOT_ID:
             if obj_row in seen:
                 return None
@@ -1574,13 +1637,133 @@ def apply_general_block(store, block, options=None, return_timing=False):
     resolves every touched field and re-orders every dirty sequence of
     every document in the batch. Mutates `store`; returns a
     :class:`GeneralPatch`. On a validation error the store rolls back to
-    its pre-apply state (clock, log, queue, tables, trees)."""
-    txn = _Txn(store)
-    try:
-        return _apply_general(store, block, options, return_timing)
-    except (ValueError, TypeError):
-        txn.rollback(store)
-        raise
+    its pre-apply state (clock, log, queue, tables, trees).
+
+    The whole host phase runs under the store's host lock, so patch
+    extraction of an EARLIER apply may proceed on another thread
+    (:func:`apply_general_block_async`) while this one stages."""
+    with store._host_lock:
+        txn = _Txn(store)
+        try:
+            return _apply_general(store, block, options, return_timing)
+        except BaseException:
+            # validation errors (ValueError/TypeError) AND unexpected
+            # failures (a MemoryError in the native stager, the forced
+            # _NATIVE_STAGING=True RuntimeError) can fire after
+            # admission/object creation mutated the store — the
+            # store-intact-on-error contract holds for all of them
+            txn.rollback(store)
+            raise
+
+
+class AsyncGeneralPatch:
+    """Future over an applier-thread apply: resolves to the real
+    :class:`GeneralPatch` (or re-raises the apply's error — the store
+    itself rolled back and stays usable). Read methods proxy through
+    :meth:`result`."""
+
+    __slots__ = ('_event', '_patch', '_error')
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._patch = None
+        self._error = None
+
+    def result(self):
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+        return self._patch
+
+    def block_until_ready(self):
+        return self.result().block_until_ready()
+
+    def diffs(self, d):
+        return self.result().diffs(d)
+
+    def patch(self, d):
+        return self.result().patch(d)
+
+    def to_patches(self):
+        return self.result().to_patches()
+
+
+def apply_general_block_async(store, block, options=None):
+    """Apply on the store's applier thread: the caller overlaps patch
+    EXTRACTION of earlier applies (diff materialization is the
+    remaining host cost once staging went native) with the staging +
+    dispatch of this block — the chip never idles behind a host that is
+    busy reading patches.
+
+    Returns an :class:`AsyncGeneralPatch`. Successive async applies are
+    serialized by the applier queue; a failed apply rolls the store
+    back (same contract as the sync path) and surfaces its error on the
+    future. Synchronous `apply_general_block` calls interleave safely
+    (the host lock serializes store mutation) but their ordering
+    relative to queued async applies is the queue's; drain first
+    (:func:`drain_general`) when order matters. Whole-store readers
+    (materialize, snapshots) should also drain first."""
+    import queue
+    out = AsyncGeneralPatch()
+    with store._host_lock:       # two first-callers must not both init
+        if getattr(store, '_applier', None) is None:
+            jobs = store._jobs = queue.Queue()
+
+            def run(jobs=jobs):
+                # closes over the QUEUE, not the store: a dropped store
+                # is collectable even while its idle applier lingers
+                while True:
+                    j = jobs.get()
+                    if j is None:
+                        return
+                    j()
+
+            store._applier = threading.Thread(target=run, daemon=True)
+            store._applier.start()
+
+    def job():
+        try:
+            out._patch = apply_general_block(store, block, options)
+        except BaseException as e:     # surfaced on result()
+            out._error = e
+        finally:
+            out._event.set()
+
+    with store._host_lock:
+        if getattr(store, '_jobs', None) is None:
+            # a concurrent close_general stopped the applier between
+            # our init check and this put: restart it
+            return apply_general_block_async(store, block, options)
+        store._jobs.put(job)
+        store._last_async = out
+    return out
+
+
+def drain_general(store):
+    """Wait for every queued async apply (queue order; waiting on the
+    last suffices). Does NOT raise for failed applies — each failure
+    belongs to its own future, and the store rolled back past it.
+    Safe to call from several threads: everyone waits; the record
+    clears only after the wait completes."""
+    p = getattr(store, '_last_async', None)
+    if p is not None:
+        p._event.wait()
+        if getattr(store, '_last_async', None) is p:
+            store._last_async = None
+
+
+def close_general(store):
+    """Drain and stop the store's applier thread. The store remains
+    fully usable synchronously; a later async apply restarts it."""
+    drain_general(store)
+    with store._host_lock:
+        applier = getattr(store, '_applier', None)
+        if applier is None:
+            return
+        store._jobs.put(None)
+        store._applier = None
+        store._jobs = None
+    applier.join()
 
 
 def _apply_general(store, block, options, return_timing):
@@ -1664,39 +1847,606 @@ def _apply_general(store, block, options, return_timing):
 
     # block obj table -> store rows. Non-root uuids are globally unique,
     # so the block obj index determines the row; ROOT is per document.
-    uniq_bo, first_idx = np.unique(o_obj_blk, return_index=True)
+    # First-use doc per table entry comes from one reversed scatter
+    # (last write wins = first occurrence) instead of a million-row
+    # np.unique sort.
+    first_doc = np.full(len(block.objs), -1, np.int64)
+    if len(o_obj_blk):
+        first_doc[o_obj_blk[::-1]] = o_doc[::-1]
     omap = np.full(len(block.objs), -1, np.int64)
     get_row = store.obj_of.get
     objs_list = block.objs
-    for bo, fj in zip(uniq_bo.tolist(), first_idx.tolist()):
-        if bo == 0:
-            continue                     # encoder pins ROOT at objs[0]
-        r = get_row((int(o_doc[fj]), objs_list[bo]))
+    for bo in range(1, len(objs_list)):
+        if first_doc[bo] < 0:
+            continue                     # unreferenced table entry
+        r = get_row((int(first_doc[bo]), objs_list[bo]))
         if r is None:
             raise ValueError('Modification of unknown object '
                              + objs_list[bo])
         omap[bo] = r
-    o_objrow = np.where(root_ops, store._root_row[o_doc],
-                        omap[o_obj_blk])
-    # cross-document object reuse is malformed input, not a crash
     obj_doc_arr, obj_type_arr = store.obj_arrays()
-    if not (obj_doc_arr[o_objrow] == o_doc).all():
-        bad = int(np.flatnonzero(obj_doc_arr[o_objrow] != o_doc)[0])
-        raise ValueError('Modification of unknown object '
-                         + block.objs[int(o_obj_blk[bad])])
 
-    # ---- ins ops: batch-grow the pooled insertion trees ----
-    ins_mask = o_act == _INS
-    assign_mask = (o_act == _SET) | (o_act == _DEL) | (o_act == _LINK)
-    ins_rows = np.flatnonzero(ins_mask)
-    o_node = np.full(len(o_act), -1, np.int64)   # local node of each op
-    ins_objs = np.zeros(0, np.int64)
-
-    a_rows = np.flatnonzero(assign_mask)
+    # ---- op partition; make-only batches finish here ----
+    ins_rows = np.flatnonzero(o_act == _INS)
+    a_rows = np.flatnonzero((o_act == _SET) | (o_act == _DEL)
+                            | (o_act == _LINK))
     if len(a_rows) == 0 and not len(ins_rows):
         # make-only batch
         _finish_empty(patch)
         return (patch, {'admit': t1 - t0}) if return_timing else patch
+
+    la = st.la
+    # per-CHANGE local actor slots (C << n ops); the native stager and
+    # the clock-exception builder both gather from this
+    chg_local = la.local_of(block.doc, st.b_actor) \
+        if block.n_changes else np.zeros(0, np.int32)
+
+    # ---- op resolution: the native stager computes the ins grouping,
+    # node minting, elemId resolution (peepholes + duplicate check),
+    # packed field keys and the STABLE field sort in one C++ pass for
+    # fully-admitted blocks; `_resolve_ops_numpy` is the byte-identical
+    # fallback (no native library, queued/dropped changes at admission,
+    # late-bound string elemIds) ----
+    ns = None
+    if _NATIVE_STAGING is not False and st.keep.all() and block.n_ops:
+        from .. import native as _amnative
+        ns = _amnative.stage_general_block(
+            block, chg_local, st.a_tab, st.k_tab, omap,
+            store._root_row, obj_doc_arr, obj_type_arr, pool,
+            st.b_actor,
+            pool.mirror['n'] if pool.mirror is not None else 0,
+            obj_uuid=store.obj_uuid)
+    if _NATIVE_STAGING is True and ns is None:
+        raise RuntimeError('native staging required but unavailable')
+    if ns is not None:
+        a_rows = ns.a_rows
+        f_new = ns.o_field
+        a_node = ns.a_node
+        a_objr = ns.a_objrow
+        dirty = ns.dirty
+        ins_objs = ns.dirty[ns.new_cnt > 0]
+        if ns.n_ins:
+            pool.append_batch(ns.g_obj, ns.g_local, ns.g_parent,
+                              ns.g_actor, ns.g_elem)
+    else:
+        f_new, a_node, a_objr, dirty, ins_objs = _resolve_ops_numpy(
+            store, block, st, omap, root_ops, obj_doc_arr,
+            obj_type_arr, o_act, o_doc, o_obj_blk, o_kind, o_key_raw,
+            o_key_elem, o_elem, ins_rows, a_rows)
+
+    # ---- deferred-commit point: everything ABOVE here is independent
+    # of the entry columns, so it ran while the PREVIOUS apply's device
+    # program was still in flight; now fold that apply in (the wait, if
+    # any, is the PREVIOUS device program still running — metered
+    # separately from this block's staging time)
+    tc0 = time.perf_counter()
+    store._commit_pending()
+    tc1 = time.perf_counter()
+
+    # ---- touched fields + prior entries ----
+    # one stable int64 field sort serves BOTH the unique-field
+    # derivation and the field-sorted row order; the native stager
+    # already ran it (radix) — numpy recomputes it otherwise
+    if ns is not None:
+        touched_fields = ns.touched
+        seg_new = ns.seg_new
+        order_new = ns.order
+        r_seg_new = ns.r_seg
+    else:
+        order_new = np.argsort(f_new, kind='stable')
+        f_sorted = f_new[order_new]
+        n_new0 = len(f_sorted)
+        bnd_new = np.empty(n_new0, bool)
+        if n_new0:
+            bnd_new[0] = True
+            bnd_new[1:] = f_sorted[1:] != f_sorted[:-1]
+        touched_fields = f_sorted[bnd_new]
+        seg_sorted_new = np.cumsum(bnd_new) - 1
+        seg_new = np.empty(n_new0, np.int64)
+        seg_new[order_new] = seg_sorted_new
+        r_seg_new = seg_sorted_new.astype(np.int32)
+    # packed (obj << 32 | key) per store entry, cached per entry-table
+    # identity (the columns are replaced at commit, never mutated)
+    cache = getattr(store, '_e_field_cache', None)
+    if cache is not None and cache[0] is store.e_obj:
+        e_field = cache[1]
+    else:
+        e_field = (store.e_obj.astype(np.int64) << 32) | store.e_key
+        store._e_field_cache = (store.e_obj, e_field)
+    if len(e_field):
+        pos = np.minimum(np.searchsorted(touched_fields, e_field),
+                         max(len(touched_fields) - 1, 0))
+        prior_mask = (touched_fields[pos] == e_field) \
+            if len(touched_fields) else np.zeros(len(e_field), bool)
+        prior_rows = np.flatnonzero(prior_mask)
+        seg_prior = pos[prior_rows]
+    else:
+        prior_mask = np.zeros(0, bool)
+        prior_rows = np.zeros(0, np.int64)
+        seg_prior = np.zeros(0, np.int64)
+    F = len(touched_fields)
+    S = opts.pad_segments(max(F, 1))
+
+    n_new, n_prior = len(a_rows), len(prior_rows)
+    n_rows = n_new + n_prior
+    n_pad = opts.pad_ops(max(n_rows, 8))    # >= 8: masks ride bit-packed
+    A = opts.pad_actors(max(la.width, 1))
+
+    # canonical row order: FIELD-SORTED (segment-grouped) — the seg ids
+    # then ship as one boundary BIT per row, and every r_* column below
+    # (and the kernel's winner row ids) lives in these coordinates.
+    # With no prior rows the field sort IS the order.
+    p_doc = store.e_doc[prior_rows]
+    if n_prior:
+        seg_cat = np.concatenate([seg_new, seg_prior]).astype(np.int32)
+        order = np.argsort(seg_cat, kind='stable')
+        r_seg = seg_cat[order]
+    else:
+        order = order_new
+        r_seg = r_seg_new
+    inv_order = np.empty(n_rows, np.int64)
+    inv_order[order] = np.arange(n_rows)
+    prior_local = la.local_of(p_doc, store.e_actor[prior_rows]) \
+        if n_prior else np.zeros(0, np.int32)
+
+    # staged row columns: when the native stager wrote the wire buffer
+    # (no prior rows, packed program) these never materialize on host —
+    # build the numpy forms only for the fallback plane paths
+    native_rows = ns is not None and n_prior == 0
+    if native_rows:
+        local_cat = seq_cat_store = isdel_cat = None
+        max_seq = ns.max_seq if n_rows else 0
+    else:
+        local_cat = np.concatenate([chg_local[oc[a_rows]],
+                                    prior_local]) \
+            if n_prior else chg_local[oc[a_rows]]
+        seq_cat_store = np.concatenate(
+            [st.o_seq[a_rows], store.e_seq[prior_rows]]) if n_prior \
+            else st.o_seq[a_rows]
+        isdel_cat = np.concatenate(
+            [o_act[a_rows] == _DEL, np.zeros(n_prior, bool)]) \
+            if n_prior else (o_act[a_rows] == _DEL)
+        max_seq = int(seq_cat_store.max()) if n_rows else 0
+
+    # narrowest dtypes that fit (each distinct signature compiles once)
+    a_dtype = np.uint8 if A <= 256 else np.int32
+    s_dtype = np.int16 if max_seq < (1 << 15) else np.int32
+
+    # clock exceptions as COO: clock[i, actor_i] = seq_i - 1 always (the
+    # fold's final SET), so only cross-actor closure entries ship
+    coo = []
+    R = st.R
+    if R.any():
+        rows_clock = R[oc[a_rows]]
+        nz_r, nz_c = np.nonzero(rows_clock)
+        new_local = chg_local[oc[a_rows]]
+        own = nz_c == new_local[nz_r]
+        coo.append((inv_order[nz_r[~own]], nz_c[~own],
+                    rows_clock[nz_r[~own], nz_c[~own]]))
+    if n_prior:
+        e_log = store.e_change[prior_rows]
+        prior_counts = (store.l_dep_ptr[e_log + 1]
+                        - store.l_dep_ptr[e_log])
+        if prior_counts.sum():
+            idx = _span_indices(store.l_dep_ptr[e_log], prior_counts)
+            rows_rep = np.repeat(
+                np.arange(n_new, n_rows, dtype=np.int64), prior_counts)
+            doc_rep = np.repeat(p_doc, prior_counts)
+            cols = la.local_of(doc_rep, store.l_dep_actor[idx])
+            vals = store.l_dep_seq[idx]
+            own = cols == prior_local[rows_rep - n_new]
+            # the own-column closure of a PRIOR entry is its seq-1 by
+            # the same invariant, so dropping own rows stays exact
+            coo.append((inv_order[rows_rep[~own]], cols[~own],
+                        vals[~own]))
+    if coo:
+        coo_row = np.concatenate([c[0] for c in coo]).astype(np.int32)
+        coo_col_v = np.concatenate([c[1] for c in coo])
+        coo_val_v = np.concatenate([c[2] for c in coo])
+    else:
+        coo_row = np.zeros(0, np.int32)
+        coo_col_v = coo_val_v = np.zeros(0, np.int32)
+    c_dtype = np.int16 if (len(coo_val_v) == 0
+                           or int(coo_val_v.max()) < (1 << 15)) \
+        else np.int32
+    nnz_pad = opts.pad_ops(max(len(coo_row), 1))
+    coo_col = np.zeros(nnz_pad, a_dtype)
+    coo_col[:len(coo_col_v)] = coo_col_v
+    coo_val = np.zeros(nnz_pad, c_dtype)
+    coo_val[:len(coo_val_v)] = coo_val_v
+    coo_row = np.concatenate(
+        [coo_row, np.full(nnz_pad - len(coo_row), n_pad, np.int32)])
+
+    # ---- device-resident trees: ship only this apply's NEW nodes ----
+    K = max(len(dirty), 1)
+    if ns is not None:
+        n_j = ns.n_j
+    else:
+        n_j = pool.n_of[dirty] if len(dirty) else np.zeros(0, np.int64)
+    m_pad = opts.pad_nodes(int(max(n_j.max() if len(n_j) else 1, 8)))
+    n_total = pool.n_nodes
+    n_act = len(store.actors)
+
+    # variant pick: the packed program (2-word mirror, one wire buffer)
+    # wherever its bit-field guards hold; `_fused_general_resident` is
+    # the fallback (huge single trees, wide actor sets). Both share the
+    # staging idioms (_insert_counts/_build_clock/_vis_grid and the
+    # scan resolve) — the cross-check for those is the host oracle and
+    # the sharded-step equality gates, while the fallback remains the
+    # independent check of the packed mirror FORMAT (bit fields, wire
+    # layout, dtype narrowing).
+    use_packed = (_packed_mirror_guard(pool, n_act, A)
+                  and s_dtype is np.int16
+                  and c_dtype is np.int16)
+    mir = pool.mirror
+    if mir is not None and (mir.get('fmt', 'cols') == 'packed') \
+            != use_packed:
+        mir = pool.mirror = _mirror_convert(mir, use_packed, store, opts)
+
+    if mir is None:
+        # first resident apply: EVERY node is this apply's delta — the
+        # mirror materializes on device with zero extra wire bytes
+        cap = opts.pad_nodes(max(n_total, 8))
+        n_old = 0
+    elif mir['cap'] < n_total:
+        # capacity growth ON DEVICE (2x headroom so block-sized growth
+        # amortizes): pad each resident column; nothing ships
+        cap = opts.pad_nodes(max(2 * mir['cap'], n_total))
+        n_old = mir['n']
+    else:
+        cap = mir['cap']
+        n_old = mir['n']
+
+    d_n = n_total - n_old
+    d_pad = opts.pad_nodes(max(d_n, 8))
+    native_wire = native_rows and use_packed
+
+    if not native_wire:
+        # host-built planes: d columns + job table + row slots + the
+        # staged row arrays (the native stager still provides the d
+        # planes and job table when it ran — exact for any admission)
+        if ns is not None:
+            d_parent = np.zeros(d_pad, np.int32)
+            d_elemc = np.zeros(d_pad, np.int32)
+            d_actor = np.zeros(d_pad, np.int32)
+            d_pos = np.full(d_pad, cap, np.int32)
+            job_start = np.zeros(K, np.int32)
+            n_j_arr = np.zeros(K, np.int32)
+            ns.fill_dplanes(d_parent, d_elemc, d_actor, d_pos,
+                            job_start, n_j_arr)
+        else:
+            new_glob = np.arange(n_old, n_total, dtype=np.int64)
+            keys = (pool.obj[new_glob].astype(np.int64) << 32) | \
+                pool.local[new_glob]
+            final_pos = np.searchsorted(pool.pos_sorted, keys)
+            if d_n > 1 and not (final_pos[1:] >= final_pos[:-1]).all():
+                ordp = np.argsort(final_pos, kind='stable')
+                final_pos = final_pos[ordp]
+            else:
+                ordp = None     # appends landed in pos order (common)
+
+            def dcol(col):
+                out = np.zeros(d_pad, np.int32)
+                new = col[new_glob]
+                out[:d_n] = new if ordp is None else new[ordp]
+                return out
+
+            d_parent = dcol(pool.parent)
+            d_elemc = dcol(pool.elemc)
+            d_actor = dcol(pool.actor)
+            d_pos = np.full(d_pad, cap, np.int32)
+            d_pos[:d_n] = final_pos - np.arange(d_n)
+
+            # job table: each dirty object's contiguous pos slice
+            job_start = np.zeros(K, np.int32)
+            n_j_arr = np.zeros(K, np.int32)
+            if len(dirty):
+                job_start[:] = np.searchsorted(pool.pos_sorted,
+                                               dirty << np.int64(32))
+                n_j_arr[:] = n_j
+
+        # per-row (job, node) slots, in the field-sorted coordinates
+        row_slot = np.full(n_pad, -1, np.int32)
+        if len(dirty):
+            slot_cat = np.full(n_rows, -1, np.int64)
+            dirty_lookup = np.full(len(store.obj_uuid), -1, np.int64)
+            dirty_lookup[dirty] = np.arange(K)
+            if n_new:
+                loc = dirty_lookup[a_objr]
+                nd = a_node
+                slot_cat[:n_new] = np.where((loc >= 0) & (nd >= 0),
+                                            loc * m_pad + nd, -1)
+            if n_prior:
+                p_loc = dirty_lookup[store.e_obj[prior_rows]]
+                p_elem_key = store.e_key[prior_rows]
+                p_node = np.where(p_elem_key & _ELEM_BIT,
+                                  p_elem_key & 0x7FFFFFFF, -1)
+                slot_cat[n_new:n_rows] = np.where(
+                    (p_loc >= 0) & (p_node >= 0),
+                    p_loc * m_pad + p_node, -1)
+            row_slot[:n_rows] = slot_cat[order]
+
+        if local_cat is None:    # native rows but the cols program
+            local_cat = chg_local[oc[a_rows]]
+            seq_cat_store = st.o_seq[a_rows]
+            isdel_cat = o_act[a_rows] == _DEL
+        actor_arr = np.zeros(n_pad, a_dtype)
+        actor_arr[:n_rows] = local_cat[order]
+        seq_arr = np.zeros(n_pad, s_dtype)
+        seq_arr[:n_rows] = seq_cat_store[order]
+        boundary = np.zeros(n_pad, bool)
+        if n_rows:
+            boundary[0] = True
+            boundary[1:n_rows] = r_seg[1:] != r_seg[:-1]
+        del_arr = np.zeros(n_pad, bool)
+        del_arr[:n_rows] = isdel_cat[order]
+        flags_u8 = np.concatenate([np.packbits(boundary),
+                                   np.packbits(del_arr)])
+    t2 = time.perf_counter()
+
+    if use_packed:
+        ranks = np.asarray(store.actor_str_ranks())
+        if mir is None:
+            w1m = jnp.zeros(cap, jnp.int32)
+            w2m = jnp.zeros(cap, jnp.int32)
+            remap_dev, has_remap = _NO_REMAP, False
+        else:
+            if mir['cap'] < n_total:
+                pad = cap - mir['cap']
+                w1m = jnp.concatenate(
+                    [mir['w1'], jnp.zeros(pad, jnp.int32)])
+                w2m = jnp.concatenate(
+                    [mir['w2'], jnp.zeros(pad, jnp.int32)])
+            else:
+                w1m, w2m = mir['w1'], mir['w2']
+            old_ranks = mir['ranks']
+            if np.array_equal(old_ranks, ranks[:len(old_ranks)]):
+                remap_dev, has_remap = _NO_REMAP, False
+            else:
+                # existing actors shifted rank (new actors landed in
+                # the sorted order): remap the mirror's rank field
+                rm = np.zeros(opts.pad_actors(len(old_ranks) + 2),
+                              np.int32)
+                rm[old_ranks + 1] = \
+                    ranks[:len(old_ranks)].astype(np.int32) + 1
+                remap_dev, has_remap = jnp.asarray(rm), True
+
+        sizes = (d_pad, n_pad, K, nnz_pad)
+        wire = np.empty(_wire_sizes(*sizes), np.uint8)
+        i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
+        i16_n = d_pad + n_pad + nnz_pad
+        if native_wire:
+            # C++ writes every section except the three admission-clock
+            # COO sections, which only the admission layer knows
+            ns.fill_wire(wire, cap, d_pad, n_pad, K, nnz_pad, m_pad,
+                         ranks)
+            o = 4 * (2 * d_pad + n_pad)
+            wire[o:o + 4 * nnz_pad].view(np.int32)[:] = coo_row
+            o = 4 * i32_n + 2 * (d_pad + n_pad)
+            wire[o:o + 2 * nnz_pad].view(np.int16)[:] = coo_val
+            o = 4 * i32_n + 2 * i16_n + n_pad + 2 * (n_pad >> 3)
+            wire[o:o + nnz_pad] = coo_col.view(np.uint8)
+        else:
+            rank1_new = np.where(
+                d_actor >= 0, ranks[np.maximum(d_actor, 0)] + 1, 0) \
+                .astype(np.int32)
+            w1_new = (d_parent << 16) | rank1_new
+            o = 0
+            for arr, width in ((w1_new, 4), (d_pos, 4), (row_slot, 4),
+                               (coo_row, 4), (job_start, 4),
+                               (n_j_arr, 4)):
+                nb_ = width * len(arr)
+                wire[o:o + nb_].view(np.int32)[:] = arr
+                o += nb_
+            for arr in (d_elemc, seq_arr, coo_val):
+                nb_ = 2 * len(arr)
+                wire[o:o + nb_].view(np.int16)[:] = arr
+                o += nb_
+            for arr in (actor_arr, flags_u8, coo_col):
+                wire[o:o + len(arr)] = arr.view(np.uint8)
+                o += len(arr)
+            assert o == len(wire)
+
+        outs = _fused_general_packed(
+            w1m, w2m, jnp.asarray(wire), np.int32(n_old),
+            jnp.asarray(np.int32(n_rows)), remap_dev,
+            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
+            has_remap=has_remap, has_old=n_old > 0)
+        pool.mirror = {
+            'fmt': 'packed', 'cap': cap, 'n': n_total,
+            'w1': outs[0], 'w2': outs[1], 'ranks': ranks.copy(),
+            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
+        }
+        surv_u8_dev, winner_dev = outs[2], outs[3]
+        vis_planes = outs[4] if len(dirty) else None
+        vis_fmt = 'packed'
+    else:
+        if mir is None:
+            m_cols = (jnp.zeros(cap, jnp.int32),
+                      jnp.zeros(cap, jnp.int32),
+                      jnp.full(cap, -1, jnp.int32),
+                      jnp.zeros(cap, bool),
+                      jnp.full(cap, -1, jnp.int32))
+        elif mir['cap'] < n_total:
+            def grow(col, fill):
+                return jnp.concatenate(
+                    [col, jnp.full(cap - mir['cap'], fill, col.dtype)])
+
+            m_cols = (grow(mir['parent'], 0), grow(mir['elemc'], 0),
+                      grow(mir['actor'], -1),
+                      grow(mir['visible'], False),
+                      grow(mir['vis_index'], -1))
+        else:
+            m_cols = (mir['parent'], mir['elemc'], mir['actor'],
+                      mir['visible'], mir['vis_index'])
+
+        # actor -> string-rank table, re-shipped only when it grew
+        if mir is None or mir.get('rank_n') != n_act:
+            rank_table_dev = _rank_table(store, opts)
+        else:
+            rank_table_dev = mir['rank_table']
+
+        outs = _fused_general_resident(
+            *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
+            jnp.asarray(d_actor), jnp.asarray(d_pos), np.int32(n_old),
+            jnp.asarray(job_start), jnp.asarray(n_j_arr),
+            rank_table_dev,
+            jnp.asarray(actor_arr), jnp.asarray(seq_arr),
+            jnp.asarray(row_slot), jnp.asarray(flags_u8),
+            jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
+            jnp.asarray(coo_col), jnp.asarray(coo_val),
+            num_segments=S, a_pad=A, m_pad=m_pad)
+        pool.mirror = {
+            'fmt': 'cols', 'cap': cap, 'n': n_total,
+            'parent': outs[0], 'elemc': outs[1], 'actor': outs[2],
+            'visible': outs[3], 'vis_index': outs[4],
+            'rank_n': n_act, 'rank_table': rank_table_dev,
+            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
+        }
+        surv_u8_dev, winner_dev = outs[5], outs[6]
+        vis_planes = outs[7:11] if len(dirty) else None
+        vis_fmt = 'cols'
+    pool._epoch += 1
+    if _STAGE_CAPTURE is not None:
+        if native_wire:
+            # the staged planes live in the wire buffer — expose them
+            # through views at the layout offsets
+            o_rs = 4 * (2 * d_pad)
+            cap_slot = wire[o_rs:o_rs + 4 * n_pad].view(np.int32)
+            o_sq = 4 * i32_n + 2 * d_pad
+            cap_seq = wire[o_sq:o_sq + 2 * n_pad].view(np.int16)
+            o_ac = 4 * i32_n + 2 * i16_n
+            cap_actor = wire[o_ac:o_ac + n_pad]
+            cap_flags = wire[o_ac + n_pad:
+                             o_ac + n_pad + 2 * (n_pad >> 3)]
+        else:
+            cap_slot, cap_seq = row_slot, seq_arr
+            cap_actor, cap_flags = actor_arr, flags_u8
+        _STAGE_CAPTURE({
+            'ops_actor': cap_actor, 'ops_seq': cap_seq,
+            'ops_slot': cap_slot, 'flags_u8': cap_flags,
+            'n_rows': n_rows, 'coo_row': coo_row, 'coo_col': coo_col,
+            'coo_val': coo_val, 'num_segments': S, 'a_pad': A,
+            'm_pad': m_pad, 'surv_u8': surv_u8_dev,
+            'winner': winner_dev, 'vis_fmt': vis_fmt,
+            'vis_planes': vis_planes, 'variant':
+                'packed' if use_packed else 'cols'})
+    t3 = time.perf_counter()
+
+    # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
+    # `cat` holds the UNPERMUTED row columns plus `order` (the
+    # field-sorted permutation matching the kernel's winner row ids);
+    # consumers gather lazily — commit fetches only the survivor rows,
+    # conflict columns materialize on first diff read. Nothing blocks
+    # here: the 33KB survivor fetch and the entry update wait in
+    # _pending_commit until the next entry reader (usually the next
+    # apply's prior-entry match), so host staging of block n+1 overlaps
+    # this block's device program.
+    # columns build LAZILY on first access (8 half-million-row gathers
+    # + concatenates off the dispatch path — the commit or a diff read
+    # pays them, overlapping the device program). The e_* refs snapshot
+    # NOW: the store's entry columns are replaced (never mutated) at
+    # commit, so the captured arrays stay the pre-commit state.
+    e_snap = (store.e_value, store.e_link, store.e_actor,
+              store.e_change, store.e_obj, store.e_key)
+
+    def seq_thunk():
+        if seq_cat_store is not None:
+            return seq_cat_store, None
+        return st.o_seq[a_rows], None
+
+    cat = _LazyCat({
+        'value': lambda: (st.o_value[a_rows], e_snap[0][prior_rows]),
+        'link': lambda: (o_act[a_rows] == _LINK,
+                         e_snap[1][prior_rows]),
+        'actor': lambda: (st.o_actor[a_rows], e_snap[2][prior_rows]),
+        'doc': lambda: (o_doc[a_rows], p_doc),
+        'seq': seq_thunk,
+        'change': lambda: (st.cmap[oc[a_rows]].astype(np.int32),
+                           e_snap[3][prior_rows]),
+        'obj': lambda: (a_objr.astype(np.int32),
+                        e_snap[4][prior_rows]),
+        'key': lambda: (f_new & 0xFFFFFFFF,
+                        e_snap[5][prior_rows]),
+    }, n_prior)
+
+    f_obj = (touched_fields >> 32).astype(np.int32)
+    patch.f_obj = f_obj
+    patch.f_doc = obj_doc_arr[f_obj] if len(obj_doc_arr) \
+        else np.zeros(0, np.int32)
+    patch.f_key = touched_fields & 0xFFFFFFFF
+    patch.f_kind = (patch.f_key & _ELEM_BIT) != 0
+
+    # ---- lazy wiring: winner columns, conflicts, sequence edits ----
+    pos_snap = (pool.pos_sorted, pool.pos_row)
+
+    def rows_flat_thunk(d=dirty, nj=n_j, ps=pos_snap):
+        # the flat node-row gather of every dirty object is paid by the
+        # first patch READ, not the apply dispatch; the pos snapshot
+        # pins this apply's tree extent (later applies append more)
+        if not len(d):
+            return np.zeros(0, np.int64)
+        lo = np.searchsorted(ps[0], d << np.int64(32))
+        return ps[1][_span_indices(lo, nj)]
+
+    patch._raw = {
+        'winner_dev': winner_dev, 'surviving': None,   # set at commit
+        'cat': cat, 'order': order, 'vis_fmt': vis_fmt,
+        'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
+        'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat_thunk,
+        # per-object maxElem SNAPSHOT at apply time: a pipelined reader
+        # may materialize this patch after apply N+1 has grown the pool,
+        # and the reference reports the per-apply maxElem
+        # (/root/reference/backend/op_set.js:118-125)
+        'gained_max_elem': {int(o): int(pool.max_elem_of[o])
+                            for o in ins_objs.tolist()},
+    }
+    patch._ready = False
+    store._pending_commit = {
+        'surv_u8_dev': surv_u8_dev, 'n_rows': n_rows,
+        'prior_mask': prior_mask, 'touched_fields': touched_fields,
+        'r_seg': r_seg, 'cat': cat, 'order': order, 'patch': patch,
+    }
+    t4 = time.perf_counter()
+
+    metrics.bump('general_batches')
+    metrics.bump('general_ops', int(keep.sum()))
+    metrics.bump('general_stage_native_batches' if ns is not None
+                 else 'general_stage_numpy_batches')
+    metrics.observe('general_stage_ms',
+                    (t2 - t1 - (tc1 - tc0)) * 1e3)
+    metrics.observe('general_commit_wait_ms', (tc1 - tc0) * 1e3)
+    if return_timing:
+        return patch, {'admit': t1 - t0, 'pack': t2 - t1,
+                       'commit_wait': tc1 - tc0,
+                       'device': t3 - t2, 'unpack': t4 - t3}
+    return patch
+
+
+def _resolve_ops_numpy(store, block, st, omap, root_ops, obj_doc_arr,
+                       obj_type_arr, o_act, o_doc, o_obj_blk, o_kind,
+                       o_key_raw, o_key_elem, o_elem, ins_rows, a_rows):
+    """The numpy op-resolution path of `_apply_general`: per-op store
+    object rows, ins grouping + local node minting, elemId resolution
+    with the duplicate check, packed field keys. Mutates the pool
+    (append_batch). The native stager (`native.stage_general_block`)
+    computes exactly these outputs in C++; this remains the fallback
+    for partially-admitted blocks and late-bound string elemIds, and
+    the parity oracle for the native path.
+
+    Returns (f_new, a_node, a_objr, dirty, ins_objs): per-assignment-
+    row packed field keys / target nodes / object rows, plus the dirty
+    sequence objects and the objects that gained nodes."""
+    pool = store.pool
+    o_objrow = np.where(root_ops, store._root_row[o_doc],
+                        omap[o_obj_blk])
+    # cross-document object reuse is malformed input, not a crash
+    if not (obj_doc_arr[o_objrow] == o_doc).all():
+        bad = int(np.flatnonzero(obj_doc_arr[o_objrow] != o_doc)[0])
+        raise ValueError('Modification of unknown object '
+                         + block.objs[int(o_obj_blk[bad])])
+    o_node = np.full(len(o_act), -1, np.int64)   # local node of each op
+    ins_objs = np.zeros(0, np.int64)
 
     # ---- ins prep: group by object, mint local node ids ----
     g_rows = g_obj = g_actor = g_elem = local_new = None
@@ -1963,416 +2713,9 @@ def _apply_general(store, block, options, return_timing):
     if len(a_rows):
         o_field[a_rows] = (objr << 32) | fkey
 
-    # ---- deferred-commit point: everything ABOVE here is independent
-    # of the entry columns, so it ran while the PREVIOUS apply's device
-    # program was still in flight; now fold that apply in ----
-    store._commit_pending()
 
-    # ---- touched fields + prior entries ----
-    # one int64 argsort serves BOTH the unique-field derivation and the
-    # field-sorted row order (with no priors they are the same sort)
     f_new = o_field[a_rows]
-    order_new = np.argsort(f_new, kind='stable')
-    f_sorted = f_new[order_new]
-    n_new0 = len(f_sorted)
-    bnd_new = np.empty(n_new0, bool)
-    if n_new0:
-        bnd_new[0] = True
-        bnd_new[1:] = f_sorted[1:] != f_sorted[:-1]
-    touched_fields = f_sorted[bnd_new]
-    seg_sorted_new = np.cumsum(bnd_new) - 1
-    seg_new = np.empty(n_new0, np.int64)
-    seg_new[order_new] = seg_sorted_new
-    e_field = (store.e_obj.astype(np.int64) << 32) | store.e_key
-    if len(e_field):
-        pos = np.minimum(np.searchsorted(touched_fields, e_field),
-                         max(len(touched_fields) - 1, 0))
-        prior_mask = (touched_fields[pos] == e_field) \
-            if len(touched_fields) else np.zeros(len(e_field), bool)
-        prior_rows = np.flatnonzero(prior_mask)
-        seg_prior = pos[prior_rows]
-    else:
-        prior_mask = np.zeros(0, bool)
-        prior_rows = np.zeros(0, np.int64)
-        seg_prior = np.zeros(0, np.int64)
-    F = len(touched_fields)
-    S = opts.pad_segments(max(F, 1))
-
-    n_new, n_prior = len(a_rows), len(prior_rows)
-    n_rows = n_new + n_prior
-    n_pad = opts.pad_ops(max(n_rows, 8))    # >= 8: masks ride bit-packed
-    la = st.la
-    A = opts.pad_actors(max(la.width, 1))
-
-    # canonical row order: FIELD-SORTED (segment-grouped) — the seg ids
-    # then ship as one boundary BIT per row, and every r_* column below
-    # (and the kernel's winner row ids) lives in these coordinates.
-    # With no prior rows the unique-inverse is already the sort.
-    p_doc = store.e_doc[prior_rows]
-    if n_prior:
-        seg_cat = np.concatenate([seg_new, seg_prior]).astype(np.int32)
-        order = np.argsort(seg_cat, kind='stable')
-        r_seg = seg_cat[order]
-    else:
-        order = order_new                   # the field sort IS the order
-        r_seg = seg_sorted_new.astype(np.int32)
-    inv_order = np.empty(n_rows, np.int64)
-    inv_order[order] = np.arange(n_rows)
-    # per-CHANGE local actor slots, gathered per row (C << n ops)
-    chg_local = la.local_of(block.doc, st.b_actor) \
-        if block.n_changes else np.zeros(0, np.int32)
-    prior_local = la.local_of(p_doc, store.e_actor[prior_rows]) \
-        if n_prior else np.zeros(0, np.int32)
-    local_cat = np.concatenate([chg_local[oc[a_rows]], prior_local]) \
-        if n_prior else chg_local[oc[a_rows]]
-    seq_cat_store = np.concatenate(
-        [st.o_seq[a_rows], store.e_seq[prior_rows]]) if n_prior \
-        else st.o_seq[a_rows]
-    isdel_cat = np.concatenate(
-        [o_act[a_rows] == _DEL, np.zeros(n_prior, bool)]) if n_prior \
-        else (o_act[a_rows] == _DEL)
-
-    # narrowest dtypes that fit (each distinct signature compiles once)
-    a_dtype = np.uint8 if A <= 256 else np.int32
-    max_seq = int(seq_cat_store.max()) if n_rows else 0
-    s_dtype = np.int16 if max_seq < (1 << 15) else np.int32
-    actor_arr = np.zeros(n_pad, a_dtype)
-    actor_arr[:n_rows] = local_cat[order]
-    seq_arr = np.zeros(n_pad, s_dtype)
-    seq_arr[:n_rows] = seq_cat_store[order]
-    boundary = np.zeros(n_pad, bool)
-    if n_rows:
-        boundary[0] = True
-        boundary[1:n_rows] = r_seg[1:] != r_seg[:-1]
-    del_arr = np.zeros(n_pad, bool)
-    del_arr[:n_rows] = isdel_cat[order]
-
-    # clock exceptions as COO: clock[i, actor_i] = seq_i - 1 always (the
-    # fold's final SET), so only cross-actor closure entries ship
-    coo = []
-    R = st.R
-    if R.any():
-        rows_clock = R[oc[a_rows]]
-        nz_r, nz_c = np.nonzero(rows_clock)
-        new_local = chg_local[oc[a_rows]]
-        own = nz_c == new_local[nz_r]
-        coo.append((inv_order[nz_r[~own]], nz_c[~own],
-                    rows_clock[nz_r[~own], nz_c[~own]]))
-    if n_prior:
-        e_log = store.e_change[prior_rows]
-        prior_counts = (store.l_dep_ptr[e_log + 1]
-                        - store.l_dep_ptr[e_log])
-        if prior_counts.sum():
-            idx = _span_indices(store.l_dep_ptr[e_log], prior_counts)
-            rows_rep = np.repeat(
-                np.arange(n_new, n_rows, dtype=np.int64), prior_counts)
-            doc_rep = np.repeat(p_doc, prior_counts)
-            cols = la.local_of(doc_rep, store.l_dep_actor[idx])
-            vals = store.l_dep_seq[idx]
-            own = cols == prior_local[rows_rep - n_new]
-            # the own-column closure of a PRIOR entry is its seq-1 by
-            # the same invariant, so dropping own rows stays exact
-            coo.append((inv_order[rows_rep[~own]], cols[~own],
-                        vals[~own]))
-    if coo:
-        coo_row = np.concatenate([c[0] for c in coo]).astype(np.int32)
-        coo_col_v = np.concatenate([c[1] for c in coo])
-        coo_val_v = np.concatenate([c[2] for c in coo])
-    else:
-        coo_row = np.zeros(0, np.int32)
-        coo_col_v = coo_val_v = np.zeros(0, np.int32)
-    c_dtype = np.int16 if (len(coo_val_v) == 0
-                           or int(coo_val_v.max()) < (1 << 15)) \
-        else np.int32
-    nnz_pad = opts.pad_ops(max(len(coo_row), 1))
-    coo_col = np.zeros(nnz_pad, a_dtype)
-    coo_col[:len(coo_col_v)] = coo_col_v
-    coo_val = np.zeros(nnz_pad, c_dtype)
-    coo_val[:len(coo_val_v)] = coo_val_v
-    coo_row = np.concatenate(
-        [coo_row, np.full(nnz_pad - len(coo_row), n_pad, np.int32)])
-
-    # ---- device-resident trees: ship only this apply's NEW nodes ----
-    K = max(len(dirty), 1)
-    rows_flat, n_j = (pool.rows_of_objs(dirty) if len(dirty)
-                      else (np.zeros(0, np.int64), np.zeros(0, np.int64)))
-    m_pad = opts.pad_nodes(int(max(n_j.max() if len(n_j) else 1, 8)))
-    n_total = pool.n_nodes
-    n_act = len(store.actors)
-
-    # variant pick: the packed program (2-word mirror, one wire buffer)
-    # wherever its bit-field guards hold; `_fused_general_resident` is
-    # the fallback (huge single trees, wide actor sets). Both share the
-    # staging idioms (_insert_counts/_build_clock/_vis_grid and the
-    # scan resolve) — the cross-check for those is the host oracle and
-    # the sharded-step equality gates, while the fallback remains the
-    # independent check of the packed mirror FORMAT (bit fields, wire
-    # layout, dtype narrowing).
-    use_packed = (pool.max_tree <= 0x7FFF
-                  and pool.max_elem < (1 << 15)
-                  and n_act < 65535
-                  and a_dtype is np.uint8 and s_dtype is np.int16
-                  and c_dtype is np.int16)
-    mir = pool.mirror
-    if mir is not None and (mir.get('fmt', 'cols') == 'packed') \
-            != use_packed:
-        mir = pool.mirror = _mirror_convert(mir, use_packed, store, opts)
-
-    if mir is None:
-        # first resident apply: EVERY node is this apply's delta — the
-        # mirror materializes on device with zero extra wire bytes
-        cap = opts.pad_nodes(max(n_total, 8))
-        n_old = 0
-    elif mir['cap'] < n_total:
-        # capacity growth ON DEVICE (2x headroom so block-sized growth
-        # amortizes): pad each resident column; nothing ships
-        cap = opts.pad_nodes(max(2 * mir['cap'], n_total))
-        n_old = mir['n']
-    else:
-        cap = mir['cap']
-        n_old = mir['n']
-
-    new_glob = np.arange(n_old, n_total, dtype=np.int64)
-    d_n = len(new_glob)
-    d_pad = opts.pad_nodes(max(d_n, 8))
-    keys = (pool.obj[new_glob].astype(np.int64) << 32) | \
-        pool.local[new_glob]
-    final_pos = np.searchsorted(pool.pos_sorted, keys)
-    if d_n > 1 and not (final_pos[1:] >= final_pos[:-1]).all():
-        ordp = np.argsort(final_pos, kind='stable')
-        final_pos = final_pos[ordp]
-    else:
-        ordp = None     # appends landed in pos order (common)
-
-    def dcol(col):
-        out = np.zeros(d_pad, np.int32)
-        new = col[new_glob]
-        out[:d_n] = new if ordp is None else new[ordp]
-        return out
-
-    d_parent = dcol(pool.parent)
-    d_elemc = dcol(pool.elemc)
-    d_actor = dcol(pool.actor)
-    d_pos = np.full(d_pad, cap, np.int32)
-    d_pos[:d_n] = final_pos - np.arange(d_n)
-
-    # job table: each dirty object's contiguous pos slice
-    job_start = np.zeros(K, np.int32)
-    n_j_arr = np.zeros(K, np.int32)
-    if len(dirty):
-        job_start[:] = np.searchsorted(pool.pos_sorted,
-                                       dirty << np.int64(32))
-        n_j_arr[:] = n_j
-
-    # per-row (job, node) slots, in the field-sorted coordinates
-    row_slot = np.full(n_pad, -1, np.int32)
-    if len(dirty):
-        slot_cat = np.full(n_rows, -1, np.int64)
-        dirty_lookup = np.full(len(store.obj_uuid), -1, np.int64)
-        dirty_lookup[dirty] = np.arange(K)
-        if n_new:
-            loc = dirty_lookup[o_objrow[a_rows]]
-            nd = o_node[a_rows]
-            slot_cat[:n_new] = np.where((loc >= 0) & (nd >= 0),
-                                        loc * m_pad + nd, -1)
-        if n_prior:
-            p_loc = dirty_lookup[store.e_obj[prior_rows]]
-            p_elem_key = store.e_key[prior_rows]
-            p_node = np.where(p_elem_key & _ELEM_BIT,
-                              p_elem_key & 0x7FFFFFFF, -1)
-            slot_cat[n_new:n_rows] = np.where(
-                (p_loc >= 0) & (p_node >= 0), p_loc * m_pad + p_node, -1)
-        row_slot[:n_rows] = slot_cat[order]
-    t2 = time.perf_counter()
-
-    flags_u8 = np.concatenate([np.packbits(boundary),
-                               np.packbits(del_arr)])
-    if use_packed:
-        ranks = np.asarray(store.actor_str_ranks())
-        if mir is None:
-            w1m = jnp.zeros(cap, jnp.int32)
-            w2m = jnp.zeros(cap, jnp.int32)
-            remap_dev, has_remap = _NO_REMAP, False
-        else:
-            if mir['cap'] < n_total:
-                pad = cap - mir['cap']
-                w1m = jnp.concatenate(
-                    [mir['w1'], jnp.zeros(pad, jnp.int32)])
-                w2m = jnp.concatenate(
-                    [mir['w2'], jnp.zeros(pad, jnp.int32)])
-            else:
-                w1m, w2m = mir['w1'], mir['w2']
-            old_ranks = mir['ranks']
-            if np.array_equal(old_ranks, ranks[:len(old_ranks)]):
-                remap_dev, has_remap = _NO_REMAP, False
-            else:
-                # existing actors shifted rank (new actors landed in
-                # the sorted order): remap the mirror's rank field
-                rm = np.zeros(opts.pad_actors(len(old_ranks) + 2),
-                              np.int32)
-                rm[old_ranks + 1] = \
-                    ranks[:len(old_ranks)].astype(np.int32) + 1
-                remap_dev, has_remap = jnp.asarray(rm), True
-
-        rank1_new = np.where(
-            d_actor >= 0, ranks[np.maximum(d_actor, 0)] + 1, 0) \
-            .astype(np.int32)
-        w1_new = (d_parent << 16) | rank1_new
-
-        sizes = (d_pad, n_pad, K, nnz_pad)
-        wire = np.empty(_wire_sizes(*sizes), np.uint8)
-        o = 0
-        for arr, width in ((w1_new, 4), (d_pos, 4), (row_slot, 4),
-                           (coo_row, 4), (job_start, 4), (n_j_arr, 4)):
-            nb_ = width * len(arr)
-            wire[o:o + nb_].view(np.int32)[:] = arr
-            o += nb_
-        for arr in (d_elemc, seq_arr, coo_val):
-            nb_ = 2 * len(arr)
-            wire[o:o + nb_].view(np.int16)[:] = arr
-            o += nb_
-        for arr in (actor_arr, flags_u8, coo_col):
-            wire[o:o + len(arr)] = arr.view(np.uint8)
-            o += len(arr)
-        assert o == len(wire)
-
-        outs = _fused_general_packed(
-            w1m, w2m, jnp.asarray(wire), np.int32(n_old),
-            jnp.asarray(np.int32(n_rows)), remap_dev,
-            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
-            has_remap=has_remap, has_old=n_old > 0)
-        pool.mirror = {
-            'fmt': 'packed', 'cap': cap, 'n': n_total,
-            'w1': outs[0], 'w2': outs[1], 'ranks': ranks.copy(),
-            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
-        }
-        surv_u8_dev, winner_dev = outs[2], outs[3]
-        vis_planes = outs[4] if len(dirty) else None
-        vis_fmt = 'packed'
-    else:
-        if mir is None:
-            m_cols = (jnp.zeros(cap, jnp.int32),
-                      jnp.zeros(cap, jnp.int32),
-                      jnp.full(cap, -1, jnp.int32),
-                      jnp.zeros(cap, bool),
-                      jnp.full(cap, -1, jnp.int32))
-        elif mir['cap'] < n_total:
-            def grow(col, fill):
-                return jnp.concatenate(
-                    [col, jnp.full(cap - mir['cap'], fill, col.dtype)])
-
-            m_cols = (grow(mir['parent'], 0), grow(mir['elemc'], 0),
-                      grow(mir['actor'], -1),
-                      grow(mir['visible'], False),
-                      grow(mir['vis_index'], -1))
-        else:
-            m_cols = (mir['parent'], mir['elemc'], mir['actor'],
-                      mir['visible'], mir['vis_index'])
-
-        # actor -> string-rank table, re-shipped only when it grew
-        if mir is None or mir.get('rank_n') != n_act:
-            rank_table_dev = _rank_table(store, opts)
-        else:
-            rank_table_dev = mir['rank_table']
-
-        outs = _fused_general_resident(
-            *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
-            jnp.asarray(d_actor), jnp.asarray(d_pos), np.int32(n_old),
-            jnp.asarray(job_start), jnp.asarray(n_j_arr),
-            rank_table_dev,
-            jnp.asarray(actor_arr), jnp.asarray(seq_arr),
-            jnp.asarray(row_slot), jnp.asarray(flags_u8),
-            jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
-            jnp.asarray(coo_col), jnp.asarray(coo_val),
-            num_segments=S, a_pad=A, m_pad=m_pad)
-        pool.mirror = {
-            'fmt': 'cols', 'cap': cap, 'n': n_total,
-            'parent': outs[0], 'elemc': outs[1], 'actor': outs[2],
-            'visible': outs[3], 'vis_index': outs[4],
-            'rank_n': n_act, 'rank_table': rank_table_dev,
-            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
-        }
-        surv_u8_dev, winner_dev = outs[5], outs[6]
-        vis_planes = outs[7:11] if len(dirty) else None
-        vis_fmt = 'cols'
-    pool._epoch += 1
-    if _STAGE_CAPTURE is not None:
-        _STAGE_CAPTURE({
-            'ops_actor': actor_arr, 'ops_seq': seq_arr,
-            'ops_slot': row_slot, 'flags_u8': flags_u8,
-            'n_rows': n_rows, 'coo_row': coo_row, 'coo_col': coo_col,
-            'coo_val': coo_val, 'num_segments': S, 'a_pad': A,
-            'm_pad': m_pad, 'surv_u8': surv_u8_dev,
-            'winner': winner_dev, 'vis_fmt': vis_fmt,
-            'vis_planes': vis_planes, 'variant':
-                'packed' if use_packed else 'cols'})
-    t3 = time.perf_counter()
-
-    # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
-    # `cat` holds the UNPERMUTED row columns plus `order` (the
-    # field-sorted permutation matching the kernel's winner row ids);
-    # consumers gather lazily — commit fetches only the survivor rows,
-    # conflict columns materialize on first diff read. Nothing blocks
-    # here: the 33KB survivor fetch and the entry update wait in
-    # _pending_commit until the next entry reader (usually the next
-    # apply's prior-entry match), so host staging of block n+1 overlaps
-    # this block's device program.
-    # columns build LAZILY on first access (8 half-million-row gathers
-    # + concatenates off the dispatch path — the commit or a diff read
-    # pays them, overlapping the device program). The e_* refs snapshot
-    # NOW: the store's entry columns are replaced (never mutated) at
-    # commit, so the captured arrays stay the pre-commit state.
-    e_snap = (store.e_value, store.e_link, store.e_actor,
-              store.e_change, store.e_obj, store.e_key)
-    cat = _LazyCat({
-        'value': lambda: (st.o_value[a_rows], e_snap[0][prior_rows]),
-        'link': lambda: (o_act[a_rows] == _LINK,
-                         e_snap[1][prior_rows]),
-        'actor': lambda: (st.o_actor[a_rows], e_snap[2][prior_rows]),
-        'doc': lambda: (o_doc[a_rows], p_doc),
-        'seq': lambda: (seq_cat_store, None),
-        'change': lambda: (st.cmap[oc[a_rows]].astype(np.int32),
-                           e_snap[3][prior_rows]),
-        'obj': lambda: (o_objrow[a_rows].astype(np.int32),
-                        e_snap[4][prior_rows]),
-        'key': lambda: (o_field[a_rows] & 0xFFFFFFFF,
-                        e_snap[5][prior_rows]),
-    }, n_prior)
-
-    f_obj = (touched_fields >> 32).astype(np.int32)
-    patch.f_obj = f_obj
-    patch.f_doc = obj_doc_arr[f_obj] if len(obj_doc_arr) \
-        else np.zeros(0, np.int32)
-    patch.f_key = touched_fields & 0xFFFFFFFF
-    patch.f_kind = (patch.f_key & _ELEM_BIT) != 0
-
-    # ---- lazy wiring: winner columns, conflicts, sequence edits ----
-    patch._raw = {
-        'winner_dev': winner_dev, 'surviving': None,   # set at commit
-        'cat': cat, 'order': order, 'vis_fmt': vis_fmt,
-        'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
-        'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat,
-        # per-object maxElem SNAPSHOT at apply time: a pipelined reader
-        # may materialize this patch after apply N+1 has grown the pool,
-        # and the reference reports the per-apply maxElem
-        # (/root/reference/backend/op_set.js:118-125)
-        'gained_max_elem': {int(o): int(pool.max_elem_of[o])
-                            for o in ins_objs.tolist()},
-    }
-    patch._ready = False
-    store._pending_commit = {
-        'surv_u8_dev': surv_u8_dev, 'n_rows': n_rows,
-        'prior_mask': prior_mask, 'touched_fields': touched_fields,
-        'r_seg': r_seg, 'cat': cat, 'order': order, 'patch': patch,
-    }
-    t4 = time.perf_counter()
-
-    metrics.bump('general_batches')
-    metrics.bump('general_ops', int(keep.sum()))
-    if return_timing:
-        return patch, {'admit': t1 - t0, 'pack': t2 - t1,
-                       'device': t3 - t2, 'unpack': t4 - t3}
-    return patch
+    return f_new, o_node[a_rows], o_objrow[a_rows], dirty, ins_objs
 
 
 class _LazyCat:
@@ -2380,14 +2723,25 @@ class _LazyCat:
     `thunks[k]()` returns (new_part, prior_part); prior_part of None
     means the column is already concatenated."""
 
-    __slots__ = ('_thunks', '_n_prior', '_cols')
+    __slots__ = ('_thunks', '_n_prior', '_cols', '_lock')
 
     def __init__(self, thunks, n_prior):
         self._thunks = thunks
         self._n_prior = n_prior
         self._cols = {}
+        # the applier thread (deferred commit) and a patch reader can
+        # both force a column; builds are idempotent but the thunk-drop
+        # below is not
+        self._lock = threading.Lock()
 
     def __getitem__(self, k):
+        c = self._cols.get(k)
+        if c is not None:
+            return c
+        with self._lock:
+            return self._build(k)
+
+    def _build(self, k):
         c = self._cols.get(k)
         if c is None:
             new_part, prior_part = self._thunks[k]()

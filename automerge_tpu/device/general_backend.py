@@ -155,8 +155,8 @@ def current_token(state):
         return state
     fork = _fork(state)
     fork.undo_pos = state.undo_pos
-    fork.undo_stack = state.undo_stack
-    fork.redo_stack = state.redo_stack
+    fork.undo_stack = list(state.undo_stack)
+    fork.redo_stack = list(state.redo_stack)
     return fork
 
 
@@ -224,10 +224,12 @@ def apply_changes(state, changes, options=None):
                               all_deps_tab)
     # local-change history carries across remote applies (the per-doc
     # backend and the reference both keep it) — from the CALLER's
-    # token, which a stale fork must not reset
+    # token, which a stale fork must not reset. COPIED, matching
+    # DeviceBackendState.clone's convention: a future in-place append
+    # on either token must not corrupt the other's history.
     new.undo_pos = orig.undo_pos
-    new.undo_stack = orig.undo_stack
-    new.redo_stack = orig.redo_stack
+    new.undo_stack = list(orig.undo_stack)
+    new.redo_stack = list(orig.redo_stack)
     patch = {'clock': dict(clock), 'deps': dict(deps),
              'canUndo': new.undo_pos > 0,
              'canRedo': bool(new.redo_stack),

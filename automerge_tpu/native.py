@@ -248,3 +248,244 @@ def clone_index(idx):
     if isinstance(idx, SeqIndex):
         return idx.clone()
     return list(idx)
+
+
+# ---------------------------------------------------------------------------
+# Native general-block staging (the amst_* entry points of libamwire.so).
+#
+# `device/general._apply_general` turns an admitted block into the staged
+# planes the fused device program consumes. The heavy per-op passes —
+# object-row mapping, ins grouping + local node minting, elemId
+# resolution with the duplicate check, packed field keys, the stable
+# field sort, the new-node d-planes and the single packed wire buffer —
+# run here in one C++ call, byte-identical to the numpy staging (which
+# remains the fallback whenever the library is unavailable, a change was
+# queued/dropped at admission, or a late-bound string elemId appears).
+
+import numpy as _np
+
+_STAGE_LIB = None
+_STAGE_ATTEMPTED = False
+
+_i64 = ctypes.c_int64
+_P8 = ctypes.POINTER(ctypes.c_int8)
+_P32 = ctypes.POINTER(ctypes.c_int32)
+_P64 = ctypes.POINTER(ctypes.c_int64)
+_PU8 = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _bind_stage(lib):
+    lib.amst_stage_general.argtypes = [
+        _i64, _P8, _P32, _P8, _P32, _P32, _P32,          # op columns
+        _i64, _P32, _P32, _P32, _P32, _P32,              # change columns
+        _P32, _P32,                                      # a_tab, k_tab
+        _P64, _P64, _P32, _P32, _i64,                    # omap/root/obj
+        _P64, _P64, _P64, _P64, _i64,                    # pool tables
+        _P32, _P32, _P32, _P32, _P32,                    # pool columns
+        _i64]                                            # n_old_mirror
+    lib.amst_stage_general.restype = ctypes.c_void_p
+    for name in ('amst_err', 'amst_err_payload', 'amst_fallback',
+                 'amst_n_ins', 'amst_n_arows', 'amst_n_dirty',
+                 'amst_n_fields', 'amst_max_seq', 'amst_max_nj',
+                 'amst_d_n'):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = _i64
+    lib.amst_free.argtypes = [ctypes.c_void_p]
+    lib.amst_free.restype = None
+    lib.amst_fill_append.argtypes = [ctypes.c_void_p, _P64, _P64, _P64,
+                                     _P32, _P64]
+    lib.amst_fill_append.restype = None
+    lib.amst_fill_res.argtypes = [ctypes.c_void_p] + [_P64] * 5
+    lib.amst_fill_res.restype = None
+    lib.amst_fill_order.argtypes = [ctypes.c_void_p, _P64, _P32]
+    lib.amst_fill_order.restype = None
+    lib.amst_fill_fields.argtypes = [ctypes.c_void_p, _P64]
+    lib.amst_fill_fields.restype = None
+    lib.amst_fill_dirty.argtypes = [ctypes.c_void_p, _P64, _P64, _P64]
+    lib.amst_fill_dirty.restype = None
+    lib.amst_fill_dplanes.argtypes = [ctypes.c_void_p] + [_P32] * 6
+    lib.amst_fill_dplanes.restype = None
+    lib.amst_fill_wire.argtypes = [ctypes.c_void_p, _PU8, _i64, _i64,
+                                   _i64, _i64, _i64, _i64, _P64]
+    lib.amst_fill_wire.restype = None
+    return lib
+
+
+def stage_lib():
+    """The staging library, or None (no native codec / stale binary
+    without the amst_* symbols / AUTOMERGE_TPU_NATIVE_STAGE=0)."""
+    global _STAGE_LIB, _STAGE_ATTEMPTED
+    if _STAGE_ATTEMPTED:
+        return _STAGE_LIB
+    _STAGE_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE_STAGE', '1') == '0':
+        return None
+    from . import wire as _wire
+    lib = _wire._load()
+    if lib is None:
+        return None
+    try:
+        _STAGE_LIB = _bind_stage(lib)
+    except AttributeError:
+        _STAGE_LIB = None            # stale .so predating the stager
+    return _STAGE_LIB
+
+
+def stage_available():
+    return stage_lib() is not None
+
+
+def _p32(a):
+    return a.ctypes.data_as(_P32)
+
+
+def _p64(a):
+    return a.ctypes.data_as(_P64)
+
+
+def _p8(a):
+    return a.ctypes.data_as(_P8)
+
+
+# staging error codes (wire_codec.cpp ErrCode) -> exception builders;
+# messages match the numpy staging exactly
+_STAGE_ERRORS = {
+    1: (ValueError, 'Modification of unknown object {obj}'),
+    2: (ValueError, 'Insertion into non-sequence object {uuid}'),
+    3: (ValueError, 'Duplicate list element ID'),
+    4: (ValueError, 'List element insertion after unknown element'),
+    5: (TypeError, 'Missing index entry for list element'),
+    6: (ValueError, 'assignment to _head'),
+}
+
+
+class GeneralStagedPlanes:
+    """Handle over one native staging result. Numpy views of the
+    resolution columns materialize on construction; the plane fills
+    (`fill_wire`, `fill_dplanes`) stream straight from the C++ buffers
+    into caller-allocated arrays. Keeps every borrowed input array
+    alive until freed."""
+
+    __slots__ = ('_lib', '_h', '_keep', 'n_ins', 'n_arows', 'n_fields',
+                 'n_dirty', 'max_seq', 'max_nj', 'd_n',
+                 'a_rows', 'o_field', 'seg_new', 'a_node', 'a_objrow',
+                 'g_obj', 'g_local', 'g_parent', 'g_actor', 'g_elem',
+                 'order', 'r_seg', 'touched', 'dirty', 'n_j', 'new_cnt')
+
+    def __init__(self, lib, h, keep):
+        self._lib = lib
+        self._h = h
+        self._keep = keep            # borrowed-arrays lifeline
+        self.n_ins = int(lib.amst_n_ins(h))
+        self.n_arows = int(lib.amst_n_arows(h))
+        self.n_fields = int(lib.amst_n_fields(h))
+        self.n_dirty = int(lib.amst_n_dirty(h))
+        self.max_seq = int(lib.amst_max_seq(h))
+        self.max_nj = int(lib.amst_max_nj(h))
+        self.d_n = int(lib.amst_d_n(h))
+        n_a, n_i, K, F = self.n_arows, self.n_ins, self.n_dirty, \
+            self.n_fields
+        self.a_rows = _np.empty(n_a, _np.int64)
+        self.o_field = _np.empty(n_a, _np.int64)
+        self.seg_new = _np.empty(n_a, _np.int64)
+        self.a_node = _np.empty(n_a, _np.int64)
+        self.a_objrow = _np.empty(n_a, _np.int64)
+        lib.amst_fill_res(h, _p64(self.a_rows), _p64(self.o_field),
+                          _p64(self.seg_new), _p64(self.a_node),
+                          _p64(self.a_objrow))
+        self.g_obj = _np.empty(n_i, _np.int64)
+        self.g_local = _np.empty(n_i, _np.int64)
+        self.g_parent = _np.empty(n_i, _np.int64)
+        self.g_actor = _np.empty(n_i, _np.int32)
+        self.g_elem = _np.empty(n_i, _np.int64)
+        lib.amst_fill_append(h, _p64(self.g_obj), _p64(self.g_local),
+                             _p64(self.g_parent), _p32(self.g_actor),
+                             _p64(self.g_elem))
+        self.order = _np.empty(n_a, _np.int64)
+        self.r_seg = _np.empty(n_a, _np.int32)
+        lib.amst_fill_order(h, _p64(self.order), _p32(self.r_seg))
+        self.touched = _np.empty(F, _np.int64)
+        lib.amst_fill_fields(h, _p64(self.touched))
+        self.dirty = _np.empty(K, _np.int64)
+        self.n_j = _np.empty(K, _np.int64)
+        self.new_cnt = _np.empty(K, _np.int64)
+        lib.amst_fill_dirty(h, _p64(self.dirty), _p64(self.n_j),
+                            _p64(self.new_cnt))
+
+    def fill_dplanes(self, d_parent, d_elemc, d_actor, d_pos,
+                     job_start, n_j_arr):
+        """Write the new-node planes + job table into pre-padded
+        caller arrays (d_pos must be pre-filled with the cap
+        sentinel)."""
+        self._lib.amst_fill_dplanes(
+            self._h, _p32(d_parent), _p32(d_elemc), _p32(d_actor),
+            _p32(d_pos), _p32(job_start), _p32(n_j_arr))
+
+    def fill_wire(self, wire, cap, d_pad, n_pad, K, nnz_pad, m_pad,
+                  ranks):
+        """Write the packed program's wire buffer (all sections except
+        the three admission-clock COO sections, which the caller
+        owns)."""
+        self._lib.amst_fill_wire(
+            self._h, wire.ctypes.data_as(_PU8), cap, d_pad, n_pad, K,
+            nnz_pad, m_pad, _p64(ranks))
+
+    def __del__(self):
+        h = getattr(self, '_h', None)
+        if h:
+            self._lib.amst_free(h)
+            self._h = None
+
+
+def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
+                        obj_doc, obj_type, pool, b_actor, n_old_mirror,
+                        obj_uuid=None):
+    """Run the native stager over an admitted general block.
+
+    Returns a :class:`GeneralStagedPlanes`, ``None`` when the library
+    is unavailable or the stager requests the numpy fallback
+    (late-bound string elemIds), or raises exactly the staging error
+    the numpy path would raise (same type, same message).
+    ``obj_uuid`` is the store's object-uuid table (error messages)."""
+    lib = stage_lib()
+    if lib is None:
+        return None
+    n_of = _np.ascontiguousarray(pool.n_of, _np.int64)
+    max_elem_of = _np.ascontiguousarray(pool.max_elem_of, _np.int64)
+    keep = (block, chg_local, a_tab, k_tab, omap, root_row, obj_doc,
+            obj_type, n_of, max_elem_of, pool.pos_sorted, pool.pos_row,
+            pool.obj, pool.local, pool.actor, pool.elemc, pool.parent,
+            b_actor)
+    h = lib.amst_stage_general(
+        block.n_ops, _p8(block.action), _p32(block.obj),
+        _p8(block.key_kind), _p32(block.key), _p32(block.key_elem),
+        _p32(block.elem),
+        block.n_changes, _p32(block.op_ptr), _p32(block.doc),
+        _p32(block.seq), _p32(b_actor), _p32(chg_local),
+        _p32(a_tab), _p32(k_tab),
+        _p64(omap), _p64(root_row), _p32(obj_doc), _p32(obj_type),
+        len(obj_doc),
+        _p64(n_of), _p64(max_elem_of),
+        _p64(pool.pos_sorted), _p64(pool.pos_row), pool.n_nodes,
+        _p32(pool.obj), _p32(pool.local), _p32(pool.actor),
+        _p32(pool.elemc), _p32(pool.parent),
+        n_old_mirror)
+    if not h:
+        raise MemoryError('native staging allocation failed')
+    err = int(lib.amst_err(h))
+    if err:
+        payload = int(lib.amst_err_payload(h))
+        lib.amst_free(h)
+        exc, msg = _STAGE_ERRORS[err]
+        if err == 1:        # payload = block obj table index
+            msg = msg.format(obj=block.objs[payload])
+        elif err == 2:      # payload = store object row
+            msg = msg.format(
+                uuid=obj_uuid[payload] if obj_uuid is not None
+                else '<object>')
+        raise exc(msg)
+    if lib.amst_fallback(h):
+        lib.amst_free(h)
+        return None
+    return GeneralStagedPlanes(lib, h, keep)

@@ -244,7 +244,7 @@ def sharded_step_from_capture(mesh, store, patch, captured):
 
     raw = patch._raw
     dirty, n_j = raw['dirty'], raw['dirty_n']
-    rows_flat = raw['rows_flat']
+    rows_flat = raw['rows_flat']()   # lazy node-row gather
     mj = captured['m_pad']
     Kj = max(len(dirty), 1)
     pool = store.pool

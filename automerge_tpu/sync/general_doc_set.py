@@ -175,6 +175,56 @@ class GeneralDocSet:
 
     applyChangesBatch = apply_changes_batch
 
+    def apply_wire(self, data, doc_ids=None):
+        """Batched admission straight from WIRE BYTES: the JSON text of
+        per-document change lists (``[[change, ...], ...]``) runs
+        through the native codec (C++ JSON -> columns, key kinds
+        resolved against this store's object table) and then the native
+        stager inside one fused apply — no per-op Python on the whole
+        path. ``doc_ids`` names the documents the arrays correspond to
+        (defaults to positional ``doc-<i>`` ids, created on first
+        touch). Falls back to the pure-Python edge when the codec
+        library is unavailable.
+
+        Returns the list of touched :class:`GeneralDocHandle`."""
+        from ..wire import parse_general_block
+        from ..device.blocks import ChangeBlock
+        block = parse_general_block(data, store=self.store)
+        n = block.n_docs
+        if doc_ids is None:
+            doc_ids = [f'doc-{i}' for i in range(n)]
+        elif len(doc_ids) != n:
+            raise ValueError(
+                f'wire block carries {n} documents, got '
+                f'{len(doc_ids)} doc ids')
+        for doc_id in doc_ids:
+            self._index(doc_id, create=True)
+        # widen the block's doc axis to the store capacity (documents
+        # map positionally: doc_ids[i] -> store index of that id)
+        idx_of = [self.id_of[doc_id] for doc_id in doc_ids]
+        if idx_of != list(range(n)) or n != self.capacity:
+            remap = np.asarray(idx_of, np.int32)
+            block = ChangeBlock(
+                self.capacity,
+                remap[block.doc] if block.n_changes else block.doc,
+                block.actor, block.seq, block.dep_ptr, block.dep_actor,
+                block.dep_seq, block.op_ptr, block.action, block.key,
+                block.value, block.actors, block.keys, block.values,
+                dup_keys=block._dup_keys, obj=block.obj,
+                key_kind=block.key_kind, key_elem=block.key_elem,
+                elem=block.elem, objs=block.objs)
+        _general.apply_general_block(self.store, block,
+                                     options=self._options)
+        out = []
+        for doc_id in doc_ids:
+            doc = self.get_doc(doc_id)
+            out.append(doc)
+            for handler in list(self.handlers):
+                handler(doc_id, doc)
+        return out
+
+    applyWire = apply_wire
+
     def register_handler(self, handler):
         if handler not in self.handlers:
             self.handlers = self.handlers + [handler]

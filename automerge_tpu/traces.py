@@ -27,19 +27,21 @@ _ALPHABET = 'abcdefghijklmnopqrstuvwxyz     ,.\n'
 
 
 def gen_editing_trace(n_ops=2000, actor='author', seed=0,
-                      backspace_p=0.07, jump_p=0.03):
+                      backspace_p=0.07, jump_p=0.03, obj=TEXT_OBJ):
     """A deterministic single-author editing session.
 
     Returns a list of wire-format changes: change 1 creates the Text object
     and links it at the root key ``'text'``; each subsequent change is one
     keystroke — an insert (``ins`` + ``set``) at the cursor, or a backspace
     (``del``). Cursor occasionally jumps (revision behavior in the real
-    trace).
+    trace). ``obj`` overrides the Text object's uuid — non-root uuids
+    are globally unique on the block path, so distinct documents in one
+    batch need distinct object ids.
     """
     rng = np.random.default_rng(seed)
     changes = [{'actor': actor, 'seq': 1, 'deps': {}, 'ops': [
-        {'action': 'makeText', 'obj': TEXT_OBJ},
-        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': TEXT_OBJ},
+        {'action': 'makeText', 'obj': obj},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text', 'value': obj},
     ]}]
 
     elems = []          # visible elemIds in order (host shadow)
@@ -55,15 +57,15 @@ def gen_editing_trace(n_ops=2000, actor='author', seed=0,
         if kinds[i] < backspace_p and cursor > 0:
             victim = elems.pop(cursor - 1)
             cursor -= 1
-            ops = [{'action': 'del', 'obj': TEXT_OBJ, 'key': victim}]
+            ops = [{'action': 'del', 'obj': obj, 'key': victim}]
         else:
             max_elem += 1
             elem_id = f'{actor}:{max_elem}'
             prev = elems[cursor - 1] if cursor > 0 else '_head'
             ops = [
-                {'action': 'ins', 'obj': TEXT_OBJ, 'key': prev,
+                {'action': 'ins', 'obj': obj, 'key': prev,
                  'elem': max_elem},
-                {'action': 'set', 'obj': TEXT_OBJ, 'key': elem_id,
+                {'action': 'set', 'obj': obj, 'key': elem_id,
                  'value': _ALPHABET[chars[i]]},
             ]
             elems.insert(cursor, elem_id)

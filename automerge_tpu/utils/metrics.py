@@ -12,6 +12,7 @@ Everything is no-op-cheap when nothing subscribes: counter bumps are one
 dict add; events are only materialized if a subscriber is registered.
 """
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -24,14 +25,35 @@ class Metrics:
     def __init__(self):
         self.counters = defaultdict(int)
         self._subscribers = []
+        # counter updates are read-modify-write; the async applier
+        # thread (device.general) and the main thread share this
+        # registry, so the updates take a (cheap, per-batch) lock
+        self._lock = threading.Lock()
 
     # -- counters ----------------------------------------------------------
 
     def bump(self, name, value=1):
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     def set_gauge(self, name, value):
         self.counters[name] = value
+
+    def observe(self, name, value):
+        """Record one sample of a duration/size series: keeps count,
+        sum and max under ``<name>.count`` / ``.sum`` / ``.max`` (the
+        staging-time counters of the general engine ride this). Cheap:
+        three dict writes, no history retained."""
+        with self._lock:
+            self.counters[name + '.count'] += 1
+            self.counters[name + '.sum'] += value
+            if value > self.counters[name + '.max']:
+                self.counters[name + '.max'] = value
+
+    def mean(self, name):
+        """Mean of an :meth:`observe` series (0.0 when empty)."""
+        n = self.counters.get(name + '.count', 0)
+        return self.counters.get(name + '.sum', 0) / n if n else 0.0
 
     def snapshot(self):
         return dict(self.counters)
@@ -71,6 +93,8 @@ unsubscribe = metrics.unsubscribe
 emit = metrics.emit
 bump = metrics.bump
 set_gauge = metrics.set_gauge
+observe = metrics.observe
+mean = metrics.mean
 
 
 @contextmanager

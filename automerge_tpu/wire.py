@@ -13,6 +13,12 @@ apply path values ride to the store without ever being parsed.
 ``json.dumps(block.to_changes())``). Falls back to
 ``json.loads`` + ``from_changes`` when the native library is
 unavailable.
+
+The same library also exports the ``amst_*`` native STAGER (bound in
+:mod:`automerge_tpu.native`): the general engine feeds a parsed block
+straight through C++ staging into the fused device program, so the
+whole wire-bytes -> device-planes path runs without per-op Python
+(``GeneralDocSet.apply_wire`` is the end-to-end edge).
 """
 
 import ctypes

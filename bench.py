@@ -125,7 +125,7 @@ def bench_e2e_dense(iters=200, stream_k=8):
     # statement about overlap rather than about link weather
     t_sync = min(run_stream(gen_stream(), False) for _ in range(2))
     t_pipe = min(run_stream(gen_stream(), True) for _ in range(2))
-    return block.n_ops, t_med, t_p99, t_sync, t_pipe
+    return block.n_ops, t_med, t_p99, t_sync, t_pipe, stream_k
 
 
 def bench_e2e_host_blocks(n_docs=2048, iters=10):
@@ -789,7 +789,37 @@ def bench_general_multidoc(n_docs=4096, list_ops=122, iters=8,
     run_stream(True)                          # warm wide-store shapes
     t_sync = run_stream(True)
     t_pipe = run_stream(False)
-    return n_docs, n_ops, t_med, t_p99, t_sync, t_pipe, stream_k
+
+    # extraction overlap: each block's PATCHES are read (diffs for a
+    # fixed slice of its documents) — serially after each apply vs on
+    # the main thread while the applier stages the next block
+    # (apply_general_block_async). Same total work, measured overlap.
+    x_docs = min(256, n_docs)
+
+    def run_extract(overlapped):
+        store = general.init_store(wide)
+        t0 = time.perf_counter()
+        if overlapped:
+            futs = [general.apply_general_block_async(store, b)
+                    for b in blocks]
+            for k, f in enumerate(futs):
+                for d in range(k * n_docs, k * n_docs + x_docs):
+                    f.diffs(d)
+            general.close_general(store)
+        else:
+            for k, b in enumerate(blocks):
+                p = general.apply_general_block(store, b)
+                p.block_until_ready()
+                for d in range(k * n_docs, k * n_docs + x_docs):
+                    p.diffs(d)
+        store._commit_pending()
+        return (time.perf_counter() - t0) / stream_k
+
+    run_extract(True)                         # warm the applier path
+    t_xsync = run_extract(False)
+    t_xpipe = run_extract(True)
+    return (n_docs, n_ops, t_med, t_p99, t_sync, t_pipe, stream_k,
+            t_xsync, t_xpipe, x_docs)
 
 
 def main():
@@ -815,8 +845,8 @@ def main():
     log(f'devices: {jax.devices()}')
 
     # ---- HEADLINE: config 5 end to end (wire changes -> patches) ----
-    total_ops, t_med, t_p99, t_stream_sync, t_stream_pipe = \
-        bench_e2e_dense()
+    (total_ops, t_med, t_p99, t_stream_sync, t_stream_pipe,
+     d_stream_k) = bench_e2e_dense()
     e2e_ops_per_sec = total_ops / t_med
     log(f'e2e-docset-merge[dense store]: {total_ops} wire ops / 10240 docs '
         f'in {t_med * 1e3:.1f} ms (p99 of 200: {t_p99 * 1e3:.1f} ms) '
@@ -943,17 +973,50 @@ def main():
 
     bench_trace_replay()
 
+    from automerge_tpu.utils.metrics import metrics as _metrics
+    from automerge_tpu import native as _amnat
+    _metrics.reset()
     (g_docs, g_ops, t_gmd, t_gp99, t_gsync, t_gpipe,
-     g_stream_k) = bench_general_multidoc()
+     g_stream_k, t_gxsync, t_gxpipe, g_xdocs) = bench_general_multidoc()
+    g_stage_ms = _metrics.mean('general_stage_ms')
+    g_native = _metrics.counters.get('general_stage_native_batches', 0)
+    g_numpy = _metrics.counters.get('general_stage_numpy_batches', 0)
     log(f'general-multidoc: {g_ops} mixed ops (lists+maps+links, causal '
         f'chains) across {g_docs} docs — one-shot median '
         f'{t_gmd * 1e3:.0f} ms (p99 {t_gp99 * 1e3:.0f} ms) -> '
-        f'{g_ops / t_gmd / 1e6:.2f}M ops/s, one fused dispatch')
+        f'{g_ops / t_gmd / 1e6:.2f}M ops/s, one fused dispatch '
+        f'(host staging {g_stage_ms:.0f} ms/apply mean ex commit-wait, '
+        f'{"native C++" if g_native > g_numpy else "numpy"} stager: '
+        f'{g_native} native / {g_numpy} numpy applies)')
     log(f'general-multidoc[stream of {g_stream_k}x{g_ops}]: sync-each '
         f'{t_gsync * 1e3:.0f} ms/apply, pipelined {t_gpipe * 1e3:.0f} '
         f'ms/apply ({t_gpipe / t_gsync:.2f}x) -> '
         f'{g_ops / t_gpipe / 1e6:.2f}M ops/s sustained (deferred-commit '
         f'overlap: host staging of block n+1 under device work of n)')
+    log(f'general-multidoc[extract-overlap]: patches of {g_xdocs} '
+        f'docs/block read back — serial {t_gxsync * 1e3:.0f} ms/apply, '
+        f'extraction under next-block staging {t_gxpipe * 1e3:.0f} '
+        f'ms/apply ({t_gxpipe / t_gxsync:.2f}x, applier thread)')
+
+    # floor-subtracted overlap: sync-each pays one ~t_floor link round
+    # trip PER APPLY by construction, the pipeline one per stream — the
+    # raw pipelined ratio therefore improves whenever the link gets
+    # WORSE (VERDICT r5 weak #3). Subtracting the measured floor from
+    # both modes leaves the device/host compute-overlap that the
+    # pipeline actually achieves.
+    def ex_floor(t_sync_s, t_pipe_s, k):
+        es = max(t_sync_s - t_floor, 1e-9)
+        ep = max(t_pipe_s - t_floor / k, 1e-9)
+        return es, ep
+
+    d_es, d_ep = ex_floor(t_stream_sync, t_stream_pipe, d_stream_k)
+    g_es, g_ep = ex_floor(t_gsync, t_gpipe, g_stream_k)
+    log(f'pipelined-ratio[ex-floor]: dense {d_ep / d_es:.2f}x '
+        f'(raw {t_stream_pipe / t_stream_sync:.2f}x), general '
+        f'{g_ep / g_es:.2f}x (raw {t_gpipe / t_gsync:.2f}x) — '
+        f'{t_floor * 1e3:.0f} ms link floor subtracted per sync-each '
+        f'apply, floor/{g_stream_k} per pipelined apply; what remains '
+        f'is true device/host compute overlap')
 
     north_star = 1e7  # 1M ops / 100ms end-to-end (BASELINE.json)
     print(json.dumps({
@@ -963,10 +1026,15 @@ def main():
         'vs_baseline': round(e2e_ops_per_sec / north_star, 2),
         'p99_apply_ms': round(t_p99 * 1e3, 2),
         'pipelined_ratio': round(t_stream_pipe / t_stream_sync, 2),
+        'pipelined_ratio_ex_floor': round(d_ep / d_es, 2),
         'kernel_ops_per_sec': round(k_ops / k_med, 1),
         'link_floor_ms': round(t_floor * 1e3, 2),
         'general_ops_per_sec': round(g_ops / t_gmd, 1),
         'general_stream_ops_per_sec': round(g_ops / t_gpipe, 1),
+        'general_pipelined_ratio_ex_floor': round(g_ep / g_es, 2),
+        'general_extract_overlap_ratio': round(t_gxpipe / t_gxsync, 2),
+        'general_stage_ms': round(g_stage_ms, 1),
+        'general_stage_native': bool(_amnat.stage_available()),
         'general_p99_ms': round(t_gp99 * 1e3, 2),
         'general_sync_docs_per_sec': round(n_gd / t_gbatch, 1),
         'resolve_hbm_frac': round(res_hbm, 4),

@@ -27,6 +27,10 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <limits>
 #include <string>
 #include <vector>
 #include <unordered_map>
@@ -722,5 +726,760 @@ void amwc_fill_value_spans(void* h, int64_t* starts, int64_t* ends) {
 }
 
 void amwc_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native staging: general block columns -> device-ready staged planes.
+//
+// `_apply_general` (device/general.py) turns an admitted block into the
+// planes the fused packed program consumes: per-op store object rows,
+// ins grouping + local node minting, elemId resolution (sequential
+// peepholes + a sorted-composite residue lookup + the duplicate check),
+// packed int64 field keys, the STABLE field sort (touched fields,
+// segment boundaries), narrow-dtype actor/seq planes, bit-packed flag
+// planes, the new-node d-planes with their pos-order insert positions,
+// and the job table — ~10 full numpy passes plus two million-row
+// argsorts per block. This section computes all of it in one C++ pass
+// (stable radix sorts, run arithmetic), byte-identical to the numpy
+// staging (same stable sort order, same dtypes, same error messages),
+// and writes the packed program's single wire buffer directly.
+//
+// Scope: the FULLY-ADMITTED block (the bulk one-shot shape). The Python
+// caller checks admission results first and keeps the numpy path for
+// everything else (queued/duplicate changes, late-bound string elemIds
+// -> `fallback`); the resolution outputs (field keys, node ids,
+// pool-append columns) are exact for any admitted block and feed the
+// numpy plane staging when prior store entries join the sort.
+//
+// All pointers are borrowed from the caller's numpy arrays and must
+// stay alive until amst_free.
+
+namespace stage {
+
+constexpr int64_t kElemBit = int64_t(1) << 31;
+constexpr int8_t kStSet = 0, kStDel = 1, kStIns = 2, kStLink = 3;
+constexpr int8_t kStMake = 4;                  // >= kStMake: make*
+constexpr int8_t kKStr = 0, kKElem = 1, kKHead = 2;
+constexpr int32_t kTMap = 0;
+
+enum ErrCode {
+    kErrNone = 0,
+    kErrCrossDoc = 1,        // ValueError: Modification of unknown object
+    kErrInsIntoMap = 2,      // ValueError: Insertion into non-sequence
+    kErrDupElem = 3,         // ValueError: Duplicate list element ID
+    kErrUnknownParent = 4,   // ValueError: insertion after unknown elem
+    kErrMissingIndex = 5,    // TypeError: Missing index entry
+    kErrHeadAssign = 6,      // ValueError: assignment to _head
+};
+
+struct Stager {
+    int err = kErrNone;
+    int64_t err_payload = -1;
+    bool fallback = false;   // late-bound string elemId: numpy path only
+
+    // borrowed pool pointers (fills need them)
+    const int64_t* pos_sorted = nullptr;
+    int64_t n_nodes = 0;     // pool size at call time (post-make)
+    int64_t n_old = 0;       // mirror['n'] (0 when no mirror)
+
+    // per assignment row, in op order
+    std::vector<int64_t> a_rows;     // op indexes of set/del/link rows
+    std::vector<int64_t> o_field;    // (objrow << 32) | fkey
+    std::vector<int64_t> a_node;     // target local node (-1: map field)
+    std::vector<int64_t> a_objrow;
+    std::vector<int32_t> a_local;    // per-change local actor slot
+    std::vector<int32_t> a_seq;
+    std::vector<uint8_t> a_del;
+    // pool-append columns (grouped: obj asc, block order within)
+    std::vector<int64_t> g_obj, g_local, g_parent, g_elem;
+    std::vector<int32_t> g_actor;
+    // field sort
+    std::vector<int64_t> order;      // stable field-sorted permutation
+    std::vector<int32_t> r_seg;      // segment id per sorted row
+    std::vector<int64_t> seg_new;    // segment id per UNSORTED a-row
+    std::vector<int64_t> touched;    // sorted distinct field keys
+    // dirty sequence objects
+    std::vector<int64_t> dirty;      // sorted
+    std::vector<int64_t> n_j;        // post-append node counts
+    std::vector<int64_t> new_cnt;    // minted nodes per dirty object
+    std::vector<int64_t> job_start;  // post-append pos run starts
+    // new-to-mirror node planes, key-sorted (the numpy ordp order)
+    std::vector<int32_t> d_parent, d_elemc, d_actor;
+    std::vector<int64_t> d_pos;
+    int64_t max_seq = 0;
+};
+
+// LSD radix sort of (key, idx) pairs by non-negative int64 key,
+// 16-bit digits — stable, so the resulting idx permutation is
+// EXACTLY numpy's argsort(key, kind='stable').
+static void radix_sort_pairs(std::vector<int64_t>& key,
+                             std::vector<int64_t>& idx) {
+    size_t n = key.size();
+    if (n < 2) return;
+    int64_t mx = 0;
+    for (int64_t k : key) mx = std::max(mx, k);
+    std::vector<int64_t> kbuf(n), ibuf(n);
+    int64_t* ksrc = key.data();
+    int64_t* isrc = idx.data();
+    int64_t* kdst = kbuf.data();
+    int64_t* idst = ibuf.data();
+    std::vector<size_t> hist(65536);
+    for (int shift = 0; shift < 64; shift += 16) {
+        if (shift && !(mx >> shift)) break;
+        std::fill(hist.begin(), hist.end(), 0);
+        for (size_t i = 0; i < n; i++)
+            hist[(ksrc[i] >> shift) & 0xFFFF]++;
+        size_t pos = 0;
+        for (size_t b = 0; b < 65536; b++) {
+            size_t c = hist[b];
+            hist[b] = pos;
+            pos += c;
+        }
+        for (size_t i = 0; i < n; i++) {
+            size_t b = (ksrc[i] >> shift) & 0xFFFF;
+            kdst[hist[b]] = ksrc[i];
+            idst[hist[b]] = isrc[i];
+            hist[b]++;
+        }
+        std::swap(ksrc, kdst);
+        std::swap(isrc, idst);
+    }
+    if (ksrc != key.data()) {
+        std::memcpy(key.data(), ksrc, n * 8);
+        std::memcpy(idx.data(), isrc, n * 8);
+    }
+}
+
+}  // namespace stage
+
+extern "C" {
+
+void* amst_stage_general(
+        // block op columns (N)
+        int64_t n_ops, const int8_t* action, const int32_t* obj_blk,
+        const int8_t* key_kind, const int32_t* key,
+        const int32_t* key_elem, const int32_t* elem,
+        // block change columns (C) + op CSR
+        int64_t n_changes, const int32_t* op_ptr, const int32_t* chg_doc,
+        const int32_t* chg_seq, const int32_t* chg_actor,
+        const int32_t* chg_local,
+        // block table -> store id maps
+        const int32_t* a_tab, const int32_t* k_tab,
+        // object tables (omap[0] ignored; ROOT resolves per doc)
+        const int64_t* omap, const int64_t* root_row,
+        const int32_t* obj_doc, const int32_t* obj_type,
+        int64_t n_store_objs,
+        // pool state (post-make, pre-append)
+        const int64_t* n_of, const int64_t* max_elem_of,
+        const int64_t* pos_sorted, const int64_t* pos_row,
+        int64_t n_nodes,
+        const int32_t* p_obj, const int32_t* p_local,
+        const int32_t* p_actor, const int32_t* p_elemc,
+        const int32_t* p_parent,
+        int64_t n_old_mirror) {
+    using namespace stage;
+    auto* s = new (std::nothrow) Stager();
+    if (!s) return nullptr;
+    const bool amst_timing = std::getenv("AMST_TIMING") != nullptr;
+    auto amst_t0 = std::chrono::steady_clock::now();
+    auto amst_mark = [&](const char* what) {
+        if (!amst_timing) return;
+        auto now = std::chrono::steady_clock::now();
+        std::fprintf(stderr, "amst %-8s %6.2f ms\n", what,
+            std::chrono::duration<double, std::milli>(now - amst_t0)
+                .count());
+        amst_t0 = now;
+    };
+    s->pos_sorted = pos_sorted;
+    s->n_nodes = n_nodes;
+    s->n_old = n_old_mirror;
+
+    // ---- P0: per-op store object rows + cross-doc check (op order,
+    // matching the numpy full-column check) ----
+    std::vector<int64_t> objrow(n_ops);
+    std::vector<int32_t> opchg(n_ops);
+    for (int64_t c = 0; c < n_changes; c++)
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++)
+            opchg[j] = static_cast<int32_t>(c);
+    for (int64_t j = 0; j < n_ops; j++) {
+        int64_t row = obj_blk[j] == 0 ? root_row[chg_doc[opchg[j]]]
+                                      : omap[obj_blk[j]];
+        objrow[j] = row;
+        if (row < 0 || obj_doc[row] != chg_doc[opchg[j]]) {
+            s->err = kErrCrossDoc;
+            s->err_payload = obj_blk[j];
+            return s;
+        }
+    }
+
+    amst_mark("p0");
+    // ---- P1: partition ops ----
+    std::vector<int64_t> ins_rows;
+    for (int64_t j = 0; j < n_ops; j++) {
+        int8_t a = action[j];
+        if (a >= kStMake) continue;
+        if (a == kStIns) ins_rows.push_back(j);
+        else s->a_rows.push_back(j);
+    }
+    int64_t n_ins = static_cast<int64_t>(ins_rows.size());
+    int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
+
+    amst_mark("p1");
+    // ---- P2: ins target type check (ins order, like numpy) ----
+    for (int64_t j : ins_rows)
+        if (obj_type[objrow[j]] == kTMap) {
+            s->err = kErrInsIntoMap;
+            s->err_payload = objrow[j];
+            return s;
+        }
+
+    // ---- P3: late-bound string elemIds -> numpy fallback. Order
+    // matters: numpy processes ins parents (B) before assignment
+    // conversions (C), and either can need the store's actor_of
+    // dict — bail before any downstream error can fire out of
+    // numpy's order. ----
+    for (int64_t j : ins_rows)
+        if (key_kind[j] == kKStr) {
+            s->fallback = true;
+            return s;
+        }
+    for (int64_t j : s->a_rows)
+        if (key_kind[j] == kKStr && obj_type[objrow[j]] != kTMap) {
+            s->fallback = true;
+            return s;
+        }
+
+    // ---- P4: assignment kind checks (numpy assign-prep order) ----
+    for (int64_t j : s->a_rows)
+        if (key_kind[j] == kKHead) {
+            s->err = kErrHeadAssign;
+            return s;
+        }
+    for (int64_t j : s->a_rows)
+        if (key_kind[j] == kKElem && obj_type[objrow[j]] == kTMap) {
+            s->err = kErrMissingIndex;
+            return s;
+        }
+
+    amst_mark("p2-4");
+    // ---- P5: ins grouping (stable by object) + local node minting ----
+    std::vector<int64_t> g_rows(ins_rows);
+    bool monotonic = true;
+    for (int64_t i = 1; i < n_ins; i++)
+        if (objrow[g_rows[i]] < objrow[g_rows[i - 1]]) {
+            monotonic = false;
+            break;
+        }
+    if (!monotonic) {
+        std::vector<int64_t> gkey(n_ins), gidx(n_ins);
+        for (int64_t i = 0; i < n_ins; i++) {
+            gkey[i] = objrow[ins_rows[i]];
+            gidx[i] = i;
+        }
+        radix_sort_pairs(gkey, gidx);
+        for (int64_t i = 0; i < n_ins; i++)
+            g_rows[i] = ins_rows[gidx[i]];
+    }
+    s->g_obj.resize(n_ins);
+    s->g_local.resize(n_ins);
+    s->g_parent.assign(n_ins, 0);
+    s->g_elem.resize(n_ins);
+    s->g_actor.resize(n_ins);
+    std::vector<int64_t> new_key(n_ins), p_key(n_ins);
+    std::vector<int64_t> run_obj;        // distinct ins objects, asc
+    std::vector<int64_t> run_newcnt;
+    std::vector<int64_t> run_lo;         // g-coord start of each run
+    std::vector<int64_t> node_of_op(n_ops, -1);   // minted local per op
+    for (int64_t i = 0; i < n_ins; i++) {
+        int64_t j = g_rows[i];
+        int64_t o = objrow[j];
+        if (run_obj.empty() || run_obj.back() != o) {
+            run_obj.push_back(o);
+            run_newcnt.push_back(0);
+            run_lo.push_back(i);
+        }
+        int64_t local = n_of[o] + run_newcnt.back();
+        run_newcnt.back()++;
+        s->g_obj[i] = o;
+        s->g_local[i] = local;
+        node_of_op[j] = local;
+        int32_t act = chg_actor[opchg[j]];
+        s->g_actor[i] = act;
+        s->g_elem[i] = elem[j];
+        new_key[i] = (static_cast<int64_t>(act) << 32) | elem[j];
+        p_key[i] = key_kind[j] == kKHead
+            ? -1
+            : ((static_cast<int64_t>(a_tab[key[j]]) << 32) | key_elem[j]);
+    }
+
+    amst_mark("p5");
+    // ---- P6: dirty objects = ins targets U element-assign targets ----
+    std::vector<int32_t> run_of(n_store_objs, -1);   // obj -> ins run
+    {
+        std::vector<uint8_t> seen(n_store_objs, 0);
+        std::vector<int64_t> d(run_obj);
+        for (size_t r = 0; r < run_obj.size(); r++) {
+            seen[run_obj[r]] = 1;
+            run_of[run_obj[r]] = static_cast<int32_t>(r);
+        }
+        for (int64_t j : s->a_rows)
+            if (key_kind[j] == kKElem && !seen[objrow[j]]) {
+                seen[objrow[j]] = 1;
+                d.push_back(objrow[j]);
+            }
+        std::sort(d.begin(), d.end());
+        s->dirty = std::move(d);
+    }
+    int64_t K = static_cast<int64_t>(s->dirty.size());
+
+    // ---- P7: elemId resolution. Minted keys sort PER OBJECT RUN —
+    // the duplicate check is run adjacency and residue lookups binary-
+    // search the run (no global composite sort); existing-node tables
+    // build LAZILY per object (only when a minted elem falls inside
+    // the object's known elem range, or a residue lookup misses the
+    // minted table) — the collaborative-typing stream touches neither.
+    std::vector<int64_t> t_key(n_ar, -1);  // elem-assignment target keys
+    for (int64_t i = 0; i < n_ar; i++) {
+        int64_t j = s->a_rows[i];
+        if (key_kind[j] == kKElem)
+            t_key[i] = (static_cast<int64_t>(a_tab[key[j]]) << 32)
+                | key_elem[j];
+    }
+    run_lo.push_back(n_ins);
+    int64_t n_runs = static_cast<int64_t>(run_obj.size());
+    std::vector<int64_t> mint_key(n_ins);
+    std::vector<int32_t> mint_local(n_ins);
+    {
+        std::vector<std::pair<int64_t, int32_t>> scratch;
+        for (int64_t r = 0; r < n_runs; r++) {
+            int64_t lo = run_lo[r], hi = run_lo[r + 1];
+            scratch.clear();
+            scratch.reserve(hi - lo);
+            bool sorted = true;
+            for (int64_t i = lo; i < hi; i++) {
+                if (i > lo && new_key[i] <= new_key[i - 1])
+                    sorted = false;
+                scratch.emplace_back(
+                    new_key[i], static_cast<int32_t>(s->g_local[i]));
+            }
+            if (!sorted) std::sort(scratch.begin(), scratch.end());
+            for (int64_t i = 0; i < hi - lo; i++) {
+                if (i && scratch[i].first == scratch[i - 1].first) {
+                    s->err = kErrDupElem;
+                    return s;
+                }
+                mint_key[lo + i] = scratch[i].first;
+                mint_local[lo + i] = scratch[i].second;
+            }
+        }
+    }
+    auto mint_lookup = [&](int64_t o, int64_t k) -> int64_t {
+        int32_t r = run_of[o];
+        if (r < 0) return -1;
+        const int64_t* lo = mint_key.data() + run_lo[r];
+        const int64_t* hi = mint_key.data() + run_lo[r + 1];
+        const int64_t* it = std::lower_bound(lo, hi, k);
+        if (it == hi || *it != k) return -1;
+        return mint_local[it - mint_key.data()];
+    };
+    // lazy existing-node tables: obj row -> sorted (key, local)
+    std::unordered_map<int64_t,
+        std::vector<std::pair<int64_t, int32_t>>> old_tabs;
+    auto old_tab = [&](int64_t o)
+            -> const std::vector<std::pair<int64_t, int32_t>>& {
+        auto it = old_tabs.find(o);
+        if (it != old_tabs.end()) return it->second;
+        auto& tab = old_tabs[o];
+        int64_t lo = std::lower_bound(pos_sorted, pos_sorted + n_nodes,
+                                      o << 32) - pos_sorted;
+        int64_t cnt = n_of[o];
+        tab.reserve(cnt);
+        for (int64_t p = lo; p < lo + cnt; p++) {
+            int64_t row = pos_row[p];
+            if (p_actor[row] < 0) continue;          // virtual head
+            tab.emplace_back(
+                (static_cast<int64_t>(p_actor[row]) << 32)
+                    | p_elemc[row],
+                p_local[row]);
+        }
+        std::sort(tab.begin(), tab.end());
+        return tab;
+    };
+    auto old_lookup = [&](int64_t o, int64_t k) -> int64_t {
+        const auto& tab = old_tab(o);
+        auto it = std::lower_bound(
+            tab.begin(), tab.end(),
+            std::make_pair(k, std::numeric_limits<int32_t>::min()));
+        return (it != tab.end() && it->first == k) ? it->second : -1;
+    };
+    // duplicate vs existing nodes: only keys inside the object's known
+    // elem range can collide (elemIds are (actor, counter) pairs and
+    // max_elem_of bounds every existing counter)
+    for (int64_t i = 0; i < n_ins; i++) {
+        int64_t o = s->g_obj[i];
+        if (s->g_elem[i] <= max_elem_of[o] &&
+                old_lookup(o, new_key[i]) >= 0) {
+            s->err = kErrDupElem;
+            return s;
+        }
+    }
+    // parent resolution (grouped order): head -> node 0; peephole —
+    // parent minted by the previous ins of the same object; residue ->
+    // minted table, then existing nodes
+    for (int64_t i = 0; i < n_ins; i++) {
+        if (p_key[i] == -1) continue;                // _head
+        if (i > 0 && s->g_obj[i] == s->g_obj[i - 1]
+                && p_key[i] == new_key[i - 1]) {
+            s->g_parent[i] = s->g_local[i - 1];
+            continue;
+        }
+        int64_t got = mint_lookup(s->g_obj[i], p_key[i]);
+        if (got < 0) got = old_lookup(s->g_obj[i], p_key[i]);
+        if (got < 0) {
+            s->err = kErrUnknownParent;
+            return s;
+        }
+        s->g_parent[i] = got;
+    }
+
+    amst_mark("p7");
+    // ---- P8: assignment staging (op order): field keys + targets ----
+    s->o_field.resize(n_ar);
+    s->a_node.assign(n_ar, -1);
+    s->a_objrow.resize(n_ar);
+    s->a_local.resize(n_ar);
+    s->a_seq.resize(n_ar);
+    s->a_del.resize(n_ar);
+    for (int64_t i = 0; i < n_ar; i++) {
+        int64_t j = s->a_rows[i];
+        int64_t o = objrow[j];
+        int64_t fkey;
+        if (key_kind[j] == kKElem) {
+            int64_t node = -1;
+            // peephole: target minted by the immediately preceding op
+            // (an ins on the same object)
+            if (j > 0 && action[j - 1] == kStIns && objrow[j - 1] == o
+                    && node_of_op[j - 1] >= 0) {
+                int64_t pk = (static_cast<int64_t>(
+                    chg_actor[opchg[j - 1]]) << 32) | elem[j - 1];
+                if (pk == t_key[i]) node = node_of_op[j - 1];
+            }
+            if (node < 0) node = mint_lookup(o, t_key[i]);
+            if (node < 0) node = old_lookup(o, t_key[i]);
+            if (node < 0) {
+                s->err = kErrMissingIndex;
+                return s;
+            }
+            s->a_node[i] = node;
+            fkey = kElemBit | node;
+        } else {
+            fkey = k_tab[key[j]];
+        }
+        s->o_field[i] = (o << 32) | fkey;
+        s->a_objrow[i] = o;
+        s->a_local[i] = chg_local[opchg[j]];
+        s->a_seq[i] = chg_seq[opchg[j]];
+        s->a_del[i] = action[j] == kStDel;
+        s->max_seq = std::max<int64_t>(s->max_seq, s->a_seq[i]);
+    }
+
+    amst_mark("p8");
+    // ---- P9: stable field sort -> order / touched / segments ----
+    {
+        std::vector<int64_t> fkeys(s->o_field);
+        s->order.resize(n_ar);
+        for (int64_t i = 0; i < n_ar; i++) s->order[i] = i;
+        radix_sort_pairs(fkeys, s->order);
+        s->r_seg.resize(n_ar);
+        s->seg_new.resize(n_ar);
+        int32_t seg = -1;
+        int64_t prev = -1;
+        for (int64_t i = 0; i < n_ar; i++) {
+            if (i == 0 || fkeys[i] != prev) {
+                seg++;
+                s->touched.push_back(fkeys[i]);
+                prev = fkeys[i];
+            }
+            s->r_seg[i] = seg;
+            s->seg_new[s->order[i]] = seg;
+        }
+    }
+
+    amst_mark("p9");
+    // ---- P10: job table + new-node d-planes ----
+    // per-dirty minted counts
+    s->new_cnt.assign(K, 0);
+    for (size_t r = 0; r < run_obj.size(); r++) {
+        int64_t k = std::lower_bound(s->dirty.begin(), s->dirty.end(),
+                                     run_obj[r]) - s->dirty.begin();
+        s->new_cnt[k] = run_newcnt[r];
+    }
+    s->n_j.resize(K);
+    s->job_start.resize(K);
+    {
+        int64_t minted_before = 0;
+        for (int64_t k = 0; k < K; k++) {
+            int64_t o = s->dirty[k];
+            int64_t lo = std::lower_bound(pos_sorted,
+                                          pos_sorted + n_nodes,
+                                          o << 32) - pos_sorted;
+            s->job_start[k] = lo + minted_before;
+            s->n_j[k] = n_of[o] + s->new_cnt[k];
+            minted_before += s->new_cnt[k];
+        }
+    }
+    // d-planes: pool rows [n_old, n_nodes) merged with the minted
+    // nodes, sorted by (obj << 32 | local) — identical to numpy's
+    // final_pos order (all keys distinct). d_pos is the insert
+    // position into the OLD MIRROR table (n_old rows): entries of the
+    // pre-append pos table before the key, minus the post-mirror pool
+    // rows (which are themselves part of this delta) already merged.
+    {
+        int64_t n_pre = n_nodes - s->n_old;
+        std::vector<int64_t> xkey(n_pre), xrow(n_pre);
+        for (int64_t i = 0; i < n_pre; i++) {
+            int64_t row = s->n_old + i;
+            xkey[i] = (static_cast<int64_t>(p_obj[row]) << 32)
+                | p_local[row];
+            xrow[i] = row;
+        }
+        radix_sort_pairs(xkey, xrow);
+        int64_t d_n = n_pre + n_ins;
+        s->d_parent.resize(d_n);
+        s->d_elemc.resize(d_n);
+        s->d_actor.resize(d_n);
+        s->d_pos.resize(d_n);
+        int64_t xi = 0, yi = 0;
+        for (int64_t i = 0; i < d_n; i++) {
+            int64_t ykey = yi < n_ins
+                ? ((s->g_obj[yi] << 32) | s->g_local[yi])
+                : std::numeric_limits<int64_t>::max();
+            if (xi < n_pre && xkey[xi] < ykey) {
+                int64_t row = xrow[xi];
+                s->d_parent[i] = p_parent[row];
+                s->d_elemc[i] = p_elemc[row];
+                s->d_actor[i] = p_actor[row];
+                // the row sits in the pre table at its own lower_bound
+                s->d_pos[i] = (std::lower_bound(
+                    pos_sorted, pos_sorted + n_nodes, xkey[xi])
+                    - pos_sorted) - xi;
+                xi++;
+            } else {
+                s->d_parent[i] = static_cast<int32_t>(s->g_parent[yi]);
+                s->d_elemc[i] = static_cast<int32_t>(s->g_elem[yi]);
+                s->d_actor[i] = s->g_actor[yi];
+                s->d_pos[i] = (std::lower_bound(
+                    pos_sorted, pos_sorted + n_nodes, ykey)
+                    - pos_sorted) - xi;
+                yi++;
+            }
+        }
+    }
+    amst_mark("p10");
+    return s;
+}
+
+void amst_free(void* h) { delete static_cast<stage::Stager*>(h); }
+
+int64_t amst_err(void* h) { return static_cast<stage::Stager*>(h)->err; }
+int64_t amst_err_payload(void* h) {
+    return static_cast<stage::Stager*>(h)->err_payload;
+}
+int64_t amst_fallback(void* h) {
+    return static_cast<stage::Stager*>(h)->fallback ? 1 : 0;
+}
+int64_t amst_n_ins(void* h) {
+    return static_cast<int64_t>(
+        static_cast<stage::Stager*>(h)->g_obj.size());
+}
+int64_t amst_n_arows(void* h) {
+    return static_cast<int64_t>(
+        static_cast<stage::Stager*>(h)->a_rows.size());
+}
+int64_t amst_n_dirty(void* h) {
+    return static_cast<int64_t>(
+        static_cast<stage::Stager*>(h)->dirty.size());
+}
+int64_t amst_n_fields(void* h) {
+    return static_cast<int64_t>(
+        static_cast<stage::Stager*>(h)->touched.size());
+}
+int64_t amst_max_seq(void* h) {
+    return static_cast<stage::Stager*>(h)->max_seq;
+}
+int64_t amst_max_nj(void* h) {
+    auto* s = static_cast<stage::Stager*>(h);
+    int64_t m = 0;
+    for (int64_t v : s->n_j) m = std::max(m, v);
+    return m;
+}
+int64_t amst_d_n(void* h) {
+    return static_cast<int64_t>(
+        static_cast<stage::Stager*>(h)->d_parent.size());
+}
+
+void amst_fill_append(void* h, int64_t* g_obj, int64_t* g_local,
+                      int64_t* g_parent, int32_t* g_actor,
+                      int64_t* g_elem) {
+    auto* s = static_cast<stage::Stager*>(h);
+    size_t n = s->g_obj.size();
+    std::memcpy(g_obj, s->g_obj.data(), n * 8);
+    std::memcpy(g_local, s->g_local.data(), n * 8);
+    std::memcpy(g_parent, s->g_parent.data(), n * 8);
+    std::memcpy(g_actor, s->g_actor.data(), n * 4);
+    std::memcpy(g_elem, s->g_elem.data(), n * 8);
+}
+
+void amst_fill_res(void* h, int64_t* a_rows, int64_t* o_field,
+                   int64_t* seg_new, int64_t* a_node,
+                   int64_t* a_objrow) {
+    auto* s = static_cast<stage::Stager*>(h);
+    size_t n = s->a_rows.size();
+    std::memcpy(a_rows, s->a_rows.data(), n * 8);
+    std::memcpy(o_field, s->o_field.data(), n * 8);
+    std::memcpy(seg_new, s->seg_new.data(), n * 8);
+    std::memcpy(a_node, s->a_node.data(), n * 8);
+    std::memcpy(a_objrow, s->a_objrow.data(), n * 8);
+}
+
+void amst_fill_order(void* h, int64_t* order, int32_t* r_seg) {
+    auto* s = static_cast<stage::Stager*>(h);
+    std::memcpy(order, s->order.data(), s->order.size() * 8);
+    std::memcpy(r_seg, s->r_seg.data(), s->r_seg.size() * 4);
+}
+
+void amst_fill_fields(void* h, int64_t* touched) {
+    auto* s = static_cast<stage::Stager*>(h);
+    std::memcpy(touched, s->touched.data(), s->touched.size() * 8);
+}
+
+void amst_fill_dirty(void* h, int64_t* dirty, int64_t* n_j,
+                     int64_t* new_cnt) {
+    auto* s = static_cast<stage::Stager*>(h);
+    size_t n = s->dirty.size();
+    std::memcpy(dirty, s->dirty.data(), n * 8);
+    std::memcpy(n_j, s->n_j.data(), n * 8);
+    std::memcpy(new_cnt, s->new_cnt.data(), n * 8);
+}
+
+// d-planes for the cols fallback program: caller passes pre-padded
+// arrays (d_pos pre-filled with the cap sentinel); only d_n entries
+// are written.
+void amst_fill_dplanes(void* h, int32_t* d_parent, int32_t* d_elemc,
+                       int32_t* d_actor, int32_t* d_pos,
+                       int32_t* job_start, int32_t* n_j_arr) {
+    auto* s = static_cast<stage::Stager*>(h);
+    size_t d_n = s->d_parent.size();
+    std::memcpy(d_parent, s->d_parent.data(), d_n * 4);
+    std::memcpy(d_elemc, s->d_elemc.data(), d_n * 4);
+    std::memcpy(d_actor, s->d_actor.data(), d_n * 4);
+    for (size_t i = 0; i < d_n; i++)
+        d_pos[i] = static_cast<int32_t>(s->d_pos[i]);
+    for (size_t k = 0; k < s->dirty.size(); k++) {
+        job_start[k] = static_cast<int32_t>(s->job_start[k]);
+        n_j_arr[k] = static_cast<int32_t>(s->n_j[k]);
+    }
+}
+
+// Write the packed program's single wire buffer (byte-identical to
+// the numpy packing loop). Section layout must match _wire_sizes:
+//   i32: w1_new[d_pad] d_pos[d_pad] row_slot[n_pad] coo_row[nnz_pad]
+//        job_start[K] job_n[K]
+//   i16: w2e[d_pad] seq[n_pad] coo_val[nnz_pad]
+//   u8:  actor[n_pad] flags[2*(n_pad>>3)] coo_col[nnz_pad]
+// The three coo sections are left untouched (the caller owns the
+// admission-clock exceptions). Valid only for the no-prior-rows path:
+// n_rows == n_arows.
+void amst_fill_wire(void* h, uint8_t* wire, int64_t cap,
+                    int64_t d_pad, int64_t n_pad, int64_t K,
+                    int64_t nnz_pad, int64_t m_pad,
+                    const int64_t* ranks) {
+    auto* s = static_cast<stage::Stager*>(h);
+    int64_t d_n = static_cast<int64_t>(s->d_parent.size());
+    int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
+    int64_t Kd = static_cast<int64_t>(s->dirty.size());
+    uint8_t* p = wire;
+
+    auto i32 = [&](int64_t count) {
+        int32_t* out = reinterpret_cast<int32_t*>(p);
+        p += 4 * count;
+        return out;
+    };
+    int32_t* w1 = i32(d_pad);
+    for (int64_t i = 0; i < d_n; i++) {
+        int32_t rank1 = s->d_actor[i] >= 0
+            ? static_cast<int32_t>(ranks[s->d_actor[i]]) + 1 : 0;
+        w1[i] = (s->d_parent[i] << 16) | rank1;
+    }
+    // numpy pads the d-planes with zeros, so its padding rows compute
+    // w1 = (0 << 16) | (ranks[0] + 1) — replicate for byte parity
+    // (the rows are dead: their d_pos is the drop sentinel)
+    for (int64_t i = d_n; i < d_pad; i++)
+        w1[i] = static_cast<int32_t>(ranks[0]) + 1;
+    int32_t* dp = i32(d_pad);
+    for (int64_t i = 0; i < d_n; i++)
+        dp[i] = static_cast<int32_t>(s->d_pos[i]);
+    for (int64_t i = d_n; i < d_pad; i++)
+        dp[i] = static_cast<int32_t>(cap);
+    int32_t* slot = i32(n_pad);
+    {
+        // per-row (job, node) slots in field-sorted coordinates
+        for (int64_t i = 0; i < n_pad; i++) slot[i] = -1;
+        for (int64_t i = 0; i < n_ar; i++) {
+            int64_t row = s->order[i];
+            int64_t node = s->a_node[row];
+            if (node < 0) continue;
+            auto it = std::lower_bound(s->dirty.begin(), s->dirty.end(),
+                                       s->a_objrow[row]);
+            if (it == s->dirty.end() || *it != s->a_objrow[row])
+                continue;
+            slot[i] = static_cast<int32_t>(
+                (it - s->dirty.begin()) * m_pad + node);
+        }
+    }
+    i32(nnz_pad);                                    // coo_row: caller's
+    int32_t* js = i32(K);
+    int32_t* jn = i32(K);
+    std::memset(js, 0, 4 * K);
+    std::memset(jn, 0, 4 * K);
+    for (int64_t k = 0; k < Kd; k++) {
+        js[k] = static_cast<int32_t>(s->job_start[k]);
+        jn[k] = static_cast<int32_t>(s->n_j[k]);
+    }
+
+    auto i16 = [&](int64_t count) {
+        int16_t* out = reinterpret_cast<int16_t*>(p);
+        p += 2 * count;
+        return out;
+    };
+    int16_t* w2e = i16(d_pad);
+    for (int64_t i = 0; i < d_n; i++)
+        w2e[i] = static_cast<int16_t>(s->d_elemc[i]);
+    std::memset(w2e + d_n, 0, 2 * (d_pad - d_n));
+    int16_t* seq = i16(n_pad);
+    for (int64_t i = 0; i < n_ar; i++)
+        seq[i] = static_cast<int16_t>(s->a_seq[s->order[i]]);
+    std::memset(seq + n_ar, 0, 2 * (n_pad - n_ar));
+    i16(nnz_pad);                                    // coo_val: caller's
+
+    uint8_t* act = p;
+    p += n_pad;
+    for (int64_t i = 0; i < n_ar; i++)
+        act[i] = static_cast<uint8_t>(s->a_local[s->order[i]]);
+    std::memset(act + n_ar, 0, n_pad - n_ar);
+    uint8_t* flags = p;
+    p += 2 * (n_pad >> 3);
+    std::memset(flags, 0, 2 * (n_pad >> 3));
+    // boundary bits (MSB-first, np.packbits layout), then del bits
+    int64_t nb = n_pad >> 3;
+    for (int64_t i = 0; i < n_ar; i++) {
+        bool boundary = i == 0 || s->r_seg[i] != s->r_seg[i - 1];
+        if (boundary) flags[i >> 3] |= uint8_t(0x80) >> (i & 7);
+        if (s->a_del[s->order[i]])
+            flags[nb + (i >> 3)] |= uint8_t(0x80) >> (i & 7);
+    }
+    // coo_col section follows: caller's
+}
 
 }  // extern "C"

@@ -1,0 +1,371 @@
+"""Native staging pipeline: C++ staged planes must byte-match the
+numpy staging across the trace corpus, the numpy fallback must engage
+cleanly without the library, and the async applier must equal the sync
+path. (The perf_opt PR's parity gates.)"""
+
+import json
+
+import numpy as np
+import pytest
+
+from automerge_tpu import traces
+from automerge_tpu import native as amnative
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import general
+
+
+PLANE_KEYS = ('ops_actor', 'ops_seq', 'ops_slot', 'flags_u8',
+              'coo_row', 'coo_col', 'coo_val')
+SCALAR_KEYS = ('n_rows', 'num_segments', 'a_pad', 'm_pad', 'variant')
+
+needs_native = pytest.mark.skipif(not amnative.stage_available(),
+                                  reason='native stager unavailable')
+
+
+class _ForcedStaging:
+    """Force the stager choice + capture staged planes and the packed
+    wire buffers for one run."""
+
+    def __init__(self, force):
+        self.force = force
+        self.captures = []
+        self.wires = []
+
+    def __enter__(self):
+        self._mode = general._NATIVE_STAGING
+        self._capture = general._STAGE_CAPTURE
+        self._packed = general._fused_general_packed
+        general._NATIVE_STAGING = self.force
+        general._STAGE_CAPTURE = lambda c: self.captures.append(
+            {k: (np.asarray(c[k]).copy()
+                 if k in PLANE_KEYS else c[k])
+             for k in PLANE_KEYS + SCALAR_KEYS})
+
+        def spy(w1m, w2m, wire, *a, **k):
+            self.wires.append(np.asarray(wire).copy())
+            return self._packed(w1m, w2m, wire, *a, **k)
+
+        general._fused_general_packed = spy
+        return self
+
+    def __exit__(self, *exc):
+        general._NATIVE_STAGING = self._mode
+        general._STAGE_CAPTURE = self._capture
+        general._fused_general_packed = self._packed
+
+
+def _corpus_blocks():
+    """Per-store lists of change batches covering the full op surface:
+    editing traces (ins/set/del, elemIds, head inserts), multi-actor
+    interleavings, nested objects, links, conflicts, deletions."""
+    out = []
+
+    # 1. editing traces, two actors, two docs
+    t1 = traces.gen_editing_trace(120, actor='alice', seed=1)
+    t2 = traces.gen_editing_trace(90, actor='bob', seed=2,
+                                  obj='00000000-0000-4000-8000-0000000000bb')
+    out.append(('traces', 2, [[t1, t2]]))
+
+    # 2. nested maps + lists + links + conflicts, applied in two waves
+    la, lb = ('aaaaaaaa-0000-4000-8000-000000000001',
+              'bbbbbbbb-0000-4000-8000-000000000002')
+    wave1 = [[
+        {'actor': 'w0', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': la},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+             'value': la},
+            {'action': 'ins', 'obj': la, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': la, 'key': 'w0:1', 'value': 'a'},
+            {'action': 'ins', 'obj': la, 'key': 'w0:1', 'elem': 2},
+            {'action': 'set', 'obj': la, 'key': 'w0:2', 'value': 'b'},
+            {'action': 'makeMap', 'obj': lb},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'meta',
+             'value': lb},
+            {'action': 'set', 'obj': lb, 'key': 'k', 'value': 1},
+        ]},
+        {'actor': 'w1', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'title',
+             'value': 'one'},
+        ]},
+    ]]
+    wave2 = [[
+        # concurrent set on the same field (conflict), a delete of a
+        # list element, a head insert racing the existing chain
+        {'actor': 'w2', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'title',
+             'value': 'two'},
+            {'action': 'del', 'obj': la, 'key': 'w0:1'},
+            {'action': 'ins', 'obj': la, 'key': '_head', 'elem': 3},
+            {'action': 'set', 'obj': la, 'key': 'w2:3', 'value': 'c'},
+        ]},
+        {'actor': 'w0', 'seq': 2, 'deps': {'w1': 1}, 'ops': [
+            {'action': 'ins', 'obj': la, 'key': 'w0:2', 'elem': 4},
+            {'action': 'set', 'obj': la, 'key': 'w0:4', 'value': 'd'},
+        ]},
+    ]]
+    out.append(('nested', 1, [wave1, wave2]))
+
+    # 3. many docs, object grouping NOT in block order (doc interleave)
+    per_doc = []
+    for d in range(6):
+        obj = f'00000000-0000-4000-8000-{d:012x}'
+        ops = [{'action': 'makeText', 'obj': obj},
+               {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                'value': obj},
+               {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+               {'action': 'set', 'obj': obj, 'key': f'e{d}:1',
+                'value': chr(97 + d)}]
+        per_doc.append([{'actor': f'e{d}', 'seq': 1, 'deps': {},
+                         'ops': ops}])
+    out.append(('multidoc', 6, [per_doc]))
+    return out
+
+
+@needs_native
+def test_native_planes_byte_match_numpy():
+    """The acceptance gate: native-staged planes (and the whole packed
+    wire buffer) byte-match the numpy staging across the corpus, and
+    the patch/field output is identical."""
+    for name, n_docs, waves in _corpus_blocks():
+        results = {}
+        for force in (True, False):
+            with _ForcedStaging(force) as f:
+                store = general.init_store(n_docs)
+                patches = []
+                for wave in waves:
+                    block = store.encode_changes(wave)
+                    p = general.apply_general_block(store, block)
+                    p.block_until_ready()
+                    patches.append(p.to_patches())
+                fields = [store.doc_fields(d) for d in range(n_docs)]
+            results[force] = (f.captures, f.wires, patches, fields)
+
+        nat, np_ = results[True], results[False]
+        assert len(nat[0]) == len(np_[0])
+        for ci, (ca, cb) in enumerate(zip(nat[0], np_[0])):
+            for k in PLANE_KEYS:
+                a, b = ca[k], cb[k]
+                assert a.dtype == b.dtype, (name, ci, k)
+                assert a.shape == b.shape, (name, ci, k)
+                assert (a == b).all(), (name, ci, k)
+            for k in SCALAR_KEYS:
+                assert ca[k] == cb[k], (name, ci, k)
+        assert len(nat[1]) == len(np_[1])
+        for wi, (wa, wb) in enumerate(zip(nat[1], np_[1])):
+            assert wa.shape == wb.shape, (name, wi)
+            assert (wa == wb).all(), (name, wi, 'wire bytes')
+        assert nat[2] == np_[2], name
+        assert nat[3] == np_[3], name
+
+
+@needs_native
+def test_native_staging_actually_ran():
+    """_NATIVE_STAGING=True raises when the stager would silently fall
+    back — so the parity test above really exercises the C++ path."""
+    from automerge_tpu.utils.metrics import metrics
+    before = metrics.counters.get('general_stage_native_batches', 0)
+    with _ForcedStaging(True):
+        store = general.init_store(1)
+        block = store.encode_changes(
+            [[traces.gen_editing_trace(50, seed=5)[0]]])
+        general.apply_general_block(store, block).block_until_ready()
+    assert metrics.counters.get('general_stage_native_batches', 0) \
+        == before + 1
+
+
+def test_numpy_fallback_without_library():
+    """With the staging library unavailable the numpy path must engage
+    cleanly and produce the same store state."""
+    t = traces.gen_editing_trace(200, seed=9)
+    saved = (amnative._STAGE_LIB, amnative._STAGE_ATTEMPTED)
+    try:
+        amnative._STAGE_LIB = None
+        amnative._STAGE_ATTEMPTED = True        # stage_lib() -> None
+        assert not amnative.stage_available()
+        store = general.init_store(1)
+        block = store.encode_changes([t])
+        p = general.apply_general_block(store, block)
+        p.block_until_ready()
+        no_lib_fields = store.doc_fields(0)
+        no_lib_patch = p.patch(0)
+    finally:
+        amnative._STAGE_LIB, amnative._STAGE_ATTEMPTED = saved
+    store2 = general.init_store(1)
+    p2 = general.apply_general_block(store2, store2.encode_changes([t]))
+    p2.block_until_ready()
+    assert store2.doc_fields(0) == no_lib_fields
+    assert p2.patch(0) == no_lib_patch
+
+
+@needs_native
+def test_queued_block_falls_back_and_retries():
+    """A causally-unready change (admission queues it) forces the
+    numpy path; the retry applies it identically on both stagers."""
+    chg1 = {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 1}]}
+    chg3 = {'actor': 'a', 'seq': 3, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 3}]}
+    chg2 = {'actor': 'a', 'seq': 2, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 2}]}
+    fields = {}
+    for force in (None, False):
+        general._NATIVE_STAGING = force
+        try:
+            store = general.init_store(1)
+            general.apply_general_block(
+                store, store.encode_changes([[chg1, chg3]]))
+            assert len(store.queue) == 1       # seq 3 buffered
+            general.apply_general_block(
+                store, store.encode_changes([[chg2]]))
+            assert not store.queue
+            store._commit_pending()
+            fields[force] = store.doc_fields(0)
+        finally:
+            general._NATIVE_STAGING = None
+    assert fields[None] == fields[False]
+    assert fields[None][(ROOT_ID, 'x')] == [('a', 3)]
+
+
+def test_async_apply_equals_sync_and_survives_errors():
+    n, k = 32, 3
+    wide = n * k
+    blocks = []
+    for i in range(k):
+        s = general.init_store(wide)
+        per_doc = [[] for _ in range(wide)]
+        for d in range(i * n, (i + 1) * n):
+            per_doc[d] = traces.gen_editing_trace(
+                20, actor=f'w{d}', seed=d,
+                obj=f'00000000-0000-4000-8000-{d:012x}')
+        blocks.append(s.encode_changes(per_doc))
+
+    store = general.init_store(wide)
+    futs = [general.apply_general_block_async(store, b) for b in blocks]
+    async_diffs = []
+    for i, f in enumerate(futs):
+        async_diffs.append([f.diffs(d)
+                            for d in range(i * n, (i + 1) * n)])
+    general.drain_general(store)
+
+    store2 = general.init_store(wide)
+    sync_diffs = []
+    for i, b in enumerate(blocks):
+        p = general.apply_general_block(store2, b)
+        sync_diffs.append([p.diffs(d)
+                           for d in range(i * n, (i + 1) * n)])
+    assert async_diffs == sync_diffs
+    for d in range(wide):
+        assert store.doc_fields(d) == store2.doc_fields(d)
+
+    # a failing async apply rolls back and surfaces on ITS future only
+    bad_block = store.encode_changes(
+        [[{'actor': 'z', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': ROOT_ID, 'key': '_head',
+             'elem': 1}]}]] + [[] for _ in range(wide - 1)])
+    fut = general.apply_general_block_async(store, bad_block)
+    with pytest.raises(ValueError):
+        fut.result()
+    ok = general.apply_general_block_async(store, store.encode_changes(
+        [[{'actor': 'z', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'ok',
+             'value': True}]}]] + [[] for _ in range(wide - 1)]))
+    ok.block_until_ready()
+    general.drain_general(store)
+    assert store.doc_fields(0)[(ROOT_ID, 'ok')] == [('z', True)]
+
+
+def test_docset_apply_wire():
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+    t1 = traces.gen_editing_trace(60, actor='alice', seed=3)
+    t2 = traces.gen_editing_trace(40, actor='bob', seed=4,
+                                  obj='00000000-0000-4000-8000-0000000000bb')
+    data = json.dumps([t1, t2])
+    ds = GeneralDocSet(8)
+    handles = ds.apply_wire(data, doc_ids=['d1', 'd2'])
+    assert len(handles) == 2
+    # oracle: the same changes through the dict edge
+    ds2 = GeneralDocSet(8)
+    ds2.apply_changes('d1', t1)
+    ds2.apply_changes('d2', t2)
+    assert ds.materialize('d1') == ds2.materialize('d1')
+    assert ds.materialize('d2') == ds2.materialize('d2')
+
+
+def test_bulk_routed_state_rejected_by_batch_facade():
+    """Satellite: apply_changes_batch must fail loudly on a
+    GeneralBackendState instead of an opaque AttributeError, and the
+    auto-routed facade patch must be a PLAIN list (json-serializable,
+    concatenable)."""
+    from automerge_tpu.config import Options
+    from automerge_tpu.device import backend as DeviceBackend
+
+    changes = [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': f'k{i}', 'value': i}
+        for i in range(40)]}]
+    opts = Options(bulk_route_min_ops=10)
+    state, patch = DeviceBackend.apply_changes(
+        DeviceBackend.init(), changes, options=opts)
+    from automerge_tpu.device import general_backend as gb
+    assert isinstance(state, gb.GeneralBackendState)
+    assert type(patch['diffs']) is list
+    json.dumps(patch)                        # plain JSON round-trips
+    assert (patch['diffs'] + [])[:1] == patch['diffs'][:1]
+
+    with pytest.raises(TypeError, match='GeneralBackendState'):
+        DeviceBackend.apply_changes_batch([state], [changes])
+
+
+def test_undo_stacks_copied_on_new_token():
+    """Satellite: a new token's undo/redo stacks are COPIES — an
+    in-place append on one token must not leak into the other."""
+    from automerge_tpu.device import general_backend as gb
+    s0 = gb.init()
+    s1, _ = gb.apply_changes(s0, [
+        {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'x', 'value': 1}]}])
+    s1.undo_stack.append([{'action': 'del', 'obj': ROOT_ID, 'key': 'x'}])
+    s1.undo_pos = 1
+    s2, _ = gb.apply_changes(s1, [
+        {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'y', 'value': 2}]}])
+    s2.undo_stack.append(['sentinel'])
+    assert len(s1.undo_stack) == 1           # not corrupted by s2
+    s2.redo_stack.append(['sentinel2'])
+    assert s1.redo_stack == []
+
+
+def test_resume_mirror_respects_packed_guard():
+    """Satellite: a snapshot-resumed store whose widest document holds
+    >256 actors materializes a COLS mirror directly (the apply path
+    could never keep a packed one)."""
+    store = general.init_store(1)
+    per_doc = [[]]
+    ops = [{'action': 'makeList',
+            'obj': 'cccccccc-0000-4000-8000-000000000001'},
+           {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+            'value': 'cccccccc-0000-4000-8000-000000000001'},
+           {'action': 'ins',
+            'obj': 'cccccccc-0000-4000-8000-000000000001',
+            'key': '_head', 'elem': 1}]
+    per_doc[0] = [{'actor': 'actor-000', 'seq': 1, 'deps': {},
+                   'ops': ops}]
+    # 300 actors each touch one root field
+    for i in range(1, 300):
+        per_doc[0].append(
+            {'actor': f'actor-{i:03d}', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': f'f{i}',
+                 'value': i}]})
+    general.apply_general_block(store, store.encode_changes(per_doc)) \
+        .block_until_ready()
+    data = store.save_snapshot()
+    resumed = general.GeneralStore.load_snapshot(data)
+    assert resumed.pool.mirror is not None
+    assert resumed.pool.mirror['fmt'] == 'cols'
+    # and a small store stays packed
+    store2 = general.init_store(1)
+    general.apply_general_block(store2, store2.encode_changes(
+        [[traces.gen_editing_trace(20, seed=11)[0]]])) \
+        .block_until_ready()
+    resumed2 = general.GeneralStore.load_snapshot(
+        store2.save_snapshot())
+    assert resumed2.pool.mirror['fmt'] == 'packed'
