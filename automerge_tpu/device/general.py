@@ -229,10 +229,15 @@ class _SeqPool:
                 return
             self._host_epoch = self._epoch
             n = self.mirror['n']
-            if self.mirror.get('fmt') == 'packed':
+            fmt = self.mirror.get('fmt')
+            if fmt == 'packed':
                 # ONE 4B/node fetch; the vis word host-unpacks for free
                 w2 = np.asarray(jax.device_get(self.mirror['w2'][:n]))
                 vis, idx = unpack_w2_word(w2)
+            elif fmt == 'wide':
+                # same 4B/node fetch: W2 carries visible + vis_index
+                w2 = np.asarray(jax.device_get(self.mirror['w2'][:n]))
+                vis, idx = unpack_wide_word(w2)
             else:
                 vis, idx = jax.device_get(
                     (self.mirror['visible'][:n],
@@ -594,9 +599,8 @@ class GeneralStore(BlockStore):
             a_width = int(np.diff(starts).max())
         else:
             a_width = 1
-        use_packed = _packed_mirror_guard(
-            pool, n_act, opts.pad_actors(max(a_width, 1)))
-        if use_packed:
+        a_pad = opts.pad_actors(max(a_width, 1))
+        if _packed_mirror_guard(pool, n_act, a_pad):
             ranks = np.asarray(self.actor_str_ranks())
             actor = pool.actor[rows]
             rank1 = np.where(actor >= 0,
@@ -613,6 +617,26 @@ class GeneralStore(BlockStore):
                 'fmt': 'packed', 'cap': cap, 'n': n,
                 'w1': jnp.asarray(w1), 'w2': jnp.asarray(w2),
                 'ranks': ranks.copy(), 'pos_row': pool.pos_row}
+        elif _wide_mirror_guard(pool, n_act, a_pad):
+            # a resumed long-text store builds the wide mirror
+            # DIRECTLY — it must not start on cols and upgrade later
+            actor1 = pool.actor[rows].astype(np.int32) + 1
+            w1 = np.zeros(cap, np.int32)
+            w1[:n] = (pool.parent[rows].astype(np.int32)
+                      << _WIDE_PARENT_SHIFT) | (actor1 & _WIDE_ALO_MASK)
+            w2 = np.zeros(cap, np.int32)
+            w2[:n] = ((actor1 >> 10) << _WIDE_AHI_SHIFT) | \
+                (pool.visible[rows].astype(np.int32)
+                 << _WIDE_VIS_SHIFT) | \
+                (pool.vis_index[rows].astype(np.int32) + 1)
+            w3 = np.zeros(cap, np.int32)
+            w3[:n] = pool.elemc[rows]
+            self.pool.mirror = {
+                'fmt': 'wide', 'cap': cap, 'n': n,
+                'w1': jnp.asarray(w1), 'w2': jnp.asarray(w2),
+                'w3': jnp.asarray(w3),
+                'rank_n': n_act, 'rank_table': _rank_table(self, opts),
+                'pos_row': pool.pos_row}
         else:
             def col(src, fill, dtype):
                 out = np.full(cap, fill, dtype)
@@ -629,6 +653,23 @@ class GeneralStore(BlockStore):
                 'rank_n': n_act,
                 'rank_table': _rank_table(self, opts),
                 'pos_row': pool.pos_row}
+
+    # -- capacity ------------------------------------------------------------
+
+    def grow_docs(self, n_docs):
+        """Widen the document axis in place. The store's per-document
+        state is sparse (COO clock rows, doc-tagged entries, per-row
+        object table), so growth only extends the root-row table — an
+        existing fleet keeps its indexes and its resident mirror."""
+        if n_docs <= self.n_docs:
+            return
+        if n_docs >= (1 << 22):
+            raise ValueError('store exceeds the 4M-document key space')
+        with self._host_lock:
+            pad = n_docs - self.n_docs
+            self._root_row = np.concatenate(
+                [self._root_row, np.full(pad, -1, np.int64)])
+            self.n_docs = n_docs
 
     # -- objects -------------------------------------------------------------
 
@@ -1062,25 +1103,54 @@ def _fused_general_resident(m_parent, m_elemc, m_actor, m_visible,
 
 # -- packed fused step -------------------------------------------------------
 #
-# The wire-packed variant of the resident program: the binding costs at
+# The wire-packed variants of the resident program: the binding costs at
 # block scale are (a) tunnel H2D bytes and per-array transfer overhead,
 # (b) the count of million-element gathers/scatters on device (~4ns/elem
-# on v5e, ~100x an elementwise op). So the mirror packs into TWO int32
+# on v5e, ~100x an elementwise op). So the mirror packs into a few int32
 # words per node, every staged input rides ONE uint8 buffer (sliced +
 # bitcast on device — elementwise, fuses), the field resolution rides
 # segmented associative scans instead of segment_max scatters, and the
 # small-tree RGA one-hots run in bf16 (exact: all values <= 256).
 #
+# TWO packed layouts share that design; the host pick is per apply:
+#
+# 'packed' — 2 words/node, the small-tree fast path:
 #   W1 = parent << 16 | (rank+1)      rank = actor string rank; head = 0
 #   W2 = visible << 30 | (vis_index+1) << 15 | elemc
+#   Guards: tree size <= 32767 nodes, elemc < 32768, actor count
+#   < 65535, per-doc actor slots <= 256, seq < 32768, coo seq < 32768.
 #
-# Guards (host checks; the unpacked `_fused_general_resident` is the
-# fallback for wider shapes): tree size <= 32767 nodes,
-# elemc < 32768, actor count < 65535, seq < 32768, coo seq < 32768.
+# 'wide' — 3 words/node, the long-text format (the bounds lift): trees
+# to 2^22 - 1 nodes, elemc and seq bounded only by int32. The words
+# carry the STABLE actor id (+1; 0 = head) split 10/6 across W1/W2
+# instead of the rank, so a growing actor table never remaps the
+# mirror — the RGA rank comes from the small rank_table gather instead:
+#   W1 = parent << 10 | (actor+1) & 0x3FF
+#   W2 = ((actor+1) >> 10) << 23 | visible << 22 | (vis_index+1)
+#   W3 = elemc
+#   Guards: tree size <= 2^22 - 1 nodes, actor count < 65535, per-doc
+#   actor slots <= 256 (the u8 row-staging dtype). seq/coo seq ride
+#   int32 wire sections, elemc is a full int32 word.
+#
+# The unpacked `_fused_general_resident` (cols) remains the fallback
+# for shapes past both (>4M-node trees, >65535 actors, >256 per-doc
+# actor slots), and the independent cross-check of the packed FORMATS
+# (bit fields, wire layout, dtype narrowing). A store crossing a bound
+# mid-stream converts its resident mirror in place (`_mirror_convert`)
+# — packed -> wide is the boundary a long text document crosses.
 
 _W2_ELEM = 0x7FFF
 _W2_VIS_SHIFT = 30
 _W2_IDX_SHIFT = 15
+
+# wide-format bit layout (see the module comment above)
+_WIDE_IDX_MASK = (1 << 22) - 1       # vis_index+1 (W2) / parent width
+_WIDE_VIS_SHIFT = 22
+_WIDE_AHI_SHIFT = 23
+_WIDE_AHI_BITS = 0x3F << _WIDE_AHI_SHIFT
+_WIDE_ALO_MASK = (1 << 10) - 1
+_WIDE_PARENT_SHIFT = 10
+_WIDE_MAX_TREE = (1 << 22) - 1
 
 _NO_REMAP = np.zeros(1, np.int32)     # placeholder when has_remap=False
 
@@ -1100,6 +1170,15 @@ def unpack_w2_word(w2):
     """Host-side unpack of a mirror W2 word: (visible, vis_index)."""
     vis = ((w2 >> _W2_VIS_SHIFT) & 1).astype(bool)
     idx = (((w2 >> _W2_IDX_SHIFT) & _W2_ELEM) - 1).astype(np.int32)
+    return vis, idx
+
+
+def unpack_wide_word(w):
+    """Host-side unpack of a WIDE visibility word — the mirror W2 and
+    the wide program's vis output planes share the layout
+    ``visible << 22 | (idx + 1)``: (visible, vis_index)."""
+    vis = ((w >> _WIDE_VIS_SHIFT) & 1).astype(bool)
+    idx = ((w & _WIDE_IDX_MASK) - 1).astype(np.int32)
     return vis, idx
 
 # test/dryrun hook: called once per apply with the staged planes and the
@@ -1127,6 +1206,18 @@ def _packed_mirror_guard(pool, n_act, a_pad=None):
             and (a_pad is None or a_pad <= 256))
 
 
+def _wide_mirror_guard(pool, n_act, a_pad=None):
+    """The WIDE 3-word mirror format's bounds — the packed program for
+    everything the 2-word format cannot hold short of the cols
+    fallback: trees to 2^22 - 1 nodes; elemc, seq and closure seqs
+    bounded only by int32 (they ride full int32 wire sections). Shared
+    by the apply-time pick, `_materialize_mirror` (a resumed long-text
+    store builds the wide mirror DIRECTLY) and `_mirror_convert`."""
+    return (pool.max_tree <= _WIDE_MAX_TREE
+            and n_act < 65535
+            and (a_pad is None or a_pad <= 256))
+
+
 def _wire_sizes(d_pad, n_pad, K, nnz_pad):
     """Total byte count of the single staged wire buffer. Section
     offsets are not centralized: the host packing loop in
@@ -1141,6 +1232,24 @@ def _wire_sizes(d_pad, n_pad, K, nnz_pad):
     i16_n = d_pad + n_pad + nnz_pad
     u8_n = n_pad + 2 * (n_pad >> 3) + nnz_pad
     return 4 * i32_n + 2 * i16_n + u8_n
+
+
+def _wire_sizes_wide(d_pad, n_pad, K, nnz_pad):
+    """Byte count of the WIDE program's wire buffer. Same contract as
+    `_wire_sizes`: the host packing loop, the C++ `amst_fill_wire_wide`
+    and the device slicing in `_fused_general_wide` must list the
+    sections in THIS order (seq/coo_val widen to int32 — a long-lived
+    actor's seq exceeds 32767 at exactly the history length whose tree
+    needs this format):
+    i32: w1_new[d_pad] w3_new[d_pad] d_pos[d_pad] row_slot[n_pad]
+         seq[n_pad] coo_row[nnz_pad] coo_val[nnz_pad]
+         job_start[K] job_n[K]
+    u8:  ahi_new[d_pad] actor[n_pad] flags[2*(n_pad>>3)]
+         coo_col[nnz_pad]
+    """
+    i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
+    u8_n = d_pad + n_pad + 2 * (n_pad >> 3) + nnz_pad
+    return 4 * i32_n + u8_n
 
 
 @partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
@@ -1258,6 +1367,120 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
     return w1f, w2f, surv_u8, out['winner'], vis_packed
 
 
+@partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
+                                   'm_pad', 'has_old'))
+def _fused_general_wide(w1m, w2m, w3m, wire, n_old, n_rows, rank_table,
+                        *, sizes, num_segments, a_pad, m_pad, has_old):
+    """One apply against the WIDE 3-word packed mirror (trees to
+    2^22 - 1 nodes; elemc/seq bounded only by int32). Same program
+    shape as `_fused_general_packed` with the wide bit layout, int32
+    seq/coo wire sections and actor ids (stable) in the words instead
+    of ranks — the RGA rank rides the small `rank_table` gather, so a
+    growing actor table never remaps the mirror. Outputs: (w1', w2',
+    w3', surv_u8, winner[S], vis_prior[K, m_pad], vis_new[K, m_pad]);
+    each vis plane word is ``visible << 22 | (idx + 1)``
+    (`unpack_wide_word`)."""
+    from .merge import _resolve_sorted
+    from .sequence import _rga_order_batched
+    d_pad, n_pad, K, nnz_pad = sizes
+    cap = w1m.shape[0]
+    nb = n_pad >> 3
+
+    i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
+    i32v = jax.lax.bitcast_convert_type(
+        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+    u8v = wire[4 * i32_n:]
+
+    def cut(vec, state, cnt):
+        o = state[0]
+        state[0] = o + cnt
+        return vec[o:o + cnt]
+
+    s32, s8 = [0], [0]
+    w1d = cut(i32v, s32, d_pad)
+    w3d = cut(i32v, s32, d_pad)
+    d_pos = cut(i32v, s32, d_pad)
+    row_slot = cut(i32v, s32, n_pad)
+    seq = cut(i32v, s32, n_pad)
+    coo_row = cut(i32v, s32, nnz_pad)
+    coo_val = cut(i32v, s32, nnz_pad)
+    job_start = cut(i32v, s32, K)
+    job_n = cut(i32v, s32, K)
+    d_ahi = cut(u8v, s8, d_pad).astype(jnp.int32)
+    actor = cut(u8v, s8, n_pad).astype(jnp.int32)
+    flags_u8 = cut(u8v, s8, 2 * nb)
+    coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+
+    # ---- fold the new nodes into the pos-ordered mirror ----
+    tgt_new = d_pos + jnp.arange(d_pad, dtype=jnp.int32)
+    if has_old:
+        i = jnp.arange(cap, dtype=jnp.int32)
+        cnt = _insert_counts(d_pos, cap)
+        tgt_old = jnp.where(i < n_old, i + cnt, cap)
+
+        def fold(col, dcol):
+            out = jnp.zeros((cap,), jnp.int32)
+            out = out.at[tgt_old].set(col, mode='drop')
+            return out.at[tgt_new].set(dcol, mode='drop')
+    else:
+        def fold(col, dcol):
+            return jnp.zeros((cap,), jnp.int32) \
+                .at[tgt_new].set(dcol, mode='drop')
+
+    w1f = fold(w1m, w1d)
+    # new nodes: hidden, vis_index+1 = 0, actor-hi bits ride along
+    w2f = fold(w2m, d_ahi << _WIDE_AHI_SHIFT)
+    w3f = fold(w3m, w3d)
+
+    # ---- job planes ----
+    l = jnp.arange(m_pad, dtype=jnp.int32)
+    pos_mat = job_start[:, None] + l[None, :]
+    valid_plane = l[None, :] < job_n[:, None]
+    pos_c = jnp.minimum(jnp.where(valid_plane, pos_mat, 0), cap - 1)
+    w1p = jnp.take(w1f, pos_c)
+    w2p = jnp.take(w2f, pos_c)
+    s_elem = jnp.take(w3f, pos_c)
+    s_parent = (w1p >> _WIDE_PARENT_SHIFT) & _WIDE_IDX_MASK
+    actor1 = (w1p & _WIDE_ALO_MASK) | \
+        (((w2p >> _WIDE_AHI_SHIFT) & 0x3F) << 10)
+    s_rank = jnp.take(rank_table, actor1)
+    prior_vis = ((w2p >> _WIDE_VIS_SHIFT) & 1).astype(bool) & valid_plane
+    prior_idx = jnp.where(valid_plane, (w2p & _WIDE_IDX_MASK) - 1, -1)
+
+    # ---- field resolution (scan-based; rows arrive field-sorted) ----
+    boundary = _unpack_bits(flags_u8[:nb], n_pad)
+    is_del = _unpack_bits(flags_u8[nb:], n_pad)
+    valid = jnp.arange(n_pad) < n_rows
+    clock = _build_clock(actor, seq, a_pad, coo_row, coo_col, coo_val)
+    out = _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
+                          num_segments)
+
+    # ---- element visibility ----
+    touched, vis_hit = _vis_grid(row_slot, valid, out['surviving'],
+                                 K, m_pad)
+    visible = jnp.where(touched, vis_hit, prior_vis) & valid_plane
+
+    ordered = _rga_order_batched(s_parent, s_elem, s_rank, visible,
+                                 valid_plane)
+    new_idx = ordered['vis_index']
+
+    # ---- scatter the updated vis word back (actor-hi bits preserved) ----
+    w2n = (w2p & _WIDE_AHI_BITS) | \
+        (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | (new_idx + 1)
+    scatter_pos = jnp.where(valid_plane, pos_mat, cap).reshape(-1)
+    w2f = w2f.at[scatter_pos].set(w2n.reshape(-1), mode='drop')
+
+    surv_u8 = jnp.sum(
+        out['surviving'].reshape(-1, 8).astype(jnp.uint8)
+        * (jnp.uint8(1) << (7 - jnp.arange(8, dtype=jnp.uint8))),
+        axis=1, dtype=jnp.uint8)
+    vis_prior = (prior_vis.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
+        (prior_idx + 1)
+    vis_new = (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
+        (new_idx + 1)
+    return w1f, w2f, w3f, surv_u8, out['winner'], vis_prior, vis_new
+
+
 @jax.jit
 def _mirror_pack(parent, elemc, actor, visible, visidx, rank_table):
     """cols -> packed mirror (format upgrade when the guards pass)."""
@@ -1281,6 +1504,28 @@ def _mirror_unpack(w1, w2, rank_to_actor):
     return parent, elemc, actor, visible, visidx
 
 
+@jax.jit
+def _mirror_pack_wide(parent, elemc, actor, visible, visidx):
+    """cols -> WIDE mirror words (stable actor ids, no rank table)."""
+    actor1 = actor + 1                       # head (-1) -> 0
+    w1 = (parent << _WIDE_PARENT_SHIFT) | (actor1 & _WIDE_ALO_MASK)
+    w2 = ((actor1 >> 10) << _WIDE_AHI_SHIFT) | \
+        (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | (visidx + 1)
+    return w1, w2, elemc
+
+
+@jax.jit
+def _mirror_unpack_wide(w1, w2, w3):
+    """WIDE -> cols mirror pieces."""
+    parent = (w1 >> _WIDE_PARENT_SHIFT) & _WIDE_IDX_MASK
+    actor1 = (w1 & _WIDE_ALO_MASK) | \
+        (((w2 >> _WIDE_AHI_SHIFT) & 0x3F) << 10)
+    actor = actor1 - 1
+    visible = ((w2 >> _WIDE_VIS_SHIFT) & 1).astype(bool)
+    visidx = (w2 & _WIDE_IDX_MASK) - 1
+    return parent, w3, actor, visible, visidx
+
+
 def _rank_table(store, opts):
     """actor-id -> string-rank device table, 1-BASED (slot 0 is the
     head sentinel) — the layout `_mirror_pack`/the cols program index
@@ -1291,30 +1536,49 @@ def _rank_table(store, opts):
     return jnp.asarray(rt)
 
 
-def _mirror_convert(mir, to_packed, store, opts):
-    """Convert a resident mirror between the packed and cols formats
-    (a store crossing a packed-variant guard mid-stream — e.g. a tree
-    growing past 32767 nodes). One elementwise device program plus a
-    small-table gather; same cap/n/pos_row."""
+def _mirror_convert(mir, to_fmt, store, opts):
+    """Convert a resident mirror between the packed/wide/cols formats
+    (a store crossing a format guard mid-stream — e.g. a text document
+    growing past 32767 nodes upgrades packed -> wide IN PLACE and keeps
+    riding a fused packed program). One or two elementwise device
+    programs plus small-table gathers; same cap/n/pos_row. Every
+    conversion bumps a `general_mirror_convert_<from>_to_<to>` counter
+    so a fleet silently living on a slower format is visible."""
     n_act = len(store.actors)
-    ranks = np.asarray(store.actor_str_ranks())
-    if to_packed:
-        w1, w2 = _mirror_pack(mir['parent'], mir['elemc'], mir['actor'],
-                              mir['visible'], mir['vis_index'],
+    from_fmt = mir.get('fmt', 'cols')
+    metrics.bump('general_mirror_converts')
+    metrics.bump(f'general_mirror_convert_{from_fmt}_to_{to_fmt}')
+    if from_fmt == 'packed':
+        old_ranks = mir['ranks']
+        inv = np.full(opts.pad_actors(len(old_ranks) + 2), -1, np.int32)
+        inv[old_ranks + 1] = np.arange(len(old_ranks))
+        parent, elemc, actor, visible, visidx = _mirror_unpack(
+            mir['w1'], mir['w2'], jnp.asarray(inv))
+    elif from_fmt == 'wide':
+        parent, elemc, actor, visible, visidx = _mirror_unpack_wide(
+            mir['w1'], mir['w2'], mir['w3'])
+    else:
+        parent, elemc, actor, visible, visidx = (
+            mir['parent'], mir['elemc'], mir['actor'], mir['visible'],
+            mir['vis_index'])
+    base = {'cap': mir['cap'], 'n': mir['n'], 'pos_row': mir['pos_row']}
+    if to_fmt == 'packed':
+        ranks = np.asarray(store.actor_str_ranks())
+        w1, w2 = _mirror_pack(parent, elemc, actor, visible, visidx,
                               _rank_table(store, opts))
-        return {'fmt': 'packed', 'cap': mir['cap'], 'n': mir['n'],
-                'w1': w1, 'w2': w2, 'ranks': ranks.copy(),
-                'pos_row': mir['pos_row']}
-    old_ranks = mir['ranks']
-    inv = np.full(opts.pad_actors(len(old_ranks) + 2), -1, np.int32)
-    inv[old_ranks + 1] = np.arange(len(old_ranks))
-    parent, elemc, actor, visible, visidx = _mirror_unpack(
-        mir['w1'], mir['w2'], jnp.asarray(inv))
-    return {'fmt': 'cols', 'cap': mir['cap'], 'n': mir['n'],
+        return {'fmt': 'packed', 'w1': w1, 'w2': w2,
+                'ranks': ranks.copy(), **base}
+    if to_fmt == 'wide':
+        w1, w2, w3 = _mirror_pack_wide(parent, elemc, actor, visible,
+                                       visidx)
+        return {'fmt': 'wide', 'w1': w1, 'w2': w2, 'w3': w3,
+                'rank_n': n_act, 'rank_table': _rank_table(store, opts),
+                **base}
+    return {'fmt': 'cols',
             'parent': parent, 'elemc': elemc, 'actor': actor,
             'visible': visible, 'vis_index': visidx,
             'rank_n': n_act, 'rank_table': _rank_table(store, opts),
-            'pos_row': mir['pos_row']}
+            **base}
 
 
 # -- apply -------------------------------------------------------------------
@@ -1432,6 +1696,9 @@ class GeneralPatch:
             if raw.get('vis_fmt') == 'packed':
                 pv, nv, pi, ni = unpack_vis_word(
                     np.asarray(planes).view(np.uint32))
+            elif raw.get('vis_fmt') == 'wide':
+                pv, pi = unpack_wide_word(np.asarray(planes[0]))
+                nv, ni = unpack_wide_word(np.asarray(planes[1]))
             else:
                 pv, nv, pi, ni = [np.asarray(x) for x in planes]
             dirty, n_j = raw['dirty'], raw['dirty_n']
@@ -2067,21 +2334,32 @@ def _apply_general(store, block, options, return_timing):
     n_total = pool.n_nodes
     n_act = len(store.actors)
 
-    # variant pick: the packed program (2-word mirror, one wire buffer)
-    # wherever its bit-field guards hold; `_fused_general_resident` is
-    # the fallback (huge single trees, wide actor sets). Both share the
-    # staging idioms (_insert_counts/_build_clock/_vis_grid and the
-    # scan resolve) — the cross-check for those is the host oracle and
-    # the sharded-step equality gates, while the fallback remains the
-    # independent check of the packed mirror FORMAT (bit fields, wire
-    # layout, dtype narrowing).
-    use_packed = (_packed_mirror_guard(pool, n_act, A)
-                  and s_dtype is np.int16
-                  and c_dtype is np.int16)
+    # variant pick: the 2-word packed program wherever its bit-field
+    # guards hold, the 3-word WIDE packed program for everything up to
+    # 2^22-node trees / int32 elemc+seq, and `_fused_general_resident`
+    # (cols) as the last fallback (>4M-node trees, wide actor sets).
+    # All three share the staging idioms (_insert_counts/_build_clock/
+    # _vis_grid and the scan resolve) — the cross-check for those is
+    # the host oracle and the sharded-step equality gates, while the
+    # cols fallback remains the independent check of the packed mirror
+    # FORMATS (bit fields, wire layout, dtype narrowing). A mirror
+    # already on 'wide' stays there even when the 2-word guards pass
+    # again (a seq-width oscillation must not convert per block); the
+    # tree/elemc bounds are monotone, so packed-eligibility never
+    # genuinely returns once crossed.
     mir = pool.mirror
-    if mir is not None and (mir.get('fmt', 'cols') == 'packed') \
-            != use_packed:
-        mir = pool.mirror = _mirror_convert(mir, use_packed, store, opts)
+    cur_fmt = mir.get('fmt', 'cols') if mir is not None else None
+    if (_packed_mirror_guard(pool, n_act, A)
+            and s_dtype is np.int16 and c_dtype is np.int16
+            and cur_fmt != 'wide'):
+        fmt = 'packed'
+    elif _wide_mirror_guard(pool, n_act, A):
+        fmt = 'wide'
+    else:
+        fmt = 'cols'
+    if mir is not None and cur_fmt != fmt:
+        mir = pool.mirror = _mirror_convert(mir, fmt, store, opts)
+    use_packed = fmt == 'packed'
 
     if mir is None:
         # first resident apply: EVERY node is this apply's delta — the
@@ -2099,7 +2377,7 @@ def _apply_general(store, block, options, return_timing):
 
     d_n = n_total - n_old
     d_pad = opts.pad_nodes(max(d_n, 8))
-    native_wire = native_rows and use_packed
+    native_wire = native_rows and fmt != 'cols'
 
     if not native_wire:
         # host-built planes: d columns + job table + row slots + the
@@ -2260,6 +2538,74 @@ def _apply_general(store, block, options, return_timing):
         surv_u8_dev, winner_dev = outs[2], outs[3]
         vis_planes = outs[4] if len(dirty) else None
         vis_fmt = 'packed'
+    elif fmt == 'wide':
+        if mir is None:
+            w1m = jnp.zeros(cap, jnp.int32)
+            w2m = jnp.zeros(cap, jnp.int32)
+            w3m = jnp.zeros(cap, jnp.int32)
+        elif mir['cap'] < n_total:
+            pad = cap - mir['cap']
+
+            def grow_w(col):
+                return jnp.concatenate([col, jnp.zeros(pad, jnp.int32)])
+
+            w1m, w2m, w3m = (grow_w(mir['w1']), grow_w(mir['w2']),
+                             grow_w(mir['w3']))
+        else:
+            w1m, w2m, w3m = mir['w1'], mir['w2'], mir['w3']
+        # actor -> string-rank table, re-shipped only when it grew (the
+        # wide words carry stable actor ids, never ranks)
+        if mir is None or mir.get('rank_n') != n_act:
+            rank_table_dev = _rank_table(store, opts)
+        else:
+            rank_table_dev = mir['rank_table']
+
+        sizes = (d_pad, n_pad, K, nnz_pad)
+        wire = np.empty(_wire_sizes_wide(*sizes), np.uint8)
+        i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
+        if native_wire:
+            # C++ writes every section except the three admission-clock
+            # COO sections, which only the admission layer knows
+            ns.fill_wire_wide(wire, cap, d_pad, n_pad, K, nnz_pad,
+                              m_pad)
+            o = 4 * (3 * d_pad + 2 * n_pad)
+            wire[o:o + 4 * nnz_pad].view(np.int32)[:] = coo_row
+            o += 4 * nnz_pad
+            wire[o:o + 4 * nnz_pad].view(np.int32)[:] = coo_val
+            o = 4 * i32_n + d_pad + n_pad + 2 * (n_pad >> 3)
+            wire[o:o + nnz_pad] = coo_col.view(np.uint8)
+        else:
+            actor1_new = d_actor + 1          # head (-1) -> 0
+            w1_new = (d_parent << _WIDE_PARENT_SHIFT) | \
+                (actor1_new & _WIDE_ALO_MASK)
+            seq32 = seq_arr.astype(np.int32)
+            coo_val32 = coo_val.astype(np.int32)
+            o = 0
+            for arr in (w1_new, d_elemc, d_pos, row_slot, seq32,
+                        coo_row, coo_val32, job_start, n_j_arr):
+                nb_ = 4 * len(arr)
+                wire[o:o + nb_].view(np.int32)[:] = arr
+                o += nb_
+            for arr in ((actor1_new >> 10).astype(np.uint8), actor_arr,
+                        flags_u8, coo_col):
+                wire[o:o + len(arr)] = arr.view(np.uint8)
+                o += len(arr)
+            assert o == len(wire)
+
+        outs = _fused_general_wide(
+            w1m, w2m, w3m, jnp.asarray(wire), np.int32(n_old),
+            jnp.asarray(np.int32(n_rows)), rank_table_dev,
+            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
+            has_old=n_old > 0)
+        pool.mirror = {
+            'fmt': 'wide', 'cap': cap, 'n': n_total,
+            'w1': outs[0], 'w2': outs[1], 'w3': outs[2],
+            'rank_n': n_act, 'rank_table': rank_table_dev,
+            'pos_row': pool.pos_row,  # replaced-on-append: stable ref
+        }
+        surv_u8_dev, winner_dev = outs[3], outs[4]
+        vis_planes = (outs[5], outs[6]) if len(dirty) else None
+        vis_fmt = 'wide'
     else:
         if mir is None:
             m_cols = (jnp.zeros(cap, jnp.int32),
@@ -2308,7 +2654,7 @@ def _apply_general(store, block, options, return_timing):
         vis_fmt = 'cols'
     pool._epoch += 1
     if _STAGE_CAPTURE is not None:
-        if native_wire:
+        if native_wire and use_packed:
             # the staged planes live in the wire buffer — expose them
             # through views at the layout offsets
             o_rs = 4 * (2 * d_pad)
@@ -2319,8 +2665,21 @@ def _apply_general(store, block, options, return_timing):
             cap_actor = wire[o_ac:o_ac + n_pad]
             cap_flags = wire[o_ac + n_pad:
                              o_ac + n_pad + 2 * (n_pad >> 3)]
+        elif native_wire:                      # wide wire layout
+            o_rs = 4 * (3 * d_pad)
+            cap_slot = wire[o_rs:o_rs + 4 * n_pad].view(np.int32)
+            cap_seq = wire[o_rs + 4 * n_pad:
+                           o_rs + 8 * n_pad].view(np.int32)
+            o_ac = 4 * i32_n + d_pad
+            cap_actor = wire[o_ac:o_ac + n_pad]
+            cap_flags = wire[o_ac + n_pad:
+                             o_ac + n_pad + 2 * (n_pad >> 3)]
         else:
-            cap_slot, cap_seq = row_slot, seq_arr
+            cap_slot = row_slot
+            # the wide wire carries seq as int32 — expose the same
+            # dtype so the native/numpy parity gate compares like
+            cap_seq = seq_arr.astype(np.int32) if fmt == 'wide' \
+                else seq_arr
             cap_actor, cap_flags = actor_arr, flags_u8
         _STAGE_CAPTURE({
             'ops_actor': cap_actor, 'ops_seq': cap_seq,
@@ -2329,8 +2688,7 @@ def _apply_general(store, block, options, return_timing):
             'coo_val': coo_val, 'num_segments': S, 'a_pad': A,
             'm_pad': m_pad, 'surv_u8': surv_u8_dev,
             'winner': winner_dev, 'vis_fmt': vis_fmt,
-            'vis_planes': vis_planes, 'variant':
-                'packed' if use_packed else 'cols'})
+            'vis_planes': vis_planes, 'variant': fmt})
     t3 = time.perf_counter()
 
     # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
@@ -2413,6 +2771,9 @@ def _apply_general(store, block, options, return_timing):
     metrics.bump('general_ops', int(keep.sum()))
     metrics.bump('general_stage_native_batches' if ns is not None
                  else 'general_stage_numpy_batches')
+    # per-variant apply counts: a fleet quietly living on the cols
+    # fallback (or stuck converting) shows up in the bench summary
+    metrics.bump(f'general_variant_{fmt}_applies')
     metrics.observe('general_stage_ms',
                     (t2 - t1 - (tc1 - tc0)) * 1e3)
     metrics.observe('general_commit_wait_ms', (tc1 - tc0) * 1e3)

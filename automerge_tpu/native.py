@@ -309,6 +309,9 @@ def _bind_stage(lib):
     lib.amst_fill_wire.argtypes = [ctypes.c_void_p, _PU8, _i64, _i64,
                                    _i64, _i64, _i64, _i64, _P64]
     lib.amst_fill_wire.restype = None
+    lib.amst_fill_wire_wide.argtypes = [ctypes.c_void_p, _PU8, _i64,
+                                        _i64, _i64, _i64, _i64, _i64]
+    lib.amst_fill_wire_wide.restype = None
     return lib
 
 
@@ -430,6 +433,15 @@ class GeneralStagedPlanes:
         self._lib.amst_fill_wire(
             self._h, wire.ctypes.data_as(_PU8), cap, d_pad, n_pad, K,
             nnz_pad, m_pad, _p64(ranks))
+
+    def fill_wire_wide(self, wire, cap, d_pad, n_pad, K, nnz_pad,
+                       m_pad):
+        """Write the WIDE packed program's wire buffer (same contract
+        as :meth:`fill_wire`; the wide words carry stable actor ids,
+        so no rank table crosses the boundary)."""
+        self._lib.amst_fill_wire_wide(
+            self._h, wire.ctypes.data_as(_PU8), cap, d_pad, n_pad, K,
+            nnz_pad, m_pad)
 
     def __del__(self):
         h = getattr(self, '_h', None)

@@ -276,6 +276,9 @@ def sharded_step_from_capture(mesh, store, patch, captured):
         _, vis_ref, _, idx_ref = general.unpack_vis_word(
             np.asarray(jax.device_get(captured['vis_planes']))
             .view(np.uint32))
+    elif captured['vis_fmt'] == 'wide':
+        vis_ref, idx_ref = general.unpack_wide_word(
+            np.asarray(jax.device_get(captured['vis_planes'][1])))
     else:
         pl = [np.asarray(x)
               for x in jax.device_get(captured['vis_planes'])]

@@ -89,8 +89,9 @@ class GeneralDocSet:
     buffering, conflicts.
     """
 
-    def __init__(self, capacity, options=None):
+    def __init__(self, capacity, options=None, auto_grow=True):
         self.capacity = capacity
+        self.auto_grow = auto_grow
         self.store = _general.init_store(capacity)
         self._options = options
         self.ids = []                  # index -> doc_id
@@ -111,13 +112,37 @@ class GeneralDocSet:
         idx = self.id_of.get(doc_id)
         if idx is None and create:
             if len(self.ids) >= self.capacity:
-                raise ValueError(
-                    f'{len(self.ids) + 1} documents exceed the general '
-                    f'store capacity {self.capacity}')
+                if not self.auto_grow:
+                    raise ValueError(
+                        f'GeneralDocSet is full: document '
+                        f'{len(self.ids) + 1} exceeds the configured '
+                        f'capacity of {self.capacity}. Construct with '
+                        f'a larger capacity, or auto_grow=True to let '
+                        f'the store widen on demand (document growth '
+                        f'is O(new docs); existing indexes and the '
+                        f'device mirror are kept).')
+                # doubling clamps to the store's 4M-document key space
+                # (growth to any legal size must not raise early)
+                self.grow(min(max(2 * self.capacity,
+                                  len(self.ids) + 1), (1 << 22) - 1))
+                if len(self.ids) >= self.capacity:
+                    raise ValueError(
+                        f'{len(self.ids) + 1} documents exceed the '
+                        f'4M-document key space')
             idx = len(self.ids)
             self.id_of[doc_id] = idx
             self.ids.append(doc_id)
         return idx
+
+    def grow(self, new_capacity):
+        """Widen the document axis to ``new_capacity`` (no-op when
+        already at least that wide). Existing documents keep their
+        indexes; the store's sparse per-doc state and the resident
+        mirror are untouched."""
+        if new_capacity <= self.capacity:
+            return
+        self.store.grow_docs(new_capacity)
+        self.capacity = new_capacity
 
     def get_doc(self, doc_id):
         idx = self.id_of.get(doc_id)
@@ -249,6 +274,7 @@ class GeneralDocSet:
         store_bytes = self.store.save_snapshot()
         header = json.dumps({'format': self._SNAP_FORMAT,
                              'capacity': self.capacity,
+                             'auto_grow': self.auto_grow,
                              'ids': self.ids}).encode()
         return struct.pack('>Q', len(header)) + header + store_bytes
 
@@ -260,7 +286,8 @@ class GeneralDocSet:
         header = json.loads(data[8:8 + hlen].decode())
         if header.get('format') != cls._SNAP_FORMAT:
             raise ValueError('not a general-docset snapshot')
-        out = cls(header['capacity'], options=options)
+        out = cls(header['capacity'], options=options,
+                  auto_grow=header.get('auto_grow', True))
         out.store = _general.GeneralStore.load_snapshot(
             data[8 + hlen:])
         out.ids = list(header['ids'])
@@ -275,7 +302,7 @@ class GeneralDocSet:
         mutated, so the array identity is the version)."""
         store = self.store
         ref, order, starts = self._entry_csr
-        if ref is not store.e_doc:
+        if ref is not store.e_doc or len(starts) != self.capacity + 1:
             order = np.argsort(store.e_doc, kind='stable')
             starts = np.searchsorted(store.e_doc[order],
                                      np.arange(self.capacity + 1))

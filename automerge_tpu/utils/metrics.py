@@ -58,6 +58,17 @@ class Metrics:
     def snapshot(self):
         return dict(self.counters)
 
+    def group(self, prefix):
+        """{suffix: value} of every counter under ``prefix`` — the
+        bench-summary view of counter families like the general
+        engine's per-variant apply counts (`general_variant_*_applies`)
+        and mirror format conversions (`general_mirror_convert_*`),
+        which make a fleet silently running a slow fallback visible."""
+        with self._lock:
+            return {name[len(prefix):]: value
+                    for name, value in self.counters.items()
+                    if name.startswith(prefix)}
+
     def reset(self):
         self.counters.clear()
 

@@ -489,6 +489,88 @@ def bench_general_docset_sync(n_docs=2000):
     return n_docs, n_msgs, dt_batch, dt_eager
 
 
+def bench_general_sync_10k(n_docs=10240, list_ops=22):
+    """The 10k-doc general sync at the north-star config-5 shape: a
+    rich-doc fleet (lists + links + causal chains) replicates
+    GeneralDocSet -> GeneralDocSet through BatchingConnection ticks,
+    one fused general apply per tick. The destination store starts
+    SMALL and auto-grows to the fleet size — the capacity lift that
+    replaced the hard raise in sync/general_doc_set.py."""
+    from automerge_tpu.sync import Connection
+    from automerge_tpu.sync.connection import BatchingConnection
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+    per_doc = _gen_mixed_docs(n_docs, list_ops)
+    n_ops = sum(len(c['ops']) for doc in per_doc for c in doc)
+    src = GeneralDocSet(n_docs)
+    src.apply_changes_batch(
+        {f'doc{d}': per_doc[d] for d in range(n_docs)})
+
+    def one_round():
+        dst = GeneralDocSet(1024)          # auto-grows to the fleet
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        n_msgs = 0
+        ca.open()
+        cb.open()
+        while msgs_a or msgs_b:
+            batch_a = msgs_a[:]
+            msgs_a.clear()
+            for m in batch_a:
+                n_msgs += 1
+                cb.receive_msg(m)
+            cb.flush()
+            batch_b = msgs_b[:]
+            msgs_b.clear()
+            for m in batch_b:
+                n_msgs += 1
+                ca.receive_msg(m)
+        return n_msgs, dst
+
+    one_round()                            # warm the fleet shapes
+    t0 = time.perf_counter()
+    n_msgs, dst = one_round()
+    dt = time.perf_counter() - t0
+    assert dst.capacity >= n_docs          # grew from 1024
+    got = dst.get_doc(f'doc{n_docs - 1}').materialize()
+    assert got['meta'] == n_docs - 1 and len(got['items']) == list_ops
+    return n_docs, n_ops, n_msgs, dt
+
+
+def bench_dense_breakdown(iters=20):
+    """Where the dense-path e2e vs kernel ops/s gap lives: one
+    return_timing line splitting the config-5 apply into admission,
+    wire packing, dispatch (H2D + enqueue), the device wait and the
+    patch read-back (full PatchBlock materialization)."""
+    import jax
+    from automerge_tpu.device.dense_store import DenseMapStore
+    from automerge_tpu.utils.metrics import metrics as _m
+
+    block = gen_block_workload()
+    store = DenseMapStore(block.n_docs, key_capacity=64,
+                          actor_capacity=16)
+    store.apply_block(block).block_until_ready().to_patch_block()
+    keys = ('admit', 'pack', 'dispatch', 'device', 'patch_read')
+    parts = {k: [] for k in keys}
+    for _ in range(iters):
+        store.reset()
+        jax.block_until_ready(store.eseq)
+        patch, t = store.apply_block(block, return_timing=True)
+        t0 = time.perf_counter()
+        patch.block_until_ready()
+        t['device'] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        patch.to_patch_block()
+        t['patch_read'] = time.perf_counter() - t0
+        for k in keys:
+            parts[k].append(t[k])
+    med = {k: float(np.median(parts[k])) for k in keys}
+    for k in keys:
+        _m.observe(f'dense_{k}_ms', med[k] * 1e3)
+    return block.n_ops, med
+
+
 def bench_general_snapshot_resume(n_docs=10000):
     """A 10k-doc general DocSet (real documents: lists + root fields)
     resumes from its packed snapshot replay-free."""
@@ -678,9 +760,11 @@ def bench_trace_replay(n_ops=180000, wire_ops=60000):
         general.apply_general_block(store, gb2).block_until_ready()
         times.append(time.perf_counter() - t0)
     t_gen = float(np.median(times))
+    gen_fmt = store.pool.mirror['fmt']
     log(f'trace-replay[general bulk engine]: {total_ops} ops '
         f'({n_ops} keystrokes) in {t_gen * 1e3:.0f} ms -> '
-        f'{total_ops / t_gen / 1e6:.2f}M ops/s, full protocol')
+        f'{total_ops / t_gen / 1e6:.2f}M ops/s, full protocol '
+        f'({gen_fmt} mirror — the bounds-lifted packed program)')
 
     # the native codec on the same trace with the GENERAL op schema
     from automerge_tpu import wire as _wire
@@ -699,6 +783,7 @@ def bench_trace_replay(n_ops=180000, wire_ops=60000):
             f'(ins/set/del, elemIds) — native {t_gnat * 1e3:.0f} ms '
             f'({len(js) / t_gnat / 1e6:.0f} MB/s), python '
             f'{t_gpy * 1e3:.0f} ms -> {t_gpy / t_gnat:.1f}x')
+    return total_ops, t_gen, gen_fmt
 
 
 def _gen_mixed_docs(n_docs, list_ops, doc0=0):
@@ -861,6 +946,17 @@ def main():
     log(f'e2e-docset-merge[host block path]: {n_blk} ops in '
         f'{t_blk * 1e3:.1f} ms -> {n_blk / t_blk / 1e6:.1f}M ops/s')
 
+    bd_ops, bd = bench_dense_breakdown()
+    bd_total = sum(bd.values())
+    log(f'e2e-breakdown[dense path, {bd_ops} ops]: '
+        + ' + '.join(f'{k} {bd[k] * 1e3:.1f}' for k in
+                     ('admit', 'pack', 'dispatch', 'device',
+                      'patch_read'))
+        + f' = {bd_total * 1e3:.1f} ms — the e2e-vs-kernel gap is '
+        f'{(bd_total - bd["device"]) / bd_total * 100:.0f}% host '
+        f'(admission/packing/read-back), {bd["device"] / bd_total * 100:.0f}% '
+        f'device wait')
+
     # ---- diagnostics ----
     t_floor = bench_roundtrip_floor()
     log(f'link-roundtrip-floor: {t_floor * 1e3:.1f} ms per dispatch+sync '
@@ -945,6 +1041,13 @@ def main():
         f'({n_gd / t_geager:.0f} docs/s) -> '
         f'{t_geager / t_gbatch:.1f}x, one fused apply per tick')
 
+    n_10k, n_10k_ops, n_10k_msgs, t_10k = bench_general_sync_10k()
+    log(f'docset-sync[general 10k, config-5 shape]: {n_10k} rich docs '
+        f'/ {n_10k_ops} ops replicate through {n_10k_msgs} '
+        f'BatchingConnection messages in {t_10k:.3f}s -> '
+        f'{n_10k / t_10k:.0f} docs/s ({n_10k_ops / t_10k / 1e6:.2f}M '
+        f'ops/s; destination auto-grew 1024 -> {n_10k} docs)')
+
     wb, wops, t_nat, t_py = bench_wire_parse()
     if t_nat is not None:
         log(f'wire-parse[native codec]: {wb >> 20} MiB JSON / {wops} ops — '
@@ -971,10 +1074,22 @@ def main():
         f'{t_order * 1e3:.2f} ms amortized -> '
         f'{n_nodes / t_order / 1e6:.1f}M elems/s')
 
-    bench_trace_replay()
+    tr_ops, t_trace, trace_fmt = bench_trace_replay()
 
     from automerge_tpu.utils.metrics import metrics as _metrics
     from automerge_tpu import native as _amnat
+    # silent-downgrade observability: which fused variant every general
+    # apply so far actually ran, and how often resident mirrors had to
+    # convert format (a fleet quietly living on the cols fallback —
+    # or thrash-converting — shows up here, not just in wall time)
+    _variants = _metrics.group('general_variant_')
+    _converts = _metrics.group('general_mirror_convert_')
+    log('general-variant-mix: '
+        + ', '.join(f'{v} {_variants.get(f"{v}_applies", 0)}'
+                    for v in ('packed', 'wide', 'cols'))
+        + ' applies; mirror conversions: '
+        + (', '.join(f'{k} {n}' for k, n in sorted(_converts.items()))
+           or 'none'))
     _metrics.reset()
     (g_docs, g_ops, t_gmd, t_gp99, t_gsync, t_gpipe,
      g_stream_k, t_gxsync, t_gxpipe, g_xdocs) = bench_general_multidoc()
@@ -1037,6 +1152,12 @@ def main():
         'general_stage_native': bool(_amnat.stage_available()),
         'general_p99_ms': round(t_gp99 * 1e3, 2),
         'general_sync_docs_per_sec': round(n_gd / t_gbatch, 1),
+        'general_sync10k_docs_per_sec': round(n_10k / t_10k, 1),
+        'general_sync10k_ops_per_sec': round(n_10k_ops / t_10k, 1),
+        'trace_general_ops_per_sec': round(tr_ops / t_trace, 1),
+        'trace_general_fmt': trace_fmt,
+        'dense_breakdown_ms': {k: round(v * 1e3, 2)
+                               for k, v in bd.items()},
         'resolve_hbm_frac': round(res_hbm, 4),
         'rga_hbm_frac': round(rga_hbm, 4),
     }), flush=True)

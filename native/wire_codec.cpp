@@ -1382,6 +1382,66 @@ void amst_fill_dplanes(void* h, int32_t* d_parent, int32_t* d_elemc,
     }
 }
 
+// Shared wire-section writers of the TWO packed layouts (2-word and
+// wide): the d_pos plane, the per-row (job, node) slot plane, the job
+// table, the per-row actor bytes and the MSB-first boundary/del flag
+// bits are byte-identical between amst_fill_wire and
+// amst_fill_wire_wide — one definition keeps the formats in lockstep.
+static void fill_d_pos(const stage::Stager* s, int32_t* dp,
+                       int64_t d_pad, int64_t cap) {
+    int64_t d_n = static_cast<int64_t>(s->d_pos.size());
+    for (int64_t i = 0; i < d_n; i++)
+        dp[i] = static_cast<int32_t>(s->d_pos[i]);
+    for (int64_t i = d_n; i < d_pad; i++)
+        dp[i] = static_cast<int32_t>(cap);
+}
+
+// per-row (job, node) slots in field-sorted coordinates
+static void fill_row_slots(const stage::Stager* s, int32_t* slot,
+                           int64_t n_pad, int64_t m_pad) {
+    int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
+    for (int64_t i = 0; i < n_pad; i++) slot[i] = -1;
+    for (int64_t i = 0; i < n_ar; i++) {
+        int64_t row = s->order[i];
+        int64_t node = s->a_node[row];
+        if (node < 0) continue;
+        auto it = std::lower_bound(s->dirty.begin(), s->dirty.end(),
+                                   s->a_objrow[row]);
+        if (it == s->dirty.end() || *it != s->a_objrow[row])
+            continue;
+        slot[i] = static_cast<int32_t>(
+            (it - s->dirty.begin()) * m_pad + node);
+    }
+}
+
+static void fill_job_table(const stage::Stager* s, int32_t* js,
+                           int32_t* jn, int64_t K) {
+    std::memset(js, 0, 4 * K);
+    std::memset(jn, 0, 4 * K);
+    for (size_t k = 0; k < s->dirty.size(); k++) {
+        js[k] = static_cast<int32_t>(s->job_start[k]);
+        jn[k] = static_cast<int32_t>(s->n_j[k]);
+    }
+}
+
+// per-row actor bytes + boundary/del bits (MSB-first, np.packbits
+// layout: boundary plane first, then del plane)
+static void fill_actor_flags(const stage::Stager* s, uint8_t* act,
+                             uint8_t* flags, int64_t n_pad) {
+    int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
+    for (int64_t i = 0; i < n_ar; i++)
+        act[i] = static_cast<uint8_t>(s->a_local[s->order[i]]);
+    std::memset(act + n_ar, 0, n_pad - n_ar);
+    std::memset(flags, 0, 2 * (n_pad >> 3));
+    int64_t nb = n_pad >> 3;
+    for (int64_t i = 0; i < n_ar; i++) {
+        bool boundary = i == 0 || s->r_seg[i] != s->r_seg[i - 1];
+        if (boundary) flags[i >> 3] |= uint8_t(0x80) >> (i & 7);
+        if (s->a_del[s->order[i]])
+            flags[nb + (i >> 3)] |= uint8_t(0x80) >> (i & 7);
+    }
+}
+
 // Write the packed program's single wire buffer (byte-identical to
 // the numpy packing loop). Section layout must match _wire_sizes:
 //   i32: w1_new[d_pad] d_pos[d_pad] row_slot[n_pad] coo_row[nnz_pad]
@@ -1398,7 +1458,6 @@ void amst_fill_wire(void* h, uint8_t* wire, int64_t cap,
     auto* s = static_cast<stage::Stager*>(h);
     int64_t d_n = static_cast<int64_t>(s->d_parent.size());
     int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
-    int64_t Kd = static_cast<int64_t>(s->dirty.size());
     uint8_t* p = wire;
 
     auto i32 = [&](int64_t count) {
@@ -1417,36 +1476,11 @@ void amst_fill_wire(void* h, uint8_t* wire, int64_t cap,
     // (the rows are dead: their d_pos is the drop sentinel)
     for (int64_t i = d_n; i < d_pad; i++)
         w1[i] = static_cast<int32_t>(ranks[0]) + 1;
-    int32_t* dp = i32(d_pad);
-    for (int64_t i = 0; i < d_n; i++)
-        dp[i] = static_cast<int32_t>(s->d_pos[i]);
-    for (int64_t i = d_n; i < d_pad; i++)
-        dp[i] = static_cast<int32_t>(cap);
-    int32_t* slot = i32(n_pad);
-    {
-        // per-row (job, node) slots in field-sorted coordinates
-        for (int64_t i = 0; i < n_pad; i++) slot[i] = -1;
-        for (int64_t i = 0; i < n_ar; i++) {
-            int64_t row = s->order[i];
-            int64_t node = s->a_node[row];
-            if (node < 0) continue;
-            auto it = std::lower_bound(s->dirty.begin(), s->dirty.end(),
-                                       s->a_objrow[row]);
-            if (it == s->dirty.end() || *it != s->a_objrow[row])
-                continue;
-            slot[i] = static_cast<int32_t>(
-                (it - s->dirty.begin()) * m_pad + node);
-        }
-    }
+    fill_d_pos(s, i32(d_pad), d_pad, cap);
+    fill_row_slots(s, i32(n_pad), n_pad, m_pad);
     i32(nnz_pad);                                    // coo_row: caller's
     int32_t* js = i32(K);
-    int32_t* jn = i32(K);
-    std::memset(js, 0, 4 * K);
-    std::memset(jn, 0, 4 * K);
-    for (int64_t k = 0; k < Kd; k++) {
-        js[k] = static_cast<int32_t>(s->job_start[k]);
-        jn[k] = static_cast<int32_t>(s->n_j[k]);
-    }
+    fill_job_table(s, js, i32(K), K);
 
     auto i16 = [&](int64_t count) {
         int16_t* out = reinterpret_cast<int16_t*>(p);
@@ -1465,20 +1499,72 @@ void amst_fill_wire(void* h, uint8_t* wire, int64_t cap,
 
     uint8_t* act = p;
     p += n_pad;
-    for (int64_t i = 0; i < n_ar; i++)
-        act[i] = static_cast<uint8_t>(s->a_local[s->order[i]]);
-    std::memset(act + n_ar, 0, n_pad - n_ar);
     uint8_t* flags = p;
-    p += 2 * (n_pad >> 3);
-    std::memset(flags, 0, 2 * (n_pad >> 3));
-    // boundary bits (MSB-first, np.packbits layout), then del bits
-    int64_t nb = n_pad >> 3;
-    for (int64_t i = 0; i < n_ar; i++) {
-        bool boundary = i == 0 || s->r_seg[i] != s->r_seg[i - 1];
-        if (boundary) flags[i >> 3] |= uint8_t(0x80) >> (i & 7);
-        if (s->a_del[s->order[i]])
-            flags[nb + (i >> 3)] |= uint8_t(0x80) >> (i & 7);
+    fill_actor_flags(s, act, flags, n_pad);
+    // coo_col section follows: caller's
+}
+
+// Write the WIDE packed program's wire buffer (byte-identical to the
+// numpy packing loop; trees to 2^22-1 nodes, elemc/seq as full int32).
+// Section layout must match _wire_sizes_wide:
+//   i32: w1_new[d_pad] w3_new[d_pad] d_pos[d_pad] row_slot[n_pad]
+//        seq[n_pad] coo_row[nnz_pad] coo_val[nnz_pad]
+//        job_start[K] job_n[K]
+//   u8:  ahi_new[d_pad] actor[n_pad] flags[2*(n_pad>>3)]
+//        coo_col[nnz_pad]
+// The wide words carry the STABLE actor id + 1 split 10/6 across
+// W1/W2 (no rank table). The three coo sections are left untouched
+// (the caller owns the admission-clock exceptions). Valid only for
+// the no-prior-rows path: n_rows == n_arows.
+void amst_fill_wire_wide(void* h, uint8_t* wire, int64_t cap,
+                         int64_t d_pad, int64_t n_pad, int64_t K,
+                         int64_t nnz_pad, int64_t m_pad) {
+    auto* s = static_cast<stage::Stager*>(h);
+    int64_t d_n = static_cast<int64_t>(s->d_parent.size());
+    int64_t n_ar = static_cast<int64_t>(s->a_rows.size());
+    uint8_t* p = wire;
+
+    auto i32 = [&](int64_t count) {
+        int32_t* out = reinterpret_cast<int32_t*>(p);
+        p += 4 * count;
+        return out;
+    };
+    int32_t* w1 = i32(d_pad);
+    for (int64_t i = 0; i < d_n; i++) {
+        // actor1 = actor id + 1 (0 = head); low 10 bits ride W1
+        uint32_t actor1 = static_cast<uint32_t>(s->d_actor[i] + 1);
+        uint32_t word = (static_cast<uint32_t>(s->d_parent[i]) << 10)
+            | (actor1 & 0x3FFu);
+        std::memcpy(&w1[i], &word, 4);
     }
+    // numpy pads the d-planes with zeros, so its padding rows compute
+    // w1 = (0 << 10) | ((0 + 1) & 0x3FF) = 1 — replicate for byte
+    // parity (the rows are dead: their d_pos is the drop sentinel)
+    for (int64_t i = d_n; i < d_pad; i++) w1[i] = 1;
+    int32_t* w3 = i32(d_pad);
+    std::memcpy(w3, s->d_elemc.data(), d_n * 4);
+    std::memset(w3 + d_n, 0, 4 * (d_pad - d_n));
+    fill_d_pos(s, i32(d_pad), d_pad, cap);
+    fill_row_slots(s, i32(n_pad), n_pad, m_pad);
+    int32_t* seq = i32(n_pad);
+    for (int64_t i = 0; i < n_ar; i++)
+        seq[i] = static_cast<int32_t>(s->a_seq[s->order[i]]);
+    std::memset(seq + n_ar, 0, 4 * (n_pad - n_ar));
+    i32(nnz_pad);                                    // coo_row: caller's
+    i32(nnz_pad);                                    // coo_val: caller's
+    int32_t* js = i32(K);
+    fill_job_table(s, js, i32(K), K);
+
+    uint8_t* ahi = p;
+    p += d_pad;
+    for (int64_t i = 0; i < d_n; i++)
+        ahi[i] = static_cast<uint8_t>(
+            static_cast<uint32_t>(s->d_actor[i] + 1) >> 10);
+    std::memset(ahi + d_n, 0, d_pad - d_n);
+    uint8_t* act = p;
+    p += n_pad;
+    uint8_t* flags = p;
+    fill_actor_flags(s, act, flags, n_pad);
     // coo_col section follows: caller's
 }
 

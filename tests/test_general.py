@@ -462,7 +462,7 @@ class TestPackedVariantFallback:
     def _mat_store(self, patches):
         return _apply_diff_lists([p.diffs(0) for p in patches])
 
-    def test_elemc_guard_packed_to_cols_and_exact(self):
+    def test_elemc_guard_packed_to_wide_and_exact(self):
         obj = '00000000-0000-4000-8000-00000000fb01'
         c1 = {'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
             {'action': 'makeList', 'obj': obj},
@@ -490,9 +490,12 @@ class TestPackedVariantFallback:
         assert store.pool.mirror['fmt'] == 'packed'
         p2 = general.apply_general_block(store, store.encode_changes(
             [[c2]]))
-        assert store.pool.mirror['fmt'] == 'cols'
+        # the bounds lift: elemc past 2^15 upgrades to the WIDE packed
+        # program (a fused packed path), not the cols fallback
+        assert store.pool.mirror['fmt'] == 'wide'
         p3 = general.apply_general_block(store, store.encode_changes(
             [[c3]]))
+        assert store.pool.mirror['fmt'] == 'wide'
         got = _mat_doc(self._mat_store([p1, p2, p3]))
         assert got == _via_oracle([c1, c2, c3])
 
@@ -550,7 +553,7 @@ class TestPackedVariantFallback:
             [[c1]]))
         assert store.pool.mirror['fmt'] == 'packed'
         store.pool.mirror = general._mirror_convert(
-            store.pool.mirror, False, store, as_options(None))
+            store.pool.mirror, 'cols', store, as_options(None))
         assert store.pool.mirror['fmt'] == 'cols'
         c2 = {'actor': 'v', 'seq': 1, 'deps': {}, 'ops': [
             {'action': 'ins', 'obj': obj, 'key': 'w:1', 'elem': 3},

@@ -7,8 +7,11 @@ Mirrors the reference connection suite's delivery adversities
 dropped messages, multi-hop forwarding) over general-backed replicas.
 """
 
+import pytest
+
 import automerge_tpu as am
 from automerge_tpu import frontend as Frontend
+from automerge_tpu.common import ROOT_ID
 from automerge_tpu.sync import DocSet, Connection
 from automerge_tpu.sync.connection import BatchingConnection
 from automerge_tpu.sync.general_doc_set import GeneralDocSet
@@ -214,6 +217,41 @@ class TestGeneralDocSetSync:
         assert dst.store.get_missing_deps()
         dst.apply_changes('doc0', changes[:-1])      # deps arrive
         assert dst.get_doc('doc0').materialize() == _expected(0)
+
+    def test_capacity_grows_on_demand(self):
+        """Satellite: a full GeneralDocSet widens its store instead of
+        raising — existing documents keep their indexes and state, and
+        auto_grow=False still fails with a clear sizing message."""
+        ds = GeneralDocSet(2)
+        for i in range(5):
+            ds.apply_changes(f'doc{i}', [
+                {'actor': f'a{i}', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID,
+                     'key': 'v', 'value': i}]}])
+        assert ds.capacity >= 5
+        assert ds.store.n_docs == ds.capacity
+        for i in range(5):
+            assert ds.materialize(f'doc{i}') == {'v': i}
+
+        fixed = GeneralDocSet(1, auto_grow=False)
+        fixed.apply_changes('only', [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID,
+                 'key': 'v', 'value': 0}]}])
+        with pytest.raises(ValueError, match='capacity'):
+            fixed.apply_changes('second', [
+                {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID,
+                     'key': 'v', 'value': 1}]}])
+
+        # the sizing guard survives a snapshot round trip
+        restored = GeneralDocSet.load_snapshot(fixed.save_snapshot())
+        assert restored.auto_grow is False
+        with pytest.raises(ValueError, match='capacity'):
+            restored.apply_changes('second', [
+                {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID,
+                     'key': 'v', 'value': 1}]}])
 
     def test_handles_expose_clock_and_items(self):
         src = _src_docset(2)

@@ -35,6 +35,7 @@ class _ForcedStaging:
         self._mode = general._NATIVE_STAGING
         self._capture = general._STAGE_CAPTURE
         self._packed = general._fused_general_packed
+        self._wide = general._fused_general_wide
         general._NATIVE_STAGING = self.force
         general._STAGE_CAPTURE = lambda c: self.captures.append(
             {k: (np.asarray(c[k]).copy()
@@ -45,13 +46,19 @@ class _ForcedStaging:
             self.wires.append(np.asarray(wire).copy())
             return self._packed(w1m, w2m, wire, *a, **k)
 
+        def spy_wide(w1m, w2m, w3m, wire, *a, **k):
+            self.wires.append(np.asarray(wire).copy())
+            return self._wide(w1m, w2m, w3m, wire, *a, **k)
+
         general._fused_general_packed = spy
+        general._fused_general_wide = spy_wide
         return self
 
     def __exit__(self, *exc):
         general._NATIVE_STAGING = self._mode
         general._STAGE_CAPTURE = self._capture
         general._fused_general_packed = self._packed
+        general._fused_general_wide = self._wide
 
 
 def _corpus_blocks():
@@ -332,6 +339,83 @@ def test_undo_stacks_copied_on_new_token():
     assert len(s1.undo_stack) == 1           # not corrupted by s2
     s2.redo_stack.append(['sentinel2'])
     assert s1.redo_stack == []
+
+
+def _oracle_text(changes):
+    """Independent host oracle: the reference backend (native C++
+    order-statistic index when available) + the real frontend patch
+    applier."""
+    from automerge_tpu import backend as B
+    from automerge_tpu import frontend as F
+    state, _ = B.apply_changes(B.init('oracle-viewer'), changes)
+    doc = F.apply_patch(
+        F.init('viewer'),
+        {'clock': {}, 'deps': {}, 'canUndo': False, 'canRedo': False,
+         'diffs': B.get_patch(state)['diffs']})
+    return ''.join(str(c) for c in doc['text'])
+
+
+def test_packed_to_wide_boundary_crossing():
+    """The bounds-lift guard test: a text document growing past 32767
+    nodes AND past 32k elemc AND past 32k seq between blocks upgrades
+    its resident mirror packed -> wide IN PLACE (it keeps riding a
+    fused packed program, never the cols fallback), stays bit-exact vs
+    the host oracle through the transition, and the numpy and forced-
+    native stagers produce byte-identical wire buffers for both
+    formats. A snapshot of the post-crossing store resumes straight
+    onto the wide mirror."""
+    from automerge_tpu.device.general import GeneralStore
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+    from automerge_tpu.utils.metrics import metrics
+
+    n1, n2 = 32600, 33400        # nodes: 32601 (packed) -> 33401 (wide)
+    trace = traces.gen_editing_trace(n2, seed=21, backspace_p=0.0)
+    block1, block2 = trace[:n1 + 1], trace[n1 + 1:]
+    modes = [False] + ([True] if amnative.stage_available() else [])
+
+    want_mid = _oracle_text(block1)
+    want_end = _oracle_text(trace)
+    results = {}
+    for force in modes:
+        with _ForcedStaging(force) as f:
+            ds = GeneralDocSet(1)
+            store = ds.store
+            c0 = metrics.counters.get(
+                'general_mirror_convert_packed_to_wide', 0)
+            ds.apply_changes('doc', block1)
+            assert store.pool.mirror['fmt'] == 'packed', force
+            assert ds.materialize('doc')['text'] == want_mid, force
+            ds.apply_changes('doc', block2)
+            assert store.pool.mirror['fmt'] == 'wide', force
+            assert metrics.counters.get(
+                'general_mirror_convert_packed_to_wide', 0) == c0 + 1
+            assert store.pool.max_tree > 0x7FFF
+            assert store.pool.max_elem >= (1 << 15)
+            assert ds.materialize('doc')['text'] == want_end, force
+            results[force] = (f.wires, store.doc_fields(0),
+                              store.save_snapshot())
+
+    if len(modes) == 2:
+        nat_wires, np_wires = results[True][0], results[False][0]
+        assert len(nat_wires) == len(np_wires)
+        for wi, (wa, wb) in enumerate(zip(nat_wires, np_wires)):
+            assert wa.shape == wb.shape, wi
+            assert (wa == wb).all(), (wi, 'wire bytes')
+        assert results[True][1] == results[False][1]
+
+    # resume: the restored long-text store builds the wide mirror
+    # directly and keeps serving the same document
+    import jax
+    resumed = GeneralStore.load_snapshot(results[False][2])
+    mir = resumed.pool.mirror
+    assert mir['fmt'] == 'wide'
+    assert resumed.pool.max_tree == n2 + 1
+    # the materialized wide words carry exactly the restored visibility
+    vis, idx = general.unpack_wide_word(
+        np.asarray(jax.device_get(mir['w2'][:mir['n']])))
+    rows = mir['pos_row'][:mir['n']]
+    np.testing.assert_array_equal(vis, resumed.pool.visible[rows])
+    np.testing.assert_array_equal(idx, resumed.pool.vis_index[rows])
 
 
 def test_resume_mirror_respects_packed_guard():
