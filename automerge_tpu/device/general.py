@@ -407,6 +407,13 @@ class GeneralStore(BlockStore):
         self._host_lock = self.pool._lock        # one lock, store-wide
         self._root_row = np.full(n_docs, -1, np.int64)
         self._obj_arr_cache = (0, None, None)
+        # per-document applied version: bumped for exactly the doc
+        # indexes an apply touched (the dirty-doc signal view caches
+        # key on — see GeneralDocSet materialization). Monotone per
+        # store; a failed apply rolls back BEFORE the bump, so cached
+        # views stay valid across the rollback path.
+        self._doc_version = np.zeros(n_docs, np.int64)
+        self._apply_seq = 0
         # deferred survivor commit of the LAST apply: the entry update
         # waits on a 33KB device fetch, so it is postponed until the
         # next reader of the entry columns — host staging of block n+1
@@ -669,9 +676,24 @@ class GeneralStore(BlockStore):
             pad = n_docs - self.n_docs
             self._root_row = np.concatenate(
                 [self._root_row, np.full(pad, -1, np.int64)])
+            self._doc_version = np.concatenate(
+                [self._doc_version, np.zeros(pad, np.int64)])
             self.n_docs = n_docs
 
     # -- objects -------------------------------------------------------------
+
+    def _bump_doc_versions(self, docs):
+        """Mark ``docs`` (sorted/unique doc indexes) dirty for view
+        caches — called once per successful apply, after every raise
+        point, so a rolled-back apply never invalidates a view."""
+        if len(docs):
+            self._apply_seq += 1
+            self._doc_version[docs] = self._apply_seq
+
+    def doc_version(self, d):
+        """The doc's applied version — equal versions guarantee the
+        materialized view is unchanged."""
+        return int(self._doc_version[d])
 
     def obj_arrays(self):
         """(obj_doc, obj_type) as int32 arrays, cached per table size."""
@@ -2138,8 +2160,11 @@ def _apply_general(store, block, options, return_timing):
     a_rows = np.flatnonzero((o_act == _SET) | (o_act == _DEL)
                             | (o_act == _LINK))
     if len(a_rows) == 0 and not len(ins_rows):
-        # make-only batch
+        # make-only batch: object creation still counts as a touch
+        # (conservative — a created-but-unlinked object is invisible,
+        # but the root creation rides the same path)
         _finish_empty(patch)
+        store._bump_doc_versions(np.unique(o_doc))
         return (patch, {'admit': t1 - t0}) if return_timing else patch
 
     la = st.la
@@ -2766,6 +2791,11 @@ def _apply_general(store, block, options, return_timing):
         'r_seg': r_seg, 'cat': cat, 'order': order, 'patch': patch,
     }
     t4 = time.perf_counter()
+
+    # dirty-doc signal for view caches: every raise point is behind us
+    # (the dispatch succeeded, the pending commit is installed), so the
+    # bump cannot leak through a rollback
+    store._bump_doc_versions(np.unique(o_doc))
 
     metrics.bump('general_batches')
     metrics.bump('general_ops', int(keep.sum()))

@@ -312,6 +312,82 @@ def to_device_state(state):
     return state._device_state
 
 
+# native-view switch: None = auto (use the C++ view gather when the
+# library loads), False = numpy only, True = REQUIRE native (tests:
+# fail loudly instead of silently falling back) — the read-side twin
+# of general._NATIVE_STAGING
+_NATIVE_VIEW = None
+
+
+def winner_select(field, rank):
+    """The batched read path's winner index: one stable sort of the
+    packed ``(obj_row << 32) | key`` field keys plus a per-segment
+    winner pick. Returns ``(fields, winner_pos)`` — the sorted distinct
+    field keys and, per field, the position IN THE INPUT ARRAYS of its
+    winning entry (max actor string rank = op_set.js:211's highest
+    actor; first-in-entry-order on ties = the stable first-applied
+    tie-break ``doc_fields_sorted`` implements per doc).
+
+    Runs on the native gather (``amst_view_winners``) when available;
+    the numpy path below is the byte-identical fallback."""
+    n = len(field)
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    if _NATIVE_VIEW is not False:
+        from .. import native as _amnative
+        out = _amnative.view_winners(field, rank)
+        if out is not None:
+            return out
+    if _NATIVE_VIEW is True:
+        raise RuntimeError('native view path required but unavailable')
+    order = np.argsort(field, kind='stable')
+    fs = field[order]
+    bnd = np.empty(n, bool)
+    bnd[0] = True
+    bnd[1:] = fs[1:] != fs[:-1]
+    starts = np.flatnonzero(bnd)
+    seg = np.cumsum(bnd) - 1
+    r = rank[order]
+    seg_max = np.maximum.reduceat(r, starts)
+    # first max-rank row of each segment; within a segment the stable
+    # field sort preserved entry order, so min position = first applied
+    cand = np.where(r == seg_max[seg], np.arange(n), n)
+    winner_pos = order[np.minimum.reduceat(cand, starts)]
+    return fs[starts], winner_pos
+
+
+def visible_walk(pool, objs):
+    """Visible elements of ALL of ``objs`` (ascending sequence object
+    rows) in one sweep: returns ``(seg, local, counts)`` where ``seg``
+    names the object (index into ``objs``), ``local`` the node's local
+    index, both grouped per object in document (vis_index) order, and
+    ``counts[k]`` the visible length of ``objs[k]`` — the fleet-wide
+    generalization of :func:`visible_seq_rows` (requires
+    ``pool.sync()``). Native (``amst_view_walk``) when available; the
+    numpy path is the byte-identical fallback."""
+    n_objs = len(objs)
+    if n_objs == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                np.zeros(0, np.int64))
+    if _NATIVE_VIEW is not False:
+        from .. import native as _amnative
+        out = _amnative.view_walk(objs, pool)
+        if out is not None:
+            return out
+    if _NATIVE_VIEW is True:
+        raise RuntimeError('native view path required but unavailable')
+    prows, counts_n = pool.rows_of_objs(objs)
+    seg_all = np.repeat(np.arange(n_objs, dtype=np.int64), counts_n)
+    vis = pool.visible[prows]
+    seg_v = seg_all[vis]
+    loc_v = pool.local[prows[vis]].astype(np.int64)
+    # vis_index is unique per object, so the composite sort is total
+    order = np.argsort((seg_v << 32) | pool.vis_index[prows[vis]],
+                       kind='stable')
+    counts = np.bincount(seg_v, minlength=n_objs).astype(np.int64)
+    return seg_v[order], loc_v[order], counts
+
+
 def doc_fields_sorted(store, idx, rows=None):
     """{packed field key: [entry rows, winner first]} for one document
     — entries sorted STABLE actor-descending (op_set.js:211: winner =

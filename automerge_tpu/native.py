@@ -339,6 +339,109 @@ def stage_available():
     return stage_lib() is not None
 
 
+# ---------------------------------------------------------------------------
+# Native view gather (the amst_view_* entry points of libamwire.so):
+# the batched materialization's field-sort + winner select and the
+# visible-element walk, byte-identical to the numpy fallbacks in
+# `device/general_backend.winner_select` / `visible_walk`.
+
+_VIEW_LIB = None
+_VIEW_ATTEMPTED = False
+
+
+def _bind_view(lib):
+    lib.amst_view_winners.argtypes = [_i64, _P64, _P64]
+    lib.amst_view_winners.restype = ctypes.c_void_p
+    lib.amst_view_walk.argtypes = [_i64, _P64, _P64, _P64, _i64, _P64,
+                                   _P32, _PU8, _P32]
+    lib.amst_view_walk.restype = ctypes.c_void_p
+    lib.amst_view_n.argtypes = [ctypes.c_void_p]
+    lib.amst_view_n.restype = _i64
+    lib.amst_view_fill.argtypes = [ctypes.c_void_p, _P64, _P64, _P64]
+    lib.amst_view_fill.restype = None
+    lib.amst_view_free.argtypes = [ctypes.c_void_p]
+    lib.amst_view_free.restype = None
+    return lib
+
+
+def view_lib():
+    """The view-gather library, or None (no native codec / stale
+    binary without the amst_view_* symbols /
+    AUTOMERGE_TPU_NATIVE_VIEW=0)."""
+    global _VIEW_LIB, _VIEW_ATTEMPTED
+    if _VIEW_ATTEMPTED:
+        return _VIEW_LIB
+    _VIEW_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE_VIEW', '1') == '0':
+        return None
+    from . import wire as _wire
+    lib = _wire._load()
+    if lib is None:
+        return None
+    try:
+        _VIEW_LIB = _bind_view(lib)
+    except AttributeError:
+        _VIEW_LIB = None             # stale .so predating the views
+    return _VIEW_LIB
+
+
+def view_available():
+    return view_lib() is not None
+
+
+def view_winners(field, rank):
+    """Native field-sort + winner select: ``(fields, winner_pos)`` for
+    packed int64 field keys and per-entry actor string ranks, or None
+    when the library is unavailable (caller falls back to numpy)."""
+    lib = view_lib()
+    if lib is None:
+        return None
+    field = _np.ascontiguousarray(field, _np.int64)
+    rank = _np.ascontiguousarray(rank, _np.int64)
+    h = lib.amst_view_winners(len(field), _p64(field), _p64(rank))
+    if not h:
+        raise MemoryError('native view allocation failed')
+    try:
+        m = int(lib.amst_view_n(h))
+        fields = _np.empty(m, _np.int64)
+        wpos = _np.empty(m, _np.int64)
+        lib.amst_view_fill(h, _p64(fields), _p64(wpos), None)
+    finally:
+        lib.amst_view_free(h)
+    return fields, wpos
+
+
+def view_walk(objs, pool):
+    """Native visible-element walk over ``objs`` (ascending sequence
+    object rows): ``(seg, local, counts)`` in per-object document
+    order, or None when the library is unavailable."""
+    lib = view_lib()
+    if lib is None:
+        return None
+    objs = _np.ascontiguousarray(objs, _np.int64)
+    n_of = _np.ascontiguousarray(pool.n_of, _np.int64)
+    pos_sorted = _np.ascontiguousarray(pool.pos_sorted, _np.int64)
+    pos_row = _np.ascontiguousarray(pool.pos_row, _np.int64)
+    local = _np.ascontiguousarray(pool.local, _np.int32)
+    visible = _np.ascontiguousarray(pool.visible, _np.uint8)
+    vis_index = _np.ascontiguousarray(pool.vis_index, _np.int32)
+    h = lib.amst_view_walk(
+        len(objs), _p64(objs), _p64(pos_sorted), _p64(pos_row),
+        pool.n_nodes, _p64(n_of), _p32(local),
+        visible.ctypes.data_as(_PU8), _p32(vis_index))
+    if not h:
+        raise MemoryError('native view allocation failed')
+    try:
+        m = int(lib.amst_view_n(h))
+        seg = _np.empty(m, _np.int64)
+        loc = _np.empty(m, _np.int64)
+        counts = _np.empty(len(objs), _np.int64)
+        lib.amst_view_fill(h, _p64(seg), _p64(loc), _p64(counts))
+    finally:
+        lib.amst_view_free(h)
+    return seg, loc, counts
+
+
 def _p32(a):
     return a.ctypes.data_as(_P32)
 

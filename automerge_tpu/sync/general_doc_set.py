@@ -99,6 +99,12 @@ class GeneralDocSet:
         self.handlers = []
         self._handles = {}
         self._entry_csr = (None, None, None)   # (e_doc ref, order, starts)
+        # dirty-doc view cache: idx -> (applied version, tree). The
+        # store bumps a per-doc version for exactly the docs an apply
+        # touched; clean docs re-serve the SAME tree object (treat
+        # materialized views as immutable), so a sparse tick
+        # re-materializes O(dirty), not O(fleet).
+        self._views = {}
 
     # -- DocSet surface ------------------------------------------------------
 
@@ -157,8 +163,11 @@ class GeneralDocSet:
     getDoc = get_doc
 
     def set_doc(self, doc_id, doc):
-        """Adopt a frontend document by replaying its change log into
-        the store (any document shape)."""
+        """Adopt a frontend document by replaying ONLY the changes the
+        store lacks: the document's log is filtered by the store's
+        clock for this doc index, so a live-edit loop (edit -> adopt ->
+        edit ...) pays O(new changes) per adoption, independent of
+        history length — not an O(history) full replay."""
         if isinstance(doc, GeneralDocHandle):
             if doc._doc_set is self:
                 return doc
@@ -166,7 +175,9 @@ class GeneralDocSet:
                 'handle belongs to a different GeneralDocSet')
         from .doc_set import backend_of as _backend_of
         state = Frontend.get_backend_state(doc)
-        changes = _backend_of(doc).get_missing_changes(state, {})
+        idx = self.id_of.get(doc_id)
+        have = self.store.clock_of(idx) if idx is not None else {}
+        changes = _backend_of(doc).get_missing_changes(state, have)
         return self.apply_changes(doc_id, changes)
 
     setDoc = set_doc
@@ -309,57 +320,330 @@ class GeneralDocSet:
             self._entry_csr = (store.e_doc, order, starts)
         return order[starts[idx]:starts[idx + 1]]
 
+    def _winner_view(self, rows):
+        """Winner index over entry rows ``rows`` (ascending original
+        positions; None = every entry): ``(fields, w_value, w_link,
+        plain)`` — the sorted distinct packed field keys, the winners'
+        value-table ids and link flags, and the winners' BULK-DECODED
+        plain values (None where the winner is a link or valueless).
+        One vectorized field-sort + segment-argmax
+        (:func:`~..device.general_backend.winner_select`, native when
+        available) replaces the per-map ``by_field`` dict scans."""
+        store = self.store
+        cache = getattr(store, '_e_field_cache', None)
+        if cache is not None and cache[0] is store.e_obj:
+            e_field = cache[1]
+        else:
+            e_field = (store.e_obj.astype(np.int64) << 32) | store.e_key
+            store._e_field_cache = (store.e_obj, e_field)
+        ranks = store.actor_str_ranks()
+        if rows is None:
+            field = e_field
+            rank = ranks[store.e_actor] if len(e_field) \
+                else np.zeros(0, np.int64)
+        else:
+            field = e_field[rows]
+            rank = ranks[store.e_actor[rows]]
+        from ..device.general_backend import winner_select
+        fields, wpos = winner_select(field, rank)
+        w_rows = wpos if rows is None else rows[wpos]
+        w_value = store.e_value[w_rows]
+        w_link = store.e_link[w_rows]
+        plain = store.values.take(np.where(w_link, -1, w_value))
+        return fields, w_value, w_link, plain
+
     def materialize(self, doc_id):
         """The nested JSON view of one document (winners only): maps as
         dicts, lists as Python lists, text as str, links resolved
-        recursively."""
-        from ..device.general_backend import (doc_fields_sorted,
-                                              visible_seq_rows)
+        recursively. Served from the dirty-doc view cache when the doc
+        is clean; on a miss this is the single-doc fallback of the
+        batched read path — the same winner index, assembled
+        recursively (objects rebuilt per path, cycles cut with a
+        mutable path set)."""
         idx = self.id_of.get(doc_id)
         if idx is None:
             raise KeyError(doc_id)
         store = self.store
         store._commit_pending()
         store.pool.sync()
+        ver = store.doc_version(idx)
+        hit = self._views.get(idx)
+        if hit is not None and hit[0] == ver:
+            return hit[1]
+        tree = self._build_single(idx)
+        self._views[idx] = (ver, tree)
+        return tree
+
+    def _build_single(self, idx):
+        """Recursive single-doc assembly over the winner index (the
+        per-doc fallback the parity suite checks the batched path
+        against)."""
+        from ..device.general_backend import visible_seq_rows
+        store = self.store
         root = int(store._root_row[idx])
         if root < 0:
             return {}
+        fields, w_value, w_link, plain = self._winner_view(
+            self._doc_entry_rows(idx))
+        pool = store.pool
+        get_obj = store.obj_of.get
 
-        by_field = doc_fields_sorted(store, idx,
-                                     rows=self._doc_entry_rows(idx))
+        def value_at(fi, path):
+            if w_link[fi]:
+                row = get_obj((idx, store.values[int(w_value[fi])]))
+                return build(row, path) if row is not None else None
+            return plain[fi]
 
-        def value_of(j, seen):
-            if store.e_link[j]:
-                uuid = store.values[store.e_value[j]]
-                row = store.obj_of.get((idx, uuid))
-                return build(row, seen) if row is not None else None
-            v = store.e_value[j]
-            return store.values[v] if v >= 0 else None
-
-        def build(obj_row, seen):
-            if obj_row in seen:
+        def build(obj_row, path):
+            if obj_row in path:
                 return None            # defensive: cyclic links
-            seen = seen | {obj_row}
-            t = store.obj_type[obj_row]
-            if t == _TYPE_MAP:
-                out = {}
-                for fkey, js in by_field.items():
-                    if (fkey >> 32) != obj_row or \
-                            (fkey & int(_ELEM_BIT)):
-                        continue
-                    key = store.keys[fkey & 0x7FFFFFFF]
-                    out[key] = value_of(js[0], seen)
-                return out
-            # sequence: visible elements in document order
-            pool = store.pool
-            vrows = visible_seq_rows(store, obj_row)
-            items = []
-            for r in vrows.tolist():
-                js = by_field.get((obj_row << 32) | int(_ELEM_BIT)
-                                  | int(pool.local[r]))
-                items.append(value_of(js[0], seen) if js else None)
-            if t == _TYPE_TEXT:
-                return ''.join(str(v) for v in items)
-            return items
+            path.add(obj_row)
+            try:
+                t = store.obj_type[obj_row]
+                base = np.int64(obj_row) << 32
+                if t == _TYPE_MAP:
+                    lo = np.searchsorted(fields, base)
+                    hi = np.searchsorted(fields, base | _ELEM_BIT)
+                    return {store.keys[int(fields[j]) & 0x7FFFFFFF]:
+                            value_at(j, path) for j in range(lo, hi)}
+                # sequence: visible elements in document order
+                vrows = visible_seq_rows(store, obj_row)
+                q = base | _ELEM_BIT | pool.local[vrows].astype(np.int64)
+                pos = np.minimum(np.searchsorted(fields, q),
+                                 max(len(fields) - 1, 0))
+                hit = (fields[pos] == q) if len(fields) \
+                    else np.zeros(len(q), bool)
+                items = [value_at(int(pos[i]), path) if hit[i] else None
+                         for i in range(len(q))]
+                if t == _TYPE_TEXT:
+                    return ''.join(str(v) for v in items)
+                return items
+            finally:
+                path.discard(obj_row)
 
-        return build(root, frozenset())
+        return build(root, set())
+
+    def materialize_many(self, doc_ids):
+        """Materialize several documents at once: clean docs come
+        straight from the view cache; all dirty docs rebuild in ONE
+        vectorized pass over the entry columns (:meth:`_build_batch`).
+        Returns trees aligned with ``doc_ids``. Views are shared with
+        the cache — treat them as immutable. Whole-fleet readers
+        should drain pending async applies first
+        (:func:`~..device.general.drain_general`)."""
+        store = self.store
+        idxs = []
+        for doc_id in doc_ids:
+            idx = self.id_of.get(doc_id)
+            if idx is None:
+                raise KeyError(doc_id)
+            idxs.append(idx)
+        store._commit_pending()
+        store.pool.sync()
+        dirty = []
+        for i in set(idxs):
+            hit = self._views.get(i)
+            if hit is None or hit[0] != store.doc_version(i):
+                dirty.append(i)
+        if dirty:
+            # version snapshot BEFORE the build: an apply landing
+            # mid-build re-dirties these docs rather than being masked
+            dirty_vers = {i: store.doc_version(i) for i in dirty}
+            for i, tree in self._build_batch(dirty).items():
+                self._views[i] = (dirty_vers[i], tree)
+        return [self._views[i][1] for i in idxs]
+
+    def materialize_all(self):
+        """``{doc_id: tree}`` for the whole fleet — the batched k-doc
+        read path (ROADMAP "Batched materialization")."""
+        return dict(zip(self.ids,
+                        self.materialize_many(list(self.ids))))
+
+    def _build_batch(self, idxs):
+        """Vectorized materialization of doc indexes ``idxs``: one
+        winner-select over their entry rows, one visible-element walk
+        over ALL their sequence objects, values decoded in bulk, then
+        a single fill pass that builds every object exactly once
+        (links resolved by reference, cycles cut, text joined last).
+        Returns ``{idx: tree}``."""
+        from ..device.general_backend import visible_walk
+        store = self.store
+        idx_arr = np.asarray(sorted(idxs), np.int64)
+        # entry rows of the requested docs: one O(entries) mask pass
+        # unless the request covers the whole fleet
+        if len(idx_arr) >= len(self.ids):
+            rows = None
+        else:
+            want = np.zeros(store.n_docs, bool)
+            want[idx_arr] = True
+            rows = np.flatnonzero(want[store.e_doc])
+        fields, w_value, w_link, plain = self._winner_view(rows)
+
+        # containers for every object of the requested docs, built
+        # exactly once (reachability is implicit: unlinked objects are
+        # simply never referenced)
+        obj_doc_arr, obj_type_arr = store.obj_arrays()
+        if len(obj_doc_arr):
+            want_d = np.zeros(store.n_docs, bool)
+            want_d[idx_arr] = True
+            objs_sel = np.flatnonzero(want_d[obj_doc_arr])
+        else:
+            objs_sel = np.zeros(0, np.int64)
+        cont = {}
+        for orow in objs_sel.tolist():
+            cont[orow] = {} if obj_type_arr[orow] == _TYPE_MAP else []
+
+        # link winners resolve to child object rows (rare: one dict
+        # lookup per link field)
+        f_obj = (fields >> 32).astype(np.int64)
+        child_of = np.full(len(fields), -1, np.int64)
+        link_fi = np.flatnonzero(w_link)
+        if len(link_fi):
+            link_uuids = store.values.take(w_value[link_fi])
+            get_obj = store.obj_of.get
+            for k, fi in enumerate(link_fi.tolist()):
+                r = get_obj((int(obj_doc_arr[f_obj[fi]]),
+                             link_uuids[k]))
+                if r is not None:
+                    child_of[fi] = r
+
+        # out_links: parent obj row -> [(container, slot, child row)]
+        # — the link-edge record the cycle cut and text join walk
+        out_links = {}
+
+        def place_link(orow, container, slot, fi):
+            ch = int(child_of[fi])
+            child = cont.get(ch) if ch >= 0 else None
+            container[slot] = child
+            if child is not None:
+                out_links.setdefault(orow, []).append(
+                    (container, slot, ch))
+
+        # map fields (elem bit clear, parent is a map)
+        if len(fields):
+            is_map_f = ~((fields & _ELEM_BIT) != 0)
+            is_map_f &= obj_type_arr[f_obj] == _TYPE_MAP
+            keys_tab = store.keys
+            for fi in np.flatnonzero(is_map_f).tolist():
+                d = cont.get(int(f_obj[fi]))
+                if d is None:
+                    continue           # object of an unrequested doc
+                key = keys_tab[int(fields[fi]) & 0x7FFFFFFF]
+                if w_link[fi]:
+                    place_link(int(f_obj[fi]), d, key, fi)
+                else:
+                    d[key] = plain[fi]
+
+        # sequences: ONE visible-element sweep over every list/text
+        # object of the requested docs, then one searchsorted resolves
+        # each element's winner field
+        if len(objs_sel):
+            seq_objs = objs_sel[obj_type_arr[objs_sel] != _TYPE_MAP] \
+                .astype(np.int64)
+        else:
+            seq_objs = objs_sel
+        seg, local, counts = visible_walk(store.pool, seq_objs)
+        starts = np.zeros(len(seq_objs) + 1, np.int64)
+        if len(seq_objs):
+            np.cumsum(counts, out=starts[1:])
+        if len(seg):
+            q = (seq_objs[seg] << 32) | _ELEM_BIT | local
+            pos = np.minimum(np.searchsorted(fields, q),
+                             max(len(fields) - 1, 0))
+            hit = (fields[pos] == q) if len(fields) \
+                else np.zeros(len(q), bool)
+            # bulk element values (plain decodes; a link's plain is
+            # None, fixed up below), one list comp + extend per object
+            item_vals = [plain[p] if h else None
+                         for p, h in zip(pos.tolist(), hit.tolist())]
+            starts_l = starts.tolist()
+            for k, orow in enumerate(seq_objs.tolist()):
+                cont[orow].extend(
+                    item_vals[starts_l[k]:starts_l[k + 1]])
+            for i in np.flatnonzero(hit & w_link[pos]).tolist():
+                k = int(seg[i])
+                orow = int(seq_objs[k])
+                place_link(orow, cont[orow],
+                           int(i - starts[k]), int(pos[i]))
+
+        # cycle cut: DFS from each root over the link edges; a link to
+        # an object on the current path nulls out (the batched reading
+        # of the per-doc path's frozenset guard). O(links). Known
+        # divergence from the per-doc fallback: objects build ONCE
+        # here, so on a CYCLIC graph reachable via several paths the
+        # cut lands relative to the first discovery path, while the
+        # per-doc path re-unrolls the cycle per access path. Acyclic
+        # documents (anything the reference frontend can produce,
+        # including DAG-shared links) are value-identical on both
+        # paths.
+        state = {}
+        for idx in idx_arr.tolist():
+            root = int(store._root_row[idx])
+            if root < 0:
+                continue
+            stack = [(root, iter(out_links.get(root, ())))]
+            state[root] = 1
+            while stack:
+                row, it = stack[-1]
+                nxt = next(it, None)
+                if nxt is None:
+                    state[row] = 2
+                    stack.pop()
+                    continue
+                container, slot, child = nxt
+                st_c = state.get(child, 0)
+                if st_c == 1:
+                    container[slot] = None
+                elif st_c == 0:
+                    state[child] = 1
+                    stack.append(
+                        (child, iter(out_links.get(child, ()))))
+
+        # text joins LAST (after the cut, so a cut link stays None):
+        # every un-cut reference to a text object is replaced by its
+        # joined string, INNER-FIRST over the link graph — a text (or
+        # a container inside one) linking to another text embeds the
+        # joined string, never the raw element list. The cut pass
+        # broke every reachable cycle; `joining` guards unreachable
+        # text cycles.
+        if len(objs_sel):
+            text_rows = objs_sel[obj_type_arr[objs_sel] == _TYPE_TEXT]
+            if len(text_rows):
+                tset = set(text_rows.tolist())
+                joined = {}
+                joining = set()
+                resolved = set()
+
+                def resolve(obj):
+                    """Replace text-link slots in obj's subtree."""
+                    if obj in resolved:
+                        return
+                    resolved.add(obj)
+                    for container, slot, child in \
+                            out_links.get(obj, ()):
+                        if child in tset:
+                            if container[slot] is cont[child]:
+                                container[slot] = join(child)
+                        else:
+                            resolve(child)
+
+                def join(r):
+                    s = joined.get(r)
+                    if s is None:
+                        if r in joining:
+                            return None    # unreachable text cycle
+                        joining.add(r)
+                        resolve(r)
+                        joining.discard(r)
+                        s = joined[r] = ''.join(str(v)
+                                                for v in cont[r])
+                    return s
+
+                for obj in list(out_links):
+                    resolve(obj)
+
+        out = {}
+        for idx in idx_arr.tolist():
+            root = int(store._root_row[idx])
+            out[idx] = cont[root] if root >= 0 else {}
+        return out

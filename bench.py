@@ -538,6 +538,47 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     return n_docs, n_ops, n_msgs, dt
 
 
+def bench_general_materialize_10k(n_docs=10240, list_ops=22,
+                                  dirty_frac=0.01):
+    """The read-side twin of `bench_general_sync_10k`: the config-5
+    destination fleet materializes COLD (every doc rebuilt through the
+    batched k-doc read path — one fleet-wide winner select + one
+    visible-element walk), then a sparse tick dirties ``dirty_frac``
+    of the docs and the fleet re-materializes — the dirty-doc view
+    cache makes that pass O(dirty), not O(fleet)."""
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+    per_doc = _gen_mixed_docs(n_docs, list_ops)
+    ds = GeneralDocSet(n_docs)
+    ds.apply_changes_batch(
+        {f'doc{d}': per_doc[d] for d in range(n_docs)})
+
+    t0 = time.perf_counter()
+    views = ds.materialize_all()
+    t_cold = time.perf_counter() - t0
+    got = views[f'doc{n_docs - 1}']
+    assert got['meta'] == n_docs - 1 and len(got['items']) == list_ops
+
+    # 1%-dirty tick: one more root set on every ``dirty_frac`` doc
+    n_dirty = max(int(n_docs * dirty_frac), 1)
+    step = n_docs // n_dirty
+    tick = {f'doc{d}': [{'actor': f'w1-{d}', 'seq': 2,
+                         'deps': {f'w0-{d}': 1},
+                         'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                  'key': 'meta', 'value': -d}]}]
+            for d in range(0, n_dirty * step, step)}
+    ds.apply_changes_batch(tick)
+    t0 = time.perf_counter()
+    views2 = ds.materialize_all()
+    t_dirty = time.perf_counter() - t0
+    assert views2[f'doc{step}']['meta'] == -step
+    if step > 1:
+        # clean docs re-serve the cached tree object
+        assert views2['doc1'] is views['doc1']
+    return n_docs, n_dirty, t_cold, t_dirty
+
+
 def bench_dense_breakdown(iters=20):
     """Where the dense-path e2e vs kernel ops/s gap lives: one
     return_timing line splitting the config-5 apply into admission,
@@ -1048,6 +1089,16 @@ def main():
         f'{n_10k / t_10k:.0f} docs/s ({n_10k_ops / t_10k / 1e6:.2f}M '
         f'ops/s; destination auto-grew 1024 -> {n_10k} docs)')
 
+    n_mat, n_mat_dirty, t_mat_cold, t_mat_dirty = \
+        bench_general_materialize_10k()
+    log(f'materialize[general 10k, batched read path]: {n_mat} rich '
+        f'docs cold in {t_mat_cold:.3f}s '
+        f'({n_mat / t_mat_cold:.0f} docs/s, one fleet-wide winner '
+        f'select + visible walk); {n_mat_dirty}-doc dirty tick '
+        f're-materializes the fleet in {t_mat_dirty * 1e3:.0f} ms '
+        f'({t_mat_cold / max(t_mat_dirty, 1e-9):.0f}x over cold — '
+        f'the view cache serves every clean doc)')
+
     wb, wops, t_nat, t_py = bench_wire_parse()
     if t_nat is not None:
         log(f'wire-parse[native codec]: {wb >> 20} MiB JSON / {wops} ops — '
@@ -1154,6 +1205,9 @@ def main():
         'general_sync_docs_per_sec': round(n_gd / t_gbatch, 1),
         'general_sync10k_docs_per_sec': round(n_10k / t_10k, 1),
         'general_sync10k_ops_per_sec': round(n_10k_ops / t_10k, 1),
+        'general_materialize_docs_per_sec': round(n_mat / t_mat_cold,
+                                                  1),
+        'general_rematerialize_dirty_ms': round(t_mat_dirty * 1e3, 2),
         'trace_general_ops_per_sec': round(tr_ops / t_trace, 1),
         'trace_general_fmt': trace_fmt,
         'dense_breakdown_ms': {k: round(v * 1e3, 2)

@@ -1569,3 +1569,102 @@ void amst_fill_wire_wide(void* h, uint8_t* wire, int64_t cap,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batched materialization view gather (the amst_view_* entry points).
+//
+// The k-doc read path (sync/general_doc_set.py materialize_many) spends
+// its vectorized time in two gathers: the fleet-wide stable field sort
+// with per-segment winner select, and the visible-element walk of every
+// sequence object in document order. Both run here in one C++ call
+// each, byte-identical to the numpy fallback in
+// device/general_backend.py (same stable order, same winner tie-break:
+// max actor string rank, first-in-entry-order on ties). All pointers
+// are borrowed and must stay alive until amst_view_free.
+
+namespace view {
+
+struct View {
+    std::vector<int64_t> a;        // winners: fields  | walk: seg
+    std::vector<int64_t> b;        // winners: wpos    | walk: local
+    std::vector<int64_t> c;        // winners: (empty) | walk: counts
+};
+
+}  // namespace view
+
+extern "C" {
+
+void* amst_view_winners(int64_t n, const int64_t* field,
+                        const int64_t* rank) {
+    auto* v = new view::View();
+    std::vector<int64_t> key(field, field + n), idx(n);
+    for (int64_t i = 0; i < n; i++) idx[i] = i;
+    stage::radix_sort_pairs(key, idx);     // stable: numpy argsort order
+    v->a.reserve(n);
+    v->b.reserve(n);
+    int64_t cur_max = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (i == 0 || key[i] != key[i - 1]) {
+            v->a.push_back(key[i]);
+            v->b.push_back(idx[i]);
+            cur_max = rank[idx[i]];
+        } else if (rank[idx[i]] > cur_max) {  // strict: ties keep first
+            cur_max = rank[idx[i]];
+            v->b.back() = idx[i];
+        }
+    }
+    return v;
+}
+
+void* amst_view_walk(int64_t n_objs, const int64_t* objs,
+                     const int64_t* pos_sorted, const int64_t* pos_row,
+                     int64_t n_pool, const int64_t* n_of,
+                     const int32_t* local, const uint8_t* visible,
+                     const int32_t* vis_index) {
+    auto* v = new view::View();
+    std::vector<int64_t> comp, loc;
+    std::vector<int64_t> counts(n_objs, 0);
+    for (int64_t k = 0; k < n_objs; k++) {
+        int64_t obj = objs[k];
+        const int64_t* lo = std::lower_bound(pos_sorted,
+                                             pos_sorted + n_pool,
+                                             obj << 32);
+        int64_t start = lo - pos_sorted;
+        int64_t cnt = n_of[obj];
+        for (int64_t j = 0; j < cnt; j++) {
+            int64_t row = pos_row[start + j];
+            if (!visible[row]) continue;
+            comp.push_back((k << 32) |
+                           static_cast<int64_t>(vis_index[row]));
+            loc.push_back(local[row]);
+            counts[k]++;
+        }
+    }
+    int64_t m = static_cast<int64_t>(comp.size());
+    std::vector<int64_t> idx(m);
+    for (int64_t i = 0; i < m; i++) idx[i] = i;
+    stage::radix_sort_pairs(comp, idx);
+    v->a.resize(m);
+    v->b.resize(m);
+    for (int64_t i = 0; i < m; i++) {
+        v->a[i] = comp[i] >> 32;
+        v->b[i] = loc[idx[i]];
+    }
+    v->c = std::move(counts);
+    return v;
+}
+
+int64_t amst_view_n(void* h) {
+    return static_cast<int64_t>(static_cast<view::View*>(h)->a.size());
+}
+
+void amst_view_fill(void* h, int64_t* a, int64_t* b, int64_t* c) {
+    auto* v = static_cast<view::View*>(h);
+    std::memcpy(a, v->a.data(), v->a.size() * 8);
+    std::memcpy(b, v->b.data(), v->b.size() * 8);
+    if (c) std::memcpy(c, v->c.data(), v->c.size() * 8);
+}
+
+void amst_view_free(void* h) { delete static_cast<view::View*>(h); }
+
+}  // extern "C"
