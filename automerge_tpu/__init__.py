@@ -228,10 +228,12 @@ get_object_id = Frontend.get_object_id
 get_element_ids = Frontend.get_element_ids
 
 from .config import Options                 # noqa: E402
-from .snapshot import save_snapshot, load_snapshot  # noqa: E402
+from .snapshot import (save_snapshot, load_snapshot,  # noqa: E402
+                       SnapshotCorruptError)
 from .sync.doc_set import DocSet            # noqa: E402
 from .sync.watchable_doc import WatchableDoc  # noqa: E402
-from .sync.connection import Connection     # noqa: E402
+from .sync.connection import Connection, MessageRejected  # noqa: E402
+from .sync.resilient import ResilientConnection  # noqa: E402
 
 # camelCase aliases (reference API parity)
 emptyChange = empty_change

@@ -1942,6 +1942,7 @@ def apply_general_block(store, block, options=None, return_timing=False):
             # admission/object creation mutated the store — the
             # store-intact-on-error contract holds for all of them
             txn.rollback(store)
+            metrics.bump('apply_rollbacks')
             raise
 
 

@@ -35,6 +35,45 @@ from .device import backend as DeviceBackend
 FORMAT = 'automerge-tpu-snapshot@1'
 
 
+class SnapshotCorruptError(ValueError):
+    """A snapshot payload failed validation: truncated bytes, non-JSON
+    text, a checksum mismatch, or a missing/mistyped field. Every
+    load path raises this (naming what failed) instead of leaking a
+    bare ``KeyError``/``JSONDecodeError`` from deep inside
+    reconstruction — a corrupt checkpoint must be a clean, catchable
+    condition, not a crash."""
+
+
+def _require(payload, fields, what):
+    """Validate that ``payload`` is a dict carrying every name in
+    ``fields``; raise :class:`SnapshotCorruptError` naming the first
+    missing field."""
+    if not isinstance(payload, dict):
+        raise SnapshotCorruptError(
+            f'{what}: payload is {type(payload).__name__}, not a dict')
+    for name in fields:
+        if name not in payload:
+            raise SnapshotCorruptError(
+                f"{what}: missing field '{name}' (truncated or "
+                f"corrupt snapshot)")
+
+
+def _corrupt_guard(fn, what):
+    """Run reconstruction ``fn``; fold any mistyped-field crash
+    (AttributeError/TypeError/ValueError/KeyError/...) into the
+    documented :class:`SnapshotCorruptError` contract — presence checks
+    alone cannot cover every corruption shape, and a load path must
+    never leak a bare reconstruction traceback."""
+    try:
+        return fn()
+    except SnapshotCorruptError:
+        raise
+    except Exception as err:
+        raise SnapshotCorruptError(
+            f'{what}: payload failed to reconstruct '
+            f'({type(err).__name__}: {err})') from err
+
+
 def snapshot_state(state):
     """DeviceBackendState -> JSON-ready dict (no op payload duplication:
     field entries reference values inline, change bodies are dropped)."""
@@ -71,15 +110,28 @@ def snapshot_state(state):
 
 
 def restore_state(payload):
-    """JSON dict -> DeviceBackendState (O(state))."""
-    if payload.get('format') != FORMAT:
-        raise ValueError(f'not a {FORMAT} snapshot')
+    """JSON dict -> DeviceBackendState (O(state)). Raises
+    :class:`SnapshotCorruptError` (naming what failed) on a truncated,
+    field-missing or mistyped payload."""
+    _require(payload, ('format',), 'snapshot')
+    if payload['format'] != FORMAT:
+        raise SnapshotCorruptError(f'not a {FORMAT} snapshot')
+    _require(payload, ('objects', 'fields', 'clock', 'deps', 'queue',
+                       'closures'), 'snapshot')
+    return _corrupt_guard(lambda: _restore_state_unchecked(payload),
+                          'snapshot')
+
+
+def _restore_state_unchecked(payload):
     state = DeviceBackendState()
     state.objects = {}
     for entry in payload['objects']:
+        _require(entry, ('obj', 'type', 'inbound'), 'snapshot object')
         rec = _ObjRecord(entry['type'])
         rec.inbound = [tuple(ref) for ref in entry['inbound']]
         if rec.is_sequence():
+            _require(entry, ('nodes', 'parent', 'elem', 'actor',
+                             'elem_ids'), 'snapshot sequence object')
             rec.nodes = list(entry['nodes'])
             rec.node_of = {e: i for i, e in enumerate(rec.nodes)}
             rec.node_parent = list(entry['parent'])
@@ -142,19 +194,38 @@ def _snapshot_general(state):
 
 def _restore_general(payload, actor_id=None):
     import base64
+    import binascii
     from .device import general as _general
     from .device import general_backend as _gb
-    store = _general.GeneralStore.load_snapshot(
-        base64.b64decode(payload['store']))
+    _require(payload, ('store', 'clock', 'deps', 'all_deps'),
+             'general snapshot')
+    try:
+        store_bytes = base64.b64decode(payload['store'])
+    except (binascii.Error, TypeError, ValueError) as err:
+        raise SnapshotCorruptError(
+            f"general snapshot: field 'store' is not valid base64 "
+            f'({err})') from None
+    try:
+        store = _general.GeneralStore.load_snapshot(store_bytes)
+    except SnapshotCorruptError:
+        raise
+    except Exception as err:
+        raise SnapshotCorruptError(
+            f"general snapshot: field 'store' failed to decode "
+            f'({type(err).__name__}: {err})') from err
     store._gb_version = 0
-    state = _gb.GeneralBackendState(
-        store, 0, dict(payload['clock']), dict(payload['deps']),
-        {(a, s): d for a, s, d in payload['all_deps']})
-    state.undo_pos = payload.get('undo_pos', 0)
-    state.undo_stack = [list(ops) for ops
-                        in payload.get('undo_stack', [])]
-    state.redo_stack = [list(ops) for ops
-                        in payload.get('redo_stack', [])]
+
+    def build():
+        state = _gb.GeneralBackendState(
+            store, 0, dict(payload['clock']), dict(payload['deps']),
+            {(a, s): d for a, s, d in payload['all_deps']})
+        state.undo_pos = payload.get('undo_pos', 0)
+        state.undo_stack = [list(ops) for ops
+                            in payload.get('undo_stack', [])]
+        state.redo_stack = [list(ops) for ops
+                            in payload.get('redo_stack', [])]
+        return state
+    state = _corrupt_guard(build, 'general snapshot')
     options = {'backend': DeviceBackend}
     if actor_id is not None:
         options['actorId'] = actor_id
@@ -182,8 +253,21 @@ def save_snapshot(doc):
 
 
 def load_snapshot(data, actor_id=None):
-    """Materialize a document from a packed snapshot in O(state)."""
-    payload = _json.loads(data)
+    """Materialize a document from a packed snapshot in O(state).
+
+    Every corruption mode — truncated bytes, non-JSON text, missing
+    fields — surfaces as a :class:`SnapshotCorruptError` naming what
+    failed, never a bare ``JSONDecodeError``/``KeyError``."""
+    try:
+        payload = _json.loads(data)
+    except (ValueError, TypeError) as err:
+        raise SnapshotCorruptError(
+            f'snapshot payload is not valid JSON (truncated or '
+            f'corrupt): {err}') from None
+    if not isinstance(payload, dict):
+        raise SnapshotCorruptError(
+            f'snapshot payload decodes to {type(payload).__name__}, '
+            f'not an object')
     if payload.get('format') == GENERAL_FORMAT:
         return _restore_general(payload, actor_id=actor_id)
     state = restore_state(payload)

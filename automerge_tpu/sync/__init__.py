@@ -12,7 +12,10 @@ from .device_doc_set import DeviceDocSet
 from .dense_doc_set import DenseDocSet
 from .general_doc_set import GeneralDocSet
 from .watchable_doc import WatchableDoc
-from .connection import Connection, BatchingConnection
+from .connection import (Connection, BatchingConnection,
+                         MessageRejected, validate_msg)
+from .resilient import ResilientConnection
 
 __all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
-           'WatchableDoc', 'Connection', 'BatchingConnection']
+           'WatchableDoc', 'Connection', 'BatchingConnection',
+           'MessageRejected', 'validate_msg', 'ResilientConnection']

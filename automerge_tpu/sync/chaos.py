@@ -1,0 +1,254 @@
+"""ChaosTransport: a seeded adversarial message fabric for sync fleets.
+
+The convergence claim of the whole system — replicas that exchange
+messages end up byte-identical — is only worth something if it holds
+when the transport misbehaves. This module is the harness that proves
+it: a deterministic (seeded) in-process network between N peers that
+drops, duplicates, delays/reorders, corrupts and partitions envelopes
+on schedule, plus a fleet driver that wires
+:class:`~.resilient.ResilientConnection` endpoints over it, ticks
+logical time, and checks byte-identical convergence of every peer's
+materialized state against a clean run.
+
+Used by ``tests/test_chaos.py`` (the chaos convergence suite, pinned
+seeds in CI) and ``bench.py``'s ``bench_degraded_link`` (the config-5
+10k-doc fleet under 5%/20% loss).
+
+Everything is logical-time and seeded — a failing schedule replays
+exactly from its seed, which is what makes transport bugs debuggable.
+"""
+
+import copy
+import json
+import random
+from collections import Counter
+
+from .resilient import ResilientConnection
+
+
+def doc_view(doc):
+    """Plain-JSON materialization of one document (frontend docs and
+    GeneralDocHandles alike) — the byte-identity comparand."""
+    if hasattr(doc, 'materialize'):
+        return doc.materialize()
+
+    def conv(obj):
+        name = type(obj).__name__
+        if name == 'Text':
+            return ''.join(str(c) for c in obj)
+        if name == 'AmList':
+            return [conv(v) for v in obj]
+        if hasattr(obj, '_conflicts') or hasattr(obj, 'items'):
+            return {k: conv(v) for k, v in obj.items()}
+        return obj
+    return conv(doc)
+
+
+def doc_set_view(doc_set):
+    """``{doc_id: plain tree}`` for a whole doc set (uses the batched
+    read path when the doc set has one)."""
+    if hasattr(doc_set, 'materialize_all'):
+        return dict(doc_set.materialize_all())
+    return {doc_id: doc_view(doc_set.get_doc(doc_id))
+            for doc_id in doc_set.doc_ids}
+
+
+def canonical(view):
+    """Canonical byte encoding of a view — equality here IS
+    byte-identical convergence."""
+    return json.dumps(view, sort_keys=True, default=str)
+
+
+class ChaosFleet:
+    """N peers over a full-mesh adversarial fabric.
+
+    ``doc_sets`` is a list of DocSet-like objects (one per peer); each
+    directed link gets a :class:`ResilientConnection` endpoint. Per-tick
+    scheduling: deliver every envelope whose delay expired, advance
+    every endpoint's logical clock (retransmits + heartbeats), then
+    flush batching endpoints. Fault injection happens at SEND time from
+    one seeded RNG, so a schedule is a pure function of the seed.
+
+    Fault knobs: ``drop``/``dup``/``corrupt`` are per-envelope
+    probabilities; ``delay`` is the max extra ticks of random delivery
+    delay (0 = in-order); :meth:`partition`/:meth:`heal` sever and
+    restore node pairs (severed links drop everything, like a dead
+    cable, not like a polite shutdown).
+    """
+
+    def __init__(self, doc_sets, seed=0, drop=0.0, dup=0.0, delay=0,
+                 corrupt=0.0, batching=True, heartbeat_every=8,
+                 conn_kwargs=None):
+        self.doc_sets = list(doc_sets)
+        self.rng = random.Random(seed)
+        self.drop = drop
+        self.dup = dup
+        self.delay = delay
+        self.corrupt = corrupt
+        self.batching = batching
+        self.now = 0
+        self._order = 0
+        self.stats = Counter()
+        self.queues = {}                 # (frm, to) -> [[due, order, env]]
+        self.conns = {}                  # (owner, peer) -> endpoint
+        self.partitioned = set()         # frozenset({a, b})
+        self._conn_kwargs = dict(conn_kwargs or {})
+        self._conn_kwargs.setdefault('heartbeat_every', heartbeat_every)
+        nodes = range(len(self.doc_sets))
+        for a in nodes:
+            for b in nodes:
+                if a != b:
+                    self.queues[(a, b)] = []
+        for a in nodes:
+            for b in nodes:
+                if a != b:
+                    self._make_conn(a, b)
+        for conn in self.conns.values():
+            conn.open()
+
+    def _make_conn(self, owner, peer):
+        conn = ResilientConnection(
+            self.doc_sets[owner], self._sender(owner, peer),
+            batching=self.batching,
+            seed=self.rng.randrange(1 << 30), **self._conn_kwargs)
+        self.conns[(owner, peer)] = conn
+        return conn
+
+    # -- the adversarial link ------------------------------------------------
+
+    def _sender(self, frm, to):
+        def send(env):
+            self.stats['sent'] += 1
+            if frozenset((frm, to)) in self.partitioned:
+                self.stats['partition_dropped'] += 1
+                return
+            copies = 1
+            if self.drop and self.rng.random() < self.drop:
+                self.stats['dropped'] += 1
+                copies = 0
+            elif self.dup and self.rng.random() < self.dup:
+                self.stats['duplicated'] += 1
+                copies = 2
+            for _ in range(copies):
+                e = env
+                if self.corrupt and self.rng.random() < self.corrupt:
+                    self.stats['corrupted'] += 1
+                    e = self._corrupt_env(env)
+                due = self.now + 1 + (self.rng.randrange(self.delay + 1)
+                                      if self.delay else 0)
+                self._order += 1
+                self.queues[(frm, to)].append([due, self._order, e])
+        return send
+
+    def _corrupt_env(self, env):
+        """One seeded mutation: flipped checksum, bogus version, mangled
+        seq/kind, or a field torn out of the payload — every shape the
+        receiver must survive (and count) without crashing."""
+        env = copy.deepcopy(env)
+        mode = self.rng.randrange(5)
+        if mode == 0:
+            env['sum'] = env.get('sum', 0) ^ 0x5A5A5A5A
+        elif mode == 1:
+            env['v'] = 99
+        elif mode == 2:
+            env['seq'] = 'corrupt'
+        elif mode == 3:
+            env['kind'] = 'garbage'
+        else:
+            body = env.get('payload') if isinstance(
+                env.get('payload'), dict) else env.get('clocks')
+            if isinstance(body, dict) and body:
+                del body[self.rng.choice(sorted(body, key=str))]
+            else:
+                env['sum'] = -1
+        return env
+
+    # -- partitions ----------------------------------------------------------
+
+    def partition(self, a, b):
+        """Sever the (bidirectional) link between peers a and b; queued
+        traffic on the link is lost too (a dead cable, not a drain)."""
+        self.partitioned.add(frozenset((a, b)))
+        self.queues[(a, b)].clear()
+        self.queues[(b, a)].clear()
+
+    def heal(self, a, b):
+        self.partitioned.discard(frozenset((a, b)))
+
+    # -- time ----------------------------------------------------------------
+
+    def tick(self):
+        """One network quantum: deliver due envelopes (per-link, in due
+        order), advance every endpoint's clock, flush batching
+        endpoints."""
+        self.now += 1
+        for (frm, to), q in self.queues.items():
+            if not q:
+                continue
+            due = [m for m in q if m[0] <= self.now]
+            if not due:
+                continue
+            q[:] = [m for m in q if m[0] > self.now]
+            for _, _, env in sorted(due):
+                self.stats['delivered'] += 1
+                self.conns[(to, frm)].receive_msg(env)
+        for conn in self.conns.values():
+            conn.tick()
+        if self.batching:
+            for conn in self.conns.values():
+                conn.flush()
+
+    def pending(self):
+        """Traffic still in flight: queued envelopes or unacked sends
+        awaiting retransmission."""
+        return any(self.queues.values()) or \
+            any(c.in_flight for c in self.conns.values())
+
+    # -- convergence ---------------------------------------------------------
+
+    def views(self):
+        return [doc_set_view(ds) for ds in self.doc_sets]
+
+    def converged(self):
+        views = [canonical(v) for v in self.views()]
+        return all(v == views[0] for v in views[1:])
+
+    def run(self, max_ticks=2000, min_ticks=0):
+        """Tick until every peer's materialization is byte-identical
+        and the fabric is quiet; returns the tick count. Raises if the
+        fleet has not converged by ``max_ticks`` (a chaos schedule that
+        defeats the resilience layer is a test failure, not a hang)."""
+        while self.now < max_ticks:
+            self.tick()
+            if self.now >= min_ticks and not self.pending() \
+                    and self.converged():
+                return self.now
+        raise RuntimeError(
+            f'fleet failed to converge within {max_ticks} ticks '
+            f'(stats: {dict(self.stats)})')
+
+    def close(self):
+        """Detach every endpoint from its doc set (so a doc set can be
+        reused across fleets, e.g. by the bench's loss-rate sweep)."""
+        for conn in self.conns.values():
+            conn.close()
+
+    # -- crash/restart -------------------------------------------------------
+
+    def reconnect(self, node, doc_set=None):
+        """Crash-restart peer ``node``: all its in-flight traffic is
+        lost, its doc set is replaced (e.g. recovered from snapshot +
+        journal), and every adjacent link re-establishes with FRESH
+        envelope sessions on both ends — exactly what a process restart
+        does to a connection."""
+        if doc_set is not None:
+            self.doc_sets[node] = doc_set
+        for (owner, peer), conn in list(self.conns.items()):
+            if node not in (owner, peer):
+                continue
+            try:
+                conn.close()
+            except Exception:
+                pass                     # the crashed side's handler is gone
+            self.queues[(owner, peer)].clear()
+            self._make_conn(owner, peer).open()

@@ -18,6 +18,81 @@ from ..utils.metrics import metrics
 from .doc_set import backend_of as _backend_of
 
 
+class MessageRejected(ValueError):
+    """An incoming sync message failed envelope/schema validation.
+
+    Raised by :meth:`Connection.receive_msg` BEFORE any state mutation
+    — a rejected message never pollutes ``_their_clock`` or reaches an
+    apply path. Counted under ``sync_msgs_rejected``; the message names
+    the offending field so a hostile or buggy peer is diagnosable from
+    the log line alone."""
+
+
+def _reject(reason):
+    metrics.bump('sync_msgs_rejected')
+    raise MessageRejected(reason)
+
+
+def validate_msg(msg):
+    """Validate the logical sync-message schema (advertisement, ack,
+    request, data or snapshot): ``docId`` a string, ``clock`` a dict of
+    ``str -> non-negative int`` seqs, ``changes`` (when present) a list
+    of change dicts each carrying ``actor``/``seq``/``deps``/``ops``
+    with sane types. Raises :class:`MessageRejected` (and bumps
+    ``sync_msgs_rejected``) on the first violation; returns ``msg``."""
+    if not isinstance(msg, dict):
+        _reject(f'message is {type(msg).__name__}, not a dict')
+    doc_id = msg.get('docId')
+    if not isinstance(doc_id, str):
+        _reject(f'docId is missing or not a string: {doc_id!r}')
+    clock = msg.get('clock')
+    if clock is not None:
+        if not isinstance(clock, dict):
+            _reject(f'clock is not a dict: {type(clock).__name__}')
+        for actor, seq in clock.items():
+            if not isinstance(actor, str):
+                _reject(f'clock actor is not a string: {actor!r}')
+            if not isinstance(seq, int) or isinstance(seq, bool) \
+                    or seq < 0:
+                _reject(f'clock seq for {actor!r} is not a '
+                        f'non-negative int: {seq!r}')
+    changes = msg.get('changes')
+    if changes is not None:
+        if not isinstance(changes, (list, tuple)):
+            _reject(f'changes is not a list: '
+                    f'{type(changes).__name__}')
+        for change in changes:
+            if not isinstance(change, dict):
+                _reject(f'change is not a dict: '
+                        f'{type(change).__name__}')
+            if not isinstance(change.get('actor'), str):
+                _reject(f'change actor is missing or not a string: '
+                        f'{change.get("actor")!r}')
+            seq = change.get('seq')
+            if not isinstance(seq, int) or isinstance(seq, bool) \
+                    or seq <= 0:
+                _reject(f'change seq is not a positive int: {seq!r}')
+            deps = change.get('deps')
+            if not isinstance(deps, dict):
+                _reject(f'change deps is missing or not a dict: '
+                        f'{deps!r}')
+            for actor, dseq in deps.items():
+                if not isinstance(actor, str) or \
+                        not isinstance(dseq, int) or \
+                        isinstance(dseq, bool) or dseq < 0:
+                    _reject(f'change dep {actor!r}: {dseq!r} is not '
+                            f'str -> non-negative int')
+            ops = change.get('ops')
+            if not isinstance(ops, (list, tuple)) or \
+                    not all(isinstance(op, dict) for op in ops):
+                _reject('change ops is not a list of dicts')
+    snapshot = msg.get('snapshot')
+    if snapshot is not None and not isinstance(snapshot, (str, bytes)):
+        _reject(f'snapshot payload is not str/bytes: '
+                f'{type(snapshot).__name__}')
+    return msg
+
+
 def clock_union(clock_map, doc_id, clock):
     """Merge `clock` into `clock_map[doc_id]`, taking per-actor maxima
     (connection.js:9-12). The reference rebuilds an immutable map; these
@@ -117,7 +192,11 @@ class Connection:
         self.maybe_send_changes(doc_id)
 
     def receive_msg(self, msg):
-        """(connection.js:91-108)"""
+        """(connection.js:91-108). The envelope is validated BEFORE any
+        state mutation: a malformed message raises
+        :class:`MessageRejected` (counted under ``sync_msgs_rejected``)
+        and leaves ``_their_clock`` untouched."""
+        validate_msg(msg)
         metrics.bump('sync_msgs_received')
         if metrics.active:
             metrics.emit('sync_receive', doc_id=msg.get('docId'),
@@ -198,9 +277,16 @@ class BatchingConnection(Connection):
     def __init__(self, doc_set, send_msg):
         super().__init__(doc_set, send_msg)
         self._incoming = []
+        # per-doc fault isolation record for doc sets WITHOUT their own
+        # quarantine registry (GeneralDocSet carries its own): doc_id
+        # -> {'error': repr, 'changes': [...]}. A later successful
+        # delivery clears the entry.
+        self.quarantined = {}
 
     def receive_msg(self, msg):
-        if 'changes' in msg and msg['changes'] is not None:
+        if isinstance(msg, dict) and 'changes' in msg \
+                and msg['changes'] is not None:
+            validate_msg(msg)
             metrics.bump('sync_msgs_received')
             if 'clock' in msg and msg['clock'] is not None:
                 self._their_clock = clock_union(
@@ -211,7 +297,15 @@ class BatchingConnection(Connection):
 
     def flush(self):
         """Apply every buffered data message in one batched call;
-        returns {doc_id: doc} for the docs that changed."""
+        returns {doc_id: doc} for the docs that changed.
+
+        Faults are isolated PER DOCUMENT: a doc whose changes raise is
+        rolled back (the engines' store-intact-on-error contract) and
+        quarantined with its exception — every other doc in the tick
+        applies normally. Quarantine lands on the doc set's own
+        registry when it has one (``GeneralDocSet.quarantined``), else
+        on :attr:`quarantined` here; quarantined docs are retriable (a
+        corrected later delivery clears the entry)."""
         if not self._incoming:
             return {}
         changes_by_doc = {}
@@ -223,8 +317,46 @@ class BatchingConnection(Connection):
                      sum(len(c) for c in changes_by_doc.values()))
         apply_batch = getattr(self._doc_set, 'apply_changes_batch', None)
         if apply_batch is not None:
-            return apply_batch(changes_by_doc)
-        return {doc_id: self._doc_set.apply_changes(doc_id, changes)
-                for doc_id, changes in changes_by_doc.items()}
+            if hasattr(self._doc_set, 'quarantined'):
+                # the doc set isolates internally (one fused apply on
+                # the happy path, per-doc fallback on a fault)
+                return apply_batch(changes_by_doc, isolate=True)
+            try:
+                return apply_batch(changes_by_doc)
+            except Exception:
+                # the batched apply rolled back; isolate per doc below
+                pass
+        out = {}
+        for doc_id, changes in changes_by_doc.items():
+            try:
+                out[doc_id] = self._doc_set.apply_changes(doc_id,
+                                                          changes)
+                # clear quarantine only once the STORED changes are
+                # accounted for: entries the doc's clock now covers
+                # were superseded by a corrected redelivery; the rest
+                # re-apply (transient fault) or keep the entry alive
+                held = self.quarantined.get(doc_id)
+                if held is not None:
+                    state = Frontend.get_backend_state(out[doc_id])
+                    clock = state.clock if state is not None else {}
+                    pending = [c for c in held['changes']
+                               if not isinstance(c, dict) or
+                               c.get('seq', 0) >
+                               clock.get(c.get('actor'), 0)]
+                    try:
+                        if pending:
+                            out[doc_id] = self._doc_set.apply_changes(
+                                doc_id, pending)
+                        del self.quarantined[doc_id]
+                    except Exception as err:
+                        held['error'] = repr(err)
+            except Exception as err:
+                self.quarantined[doc_id] = {'error': repr(err),
+                                            'changes': list(changes)}
+                metrics.bump('sync_docs_quarantined')
+                if metrics.active:
+                    metrics.emit('doc_quarantined', doc_id=doc_id,
+                                 error=repr(err))
+        return out
 
     receiveMsg = receive_msg

@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import frontend as Frontend
 from ..device import general as _general
+from ..utils.metrics import metrics as _metrics
 
 _ELEM_BIT = _general._ELEM_BIT
 _TYPE_MAP = _general._TYPE_MAP
@@ -105,6 +106,13 @@ class GeneralDocSet:
         # materialized views as immutable), so a sparse tick
         # re-materializes O(dirty), not O(fleet).
         self._views = {}
+        # poisoned-doc registry: doc_id -> {'error': repr(exc),
+        # 'changes': [...]} for docs whose changes raised under
+        # isolation (apply_changes_batch(isolate=True)). The doc
+        # itself rolled back (store state as if the changes never
+        # arrived); entries are retriable via retry_quarantined() and
+        # clear on any later successful apply for that doc.
+        self.quarantined = {}
 
     # -- DocSet surface ------------------------------------------------------
 
@@ -187,9 +195,55 @@ class GeneralDocSet:
 
     applyChanges = apply_changes
 
-    def apply_changes_batch(self, changes_by_doc):
+    def apply_changes_batch(self, changes_by_doc, isolate=False):
         """ONE fused device apply for the whole batch; handlers fire
-        per requested document afterwards."""
+        per requested document afterwards.
+
+        With ``isolate=True`` (the :meth:`BatchingConnection.flush
+        <automerge_tpu.sync.connection.BatchingConnection.flush>`
+        route) a fault in ANY doc's changes no longer aborts the tick:
+        the fused attempt rolls back (store-intact-on-error via the
+        engine's ``_Txn``) and each doc re-applies individually — docs
+        whose changes raise are quarantined (:attr:`quarantined`,
+        counted under ``sync_docs_quarantined``) while every other doc
+        applies normally. Returns only the docs that applied. With the
+        default ``isolate=False`` the first fault raises after
+        rollback, unchanged."""
+        if isolate:
+            # fleet-level failures are NOT per-doc poison: register
+            # every doc index up front so a capacity/key-space error
+            # raises its actionable sizing message instead of silently
+            # quarantining the whole tick
+            for doc_id in changes_by_doc:
+                self._index(doc_id, create=True)
+            try:
+                out = self._apply_batch_fused(changes_by_doc)
+            except Exception:
+                out = {}
+                for doc_id, changes in changes_by_doc.items():
+                    try:
+                        out.update(self._apply_batch_fused(
+                            {doc_id: changes}))
+                    except Exception as err:
+                        self.quarantined[doc_id] = {
+                            'error': repr(err),
+                            'changes': list(changes)}
+                        _metrics.bump('sync_docs_quarantined')
+                        if _metrics.active:
+                            _metrics.emit('doc_quarantined',
+                                          doc_id=doc_id,
+                                          error=repr(err))
+        else:
+            out = self._apply_batch_fused(changes_by_doc)
+        # a successful delivery for a quarantined doc clears the entry
+        # only if its STORED changes now apply too (a corrected
+        # redelivery makes them duplicates -> no-op -> cleared; a
+        # transiently-failed batch re-applies for real; still-poisoned
+        # changes stay quarantined rather than being silently dropped)
+        self.retry_quarantined([d for d in out if d in self.quarantined])
+        return out
+
+    def _apply_batch_fused(self, changes_by_doc):
         idxs = {self._index(doc_id, create=True): changes
                 for doc_id, changes in changes_by_doc.items()}
         # size to the touched prefix, not the capacity — a sparse tick
@@ -207,6 +261,34 @@ class GeneralDocSet:
             out[doc_id] = doc
             for handler in list(self.handlers):
                 handler(doc_id, doc)
+        return out
+
+    def retry_quarantined(self, doc_ids=None):
+        """Re-attempt the stored changes of quarantined docs (all of
+        them, or just ``doc_ids``) — e.g. after the fault's cause was
+        fixed. Stored changes whose ``(actor, seq)`` the doc's clock
+        already covers are SUPERSEDED (a corrected redelivery landed)
+        and drop; the rest re-apply. Docs that come clean leave
+        quarantine and are returned; docs that fail again stay
+        quarantined with the fresh error."""
+        targets = list(self.quarantined) if doc_ids is None \
+            else [d for d in doc_ids if d in self.quarantined]
+        out = {}
+        for doc_id in targets:
+            idx = self.id_of.get(doc_id)
+            clock = self.store.clock_of(idx) if idx is not None else {}
+            pending = [c for c in self.quarantined[doc_id]['changes']
+                       if not isinstance(c, dict) or c.get('seq', 0) >
+                       clock.get(c.get('actor'), 0)]
+            if not pending:
+                self.quarantined.pop(doc_id, None)
+                out[doc_id] = self.get_doc(doc_id)
+                continue
+            try:
+                out.update(self._apply_batch_fused({doc_id: pending}))
+                self.quarantined.pop(doc_id, None)
+            except Exception as err:
+                self.quarantined[doc_id]['error'] = repr(err)
         return out
 
     applyChangesBatch = apply_changes_batch
@@ -293,16 +375,44 @@ class GeneralDocSet:
     def load_snapshot(cls, data, options=None):
         import json
         import struct
+        from ..snapshot import SnapshotCorruptError
+        if len(data) < 8:
+            raise SnapshotCorruptError(
+                f'general-docset snapshot truncated: {len(data)} '
+                f'bytes, header-length prefix needs 8')
         (hlen,) = struct.unpack('>Q', data[:8])
-        header = json.loads(data[8:8 + hlen].decode())
-        if header.get('format') != cls._SNAP_FORMAT:
-            raise ValueError('not a general-docset snapshot')
-        out = cls(header['capacity'], options=options,
-                  auto_grow=header.get('auto_grow', True))
-        out.store = _general.GeneralStore.load_snapshot(
-            data[8 + hlen:])
-        out.ids = list(header['ids'])
-        out.id_of = {doc_id: i for i, doc_id in enumerate(out.ids)}
+        if 8 + hlen > len(data):
+            raise SnapshotCorruptError(
+                f'general-docset snapshot truncated: header claims '
+                f'{hlen} bytes, {len(data) - 8} available')
+        try:
+            header = json.loads(data[8:8 + hlen].decode())
+        except (ValueError, UnicodeDecodeError) as err:
+            raise SnapshotCorruptError(
+                f'general-docset snapshot header is not valid JSON '
+                f'({err})') from None
+        if not isinstance(header, dict) or \
+                header.get('format') != cls._SNAP_FORMAT:
+            raise SnapshotCorruptError('not a general-docset snapshot')
+        for field in ('capacity', 'ids'):
+            if field not in header:
+                raise SnapshotCorruptError(
+                    f"general-docset snapshot: missing field "
+                    f"'{field}'")
+        try:
+            out = cls(header['capacity'], options=options,
+                      auto_grow=header.get('auto_grow', True))
+            out.store = _general.GeneralStore.load_snapshot(
+                data[8 + hlen:])
+            out.ids = list(header['ids'])
+            out.id_of = {doc_id: i
+                         for i, doc_id in enumerate(out.ids)}
+        except SnapshotCorruptError:
+            raise
+        except Exception as err:
+            raise SnapshotCorruptError(
+                f'general-docset snapshot: payload failed to '
+                f'reconstruct ({type(err).__name__}: {err})') from err
         return out
 
     # -- materialization -----------------------------------------------------

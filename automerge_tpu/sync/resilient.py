@@ -1,0 +1,312 @@
+"""ResilientConnection: the sync protocol over a LOSSY transport.
+
+:class:`~.connection.Connection` assumes every ``send_msg`` arrives
+exactly once, intact, in order — true for an in-process callback, false
+for any real link (DCN between pod hosts, WAN to clients). This module
+wraps either Connection flavor in a degraded-operation shell, the
+robustness layer the ROADMAP's "heavy traffic from millions of users"
+north star requires before multi-host sync can be trusted:
+
+- **Versioned envelope** — every logical message travels as ``{'v': 1,
+  'kind': 'data', 'seq': n, 'sum': crc32(payload), 'payload': msg}``.
+  Unknown versions and malformed envelopes are counted rejections
+  (``sync_msgs_rejected``), never crashes.
+- **Checksum** — CRC32 over the canonical-JSON payload; a corrupted
+  message is dropped (``sync_checksum_failures``) and NOT acked, so the
+  sender's retransmit repairs it.
+- **Duplicate suppression** — received seqs are tracked (compactly: a
+  contiguous floor + the sparse set above it); duplicates re-ack (the
+  first ack may have been lost) but are not delivered twice
+  (``sync_msgs_duplicate``).
+- **Ack-driven retransmit** — unacked envelopes retransmit on
+  :meth:`tick` with exponential backoff + seeded jitter
+  (``sync_retransmits``) under a bounded retry budget
+  (``sync_retry_exhausted``); the protocol's own anti-entropy (below)
+  repairs anything the budget gave up on.
+- **Anti-entropy heartbeat** — every ``heartbeat_every`` ticks the
+  local clocks re-advertise (Demers et al.-style gossip repair,
+  PAPERS.md): a dropped advertisement, an exhausted retry budget or a
+  healed partition all converge through the normal
+  advertisement/request/data exchange, with no extra protocol state.
+
+Time is logical: the owner calls :meth:`tick` once per scheduling
+quantum (a network tick in tests and bench, a timer in a real
+deployment). Nothing here inspects wall clocks, so chaos schedules are
+perfectly reproducible from a seed.
+"""
+
+import json
+import random
+import zlib
+
+from ..utils.metrics import metrics
+from .connection import BatchingConnection, Connection, MessageRejected
+
+ENVELOPE_VERSION = 1
+
+
+def payload_checksum(payload):
+    """CRC32 over the canonical JSON encoding of a logical message
+    (sorted keys, no whitespace) — both ends compute the same bytes
+    regardless of dict ordering."""
+    return zlib.crc32(json.dumps(payload, sort_keys=True,
+                                 separators=(',', ':')).encode())
+
+
+class _Unacked:
+    __slots__ = ('envelope', 'due', 'attempts')
+
+    def __init__(self, envelope, due):
+        self.envelope = envelope
+        self.due = due
+        self.attempts = 0
+
+
+class ResilientConnection:
+    """One peer's end of a lossy link: an inner
+    :class:`~.connection.Connection` (or
+    :class:`~.connection.BatchingConnection` with ``batching=True``)
+    speaks the unchanged logical protocol; this shell owns envelopes,
+    acks, retransmission and heartbeats.
+
+    ``send_msg`` is the raw transport callback (now carrying envelope
+    dicts); :meth:`receive_msg` takes envelopes off the transport.
+    Logical-protocol state lives in the inner connection, reachable as
+    :attr:`connection`.
+    """
+
+    def __init__(self, doc_set, send_msg, batching=False,
+                 retry_limit=8, backoff_base=2, backoff_max=64,
+                 jitter=2, heartbeat_every=16, seed=0):
+        self._send_raw = send_msg
+        self._conn = (BatchingConnection if batching else Connection)(
+            doc_set, self._send_envelope)
+        self._doc_set = doc_set
+        self.retry_limit = retry_limit
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.jitter = jitter
+        self.heartbeat_every = heartbeat_every
+        self._rng = random.Random(seed)
+        self._now = 0
+        self._send_seq = 0
+        self._sent = {}                    # seq -> _Unacked
+        self._recv_floor = 0               # every seq <= floor delivered
+        # delivered seqs > floor. Compact while gaps are transient; a
+        # PERMANENTLY lost seq (sender's budget exhausted, its content
+        # re-advertised under a new seq by the heartbeat) pins the
+        # floor, leaving the set O(messages since the loss) until the
+        # session re-establishes — acceptable for session-scoped links
+        self._recv_above = set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def connection(self):
+        return self._conn
+
+    def open(self):
+        self._conn.open()
+
+    def close(self):
+        self._conn.close()
+
+    def flush(self):
+        """Batched flavor only: apply the tick's buffered data
+        messages (see :meth:`BatchingConnection.flush
+        <automerge_tpu.sync.connection.BatchingConnection.flush>`)."""
+        flush = getattr(self._conn, 'flush', None)
+        return flush() if flush is not None else {}
+
+    # -- outbound ------------------------------------------------------------
+
+    def _backoff(self, attempts):
+        delay = min(self.backoff_base * (2 ** attempts),
+                    self.backoff_max)
+        return delay + (self._rng.randrange(self.jitter + 1)
+                        if self.jitter else 0)
+
+    def _send_envelope(self, msg):
+        """The inner connection's send callback: wrap, remember for
+        retransmission, ship."""
+        self._send_seq += 1
+        env = {'v': ENVELOPE_VERSION, 'kind': 'data',
+               'seq': self._send_seq, 'sum': payload_checksum(msg),
+               'payload': msg}
+        self._sent[self._send_seq] = _Unacked(
+            env, self._now + self._backoff(0))
+        self._send_raw(env)
+
+    def _send_ack(self, seq):
+        # acks are integrity-checked too: a corrupted ack must not
+        # cancel retransmission of a DIFFERENT live envelope
+        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'ack',
+                        'ack': seq, 'sum': payload_checksum(seq)})
+
+    # -- inbound -------------------------------------------------------------
+
+    def _reject(self, reason):
+        metrics.bump('sync_msgs_rejected')
+        if metrics.active:
+            metrics.emit('envelope_rejected', reason=reason)
+        return None
+
+    def _seen(self, seq):
+        return seq <= self._recv_floor or seq in self._recv_above
+
+    def _mark_seen(self, seq):
+        self._recv_above.add(seq)
+        while self._recv_floor + 1 in self._recv_above:
+            self._recv_floor += 1
+            self._recv_above.discard(self._recv_floor)
+
+    def receive_msg(self, env):
+        """Take one envelope off the transport. Malformed or corrupt
+        envelopes are counted and swallowed (a hostile packet must
+        never kill the sync loop); valid duplicates re-ack and drop;
+        fresh data delivers to the inner protocol. Returns whatever
+        the inner ``receive_msg`` returned (None otherwise)."""
+        if not isinstance(env, dict):
+            return self._reject(
+                f'envelope is {type(env).__name__}, not a dict')
+        if env.get('v') != ENVELOPE_VERSION:
+            return self._reject(
+                f'unsupported envelope version {env.get("v")!r}')
+        kind = env.get('kind')
+        if kind == 'ack':
+            seq = env.get('ack')
+            if not isinstance(seq, int) or isinstance(seq, bool):
+                return self._reject(f'ack seq is not an int: {seq!r}')
+            if env.get('sum') != payload_checksum(seq):
+                metrics.bump('sync_checksum_failures')
+                return self._reject(f'ack checksum mismatch '
+                                    f'(ack {seq})')
+            self._sent.pop(seq, None)
+            return None
+        if kind == 'hb':
+            return self._receive_heartbeat(env)
+        if kind != 'data':
+            return self._reject(f'unknown envelope kind {kind!r}')
+        seq = env.get('seq')
+        if not isinstance(seq, int) or isinstance(seq, bool) or seq <= 0:
+            return self._reject(f'data seq is not a positive int: '
+                                f'{seq!r}')
+        payload = env.get('payload')
+        if not isinstance(payload, dict):
+            return self._reject('data envelope has no payload dict')
+        if env.get('sum') != payload_checksum(payload):
+            # NOT acked: the sender's retransmit re-delivers intact
+            metrics.bump('sync_checksum_failures')
+            return self._reject(f'payload checksum mismatch (seq '
+                                f'{seq})')
+        if self._seen(seq):
+            self._send_ack(seq)            # the first ack may be lost
+            metrics.bump('sync_msgs_duplicate')
+            return None
+        # deliver FIRST, ack on the outcome: an acked seq is consumed
+        # forever (dup-suppressed on redelivery), so acking before a
+        # failed apply would lose the message at the envelope layer.
+        # NOTE: in batching mode "delivered" means BUFFERED — the
+        # apply happens at flush(), where a fault lands in the
+        # quarantine registry WITH its changes (retried until they
+        # really apply), so flush-time failures are repaired at the
+        # quarantine layer, not by envelope retransmit
+        try:
+            out = self._conn.receive_msg(payload)
+        except MessageRejected:
+            # schema-invalid at ORIGIN (checksum passed): retransmits
+            # cannot fix it, so ack + consume the seq; counted by the
+            # inner validation, and the loop lives on
+            self._send_ack(seq)
+            self._mark_seen(seq)
+            return None
+        except Exception as err:
+            # apply-time failure (poisoned eager apply, transient
+            # engine error): NOT acked, NOT marked seen — the sender's
+            # retransmit redelivers and a transient cause heals; a
+            # permanent one exhausts the budget and falls to the
+            # anti-entropy loop. Either way the sync loop survives.
+            metrics.bump('sync_apply_failures')
+            if metrics.active:
+                metrics.emit('sync_apply_failure', seq=seq,
+                             error=repr(err))
+            return None
+        self._send_ack(seq)
+        self._mark_seen(seq)
+        return out
+
+    def _receive_heartbeat(self, env):
+        clocks = env.get('clocks')
+        if not isinstance(clocks, dict):
+            return self._reject('heartbeat has no clocks dict')
+        if env.get('sum') != payload_checksum(clocks):
+            metrics.bump('sync_checksum_failures')
+            return self._reject('heartbeat checksum mismatch')
+        metrics.bump('sync_heartbeats_received')
+        for doc_id, clock in clocks.items():
+            try:
+                # a heartbeat entry IS an advertisement: the normal
+                # protocol answers it (request / data / nothing)
+                self._conn.receive_msg({'docId': doc_id,
+                                        'clock': clock})
+            except MessageRejected:
+                pass
+        return None
+
+    # -- logical time --------------------------------------------------------
+
+    def tick(self):
+        """Advance one scheduling quantum: retransmit overdue unacked
+        envelopes (exponential backoff + jitter, bounded budget) and
+        emit the periodic anti-entropy heartbeat."""
+        self._now += 1
+        # seqs are minted monotonically and entries only deleted, so
+        # dict order IS ascending seq order — no re-sort per quantum
+        for seq in list(self._sent):
+            rec = self._sent.get(seq)
+            if rec is None or rec.due > self._now:
+                continue
+            if rec.attempts >= self.retry_limit:
+                # budget exhausted: stop retransmitting — the
+                # heartbeat's re-advertisement regenerates whatever
+                # this envelope carried once the link heals
+                del self._sent[seq]
+                metrics.bump('sync_retry_exhausted')
+                continue
+            rec.attempts += 1
+            rec.due = self._now + self._backoff(rec.attempts)
+            metrics.bump('sync_retransmits')
+            self._send_raw(rec.envelope)
+        if self.heartbeat_every and \
+                self._now % self.heartbeat_every == 0:
+            self.heartbeat()
+
+    def heartbeat(self):
+        """Re-advertise every local doc's current clock in one
+        unreliable envelope (loss is fine: the next beat repeats it).
+        This is the Demers-style anti-entropy loop that makes
+        convergence eventual even when retransmit budgets run out."""
+        from .. import frontend as Frontend
+        clocks = {}
+        for doc_id in self._doc_set.doc_ids:
+            doc = self._doc_set.get_doc(doc_id)
+            if doc is None:
+                continue
+            state = Frontend.get_backend_state(doc)
+            if state is None:
+                continue
+            clocks[doc_id] = dict(state.clock)
+        if not clocks:
+            return
+        metrics.bump('sync_heartbeats_sent')
+        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'hb',
+                        'sum': payload_checksum(clocks),
+                        'clocks': clocks})
+
+    @property
+    def in_flight(self):
+        """Unacked outbound envelopes (retransmission candidates)."""
+        return len(self._sent)
+
+    # camelCase aliases (reference API style)
+    receiveMsg = receive_msg

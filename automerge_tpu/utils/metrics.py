@@ -17,6 +17,33 @@ import time
 from collections import defaultdict
 from contextlib import contextmanager
 
+# Fault-path counters (the degraded-operation observability contract —
+# asserted in tests/test_metrics.py, printed in the bench summary).
+# Counters are created on first bump like any other, but these names
+# are the STABLE surface dashboards and tests key on:
+#   sync_retransmits           unacked envelopes re-sent (backoff timer)
+#   sync_retry_exhausted       envelopes dropped after the retry budget
+#   sync_msgs_rejected         malformed envelopes/messages refused
+#                              before any state mutation
+#   sync_msgs_duplicate        envelope-level duplicates suppressed
+#   sync_checksum_failures     payload CRC mismatches (corrupt in
+#                              flight; dropped unacked -> retransmitted)
+#   sync_heartbeats_sent/_received   anti-entropy clock re-adverts
+#   sync_apply_failures        deliveries whose apply raised (seq left
+#                              unacked -> retransmit/anti-entropy heal)
+#   sync_docs_quarantined      docs isolated out of a tick because
+#                              their changes raised (store rolled back)
+#   apply_rollbacks            engine applies undone by the _Txn
+#                              store-intact-on-error path
+#   snapshot_checksum_failures snapshot-container/journal CRC
+#                              mismatches caught at load
+FAULT_COUNTERS = (
+    'sync_retransmits', 'sync_retry_exhausted', 'sync_msgs_rejected',
+    'sync_msgs_duplicate', 'sync_checksum_failures',
+    'sync_heartbeats_sent', 'sync_heartbeats_received',
+    'sync_apply_failures', 'sync_docs_quarantined', 'apply_rollbacks',
+    'snapshot_checksum_failures')
+
 
 class Metrics:
     """One counter registry + event bus (a process-wide default lives at
@@ -37,7 +64,8 @@ class Metrics:
             self.counters[name] += value
 
     def set_gauge(self, name, value):
-        self.counters[name] = value
+        with self._lock:
+            self.counters[name] = value
 
     def observe(self, name, value):
         """Record one sample of a duration/size series: keeps count,
@@ -56,7 +84,10 @@ class Metrics:
         return self.counters.get(name + '.sum', 0) / n if n else 0.0
 
     def snapshot(self):
-        return dict(self.counters)
+        # same lock as bump(): dict(d) iterates, and the async applier
+        # thread may insert a first-time counter mid-iteration
+        with self._lock:
+            return dict(self.counters)
 
     def group(self, prefix):
         """{suffix: value} of every counter under ``prefix`` — the
@@ -70,7 +101,8 @@ class Metrics:
                     if name.startswith(prefix)}
 
     def reset(self):
-        self.counters.clear()
+        with self._lock:
+            self.counters.clear()
 
     # -- event stream ------------------------------------------------------
 

@@ -538,6 +538,53 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     return n_docs, n_ops, n_msgs, dt
 
 
+def bench_degraded_link(n_docs=10240, list_ops=22,
+                        rates=(0.05, 0.20)):
+    """The config-5 10240-doc fleet converging over a LOSSY link: the
+    same rich-doc workload as `bench_general_sync_10k`, but replicated
+    through ResilientConnection endpoints over a seeded ChaosFleet
+    fabric dropping/duplicating messages at each ``rates`` level.
+    Reports ticks-to-convergence and wall-clock overhead vs the
+    clean (0-loss) run of the SAME harness — the cost of degraded
+    operation, separated from the cost of the harness itself."""
+    from automerge_tpu.sync.chaos import ChaosFleet
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+    per_doc = _gen_mixed_docs(n_docs, list_ops)
+    src = GeneralDocSet(n_docs)
+    src.apply_changes_batch(
+        {f'doc{d}': per_doc[d] for d in range(n_docs)})
+
+    def one_run(loss, seed):
+        dst = GeneralDocSet(1024)          # auto-grows to the fleet
+        fleet = ChaosFleet([src, dst], seed=seed, drop=loss,
+                           dup=loss / 2, delay=2 if loss else 0,
+                           batching=True, heartbeat_every=32)
+        t0 = time.perf_counter()
+        ticks = fleet.run(max_ticks=5000)
+        dt = time.perf_counter() - t0
+        fleet.close()
+        got = dst.get_doc(f'doc{n_docs - 1}').materialize()
+        assert got['meta'] == n_docs - 1 and \
+            len(got['items']) == list_ops
+        return ticks, dt, dict(fleet.stats)
+
+    def timed(loss, seed):
+        # a lossy schedule scatters stragglers into many oddly-shaped
+        # retransmit blocks; an identical seeded warm run compiles
+        # each shape once so the measurement is sync cost, not XLA
+        # compile churn (same convention as every other section)
+        one_run(loss, seed)
+        return one_run(loss, seed)
+
+    clean_ticks, t_clean, _ = timed(0.0, 2)
+    out = {}
+    for loss in rates:
+        ticks, dt, stats = timed(loss, int(loss * 1000) + 3)
+        out[loss] = (ticks, dt, dt / t_clean, stats)
+    return n_docs, clean_ticks, t_clean, out
+
+
 def bench_general_materialize_10k(n_docs=10240, list_ops=22,
                                   dirty_frac=0.01):
     """The read-side twin of `bench_general_sync_10k`: the config-5
@@ -1089,6 +1136,21 @@ def main():
         f'{n_10k / t_10k:.0f} docs/s ({n_10k_ops / t_10k / 1e6:.2f}M '
         f'ops/s; destination auto-grew 1024 -> {n_10k} docs)')
 
+    n_deg, deg_clean_ticks, t_deg_clean, deg = bench_degraded_link()
+    for loss, (ticks, dt, overhead, stats) in sorted(deg.items()):
+        log(f'docset-sync[degraded {loss * 100:.0f}% loss]: {n_deg} '
+            f'rich docs converge in {ticks} ticks / {dt:.3f}s '
+            f'({overhead:.2f}x over the clean harness run: '
+            f'{deg_clean_ticks} ticks / {t_deg_clean:.3f}s) — '
+            f'{stats.get("dropped", 0)} dropped, '
+            f'{stats.get("duplicated", 0)} duplicated, repaired by '
+            f'retransmit + anti-entropy')
+    from automerge_tpu.utils.metrics import (metrics as _fm,
+                                             FAULT_COUNTERS)
+    log('fault-counters: ' + ', '.join(
+        f'{name} {_fm.counters.get(name, 0)}'
+        for name in FAULT_COUNTERS))
+
     n_mat, n_mat_dirty, t_mat_cold, t_mat_dirty = \
         bench_general_materialize_10k()
     log(f'materialize[general 10k, batched read path]: {n_mat} rich '
@@ -1205,6 +1267,14 @@ def main():
         'general_sync_docs_per_sec': round(n_gd / t_gbatch, 1),
         'general_sync10k_docs_per_sec': round(n_10k / t_10k, 1),
         'general_sync10k_ops_per_sec': round(n_10k_ops / t_10k, 1),
+        'general_sync10k_degraded_ticks_5': deg[0.05][0],
+        'general_sync10k_degraded_ticks_20': deg[0.20][0],
+        'general_sync10k_degraded_overhead_x_5':
+            round(deg[0.05][2], 2),
+        'general_sync10k_degraded_overhead_x_20':
+            round(deg[0.20][2], 2),
+        'general_sync10k_degraded_docs_per_sec_20':
+            round(n_deg / deg[0.20][1], 1),
         'general_materialize_docs_per_sec': round(n_mat / t_mat_cold,
                                                   1),
         'general_rematerialize_dirty_ms': round(t_mat_dirty * 1e3, 2),

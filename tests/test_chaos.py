@@ -1,0 +1,568 @@
+"""Chaos convergence suite: the sync layer under an adversarial
+transport.
+
+Every schedule here is SEEDED — a failure replays exactly. The three
+acceptance schedules (drop+dup+reorder, corrupt, partition+heal) each
+drive a multi-peer fleet to byte-identical convergence against a clean
+run, for eager and batching connections, including a general-store
+fleet; plus poisoned-doc isolation (with native/numpy rollback parity)
+and crash-restart from the journal.
+"""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.durability import DurableDocSet
+from automerge_tpu.sync import DocSet, GeneralDocSet
+from automerge_tpu.sync.chaos import ChaosFleet, canonical, doc_set_view
+from automerge_tpu.sync.resilient import (ResilientConnection,
+                                          payload_checksum)
+from automerge_tpu.utils.metrics import metrics
+
+OBJ = '00000000-0000-4000-8000-00000000aaaa'
+
+
+def frontend_fleet(n_peers=3, n_docs=3):
+    """Plain DocSets: peer 0 owns every doc; the others start empty."""
+    sets = [DocSet() for _ in range(n_peers)]
+    for i in range(n_docs):
+        doc = am.change(am.init(f'seed-{i}'),
+                        lambda d, i=i: d.update({'k': i, 'items': [i]}))
+        sets[0].set_doc(f'doc{i}', doc)
+    return sets
+
+def general_fleet(n_peers=2, n_docs=6, capacity=16):
+    """GeneralDocSets: peer 0 seeded with rich docs (list + causal
+    chain), the rest empty."""
+    sets = [GeneralDocSet(capacity) for _ in range(n_peers)]
+    per = {}
+    for i in range(n_docs):
+        obj = f'00000000-0000-4000-8000-{i:012x}'
+        per[f'doc{i}'] = [
+            {'actor': f'w0-{i}', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': obj},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+                 'value': obj},
+                {'action': 'ins', 'obj': obj, 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': obj, 'key': f'w0-{i}:1',
+                 'value': i}]},
+            {'actor': f'w1-{i}', 'seq': 1, 'deps': {f'w0-{i}': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+                      'value': i}]}]
+    sets[0].apply_changes_batch(per)
+    return sets
+
+
+def clean_views(build, batching, **fleet_kwargs):
+    fleet = ChaosFleet(build(), seed=0, batching=batching,
+                       **fleet_kwargs)
+    fleet.run(max_ticks=500)
+    return [canonical(v) for v in fleet.views()]
+
+
+class TestChaosConvergence:
+    """Acceptance schedule 1: drop + duplicate + reorder."""
+
+    @pytest.mark.parametrize('batching', [False, True])
+    def test_drop_dup_reorder(self, batching):
+        clean = clean_views(frontend_fleet, batching)
+        fleet = ChaosFleet(frontend_fleet(), seed=1234, drop=0.2,
+                           dup=0.15, delay=3, batching=batching)
+        fleet.run(max_ticks=2000)
+        got = [canonical(v) for v in fleet.views()]
+        assert got == clean
+        assert fleet.stats['dropped'] > 0
+        assert fleet.stats['duplicated'] > 0
+        assert metrics.counters.get('sync_retransmits', 0) > 0
+
+    @pytest.mark.parametrize('batching', [False, True])
+    def test_corrupt(self, batching):
+        """Acceptance schedule 2: corrupted envelopes (flipped
+        checksums, mangled versions/kinds, torn payload fields) are
+        counted rejections, and retransmission repairs every one."""
+        clean = clean_views(frontend_fleet, batching)
+        before = metrics.counters.get('sync_msgs_rejected', 0)
+        fleet = ChaosFleet(frontend_fleet(), seed=99, corrupt=0.25,
+                           batching=batching)
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == clean
+        assert fleet.stats['corrupted'] > 0
+        assert metrics.counters.get('sync_msgs_rejected', 0) > before
+
+    @pytest.mark.parametrize('batching', [False, True])
+    def test_partition_heal(self, batching):
+        """Acceptance schedule 3: a partition with DIVERGENT concurrent
+        edits on both sides; after heal, anti-entropy merges both."""
+        sets = frontend_fleet(n_peers=3)
+        fleet = ChaosFleet(sets, seed=7, drop=0.05, batching=batching,
+                           heartbeat_every=4)
+        fleet.run(max_ticks=1000)          # fully replicate first
+        fleet.partition(0, 1)
+        fleet.partition(1, 2)              # peer 1 fully isolated
+        d0 = am.change(sets[0].get_doc('doc0'),
+                       lambda d: d.__setitem__('side0', 'A'))
+        sets[0].set_doc('doc0', d0)
+        d1 = am.change(sets[1].get_doc('doc0'),
+                       lambda d: d.__setitem__('side1', 'B'))
+        sets[1].set_doc('doc0', d1)
+        for _ in range(30):
+            fleet.tick()                   # both edits marooned
+        view1 = doc_set_view(sets[1])['doc0']
+        assert 'side0' not in view1 and view1['side1'] == 'B'
+        fleet.heal(0, 1)
+        fleet.heal(1, 2)
+        fleet.run(max_ticks=3000)
+        for v in fleet.views():
+            assert v['doc0']['side0'] == 'A'
+            assert v['doc0']['side1'] == 'B'
+        assert len({canonical(v) for v in fleet.views()}) == 1
+
+    def test_general_fleet_full_chaos(self):
+        """The general-store fleet run: rich docs through
+        BatchingConnection ticks under every fault at once."""
+        clean = clean_views(general_fleet, True)
+        fleet = ChaosFleet(general_fleet(), seed=42, drop=0.15,
+                           dup=0.1, delay=2, corrupt=0.1,
+                           batching=True)
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == clean
+
+    def test_general_fleet_eager_chaos(self):
+        clean = clean_views(general_fleet, False)
+        fleet = ChaosFleet(general_fleet(), seed=43, drop=0.15,
+                           dup=0.1, delay=2, batching=False)
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == clean
+
+
+class TestResilientTransport:
+    """Unit surface of the envelope layer: a hand-driven pair of
+    endpoints over two manual queues."""
+
+    def _pair(self, **kwargs):
+        q01, q10 = [], []
+        ds0, ds1 = DocSet(), DocSet()
+        doc = am.change(am.init('a0'),
+                        lambda d: d.__setitem__('x', 1))
+        ds0.set_doc('doc0', doc)
+        c0 = ResilientConnection(ds0, q01.append, **kwargs)
+        c1 = ResilientConnection(ds1, q10.append, **kwargs)
+        c0.open()
+        c1.open()
+        return ds0, ds1, c0, c1, q01, q10
+
+    def _pump(self, c0, c1, q01, q10, ticks=30, until_quiet=True):
+        for _ in range(ticks):
+            for env in q01[:]:
+                q01.remove(env)
+                c1.receive_msg(env)
+            for env in q10[:]:
+                q10.remove(env)
+                c0.receive_msg(env)
+            c0.tick()
+            c1.tick()
+            if until_quiet and not q01 and not q10 \
+                    and not c0.in_flight and not c1.in_flight:
+                break
+
+    def test_lossless_link_syncs(self):
+        ds0, ds1, c0, c1, q01, q10 = self._pair()
+        self._pump(c0, c1, q01, q10)
+        assert am.inspect(ds1.get_doc('doc0')) == {'x': 1}
+        assert c0.in_flight == 0 and c1.in_flight == 0
+
+    def test_dropped_data_retransmits(self):
+        before = metrics.counters.get('sync_retransmits', 0)
+        ds0, ds1, c0, c1, q01, q10 = self._pair(backoff_base=1,
+                                                jitter=0)
+        q01.pop()                          # the advertisement: lost
+        self._pump(c0, c1, q01, q10, ticks=60)
+        assert am.inspect(ds1.get_doc('doc0')) == {'x': 1}
+        assert metrics.counters.get('sync_retransmits', 0) > before
+
+    def test_duplicate_suppression(self):
+        ds0, ds1, c0, c1, q01, q10 = self._pair()
+        env = q01[0]
+        before = metrics.counters.get('sync_msgs_duplicate', 0)
+        c1.receive_msg(env)
+        c1.receive_msg(env)                # replayed envelope
+        assert metrics.counters.get('sync_msgs_duplicate', 0) \
+            == before + 1
+
+    def test_checksum_rejects_and_heals(self):
+        ds0, ds1, c0, c1, q01, q10 = self._pair(backoff_base=1,
+                                                jitter=0)
+        env = dict(q01[0])
+        env['sum'] = (env['sum'] or 0) ^ 0xFFFF
+        q01[0] = env                       # corrupt in flight
+        before = metrics.counters.get('sync_checksum_failures', 0)
+        self._pump(c0, c1, q01, q10, ticks=60)
+        assert metrics.counters.get('sync_checksum_failures', 0) \
+            > before
+        assert am.inspect(ds1.get_doc('doc0')) == {'x': 1}
+
+    def test_envelope_version_gate(self):
+        ds0, ds1, c0, c1, q01, q10 = self._pair()
+        before = metrics.counters.get('sync_msgs_rejected', 0)
+        assert c1.receive_msg({'v': 99, 'kind': 'data'}) is None
+        assert c1.receive_msg('not even a dict') is None
+        assert c1.receive_msg({'v': 1, 'kind': 'data',
+                               'seq': -1}) is None
+        assert metrics.counters.get('sync_msgs_rejected', 0) \
+            == before + 3
+
+    def test_retry_budget_exhausts_then_heartbeat_repairs(self):
+        before = metrics.counters.get('sync_retry_exhausted', 0)
+        ds0, ds1, c0, c1, q01, q10 = self._pair(
+            retry_limit=2, backoff_base=1, backoff_max=1, jitter=0,
+            heartbeat_every=10)
+        # black-hole everything outbound from peer 0 until the budget
+        # is gone
+        for _ in range(12):
+            q01.clear()
+            c0.tick()
+        q01.clear()
+        assert c0.in_flight == 0           # gave up retransmitting
+        assert metrics.counters.get('sync_retry_exhausted', 0) > before
+        # ...but the next heartbeats re-advertise and the protocol
+        # regenerates the lost data (no early quiet-exit: the link is
+        # silent until the next beat)
+        self._pump(c0, c1, q01, q10, ticks=80, until_quiet=False)
+        assert am.inspect(ds1.get_doc('doc0')) == {'x': 1}
+
+    def test_checksum_is_order_insensitive(self):
+        a = {'docId': 'd', 'clock': {'x': 1, 'y': 2}}
+        b = {'clock': {'y': 2, 'x': 1}, 'docId': 'd'}
+        assert payload_checksum(a) == payload_checksum(b)
+
+
+def _poison_changes():
+    """Fully-admitted but invalid: the duplicate insertion elemId fires
+    AFTER admission, deep in staging — the hardest rollback case (and
+    one both the numpy and native stagers must fail identically on)."""
+    return [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeList', 'obj': OBJ},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'l', 'value': OBJ},
+        {'action': 'ins', 'obj': OBJ, 'key': '_head', 'elem': 1},
+        {'action': 'ins', 'obj': OBJ, 'key': '_head', 'elem': 1}]}]
+
+
+def _fixed_changes():
+    return [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'makeList', 'obj': OBJ},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'l', 'value': OBJ},
+        {'action': 'ins', 'obj': OBJ, 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': OBJ, 'key': 'p:1', 'value': 'ok'}]}]
+
+
+def _seeded_general(capacity=8, n_docs=3):
+    ds = GeneralDocSet(capacity)
+    ds.apply_changes_batch(
+        {f'doc{i}': [{'actor': f'w{i}', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'v', 'value': i}]}]
+         for i in range(n_docs)})
+    return ds
+
+
+class TestPoisonIsolation:
+    def _tick_changes(self):
+        good = {f'doc{i}':
+                [{'actor': f'w{i}', 'seq': 2, 'deps': {f'w{i}': 1},
+                  'ops': [{'action': 'set', 'obj': ROOT_ID,
+                           'key': 'v2', 'value': i * 10}]}]
+                for i in (0, 2)}
+        return {**good, 'doc1': _poison_changes()}
+
+    def _run(self):
+        """Apply one poisoned tick under isolation; return the doc set
+        and its final materializations."""
+        ds = _seeded_general()
+        out = ds.apply_changes_batch(self._tick_changes(), isolate=True)
+        return ds, out
+
+    def test_flush_tick_isolates_poisoned_doc(self):
+        before = metrics.counters.get('sync_docs_quarantined', 0)
+        ds, out = self._run()
+        assert sorted(out) == ['doc0', 'doc2']
+        assert list(ds.quarantined) == ['doc1']
+        assert 'Duplicate list element ID' in \
+            ds.quarantined['doc1']['error']
+        assert metrics.counters.get('sync_docs_quarantined', 0) \
+            == before + 1
+        # every healthy doc applied
+        assert ds.materialize('doc0') == {'v': 0, 'v2': 0}
+        assert ds.materialize('doc2') == {'v': 2, 'v2': 20}
+        # the poisoned doc is oracle-equal to never having received
+        # the tick: same store state as a replica that never saw it
+        oracle = _seeded_general()
+        oracle.apply_changes_batch(
+            {k: v for k, v in self._tick_changes().items()
+             if k != 'doc1'})
+        assert canonical(ds.materialize('doc1')) \
+            == canonical(oracle.materialize('doc1'))
+        assert ds.store.clock_of(ds.id_of['doc1']) \
+            == oracle.store.clock_of(oracle.id_of['doc1'])
+
+    def test_corrected_delivery_clears_quarantine(self):
+        ds, _ = self._run()
+        out = ds.apply_changes_batch({'doc1': _fixed_changes()},
+                                     isolate=True)
+        assert 'doc1' in out and not ds.quarantined
+        assert ds.materialize('doc1') == {'v': 1, 'l': ['ok']}
+
+    def test_retry_quarantined(self):
+        ds, _ = self._run()
+        assert ds.retry_quarantined() == {}    # same changes still bad
+        assert 'doc1' in ds.quarantined
+        # simulate the cause being fixed by swapping the stored changes
+        ds.quarantined['doc1']['changes'] = _fixed_changes()
+        out = ds.retry_quarantined()
+        assert 'doc1' in out and not ds.quarantined
+
+    def test_unisolated_batch_still_raises(self):
+        ds = _seeded_general()
+        with pytest.raises(ValueError, match='Duplicate list element'):
+            ds.apply_changes_batch({'doc1': _poison_changes()})
+        assert not ds.quarantined              # contract unchanged
+
+    def test_poison_through_connection_flush(self):
+        """End to end: a BatchingConnection tick carrying the poison
+        applies every other doc and quarantines exactly the one."""
+        from automerge_tpu.sync.connection import BatchingConnection
+        ds = _seeded_general()
+        conn = BatchingConnection(ds, lambda m: None)
+        for doc_id, changes in self._tick_changes().items():
+            conn.receive_msg({'docId': doc_id, 'clock': {},
+                              'changes': changes})
+        out = conn.flush()
+        assert sorted(out) == ['doc0', 'doc2']
+        assert list(ds.quarantined) == ['doc1']
+
+    def test_plain_docset_flush_isolates(self):
+        """The per-doc fallback path: a DocSet without its own
+        quarantine registry quarantines on the connection."""
+        from automerge_tpu.sync.connection import BatchingConnection
+        ds = DocSet()
+        conn = BatchingConnection(ds, lambda m: None)
+        good = {'actor': 'g', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k', 'value': 1}]}
+        bad = {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'definitely-not-an-action', 'obj': ROOT_ID,
+             'key': 'k', 'value': 1}]}
+        conn.receive_msg({'docId': 'good', 'clock': {},
+                          'changes': [good]})
+        conn.receive_msg({'docId': 'bad', 'clock': {},
+                          'changes': [bad]})
+        out = conn.flush()
+        assert list(out) == ['good']
+        assert list(conn.quarantined) == ['bad']
+        # corrected delivery: the WRITER re-issues (actor, seq) with
+        # fixed content — the stored poison is superseded and clears
+        fixed = dict(bad, ops=good['ops'])
+        conn.receive_msg({'docId': 'bad', 'clock': {},
+                          'changes': [fixed]})
+        assert list(conn.flush()) == ['bad']
+        assert not conn.quarantined
+
+    @pytest.mark.parametrize('force', [False, True])
+    def test_rollback_native_numpy_parity(self, force):
+        """CI satellite: a native-stager fault must roll back to
+        EXACTLY the state the numpy stager rolls back to (and the
+        quarantine outcome must match)."""
+        from automerge_tpu import native as amnative
+        from automerge_tpu.device import general
+        if force and not amnative.stage_available():
+            pytest.skip('native stager unavailable')
+        prev = general._NATIVE_STAGING
+        general._NATIVE_STAGING = force
+        try:
+            ds, out = self._run()
+            views = {d: ds.materialize(d) for d in
+                     ('doc0', 'doc1', 'doc2')}
+        finally:
+            general._NATIVE_STAGING = prev
+        assert sorted(out) == ['doc0', 'doc2']
+        assert list(ds.quarantined) == ['doc1']
+        # same final state regardless of which stager faulted
+        ref, _ = self._run()
+        assert canonical(views) == canonical(
+            {d: ref.materialize(d) for d in ('doc0', 'doc1', 'doc2')})
+
+
+class TestCrashRecovery:
+    LATE_CHANGE = [{'actor': 'late', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'late', 'value': 1}]}]
+
+    def test_crash_restart_from_journal(self, tmp_path):
+        """Kill a durable peer mid-run (≥1 journal append past the
+        checkpoint), recover from snapshot + journal tail, resume the
+        sync, and land byte-identical to an uninterrupted run."""
+        # uninterrupted reference (same sources, same late edit)
+        clean_src = _src_fleet_docs()
+        clean = ChaosFleet([clean_src, GeneralDocSet(16)],
+                           seed=0, batching=True)
+        clean.run(max_ticks=500)
+        clean_src.apply_changes('doc0', self.LATE_CHANGE)
+        clean.run(max_ticks=1000)
+        want = [canonical(v) for v in clean.views()]
+
+        src = _src_fleet_docs()
+        durable = DurableDocSet(GeneralDocSet(16), str(tmp_path))
+        fleet = ChaosFleet([src, durable], seed=11,
+                           drop=0.1, batching=True, heartbeat_every=4)
+        journal = tmp_path / DurableDocSet.JOURNAL_FILE
+        # run until the journal holds something, checkpoint it away...
+        while journal.stat().st_size == 0 and fleet.now < 200:
+            fleet.tick()
+        assert journal.stat().st_size > 0
+        durable.checkpoint()
+        assert journal.stat().st_size == 0
+        # ...then feed a LATE source edit so the post-checkpoint
+        # journal tail is guaranteed non-empty when we pull the plug
+        src.apply_changes('doc0', self.LATE_CHANGE)
+        while journal.stat().st_size == 0 and fleet.now < 600:
+            fleet.tick()
+        assert journal.stat().st_size > 0  # >=1 append past checkpoint
+        # CRASH: all in-memory state gone; rebuild from disk only
+        recovered = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(16),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        assert recovered.doc_ids           # snapshot + tail held data
+        fleet.reconnect(1, recovered)
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == want
+
+    def test_crash_with_quarantined_poison_requarantines(self,
+                                                         tmp_path):
+        """The journal faithfully replays a poisoned batch — recovery
+        must re-quarantine it, not die on it."""
+        durable = DurableDocSet(GeneralDocSet(8), str(tmp_path))
+        durable.apply_changes_batch(
+            {f'doc{i}': [{'actor': f'w{i}', 'seq': 1, 'deps': {},
+                          'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                   'key': 'v', 'value': i}]}]
+             for i in range(3)})
+        durable.apply_changes_batch(
+            {'doc1': _poison_changes(),
+             'doc0': [{'actor': 'w0', 'seq': 2, 'deps': {'w0': 1},
+                       'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                'key': 'v2', 'value': 7}]}]},
+            isolate=True)
+        assert list(durable.quarantined) == ['doc1']
+        recovered = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(8),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        assert list(recovered.quarantined) == ['doc1']
+        assert recovered.materialize('doc0') == {'v': 0, 'v2': 7}
+
+
+def _src_fleet_docs():
+    ds = GeneralDocSet(16)
+    per = {}
+    for i in range(5):
+        obj = f'00000000-0000-4000-8000-{i:012x}'
+        per[f'doc{i}'] = [
+            {'actor': f's{i}', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': obj},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+                 'value': obj},
+                {'action': 'ins', 'obj': obj, 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': obj, 'key': f's{i}:1',
+                 'value': i}]}]
+    ds.apply_changes_batch(per)
+    return ds
+
+
+class TestFaultClassification:
+    def test_capacity_error_raises_not_quarantines(self):
+        """A fleet-level sizing error must surface loudly through the
+        isolate path, not quarantine every doc (review finding)."""
+        ds = GeneralDocSet(1, auto_grow=False)
+        ds.apply_changes(
+            'a', [{'actor': 'x', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                 'value': 1}]}])
+        with pytest.raises(ValueError, match='full'):
+            ds.apply_changes_batch(
+                {'b': [{'actor': 'y', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                     'value': 2}]}]}, isolate=True)
+        assert not ds.quarantined
+
+    def test_eager_apply_failure_does_not_consume_seq(self):
+        """An apply-time failure on the eager path must neither ack nor
+        dup-suppress the envelope: the retransmit redelivers, and a
+        transient cause heals (review finding)."""
+        from automerge_tpu.sync.resilient import ResilientConnection
+        sent = []
+        ds = GeneralDocSet(4)
+        conn = ResilientConnection(ds, sent.append)
+        data = {'v': 1, 'kind': 'data', 'seq': 1, 'payload': {
+            'docId': 'a', 'clock': {'p': 1}, 'changes':
+                _poison_changes()}}
+        from automerge_tpu.sync.resilient import payload_checksum
+        data['sum'] = payload_checksum(data['payload'])
+        before = metrics.counters.get('sync_apply_failures', 0)
+        assert conn.receive_msg(data) is None      # swallowed, counted
+        assert metrics.counters.get('sync_apply_failures', 0) \
+            == before + 1
+        assert not [e for e in sent if e.get('kind') == 'ack']
+        # a corrected redelivery of the SAME seq applies (not dup-hit)
+        fixed = {'v': 1, 'kind': 'data', 'seq': 1, 'payload': {
+            'docId': 'a', 'clock': {'p': 1}, 'changes':
+                _fixed_changes()}}
+        fixed['sum'] = payload_checksum(fixed['payload'])
+        conn.receive_msg(fixed)
+        assert ds.materialize('a') == {'l': ['ok']}
+        assert [e for e in sent if e.get('kind') == 'ack']
+
+    def test_corrupted_ack_rejected(self):
+        """A mangled ack must not cancel retransmission of a different
+        live envelope (review finding: acks are checksummed too)."""
+        from automerge_tpu.sync.resilient import ResilientConnection
+        sent = []
+        ds = DocSet()
+        ds.set_doc('d', am.change(am.init('a'),
+                                  lambda d: d.__setitem__('k', 1)))
+        conn = ResilientConnection(ds, sent.append)
+        conn.open()
+        assert conn.in_flight == 1
+        good_ack = {'v': 1, 'kind': 'ack', 'ack': 1}
+        from automerge_tpu.sync.resilient import payload_checksum
+        good_ack['sum'] = payload_checksum(1) ^ 0xFF   # corrupted
+        conn.receive_msg(good_ack)
+        assert conn.in_flight == 1         # NOT popped
+        good_ack['sum'] = payload_checksum(1)
+        conn.receive_msg(good_ack)
+        assert conn.in_flight == 0
+
+    def test_later_good_batch_still_applies_stored_quarantine(self):
+        """A quarantined doc's stored changes must not be dropped when
+        an UNRELATED later batch for the doc succeeds: they re-apply
+        (transient fault) or stay quarantined (review finding)."""
+        ds = _seeded_general()
+        ds.apply_changes_batch({'doc1': _poison_changes()},
+                               isolate=True)
+        assert list(ds.quarantined) == ['doc1']
+        # unrelated good changes for the same doc
+        ds.apply_changes_batch(
+            {'doc1': [{'actor': 'w1', 'seq': 2, 'deps': {'w1': 1},
+                       'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                'key': 'other', 'value': 5}]}]},
+            isolate=True)
+        # still-poisoned stored changes stay quarantined, not dropped
+        assert list(ds.quarantined) == ['doc1']
+        assert ds.materialize('doc1') == {'v': 1, 'other': 5}
+        # once the stored changes are viable they apply on clearance
+        ds.quarantined['doc1']['changes'] = _fixed_changes()
+        ds.apply_changes_batch(
+            {'doc1': [{'actor': 'w1', 'seq': 3, 'deps': {'w1': 2},
+                       'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                'key': 'more', 'value': 6}]}]},
+            isolate=True)
+        assert not ds.quarantined
+        assert ds.materialize('doc1') == \
+            {'v': 1, 'other': 5, 'more': 6, 'l': ['ok']}

@@ -348,3 +348,85 @@ class TestSnapshotServing:
             # pre-resume history against it
             h.expect(1, 0, deliver=True)
             h.expect(0, 1, deliver=True)
+
+
+class TestMessageValidation:
+    """Satellite: receive_msg validates the envelope BEFORE any state
+    mutation — a rejected message never pollutes `_their_clock`."""
+
+    def _conn(self, batching=False):
+        from automerge_tpu.sync.connection import BatchingConnection
+        ds = DocSet()
+        cls = BatchingConnection if batching else Connection
+        return ds, cls(ds, lambda m: None)
+
+    def _rejects(self, conn, msg, match):
+        from automerge_tpu.sync.connection import MessageRejected
+        import pytest as _pytest
+        with _pytest.raises(MessageRejected, match=match):
+            conn.receive_msg(msg)
+
+    def test_missing_or_nonstring_doc_id(self):
+        _, conn = self._conn()
+        self._rejects(conn, {'clock': {}}, 'docId')
+        self._rejects(conn, {'docId': 42, 'clock': {}}, 'docId')
+        self._rejects(conn, 'not a dict', 'not a dict')
+
+    def test_bad_clock_shapes(self):
+        _, conn = self._conn()
+        self._rejects(conn, {'docId': 'd', 'clock': [1, 2]},
+                      'clock is not a dict')
+        self._rejects(conn, {'docId': 'd', 'clock': {'a': -1}},
+                      'non-negative')
+        self._rejects(conn, {'docId': 'd', 'clock': {'a': 'one'}},
+                      'non-negative')
+        self._rejects(conn, {'docId': 'd', 'clock': {'a': True}},
+                      'non-negative')
+
+    def test_bad_changes_shapes(self):
+        _, conn = self._conn()
+        self._rejects(conn, {'docId': 'd', 'clock': {},
+                             'changes': 'nope'}, 'changes is not a list')
+        self._rejects(conn, {'docId': 'd', 'clock': {},
+                             'changes': ['nope']}, 'change is not a dict')
+        self._rejects(conn, {'docId': 'd', 'clock': {}, 'changes': [
+            {'actor': 'a', 'seq': 0, 'deps': {}, 'ops': []}]},
+            'positive int')
+        self._rejects(conn, {'docId': 'd', 'clock': {}, 'changes': [
+            {'actor': 'a', 'seq': 1, 'ops': []}]}, 'deps')
+        self._rejects(conn, {'docId': 'd', 'clock': {}, 'changes': [
+            {'actor': 'a', 'seq': 1, 'deps': {'b': -2}, 'ops': []}]},
+            'dep')
+        self._rejects(conn, {'docId': 'd', 'clock': {}, 'changes': [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': 'x'}]},
+            'list of dicts')
+
+    def test_their_clock_not_polluted_by_rejection(self):
+        for batching in (False, True):
+            _, conn = self._conn(batching)
+            self._rejects(conn, {'docId': 'd',
+                                 'clock': {'evil': -5},
+                                 'changes': [{}]}, '.')
+            assert conn._their_clock == {}
+            # a VALID message for the same doc then starts clean
+            conn.receive_msg({'docId': 'd', 'clock': {'good': 1}})
+            assert conn._their_clock == {'d': {'good': 1}}
+
+    def test_rejections_are_counted(self):
+        from automerge_tpu.utils import metrics as M
+        _, conn = self._conn()
+        before = M.metrics.counters.get('sync_msgs_rejected', 0)
+        for bad in ({'docId': 7}, {'docId': 'd', 'clock': 3},
+                    {'docId': 'd', 'clock': {}, 'changes': [None]}):
+            try:
+                conn.receive_msg(bad)
+            except ValueError:
+                pass
+        assert M.metrics.counters.get('sync_msgs_rejected', 0) \
+            == before + 3
+
+    def test_batching_buffer_validates_before_buffering(self):
+        ds, conn = self._conn(batching=True)
+        self._rejects(conn, {'docId': 'd', 'clock': {},
+                             'changes': ['garbage']}, 'change')
+        assert conn.flush() == {}          # nothing was buffered

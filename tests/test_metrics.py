@@ -118,6 +118,69 @@ class TestDeviceIntegration:
         assert batch_events and batch_events[0]['docs'] == 2
 
 
+class TestFaultCounters:
+    """The degraded-operation observability contract: every fault path
+    increments its named counter (the names `FAULT_COUNTERS` pins)."""
+
+    def test_registry_names_are_pinned(self):
+        assert set(M.FAULT_COUNTERS) >= {
+            'sync_retransmits', 'sync_msgs_rejected',
+            'sync_docs_quarantined', 'apply_rollbacks',
+            'snapshot_checksum_failures'}
+
+    def test_rejected_message_counts(self):
+        from automerge_tpu.sync.connection import MessageRejected
+        ds = A.DocSet()
+        conn = A.Connection(ds, lambda m: None)
+        with pytest.raises(MessageRejected):
+            conn.receive_msg({'docId': 42, 'clock': {}})
+        assert M.counters()['sync_msgs_rejected'] == 1
+
+    def test_retransmit_and_duplicate_count(self):
+        from automerge_tpu.sync.resilient import ResilientConnection
+        sent = []
+        ds = A.DocSet()
+        ds.set_doc('d', A.change(A.init('a'),
+                                 lambda d: d.__setitem__('k', 1)))
+        conn = ResilientConnection(ds, sent.append, backoff_base=1,
+                                   jitter=0)
+        conn.open()                    # one advert in flight, no ack
+        for _ in range(3):
+            conn.tick()
+        assert M.counters()['sync_retransmits'] >= 1
+        # duplicate suppression on the receive side
+        ds2 = A.DocSet()
+        conn2 = ResilientConnection(ds2, lambda m: None)
+        env = sent[0]
+        conn2.receive_msg(env)
+        conn2.receive_msg(env)
+        assert M.counters()['sync_msgs_duplicate'] == 1
+
+    def test_quarantine_and_rollback_count(self):
+        from automerge_tpu.common import ROOT_ID
+        from automerge_tpu.sync import GeneralDocSet
+        ds = GeneralDocSet(4)
+        obj = '00000000-0000-4000-8000-000000000bad'
+        poison = [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1}]}]
+        ds.apply_changes_batch({'doc0': poison}, isolate=True)
+        assert M.counters()['sync_docs_quarantined'] == 1
+        assert M.counters()['apply_rollbacks'] >= 1
+
+    def test_snapshot_checksum_failure_counts(self):
+        from automerge_tpu import durability
+        from automerge_tpu.snapshot import SnapshotCorruptError
+        blob = bytearray(durability.pack_snapshot(b'{"payload": 1}'))
+        blob[-3] ^= 0xFF
+        with pytest.raises(SnapshotCorruptError, match='checksum'):
+            durability.unpack_snapshot(bytes(blob))
+        assert M.counters()['snapshot_checksum_failures'] == 1
+
+
 class TestProfilerBridge:
     def test_trace_annotation_runs(self):
         import jax.numpy as jnp

@@ -277,3 +277,225 @@ def test_restored_state_keeps_link_bookkeeping():
     obj = next(o for o, rec in state.objects.items()
                if o != ROOT_ID)
     assert state.objects[obj].inbound == []
+
+
+class TestSnapshotCorruption:
+    """Satellite: every corruption mode raises SnapshotCorruptError
+    naming what failed — never a bare KeyError/JSONDecodeError."""
+
+    def _snap(self):
+        return snapshot.save_snapshot(_device_doc(_frontend_changes(
+            'author', lambda d: d.__setitem__('k', 1))))
+
+    def test_truncated_payload(self):
+        snap = self._snap()
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='not valid JSON'):
+            snapshot.load_snapshot(snap[:len(snap) // 2])
+
+    def test_non_json_payload(self):
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='not valid JSON'):
+            snapshot.load_snapshot('\x00\xff garbage bytes \x07')
+
+    def test_missing_field_is_named(self):
+        payload = json.loads(self._snap())
+        del payload['clock']
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match="missing field 'clock'"):
+            snapshot.load_snapshot(json.dumps(payload))
+
+    def test_missing_object_field_is_named(self):
+        payload = json.loads(self._snap())
+        del payload['objects'][0]['inbound']
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match="missing field 'inbound'"):
+            snapshot.load_snapshot(json.dumps(payload))
+
+    def test_non_dict_payload(self):
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='not an object'):
+            snapshot.load_snapshot('[1, 2, 3]')
+
+    def test_error_is_a_value_error(self):
+        # callers that caught ValueError before keep working
+        assert issubclass(snapshot.SnapshotCorruptError, ValueError)
+
+    def _general_snapshot(self):
+        """A bulk-routed (GeneralBackendState) document's snapshot."""
+        from automerge_tpu.config import Options
+        changes = [{'actor': 'x', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': am.ROOT_ID, 'key': f'k{i}',
+             'value': i} for i in range(12)]}]
+        state, patch = DeviceBackend.apply_changes(
+            DeviceBackend.init(), changes,
+            options=Options(bulk_route_min_ops=5))
+        patch['state'] = state
+        doc = Frontend.apply_patch(
+            Frontend.init({'backend': DeviceBackend}), patch)
+        return snapshot.save_snapshot(doc)
+
+    def test_general_snapshot_missing_store_field(self):
+        snap = json.loads(self._general_snapshot())
+        assert snap['format'] == snapshot.GENERAL_FORMAT
+        broken = dict(snap)
+        del broken['store']
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match="missing field 'store'"):
+            snapshot.load_snapshot(json.dumps(broken))
+        snap['store'] = snap['store'][:40]       # truncated store bytes
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match="'store'"):
+            snapshot.load_snapshot(json.dumps(snap))
+
+    def test_general_docset_snapshot_truncated(self):
+        from automerge_tpu.sync import GeneralDocSet
+        ds = GeneralDocSet(2)
+        ds.apply_changes(
+            'a', [{'actor': 'x', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': am.ROOT_ID, 'key': 'k',
+                 'value': 1}]}])
+        blob = ds.save_snapshot()
+        for cut in (4, 12, len(blob) - 20):
+            with pytest.raises(snapshot.SnapshotCorruptError):
+                GeneralDocSet.load_snapshot(blob[:cut])
+        # intact round trip still works
+        assert GeneralDocSet.load_snapshot(blob).materialize('a') \
+            == {'k': 1}
+
+
+class TestDurability:
+    """Atomic checksummed snapshot files + the append-only journal."""
+
+    def _doc_snapshot(self):
+        return snapshot.save_snapshot(_device_doc(_frontend_changes(
+            'author', lambda d: d.__setitem__('k', 1))))
+
+    def test_snapshot_file_round_trip(self, tmp_path):
+        from automerge_tpu import durability
+        path = str(tmp_path / 'doc.amtpu')
+        durability.write_snapshot_file(path, self._doc_snapshot())
+        doc = snapshot.load_snapshot(
+            durability.read_snapshot_file(path).decode())
+        assert _materialize(doc) == {'k': 1}
+
+    def test_container_detects_truncation_and_bit_rot(self, tmp_path):
+        from automerge_tpu import durability
+        blob = durability.pack_snapshot(self._doc_snapshot())
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='truncated'):
+            durability.unpack_snapshot(blob[:len(blob) - 5])
+        flipped = bytearray(blob)
+        flipped[-1] ^= 0x01
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='checksum'):
+            durability.unpack_snapshot(bytes(flipped))
+        with pytest.raises(snapshot.SnapshotCorruptError,
+                           match='magic'):
+            durability.unpack_snapshot(b'X' * len(blob))
+
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        from automerge_tpu import durability
+        path = tmp_path / 'snap.bin'
+        durability.atomic_write_bytes(str(path), b'one')
+        durability.atomic_write_bytes(str(path), b'two')
+        assert path.read_bytes() == b'two'
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_journal_replay_and_torn_tail(self, tmp_path):
+        from automerge_tpu import durability
+        path = str(tmp_path / 'j.log')
+        j = durability.ChangeJournal(path)
+        j.append({'changes': {'a': [1]}})
+        j.append({'changes': {'b': [2]}})
+        j.close()
+        # torn tail: a crash mid-append truncates the last record
+        data = open(path, 'rb').read()
+        open(path, 'wb').write(data[:-3])
+        got = list(durability.ChangeJournal.replay(path))
+        assert got == [{'changes': {'a': [1]}}]
+
+    def test_journal_bit_rot_stops_replay_and_counts(self, tmp_path):
+        from automerge_tpu import durability
+        from automerge_tpu.utils.metrics import metrics
+        path = str(tmp_path / 'j.log')
+        j = durability.ChangeJournal(path)
+        j.append({'changes': {'a': [1]}})
+        j.append({'changes': {'b': [2]}})
+        j.close()
+        data = bytearray(open(path, 'rb').read())
+        data[-1] ^= 0xFF                   # flip a bit in record 2
+        open(path, 'wb').write(bytes(data))
+        before = metrics.counters.get('snapshot_checksum_failures', 0)
+        got = list(durability.ChangeJournal.replay(path))
+        assert got == [{'changes': {'a': [1]}}]
+        assert metrics.counters.get('snapshot_checksum_failures', 0) \
+            == before + 1
+
+    def test_checkpoint_truncates_journal(self, tmp_path):
+        from automerge_tpu.common import ROOT_ID
+        from automerge_tpu.durability import DurableDocSet
+        from automerge_tpu.sync import GeneralDocSet
+        d = DurableDocSet(GeneralDocSet(2), str(tmp_path))
+        d.apply_changes('a', [{'actor': 'x', 'seq': 1, 'deps': {},
+                               'ops': [{'action': 'set',
+                                        'obj': ROOT_ID, 'key': 'k',
+                                        'value': 1}]}])
+        journal = tmp_path / DurableDocSet.JOURNAL_FILE
+        assert journal.stat().st_size > 0
+        d.checkpoint()
+        assert journal.stat().st_size == 0
+        assert (tmp_path / DurableDocSet.SNAPSHOT_FILE).exists()
+        rec = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(2),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        assert rec.materialize('a') == {'k': 1}
+
+    def test_double_crash_journal_tail_not_stranded(self, tmp_path):
+        """Recovery must TRUNCATE a torn journal tail: records appended
+        after a recovery have to replay on the NEXT crash, not be
+        stranded behind the old garbage (review finding)."""
+        from automerge_tpu.common import ROOT_ID
+        from automerge_tpu.durability import DurableDocSet
+        from automerge_tpu.sync import GeneralDocSet
+
+        def change(seq, key, deps):
+            return [{'actor': 'x', 'seq': seq, 'deps': deps,
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': key, 'value': seq}]}]
+
+        d = DurableDocSet(GeneralDocSet(2), str(tmp_path))
+        d.apply_changes('a', change(1, 'k1', {}))
+        # crash 1: mid-append torn record at the tail
+        jp = tmp_path / DurableDocSet.JOURNAL_FILE
+        with open(jp, 'ab') as f:
+            f.write(b'\x00\x00\x00\x30garbage')
+        rec = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(2),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        # post-recovery appends...
+        rec.apply_changes('a', change(2, 'k2', {'x': 1}))
+        # ...crash 2 (no checkpoint in between): BOTH changes replay
+        rec2 = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(2),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        assert rec2.materialize('a') == {'k1': 1, 'k2': 2}
+
+    def test_mistyped_fields_raise_corrupt_error(self):
+        """Presence is not enough: mistyped fields (closures as a
+        list, fields rows as scalars) must also surface as
+        SnapshotCorruptError, never a bare AttributeError (review
+        finding)."""
+        base = json.loads(snapshot.save_snapshot(_device_doc(
+            _frontend_changes('author',
+                              lambda d: d.__setitem__('k', 1)))))
+        for field, bad in (('closures', []), ('fields', [1, 2]),
+                           ('objects', [{'obj': 'x', 'type': 'list',
+                                         'inbound': 0, 'nodes': 0,
+                                         'parent': 0, 'elem': 0,
+                                         'actor': 0, 'elem_ids': 0}]),
+                           ('clock', 'not-a-dict')):
+            payload = dict(base)
+            payload[field] = bad
+            with pytest.raises(snapshot.SnapshotCorruptError):
+                snapshot.load_snapshot(json.dumps(payload))
