@@ -237,7 +237,8 @@ class ChangeBlock:
     __slots__ = ('n_docs', 'doc', 'actor', 'seq', 'dep_ptr', 'dep_actor',
                  'dep_seq', 'op_ptr', 'action', 'key', 'value',
                  'actors', 'keys', 'values', '_dup_keys',
-                 'obj', 'key_kind', 'key_elem', 'elem', 'objs')
+                 'obj', 'key_kind', 'key_elem', 'elem', 'objs',
+                 '_wire_lits')
 
     def __init__(self, n_docs, doc, actor, seq, dep_ptr, dep_actor, dep_seq,
                  op_ptr, action, key, value, actors, keys, values,
@@ -276,6 +277,9 @@ class ChangeBlock:
         self.key_elem = key_elem
         self.elem = elem
         self.objs = objs
+        # pre-escaped JSON string-literal tables for the wire emitter
+        # (wire.encode_change_rows), built lazily once per block
+        self._wire_lits = None
 
     def is_general(self):
         """True when the block carries the general op schema (sequences,
@@ -600,6 +604,17 @@ class BlockStore:
         # admitted block rows sorted by doc (admission order within each
         # doc), docs the parallel doc column; blocks are shared refs
         self.retained = []
+        # per-change wire-encode cache over the retained log:
+        # (doc, actor, seq) -> compact JSON bytes. Changes are immutable
+        # once applied (an inconsistent seq reuse raises at admission),
+        # so entries are never invalidated; they are built lazily at
+        # serve time (get_missing_changes_wire), which means every key
+        # references a COMMITTED change — a rolled-back apply can never
+        # leave a stale body here. With N peers each change encodes
+        # once and fans out N times; retransmits reuse the same bytes.
+        self._wire_cache = {}
+        self.wire_cache_hits = 0
+        self.wire_cache_misses = 0
         self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
 
@@ -750,14 +765,13 @@ class BlockStore:
                     missing[a] = max(s, missing.get(a, 0))
         return missing
 
-    def get_missing_changes(self, d, have_deps):
-        """Changes applied to document `d` that a peer with clock
-        `have_deps` lacks, in admission (causal) order — the Connection
-        primitive for bulk stores (src/connection.js:58-66). The log is
-        the retained ChangeBlocks (indexed per doc; converged peers
-        short-circuit without touching it); after a snapshot resume it
-        only goes back to the resume point (older gaps raise, like the
-        per-doc backend)."""
+    def _missing_retained(self, d, have_deps):
+        """Retained-log rows of document `d` a peer with clock
+        `have_deps` lacks, in admission (causal) order: a list of
+        ``(block, row, actor_str, seq)``. Shared by the dict serve path
+        (:meth:`get_missing_changes`) and the wire serve path
+        (:meth:`get_missing_changes_wire`); raises the same
+        retention/truncation errors for both."""
         clock = self.clock_of(d)
         if all(have_deps.get(a, 0) >= s for a, s in clock.items()):
             return []
@@ -769,16 +783,17 @@ class BlockStore:
         for block, rows, docs in self.retained:
             lo, hi = np.searchsorted(docs, [d, d + 1])
             for c in rows[lo:hi]:
+                c = int(c)
                 actor = block.actors[block.actor[c]]
-                if block.seq[c] > have_deps.get(actor, 0):
-                    out.append(block.change_dict(c))
+                seq = int(block.seq[c])
+                if seq > have_deps.get(actor, 0):
+                    out.append((block, c, actor, seq))
         if self.log_truncated:
             # per actor the retained seqs run (resume point, clock]; a
             # peer needing anything below that range cannot be served
             min_seq = {}
-            for ch in out:
-                a = ch['actor']
-                min_seq[a] = min(min_seq.get(a, ch['seq']), ch['seq'])
+            for _, _, a, s in out:
+                min_seq[a] = min(min_seq.get(a, s), s)
             for a, s in clock.items():
                 h = have_deps.get(a, 0)
                 if h < s and (a not in min_seq or h + 1 < min_seq[a]):
@@ -787,6 +802,123 @@ class BlockStore:
                         'peer this far behind needs the snapshot or the '
                         'full log')
         return out
+
+    def get_missing_changes(self, d, have_deps):
+        """Changes applied to document `d` that a peer with clock
+        `have_deps` lacks, in admission (causal) order — the Connection
+        primitive for bulk stores (src/connection.js:58-66). The log is
+        the retained ChangeBlocks (indexed per doc; converged peers
+        short-circuit without touching it); after a snapshot resume it
+        only goes back to the resume point (older gaps raise, like the
+        per-doc backend)."""
+        return [block.change_dict(c) for block, c, _, _
+                in self._missing_retained(d, have_deps)]
+
+    def get_missing_changes_wire(self, d, have_deps):
+        """The wire-path twin of :meth:`get_missing_changes`: the same
+        missing changes, as their compact JSON encodings (one ``bytes``
+        per change, admission order) served from the per-change encode
+        cache. On a miss the encodings build in one batched emit per
+        retained block (native C++ when available) and stay cached
+        forever — a fan-out to N peers (or a retransmit) re-serves the
+        same bytes with zero re-encode. Raises exactly the
+        retention/truncation errors of the dict path."""
+        blobs, errors = self.get_missing_changes_wire_batch(
+            [(d, have_deps)])
+        if d in errors:
+            raise errors[d]
+        return blobs[d]
+
+    def get_missing_changes_wire_batch(self, wants, all_clocks=None):
+        """Fleet-grained wire serve: ``wants`` is ``[(doc,
+        have_deps)]``; returns ``({doc: [bytes, ...]}, {doc: error})``
+        where ``error`` is the retention/truncation ValueError the dict
+        path would raise for that doc (the caller's snapshot-fallback
+        candidates — other docs still serve). ALL cache misses across
+        every requested doc emit in ONE batched pass per retained
+        block, so a multi-doc tick pays one native call, not one per
+        document. ``all_clocks`` lets a caller that already swept the
+        fleet clocks (``clocks_all``) share the pass."""
+        sels, errors = {}, {}
+        # fleet-grained converged short-circuit: ONE pass over the
+        # clock rows replaces a clock_of (searchsorted + dict build)
+        # per requested doc — on a steady-state tick most peers are
+        # caught up and never reach the retained-log scan
+        if all_clocks is None and len(wants) > 16 and \
+                hasattr(self, 'clocks_all'):
+            all_clocks = self.clocks_all()
+        # bulk gather for EMPTY have-clocks (a fresh peer's full sync,
+        # the 10k-doc bench shape): every retained row of the wanted
+        # docs is missing by definition, so the rows of all such docs
+        # gather per retained block in one vectorized pass instead of
+        # a clock_of + searchsorted per document. Truncated/unretained
+        # logs keep the per-doc path (its errors are per doc).
+        fresh = [d for d, have_deps in wants if not have_deps] \
+            if len(wants) > 16 and self.retain_log \
+            and not self.log_truncated else []
+        if fresh:
+            for d in fresh:
+                sels[d] = []
+            want_arr = np.asarray(sorted(fresh), np.int64)
+            for block, rows, docs in self.retained:
+                lo = np.searchsorted(docs, want_arr)
+                hi = np.searchsorted(docs, want_arr + 1)
+                pos = _span_indices(lo, hi - lo)
+                if not len(pos):
+                    continue
+                rr = rows[pos]
+                dd = np.repeat(want_arr, hi - lo)
+                actors = block.actors
+                a_ids = block.actor[rr].tolist()
+                seqs = block.seq[rr].tolist()
+                for d, c, a, s in zip(dd.tolist(), rr.tolist(),
+                                      a_ids, seqs):
+                    sels[d].append((block, c, actors[a], s))
+        for d, have_deps in wants:
+            if d in sels:
+                continue
+            if all_clocks is not None:
+                clock = all_clocks.get(d, {})
+                if all(have_deps.get(a, 0) >= s
+                       for a, s in clock.items()):
+                    sels[d] = []
+                    continue
+            try:
+                sels[d] = self._missing_retained(d, have_deps)
+            except ValueError as err:
+                errors[d] = err
+        cache = self._wire_cache
+        out = {}
+        # one cache probe per change: misses record their output slot
+        # and are patched in place after the per-block batched emit
+        misses = {}        # id(block) -> (block, [(row, key, lst, i)])
+        n_total = 0
+        for d, sel in sels.items():
+            blobs = []
+            for block, c, actor, seq in sel:
+                key = (d, actor, seq)
+                b = cache.get(key)
+                if b is None:
+                    misses.setdefault(id(block), (block, []))[1] \
+                        .append((c, key, blobs, len(blobs)))
+                blobs.append(b)
+            out[d] = blobs
+            n_total += len(blobs)
+        n_miss = 0
+        if misses:
+            from .. import wire as _wire
+            for block, entries in misses.values():
+                n_miss += len(entries)
+                encoded = _wire.encode_change_rows(
+                    block, [c for c, _, _, _ in entries])
+                for (c, key, lst, i), blob in zip(entries, encoded):
+                    cache[key] = blob
+                    lst[i] = blob
+        self.wire_cache_misses += n_miss
+        self.wire_cache_hits += n_total - n_miss
+        metrics.bump('wire_encode_cache_misses', n_miss)
+        metrics.bump('wire_encode_cache_hits', n_total - n_miss)
+        return out, errors
 
 
 def init_store(n_docs):

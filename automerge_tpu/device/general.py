@@ -375,6 +375,7 @@ class _Txn:
         del store.obj_type[self.n_objs:]
         store._root_row = self.root_row
         store._obj_arr_cache = (0, None, None)
+        store._wire_obj_cache = None
         (store.e_doc, store.e_obj, store.e_key, store.e_actor,
          store.e_seq, store.e_value, store.e_link,
          store.e_change) = self.entries
@@ -407,6 +408,7 @@ class GeneralStore(BlockStore):
         self._host_lock = self.pool._lock        # one lock, store-wide
         self._root_row = np.full(n_docs, -1, np.int64)
         self._obj_arr_cache = (0, None, None)
+        self._wire_obj_cache = None
         # per-document applied version: bumped for exactly the doc
         # indexes an apply touched (the dirty-doc signal view caches
         # key on — see GeneralDocSet materialization). Monotone per
@@ -695,6 +697,22 @@ class GeneralStore(BlockStore):
         materialized view is unchanged."""
         return int(self._doc_version[d])
 
+    def clocks_all(self):
+        """``{doc index: {actor: seq}}`` for every document with a
+        non-empty clock, in ONE pass over the sorted clock rows. The
+        fleet surfaces (``fleet_status``, anti-entropy heartbeats) want
+        every clock at once; looping :meth:`clock_of` per doc pays a
+        searchsorted per document instead."""
+        out = {}
+        d_l = self.c_doc.tolist()
+        a_l = self.c_actor.tolist()
+        s_l = self.c_seq.tolist()
+        actors = self.actors
+        for d, a, s in zip(d_l, a_l, s_l):
+            if s > 0:
+                out.setdefault(d, {})[actors[a]] = s
+        return out
+
     def obj_arrays(self):
         """(obj_doc, obj_type) as int32 arrays, cached per table size."""
         n = len(self.obj_uuid)
@@ -703,6 +721,31 @@ class GeneralStore(BlockStore):
                                    np.asarray(self.obj_doc, np.int32),
                                    np.asarray(self.obj_type, np.int32))
         return self._obj_arr_cache[1], self._obj_arr_cache[2]
+
+    def wire_obj_tables(self):
+        """The object tables marshalled for the native wire codec
+        (uuid blob + offsets, doc/type arrays), cached per table
+        length — the tables are append-only, so a prefix of a given
+        length never changes (a rollback truncation resets the cache
+        explicitly in ``_Txn.rollback``, like ``_obj_arr_cache``). A
+        steady-state receive tick re-parses against a large object
+        table; without this the codec edge re-encodes every uuid per
+        flush."""
+        n = len(self.obj_uuid)
+        cache = self._wire_obj_cache
+        if cache is not None and cache[0] == n:
+            return cache[1:]
+        encoded = [u.encode('utf-8') for u in self.obj_uuid]
+        blob = b''.join(encoded)
+        offsets = np.zeros(n + 1, np.int64)
+        if encoded:
+            np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        doc_arr = np.asarray(self.obj_doc, np.int32) if n else \
+            np.zeros(1, np.int32)
+        type_arr = np.asarray(self.obj_type, np.int8) if n else \
+            np.zeros(1, np.int8)
+        self._wire_obj_cache = (n, blob, offsets, doc_arr, type_arr)
+        return blob, offsets, doc_arr, type_arr
 
     def obj_row(self, d, uuid, create_type=None):
         row = self.obj_of.get((d, uuid))

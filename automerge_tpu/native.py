@@ -442,6 +442,124 @@ def view_walk(objs, pool):
     return seg, loc, counts
 
 
+# ---------------------------------------------------------------------------
+# Native wire-blob emit (the amwe_* entry points of libamwire.so): change
+# rows of a retained ChangeBlock -> compact canonical JSON bytes, the
+# encode half of the zero-re-encode sync tick. Byte-identical to the
+# Python fallback in `wire._emit_change_py` by construction — the host
+# pre-escapes every string literal; C++ only splices spans and formats
+# integers.
+
+_EMIT_LIB = None
+_EMIT_ATTEMPTED = False
+
+
+def _bind_emit(lib):
+    lib.amwe_emit_general.argtypes = [
+        _i64, _P64,                                  # rows
+        _P32, _P32, _P32, _P32, _P32,                # change columns
+        _P32, _P8, _P32, _P8, _P32, _P32, _P32,      # op columns
+        _P32,                                        # val_local
+        ctypes.c_char_p, _P64, ctypes.c_char_p, _P64,
+        ctypes.c_char_p, _P64, ctypes.c_char_p, _P64]
+    lib.amwe_emit_general.restype = ctypes.c_void_p
+    lib.amwe_bytes.argtypes = [ctypes.c_void_p]
+    lib.amwe_bytes.restype = _i64
+    lib.amwe_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _P64]
+    lib.amwe_fill.restype = None
+    lib.amwe_free.argtypes = [ctypes.c_void_p]
+    lib.amwe_free.restype = None
+    return lib
+
+
+def emit_lib():
+    """The wire-emit library, or None (no native codec / stale binary
+    without the amwe_* symbols / AUTOMERGE_TPU_NATIVE_EMIT=0)."""
+    global _EMIT_LIB, _EMIT_ATTEMPTED
+    if _EMIT_ATTEMPTED:
+        return _EMIT_LIB
+    _EMIT_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE_EMIT', '1') == '0':
+        return None
+    from . import wire as _wire
+    lib = _wire._load()
+    if lib is None:
+        return None
+    try:
+        _EMIT_LIB = _bind_emit(lib)
+    except AttributeError:
+        _EMIT_LIB = None             # stale .so predating the emitter
+    return _EMIT_LIB
+
+
+def emit_available():
+    return emit_lib() is not None
+
+
+def _lit_blob(lits):
+    """(concatenated bytes, int64 offsets) of a literal table."""
+    blob = b''.join(lits)
+    off = _np.zeros(len(lits) + 1, _np.int64)
+    if lits:
+        _np.cumsum([len(x) for x in lits], out=off[1:])
+    return blob, off
+
+
+def emit_change_rows(block, rows_arr, lits, vlits, sel, use, v):
+    """Native batch emit of general-block change rows: one ``bytes``
+    per row, or None when the library is unavailable (the caller falls
+    back to the Python emitter). ``lits`` are the block's pre-escaped
+    (actors, keys, objs) literal tables; ``vlits`` maps referenced
+    value rows to their literal bytes; ``sel``/``use``/``v`` is the
+    caller's op selection (``wire._op_selection`` — computed once,
+    shared with the value-literal build)."""
+    lib = emit_lib()
+    if lib is None:
+        return None
+    # joined table blobs cache on the block next to the literal lists
+    # (wire._block_lits) — a fleet serve must not re-join per call
+    cacheobj = block._wire_lits if isinstance(block._wire_lits, dict) \
+        else None
+    blobs = cacheobj.get('blobs') if cacheobj is not None else None
+    if blobs is None:
+        actors_l, keys_l, objs_l = lits
+        blobs = (_lit_blob(actors_l), _lit_blob(keys_l),
+                 _lit_blob(objs_l))
+        if cacheobj is not None:
+            cacheobj['blobs'] = blobs
+    (a_b, a_off), (k_b, k_off), (o_b, o_off) = blobs
+    vids = _np.asarray(sorted(vlits), _np.int64)
+    v_b, v_off = _lit_blob([vlits[int(i)] for i in vids])
+    # per-op local value index (-1 none), filled for the selected ops
+    # only — one vectorized remap, no per-op Python
+    val_local = _np.full(block.n_ops, -1, _np.int32)
+    if len(vids) and len(sel):
+        val_local[sel[use]] = _np.searchsorted(
+            vids, v[use]).astype(_np.int32)
+    h = lib.amwe_emit_general(
+        len(rows_arr), _p64(rows_arr),
+        _p32(block.actor), _p32(block.seq),
+        _p32(block.dep_ptr), _p32(block.dep_actor),
+        _p32(block.dep_seq),
+        _p32(block.op_ptr), _p8(block.action), _p32(block.obj),
+        _p8(block.key_kind), _p32(block.key), _p32(block.key_elem),
+        _p32(block.elem), _p32(val_local),
+        a_b, _p64(a_off), k_b, _p64(k_off),
+        o_b, _p64(o_off), v_b, _p64(v_off))
+    if not h:
+        raise MemoryError('native wire emit allocation failed')
+    try:
+        nbytes = int(lib.amwe_bytes(h))
+        buf = ctypes.create_string_buffer(max(nbytes, 1))
+        offsets = _np.empty(len(rows_arr) + 1, _np.int64)
+        lib.amwe_fill(h, buf, _p64(offsets))
+        raw = buf.raw[:nbytes]
+    finally:
+        lib.amwe_free(h)
+    return [raw[offsets[i]:offsets[i + 1]]
+            for i in range(len(rows_arr))]
+
+
 def _p32(a):
     return a.ctypes.data_as(_P32)
 

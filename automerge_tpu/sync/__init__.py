@@ -12,10 +12,12 @@ from .device_doc_set import DeviceDocSet
 from .dense_doc_set import DenseDocSet
 from .general_doc_set import GeneralDocSet
 from .watchable_doc import WatchableDoc
-from .connection import (Connection, BatchingConnection,
-                         MessageRejected, validate_msg)
+from .connection import (Connection, BatchingConnection, WireConnection,
+                         MessageRejected, validate_msg,
+                         validate_wire_msg)
 from .resilient import ResilientConnection
 
 __all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
            'WatchableDoc', 'Connection', 'BatchingConnection',
-           'MessageRejected', 'validate_msg', 'ResilientConnection']
+           'WireConnection', 'MessageRejected', 'validate_msg',
+           'validate_wire_msg', 'ResilientConnection']

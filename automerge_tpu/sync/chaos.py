@@ -77,8 +77,8 @@ class ChaosFleet:
     """
 
     def __init__(self, doc_sets, seed=0, drop=0.0, dup=0.0, delay=0,
-                 corrupt=0.0, batching=True, heartbeat_every=8,
-                 conn_kwargs=None):
+                 corrupt=0.0, batching=True, wire=False,
+                 heartbeat_every=8, conn_kwargs=None):
         self.doc_sets = list(doc_sets)
         self.rng = random.Random(seed)
         self.drop = drop
@@ -86,6 +86,7 @@ class ChaosFleet:
         self.delay = delay
         self.corrupt = corrupt
         self.batching = batching
+        self.wire = wire                 # columnar wire data path
         self.now = 0
         self._order = 0
         self.stats = Counter()
@@ -94,6 +95,8 @@ class ChaosFleet:
         self.partitioned = set()         # frozenset({a, b})
         self._conn_kwargs = dict(conn_kwargs or {})
         self._conn_kwargs.setdefault('heartbeat_every', heartbeat_every)
+        if wire:
+            self._conn_kwargs['wire'] = True
         nodes = range(len(self.doc_sets))
         for a in nodes:
             for b in nodes:
@@ -142,10 +145,13 @@ class ChaosFleet:
 
     def _corrupt_env(self, env):
         """One seeded mutation: flipped checksum, bogus version, mangled
-        seq/kind, or a field torn out of the payload — every shape the
-        receiver must survive (and count) without crashing."""
+        seq/kind, a field torn out of the payload, or a bit flipped in
+        a wire blob — every shape the receiver must survive (and count)
+        without crashing. Blob corruption targets the CRC32-over-bytes
+        path: the flipped byte must be caught BEFORE the codec parses,
+        never quarantine a doc."""
         env = copy.deepcopy(env)
-        mode = self.rng.randrange(5)
+        mode = self.rng.randrange(6)
         if mode == 0:
             env['sum'] = env.get('sum', 0) ^ 0x5A5A5A5A
         elif mode == 1:
@@ -154,6 +160,17 @@ class ChaosFleet:
             env['seq'] = 'corrupt'
         elif mode == 3:
             env['kind'] = 'garbage'
+        elif mode == 4:
+            payload = env.get('payload')
+            blob = payload.get('blob') if isinstance(payload, dict) \
+                else None
+            if isinstance(blob, (bytes, bytearray)) and len(blob):
+                i = self.rng.randrange(len(blob))
+                payload['blob'] = blob[:i] + \
+                    bytes([blob[i] ^ (1 << self.rng.randrange(8))]) + \
+                    blob[i + 1:]
+            else:
+                env['sum'] = -1
         else:
             body = env.get('payload') if isinstance(
                 env.get('payload'), dict) else env.get('clocks')
@@ -194,7 +211,7 @@ class ChaosFleet:
                 self.conns[(to, frm)].receive_msg(env)
         for conn in self.conns.values():
             conn.tick()
-        if self.batching:
+        if self.batching or self.wire:
             for conn in self.conns.values():
                 conn.flush()
 

@@ -93,6 +93,74 @@ def validate_msg(msg):
     return msg
 
 
+def validate_wire_msg(msg):
+    """Validate the multi-doc WIRE data-message schema (the columnar
+    counterpart of a per-doc ``{docId, clock, changes}`` dict message):
+    ``docs`` a non-empty list of doc-id strings; ``clocks`` an aligned
+    list of ``str -> non-negative int`` clock dicts; ``counts`` an
+    aligned list of per-doc change counts; ``lens`` the per-change byte
+    lengths (``sum(counts)`` of them); ``blob`` the concatenated change
+    encodings (``sum(lens)`` bytes). Change CONTENT is not inspected
+    here — the blob rides under a CRC32 envelope checksum
+    (:func:`~automerge_tpu.sync.resilient.payload_checksum`) and parses
+    at flush, where a poisoned document lands in quarantine. Raises
+    :class:`MessageRejected` on the first violation; returns ``msg``."""
+    if not isinstance(msg, dict):
+        _reject(f'wire message is {type(msg).__name__}, not a dict')
+    docs = msg.get('docs')
+    if not isinstance(docs, (list, tuple)) or not docs:
+        _reject(f'wire docs is not a non-empty list: {docs!r}')
+    for doc_id in docs:
+        if not isinstance(doc_id, str):
+            _reject(f'wire doc id is not a string: {doc_id!r}')
+    clocks = msg.get('clocks')
+    if not isinstance(clocks, (list, tuple)) or \
+            len(clocks) != len(docs):
+        _reject(f'wire clocks is not a list aligned with docs: '
+                f'{type(clocks).__name__}')
+    for clock in clocks:
+        if not isinstance(clock, dict):
+            _reject(f'wire clock is not a dict: '
+                    f'{type(clock).__name__}')
+        for actor, seq in clock.items():
+            if not isinstance(actor, str) or not isinstance(seq, int) \
+                    or isinstance(seq, bool) or seq < 0:
+                _reject(f'wire clock entry {actor!r}: {seq!r} is not '
+                        f'str -> non-negative int')
+    counts = msg.get('counts')
+    if not isinstance(counts, (list, tuple)) or \
+            len(counts) != len(docs):
+        _reject(f'wire counts is not a list aligned with docs: '
+                f'{type(counts).__name__}')
+    for count in counts:
+        if not isinstance(count, int) or isinstance(count, bool) \
+                or count < 0:
+            _reject(f'wire change count is not a non-negative int: '
+                    f'{count!r}')
+    lens = msg.get('lens')
+    if not isinstance(lens, (list, tuple)) or \
+            len(lens) != sum(counts):
+        _reject(f'wire lens does not carry sum(counts)='
+                f'{sum(counts)} entries: {lens!r}')
+    total = 0
+    for ln in lens:
+        # zero-length spans can never hold a change encoding — reject
+        # them here so a bogus message cannot quarantine a healthy doc
+        # at flush (the dict path rejects malformed changes pre-state
+        # too)
+        if not isinstance(ln, int) or isinstance(ln, bool) or ln <= 0:
+            _reject(f'wire change length is not a positive int: '
+                    f'{ln!r}')
+        total += ln
+    blob = msg.get('blob')
+    if not isinstance(blob, (bytes, bytearray)):
+        _reject(f'wire blob is not bytes: {type(blob).__name__}')
+    if len(blob) != total:
+        _reject(f'wire blob carries {len(blob)} bytes, lens claim '
+                f'{total}')
+    return msg
+
+
 def clock_union(clock_map, doc_id, clock):
     """Merge `clock` into `clock_map[doc_id]`, taking per-actor maxima
     (connection.js:9-12). The reference rebuilds an immutable map; these
@@ -360,3 +428,239 @@ class BatchingConnection(Connection):
         return out
 
     receiveMsg = receive_msg
+
+
+class WireConnection(BatchingConnection):
+    """The columnar binary delta path: a BatchingConnection whose DATA
+    messages are multi-doc wire blobs instead of per-doc dict lists.
+
+    Sender side, a network tick's ``doc_changed`` follow-ups coalesce
+    into ONE multi-doc message per peer (``{'wire': 1, 'docs': [...],
+    'clocks': [...], 'counts': [...], 'lens': [...], 'blob': bytes}``):
+    each doc's missing changes come from the store's per-change encode
+    cache (:meth:`~automerge_tpu.device.blocks.BlockStore.
+    get_missing_changes_wire`) as pre-encoded byte spans — with N peers
+    a change encodes once and fans out N times, and a zero-change span
+    is a bundled clock advertisement. Receive side, the tick's buffered
+    blobs merge per doc and ride the native codec -> stager path in one
+    fused apply (:meth:`GeneralDocSet.apply_wire
+    <automerge_tpu.sync.general_doc_set.GeneralDocSet.apply_wire>`);
+    a fused-apply fault falls back to the dict batch path, which
+    isolates and quarantines per document.
+
+    Clock bookkeeping and message SEMANTICS are protocol-identical to
+    the dict path (same advertisements, same requests, same snapshot
+    fallback for truncated logs — those stay dict messages); only data
+    transport is columnar. Both endpoints must speak it: pair
+    WireConnection with WireConnection, and keep
+    Connection/BatchingConnection for dict-path interop. Requires a
+    wire-capable doc set (GeneralDocSet).
+    """
+
+    def __init__(self, doc_set, send_msg):
+        super().__init__(doc_set, send_msg)
+        store = getattr(doc_set, 'store', None)
+        if not hasattr(doc_set, 'apply_wire') or store is None or \
+                not hasattr(store, 'get_missing_changes_wire'):
+            raise TypeError(
+                'WireConnection requires a wire-capable doc set '
+                '(GeneralDocSet: apply_wire + a store serving '
+                'get_missing_changes_wire); use Connection or '
+                'BatchingConnection for other doc sets')
+        self._pending_send = {}       # doc_id -> None (insertion order)
+        self._incoming_wire = []
+
+    def maybe_send_changes(self, doc_id):
+        """Deferred: data sends coalesce into the tick's single
+        multi-doc wire message (:meth:`flush`); the data-vs-
+        advertisement decision happens there against the then-current
+        clocks."""
+        self._pending_send[doc_id] = None
+
+    maybeSendChanges = maybe_send_changes
+
+    def doc_changed(self, doc_id, doc):
+        """DocSet handler — straight to the pending set. The base
+        class's stale-state guard protects against re-registering an
+        OLD frontend document object; wire doc sets hand out live
+        handles whose state is the store itself, so the per-doc clock
+        fetch it costs is pure overhead on a 10k-doc tick."""
+        self._pending_send[doc_id] = None
+
+    docChanged = doc_changed
+
+    def receive_msg(self, msg):
+        if isinstance(msg, dict) and 'wire' in msg:
+            validate_wire_msg(msg)
+            metrics.bump('sync_msgs_received')
+            metrics.bump('sync_wire_msgs_received')
+            # clock bookkeeping happens immediately, in arrival order —
+            # exactly the dict data path
+            for doc_id, clock in zip(msg['docs'], msg['clocks']):
+                self._their_clock = clock_union(self._their_clock,
+                                                doc_id, clock)
+            self._incoming_wire.append(msg)
+            # zero-change spans are advertisements and answer NOW (data
+            # spans never trigger replies, like dict data messages);
+            # unknown docs mark pending and go out as BATCHED requests
+            # — zero-change spans with an empty clock in the next
+            # outgoing wire message, not one dict message per doc
+            for doc_id, count in zip(msg['docs'], msg['counts']):
+                if count:
+                    continue
+                if self._doc_set.get_doc(doc_id) is not None:
+                    self.maybe_send_changes(doc_id)
+                elif doc_id not in self._our_clock:
+                    self._pending_send[doc_id] = None
+            return None
+        return super().receive_msg(msg)
+
+    receiveMsg = receive_msg
+
+    def flush(self):
+        """Apply the tick's buffered data (dict messages through the
+        batched dict path, wire blobs through ONE fused apply_wire),
+        then assemble and ship the single outgoing multi-doc wire
+        message the tick's ``doc_changed`` follow-ups asked for.
+        Returns {doc_id: doc} for the docs that changed."""
+        out = super().flush()
+        out.update(self._flush_wire())
+        self._flush_outgoing()
+        return out
+
+    def _flush_wire(self):
+        """Merge the buffered wire blobs per document and apply in one
+        fused codec->stager pass."""
+        if not self._incoming_wire:
+            return {}
+        segs_by_doc = {}
+        n_changes = 0
+        for msg in self._incoming_wire:
+            blob, lens = msg['blob'], msg['lens']
+            pos = 0
+            k = 0
+            for doc_id, count in zip(msg['docs'], msg['counts']):
+                if not count:
+                    continue
+                segs = segs_by_doc.setdefault(doc_id, [])
+                for ln in lens[k:k + count]:
+                    segs.append(blob[pos:pos + ln])
+                    pos += ln
+                k += count
+                n_changes += count
+        self._incoming_wire = []
+        if not segs_by_doc:
+            return {}
+        metrics.bump('sync_changes_received', n_changes)
+        doc_ids = list(segs_by_doc)
+        data = b'[' + b','.join(
+            b'[' + b','.join(segs) + b']'
+            for segs in segs_by_doc.values()) + b']'
+        try:
+            handles = self._doc_set.apply_wire(data, doc_ids=doc_ids)
+        except Exception:
+            # the fused wire apply rolled back (store-intact-on-error):
+            # re-deliver through the dict batch path, which isolates
+            # per document and quarantines the poisoned ones. A change
+            # whose bytes do not even decode (impossible under the
+            # checksummed envelope transport) quarantines its doc with
+            # no retriable body.
+            import json as _json
+            changes_by_doc = {}
+            for doc_id, segs in segs_by_doc.items():
+                try:
+                    changes_by_doc[doc_id] = _json.loads(
+                        (b'[' + b','.join(segs) + b']').decode('utf-8'))
+                except (ValueError, UnicodeDecodeError) as err:
+                    registry = getattr(self._doc_set, 'quarantined',
+                                       self.quarantined)
+                    registry[doc_id] = {'error': repr(err),
+                                        'changes': []}
+                    metrics.bump('sync_docs_quarantined')
+            return self._doc_set.apply_changes_batch(
+                changes_by_doc, isolate=True)
+        out = dict(zip(doc_ids, handles))
+        retry = getattr(self._doc_set, 'retry_quarantined', None)
+        if retry is not None:
+            held = [d for d in out if d in self._doc_set.quarantined]
+            if held:
+                retry(held)
+        return out
+
+    def _flush_outgoing(self):
+        """Assemble and ship the tick's single multi-doc wire message:
+        cached change encodings for peers behind on data, zero-change
+        spans as bundled advertisements. The serve is fleet-grained —
+        one clock sweep and one batched cache fill
+        (``get_missing_changes_wire_batch``: at most one native emit
+        per retained block) regardless of how many docs the tick
+        touched."""
+        if not self._pending_send:
+            return
+        pending = list(self._pending_send)
+        self._pending_send.clear()
+        store = self._doc_set.store
+        id_of = self._doc_set.id_of
+        if len(pending) > 16 and hasattr(store, 'clocks_all'):
+            fleet_clocks = store.clocks_all()
+            clock_of = lambda i: fleet_clocks.get(i, {})  # noqa: E731
+        else:
+            fleet_clocks = None
+            clock_of = store.clock_of
+        wants = []                       # (idx, have) for known peers
+        for doc_id in pending:
+            idx = id_of.get(doc_id)
+            if idx is None:
+                continue
+            if doc_id in self._their_clock:
+                wants.append((idx, self._their_clock[doc_id]))
+        served, errors = store.get_missing_changes_wire_batch(
+            wants, all_clocks=fleet_clocks) if wants else ({}, {})
+        docs, clocks, counts, lens, chunks = [], [], [], [], []
+        for doc_id in pending:
+            idx = id_of.get(doc_id)
+            if idx is None:
+                # a REQUEST: the peer advertised a doc we don't hold.
+                # A zero-change span with an empty clock is protocol-
+                # identical to the dict path's send_msg(doc_id, {}),
+                # and the _our_clock entry (empty) suppresses repeat
+                # requests exactly like the dict path
+                if doc_id not in self._our_clock:
+                    self._our_clock[doc_id] = {}
+                    docs.append(doc_id)
+                    clocks.append({})
+                    counts.append(0)
+                continue
+            clock = clock_of(idx)
+            if idx in errors:
+                self._send_snapshot(
+                    doc_id, self._doc_set.get_doc(doc_id), clock,
+                    errors[idx])
+                continue
+            blobs = served.get(idx)
+            if blobs:
+                clock_union(self._their_clock, doc_id, clock)
+                clock_union(self._our_clock, doc_id, clock)
+                docs.append(doc_id)
+                clocks.append(dict(clock))
+                counts.append(len(blobs))
+                lens.extend(len(b) for b in blobs)
+                chunks.extend(blobs)
+                continue
+            if clock != self._our_clock.get(doc_id, {}):
+                clock_union(self._our_clock, doc_id, clock)
+                docs.append(doc_id)
+                clocks.append(dict(clock))
+                counts.append(0)
+        if not docs:
+            return
+        blob = b''.join(chunks)
+        metrics.bump('sync_msgs_sent')
+        metrics.bump('sync_wire_msgs_sent')
+        metrics.bump('sync_changes_sent', len(lens))
+        metrics.bump('sync_wire_bytes_sent', len(blob))
+        if metrics.active:
+            metrics.emit('sync_wire_send', docs=len(docs),
+                         changes=len(lens), blob_bytes=len(blob))
+        self._send_msg({'wire': 1, 'docs': docs, 'clocks': clocks,
+                        'counts': counts, 'lens': lens, 'blob': blob})

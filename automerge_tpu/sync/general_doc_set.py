@@ -293,6 +293,36 @@ class GeneralDocSet:
 
     applyChangesBatch = apply_changes_batch
 
+    def fleet_status(self):
+        """Operator surface over the whole fleet (ROADMAP "Quarantine
+        operator surface"): per-doc ``{'clock': {actor: seq},
+        'quarantined': error-repr-or-None, 'dirty': bool}`` plus fleet
+        totals, without reaching into the registry or the store.
+        ``dirty`` means the cached materialized view is stale (none
+        built yet, or applies landed since) — the docs the next
+        ``materialize_all`` will actually rebuild. Read-only and
+        cheap: one pass over the clock rows, one dict probe per doc."""
+        store = self.store
+        clocks = store.clocks_all()
+        docs = {}
+        n_dirty = 0
+        for idx, doc_id in enumerate(self.ids):
+            hit = self._views.get(idx)
+            dirty = hit is None or hit[0] != store.doc_version(idx)
+            n_dirty += dirty
+            held = self.quarantined.get(doc_id)
+            docs[doc_id] = {
+                'clock': dict(clocks.get(idx, {})),
+                'quarantined': held['error'] if held else None,
+                'dirty': bool(dirty)}
+        return {'docs': docs,
+                'totals': {'docs': len(self.ids),
+                           'capacity': self.capacity,
+                           'quarantined': len(self.quarantined),
+                           'dirty': int(n_dirty)}}
+
+    fleetStatus = fleet_status
+
     def apply_wire(self, data, doc_ids=None):
         """Batched admission straight from WIRE BYTES: the JSON text of
         per-document change lists (``[[change, ...], ...]``) runs

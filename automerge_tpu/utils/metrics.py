@@ -38,7 +38,8 @@ from contextlib import contextmanager
 #   snapshot_checksum_failures snapshot-container/journal CRC
 #                              mismatches caught at load
 FAULT_COUNTERS = (
-    'sync_retransmits', 'sync_retry_exhausted', 'sync_msgs_rejected',
+    'sync_retransmits', 'sync_retransmit_wire_bytes',
+    'sync_retry_exhausted', 'sync_msgs_rejected',
     'sync_msgs_duplicate', 'sync_checksum_failures',
     'sync_heartbeats_sent', 'sync_heartbeats_received',
     'sync_apply_failures', 'sync_docs_quarantined', 'apply_rollbacks',

@@ -30,7 +30,10 @@ import warnings
 
 import numpy as np
 
-from .device.blocks import ChangeBlock, LazyValues
+from .common import ROOT_ID
+from .device.blocks import (
+    ChangeBlock, LazyValues, _SET, _INS, _LINK,
+    _GEN_ACTION_CODES, _KEY_STR, _KEY_ELEM, _KEY_HEAD)
 
 _LIB = None
 _LOAD_ATTEMPTED = False
@@ -284,28 +287,197 @@ def parse_general_block(data, store=None):
             return GeneralStore(len(per_doc)).encode_changes(per_doc)
         return store.encode_changes(json.loads(data.decode('utf-8')))
 
-    uuids = list(store.obj_uuid) if store is not None else []
-    types = list(store.obj_type) if store is not None else []
-    docs = list(store.obj_doc) if store is not None else []
-    encoded = [u.encode('utf-8') for u in uuids]
-    blob = b''.join(encoded)
-    offsets = np.zeros(len(uuids) + 1, np.int64)
-    if encoded:
-        np.cumsum([len(e) for e in encoded], out=offsets[1:])
-    type_arr = np.asarray(types, np.int8) if types else \
-        np.zeros(1, np.int8)
-    doc_arr = np.asarray(docs, np.int32) if docs else np.zeros(1, np.int32)
+    if store is not None and hasattr(store, 'wire_obj_tables'):
+        # cached marshalling (rebuilding the uuid blob per parse costs
+        # O(objects) on every steady-state receive tick)
+        blob, offsets, doc_arr, type_arr = store.wire_obj_tables()
+        n_objs = len(store.obj_uuid)
+    else:
+        uuids = list(store.obj_uuid) if store is not None else []
+        types = list(store.obj_type) if store is not None else []
+        docs = list(store.obj_doc) if store is not None else []
+        encoded = [u.encode('utf-8') for u in uuids]
+        blob = b''.join(encoded)
+        offsets = np.zeros(len(uuids) + 1, np.int64)
+        if encoded:
+            np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        type_arr = np.asarray(types, np.int8) if types else \
+            np.zeros(1, np.int8)
+        doc_arr = np.asarray(docs, np.int32) if docs else \
+            np.zeros(1, np.int32)
+        n_objs = len(uuids)
 
     h = lib.amwc_parse_general(
         data, len(data), blob, offsets.ctypes.data_as(_p64),
         doc_arr.ctypes.data_as(_p32), type_arr.ctypes.data_as(_p8),
-        len(uuids))
+        n_objs)
     if not h:
         raise MemoryError('wire codec allocation failed')
     try:
         return _extract_block(lib, h, data, general=True)
     finally:
         lib.amwc_free(h)
+
+
+# ---------------------------------------------------------------------------
+# Wire-blob EMIT: change rows of a retained ChangeBlock -> the compact
+# canonical JSON bytes the codec parses (the encode side of the
+# zero-re-encode sync tick). `parse_general_block(b'[[' + b','.join(
+# encode_change_rows(block, rows)) + b']]')` round-trips to the same
+# changes. The general-schema fast path is `amwe_emit_general` in
+# native/wire_codec.cpp; the Python fallback below is byte-identical
+# (both splice the SAME host-pre-escaped string/value literals, so
+# parity is by construction — C++ only formats integers).
+
+# force switch (tests/CI): None = auto, True = native emit must be used
+# for general blocks (raise instead of falling back), False = numpy off
+_NATIVE_EMIT = None
+
+
+# one shared encoder: json.dumps builds a fresh JSONEncoder per call,
+# which is ~40% of a 276k-value cold emit
+_JSON_ENC = json.JSONEncoder(separators=(',', ':'),
+                             ensure_ascii=False).encode
+
+
+def _json_lit(v):
+    """Canonical JSON literal bytes of one host value (compact
+    separators, raw UTF-8)."""
+    return _JSON_ENC(v).encode('utf-8')
+
+
+def _block_lits(block):
+    """Pre-escaped JSON string-literal tables (actors, keys, objs) of a
+    block, built once and cached on the block — retained blocks are
+    immutable and serve many peers. (``block._wire_lits`` is a dict so
+    the native marshalling can cache its joined blob forms alongside.)
+    """
+    cache = block._wire_lits
+    if cache is None:
+        actors = [_json_lit(s) for s in block.actors]
+        keys = [_json_lit(s) for s in block.keys]
+        objs = [_json_lit(s) for s in block.objs] if block.is_general() \
+            else [_json_lit(ROOT_ID)]
+        cache = block._wire_lits = {'tables': (actors, keys, objs)}
+    return cache['tables']
+
+
+def _op_selection(block, rows_arr):
+    """Vectorized op selection of change rows: ``(sel, use, v)`` — the
+    selected op indexes, the value-bearing mask (set/link with a value
+    row) and the value column over ``sel``. Computed ONCE per emit
+    batch and shared by the value-literal build and the native
+    marshalling."""
+    from .device.blocks import _span_indices
+    if not len(rows_arr) or not block.n_ops:
+        z = np.zeros(0, np.int64)
+        return z, np.zeros(0, bool), np.zeros(0, np.int32)
+    op_ptr = block.op_ptr
+    starts = op_ptr[rows_arr].astype(np.int64)
+    counts = (op_ptr[rows_arr + 1] - op_ptr[rows_arr]).astype(np.int64)
+    sel = _span_indices(starts, counts)
+    act = block.action[sel]
+    v = block.value[sel]
+    use = ((act == _SET) | (act == _LINK)) & (v >= 0)
+    return sel, use, v
+
+
+def _value_lits(block, use, v):
+    """{value row: literal bytes} for every value the selected ops
+    reference (decoded host values re-encode canonically; spans of
+    wire-ingested blocks decode lazily here, exactly once). Bulk value
+    fetch and content-level dedup — op value tables are full of
+    repeated scalars, and each distinct one should hit the JSON
+    encoder once."""
+    vids = np.unique(v[use]) if len(v) else np.zeros(0, np.int32)
+    take = getattr(block.values, 'take', None)
+    vals = take(vids) if take is not None \
+        else [block.values[int(i)] for i in vids.tolist()]
+    out = {}
+    memo = {}
+    for i, val in zip(vids.tolist(), vals):
+        # memo keys pair the class with the value: bool IS an int and
+        # 1 == 1.0, but 'true'/'1'/'1.0' are three different literals
+        key = (val.__class__, val)
+        try:
+            blob = memo.get(key)
+        except TypeError:                  # unhashable (dict/list)
+            out[i] = _json_lit(val)
+            continue
+        if blob is None:
+            blob = memo[key] = _json_lit(val)
+        out[i] = blob
+    return out
+
+
+def _emit_change_py(block, c, lits, vlits):
+    """One change row as canonical JSON bytes (the fallback emitter —
+    keep byte-identical with amwe_emit_general)."""
+    actors_l, keys_l, objs_l = lits
+    p = [b'{"actor":', actors_l[block.actor[c]],
+         b',"seq":', b'%d' % int(block.seq[c]), b',"deps":{']
+    for i, j in enumerate(range(block.dep_ptr[c],
+                                block.dep_ptr[c + 1])):
+        if i:
+            p.append(b',')
+        p += [actors_l[block.dep_actor[j]], b':',
+              b'%d' % int(block.dep_seq[j])]
+    p.append(b'},"ops":[')
+    general = block.is_general()
+    for i, j in enumerate(range(block.op_ptr[c], block.op_ptr[c + 1])):
+        if i:
+            p.append(b',')
+        a = int(block.action[j])
+        if general:
+            p += [b'{"action":"', _GEN_ACTION_CODES[a].encode(),
+                  b'","obj":', objs_l[block.obj[j]]]
+            kind = int(block.key_kind[j])
+            if kind == _KEY_STR:
+                p += [b',"key":', keys_l[block.key[j]]]
+            elif kind == _KEY_ELEM:
+                # "<actor>:<elem>" — splice the escaped actor literal
+                # minus its closing quote (':' and digits are
+                # escape-free)
+                p += [b',"key":', actors_l[block.key[j]][:-1], b':',
+                      b'%d' % int(block.key_elem[j]), b'"']
+            elif kind == _KEY_HEAD:
+                p.append(b',"key":"_head"')
+            if a == _INS:
+                p += [b',"elem":', b'%d' % int(block.elem[j])]
+        else:
+            p += [b'{"action":"', (b'set' if a == _SET else b'del'),
+                  b'","obj":', objs_l[0],
+                  b',"key":', keys_l[block.key[j]]]
+        if a == _SET or (general and a == _LINK):
+            p += [b',"value":', vlits.get(int(block.value[j]), b'null')]
+        p.append(b'}')
+    p.append(b']}')
+    return b''.join(p)
+
+
+def encode_change_rows(block, rows):
+    """Encode change rows ``rows`` of ``block`` to their compact wire
+    bytes — one ``bytes`` per row, native C++ for general blocks when
+    the library is available, byte-identical Python fallback otherwise
+    (always Python for flat root-map blocks — the wire protocol serves
+    general stores). ``_NATIVE_EMIT = True`` raises instead of falling
+    back (the CI forced-native lane)."""
+    rows_arr = np.asarray([int(r) for r in rows], np.int64)
+    lits = _block_lits(block)
+    sel, use, v = _op_selection(block, rows_arr)
+    vlits = _value_lits(block, use, v)
+    if block.is_general() and _NATIVE_EMIT is not False:
+        from . import native as _native
+        out = _native.emit_change_rows(block, rows_arr, lits, vlits,
+                                       sel, use, v)
+        if out is not None:
+            return out
+        if _NATIVE_EMIT is True:
+            raise RuntimeError(
+                'native wire emit forced (_NATIVE_EMIT=True) but the '
+                'library is unavailable')
+    return [_emit_change_py(block, c, lits, vlits)
+            for c in rows_arr.tolist()]
 
 
 parseChangeBlock = parse_change_block

@@ -492,29 +492,48 @@ def bench_general_docset_sync(n_docs=2000):
 def bench_general_sync_10k(n_docs=10240, list_ops=22):
     """The 10k-doc general sync at the north-star config-5 shape: a
     rich-doc fleet (lists + links + causal chains) replicates
-    GeneralDocSet -> GeneralDocSet through BatchingConnection ticks,
-    one fused general apply per tick. The destination store starts
-    SMALL and auto-grows to the fleet size — the capacity lift that
-    replaced the hard raise in sync/general_doc_set.py."""
+    GeneralDocSet -> GeneralDocSet, one fused general apply per tick.
+    The destination store starts SMALL and auto-grows to the fleet
+    size.
+
+    Two protocol variants, measured in the SAME run: the DICT path
+    (BatchingConnection — per-doc dict messages, Python encode both
+    ends) and the WIRE path (WireConnection — one multi-doc binary
+    message per tick fed by the per-change encode cache, native
+    emit/codec/stager end to end). The wire number is COLD (cache
+    cleared first, so it pays the one-time encode); the fan-out round
+    serves a second peer entirely from cache — that pair is the
+    "each change encodes exactly once" claim, asserted here on the
+    store's hit/miss counters."""
     from automerge_tpu.sync import Connection
-    from automerge_tpu.sync.connection import BatchingConnection
+    from automerge_tpu.sync.connection import (BatchingConnection,
+                                               WireConnection)
     from automerge_tpu.sync.general_doc_set import GeneralDocSet
 
     per_doc = _gen_mixed_docs(n_docs, list_ops)
     n_ops = sum(len(c['ops']) for doc in per_doc for c in doc)
+    n_changes = sum(len(doc) for doc in per_doc)
     src = GeneralDocSet(n_docs)
     src.apply_changes_batch(
         {f'doc{d}': per_doc[d] for d in range(n_docs)})
 
-    def one_round():
+    def one_round(wire):
         dst = GeneralDocSet(1024)          # auto-grows to the fleet
         msgs_a, msgs_b = [], []
-        ca = Connection(src, msgs_a.append)
-        cb = BatchingConnection(dst, msgs_b.append)
+        if wire:
+            ca = WireConnection(src, msgs_a.append)
+            cb = WireConnection(dst, msgs_b.append)
+        else:
+            ca = Connection(src, msgs_a.append)
+            cb = BatchingConnection(dst, msgs_b.append)
         n_msgs = 0
         ca.open()
         cb.open()
-        while msgs_a or msgs_b:
+        for _ in range(1000):
+            if wire:
+                ca.flush()
+            if not (msgs_a or msgs_b):
+                break
             batch_a = msgs_a[:]
             msgs_a.clear()
             for m in batch_a:
@@ -526,16 +545,46 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
             for m in batch_b:
                 n_msgs += 1
                 ca.receive_msg(m)
+        ca.close()
+        cb.close()
         return n_msgs, dst
 
-    one_round()                            # warm the fleet shapes
+    def check(dst):
+        assert dst.capacity >= n_docs      # grew from 1024
+        got = dst.get_doc(f'doc{n_docs - 1}').materialize()
+        assert got['meta'] == n_docs - 1 and \
+            len(got['items']) == list_ops
+
+    one_round(False)                       # warm the fleet shapes
     t0 = time.perf_counter()
-    n_msgs, dst = one_round()
-    dt = time.perf_counter() - t0
-    assert dst.capacity >= n_docs          # grew from 1024
-    got = dst.get_doc(f'doc{n_docs - 1}').materialize()
-    assert got['meta'] == n_docs - 1 and len(got['items']) == list_ops
-    return n_docs, n_ops, n_msgs, dt
+    n_msgs, dst = one_round(False)
+    t_dict = time.perf_counter() - t0
+    check(dst)
+
+    # wire COLD: the encode cache starts empty, the round pays one
+    # encode per change (native emit) plus the binary transport
+    store = src.store
+    store._wire_cache.clear()
+    store.wire_cache_hits = store.wire_cache_misses = 0
+    t0 = time.perf_counter()
+    n_msgs_w, dst = one_round(True)
+    t_wire = time.perf_counter() - t0
+    check(dst)
+    assert store.wire_cache_misses == n_changes
+
+    # wire FAN-OUT: a second peer re-serves every change from cache
+    t0 = time.perf_counter()
+    _, dst = one_round(True)
+    t_fan = time.perf_counter() - t0
+    check(dst)
+    assert store.wire_cache_misses == n_changes   # encoded ONCE
+    assert store.wire_cache_hits >= n_changes     # fan-out all hits
+    hit_rate = store.wire_cache_hits / max(
+        store.wire_cache_hits + store.wire_cache_misses, 1)
+    return {'n_docs': n_docs, 'n_ops': n_ops, 'n_changes': n_changes,
+            'n_msgs_dict': n_msgs, 't_dict': t_dict,
+            'n_msgs_wire': n_msgs_w, 't_wire': t_wire,
+            't_wire_fanout': t_fan, 'cache_hit_rate': hit_rate}
 
 
 def bench_degraded_link(n_docs=10240, list_ops=22,
@@ -555,11 +604,12 @@ def bench_degraded_link(n_docs=10240, list_ops=22,
     src.apply_changes_batch(
         {f'doc{d}': per_doc[d] for d in range(n_docs)})
 
-    def one_run(loss, seed):
+    def one_run(loss, seed, wire=False):
         dst = GeneralDocSet(1024)          # auto-grows to the fleet
         fleet = ChaosFleet([src, dst], seed=seed, drop=loss,
                            dup=loss / 2, delay=2 if loss else 0,
-                           batching=True, heartbeat_every=32)
+                           batching=True, wire=wire,
+                           heartbeat_every=32)
         t0 = time.perf_counter()
         ticks = fleet.run(max_ticks=5000)
         dt = time.perf_counter() - t0
@@ -569,20 +619,36 @@ def bench_degraded_link(n_docs=10240, list_ops=22,
             len(got['items']) == list_ops
         return ticks, dt, dict(fleet.stats)
 
-    def timed(loss, seed):
+    def timed(loss, seed, wire=False):
         # a lossy schedule scatters stragglers into many oddly-shaped
         # retransmit blocks; an identical seeded warm run compiles
         # each shape once so the measurement is sync cost, not XLA
         # compile churn (same convention as every other section)
-        one_run(loss, seed)
-        return one_run(loss, seed)
+        from automerge_tpu.utils.metrics import metrics as _fm
+        one_run(loss, seed, wire)
+        before = _fm.counters.get('sync_retransmit_wire_bytes', 0)
+        ticks, dt, stats = one_run(loss, seed, wire)
+        # retransmit bytes of the WARM run — every one of them served
+        # from the encode cache (a retransmit re-ships the stored
+        # envelope; nothing on the retry path re-encodes)
+        stats['retransmit_wire_bytes'] = \
+            _fm.counters.get('sync_retransmit_wire_bytes', 0) - before
+        return ticks, dt, stats
 
     clean_ticks, t_clean, _ = timed(0.0, 2)
     out = {}
     for loss in rates:
         ticks, dt, stats = timed(loss, int(loss * 1000) + 3)
         out[loss] = (ticks, dt, dt / t_clean, stats)
-    return n_docs, clean_ticks, t_clean, out
+    # the WIRE lane: same harness, envelopes carrying blobs; the warm
+    # 20%-loss run reports the cached bytes its retransmits re-served
+    _, t_wire_clean, _ = timed(0.0, 12, wire=True)
+    wire_out = {}
+    for loss in (max(rates),):
+        ticks, dt, stats = timed(loss, int(loss * 1000) + 13,
+                                 wire=True)
+        wire_out[loss] = (ticks, dt, dt / t_wire_clean, stats)
+    return n_docs, clean_ticks, t_clean, out, t_wire_clean, wire_out
 
 
 def bench_general_materialize_10k(n_docs=10240, list_ops=22,
@@ -1129,14 +1195,28 @@ def main():
         f'({n_gd / t_geager:.0f} docs/s) -> '
         f'{t_geager / t_gbatch:.1f}x, one fused apply per tick')
 
-    n_10k, n_10k_ops, n_10k_msgs, t_10k = bench_general_sync_10k()
+    s10k = bench_general_sync_10k()
+    n_10k, n_10k_ops, t_10k = s10k['n_docs'], s10k['n_ops'], \
+        s10k['t_dict']
+    t_10k_wire = s10k['t_wire']
     log(f'docset-sync[general 10k, config-5 shape]: {n_10k} rich docs '
-        f'/ {n_10k_ops} ops replicate through {n_10k_msgs} '
-        f'BatchingConnection messages in {t_10k:.3f}s -> '
-        f'{n_10k / t_10k:.0f} docs/s ({n_10k_ops / t_10k / 1e6:.2f}M '
-        f'ops/s; destination auto-grew 1024 -> {n_10k} docs)')
+        f'/ {n_10k_ops} ops replicate through '
+        f'{s10k["n_msgs_dict"]} BatchingConnection messages in '
+        f'{t_10k:.3f}s -> {n_10k / t_10k:.0f} docs/s '
+        f'({n_10k_ops / t_10k / 1e6:.2f}M ops/s; destination '
+        f'auto-grew 1024 -> {n_10k} docs)')
+    log(f'docset-sync[general 10k WIRE path]: the same fleet through '
+        f'{s10k["n_msgs_wire"]} WireConnection messages — cold '
+        f'{t_10k_wire:.3f}s ({n_10k / t_10k_wire:.0f} docs/s, '
+        f'{t_10k / t_10k_wire:.1f}x over the dict path), second-peer '
+        f'fan-out {s10k["t_wire_fanout"]:.3f}s '
+        f'({n_10k / s10k["t_wire_fanout"]:.0f} docs/s, every change '
+        f'served from the encode cache — '
+        f'{s10k["cache_hit_rate"] * 100:.0f}% hit rate, '
+        f'{s10k["n_changes"]} changes each encoded exactly once)')
 
-    n_deg, deg_clean_ticks, t_deg_clean, deg = bench_degraded_link()
+    (n_deg, deg_clean_ticks, t_deg_clean, deg, t_deg_wire_clean,
+     deg_wire) = bench_degraded_link()
     for loss, (ticks, dt, overhead, stats) in sorted(deg.items()):
         log(f'docset-sync[degraded {loss * 100:.0f}% loss]: {n_deg} '
             f'rich docs converge in {ticks} ticks / {dt:.3f}s '
@@ -1145,6 +1225,14 @@ def main():
             f'{stats.get("dropped", 0)} dropped, '
             f'{stats.get("duplicated", 0)} duplicated, repaired by '
             f'retransmit + anti-entropy')
+    for loss, (ticks, dt, overhead, stats) in sorted(deg_wire.items()):
+        log(f'docset-sync[degraded {loss * 100:.0f}% loss, WIRE '
+            f'path]: converges in {ticks} ticks / {dt:.3f}s '
+            f'({overhead:.2f}x over its clean run '
+            f'{t_deg_wire_clean:.3f}s) — '
+            f'{stats.get("retransmit_wire_bytes", 0) >> 10} KB '
+            f'retransmitted, all served from the encode cache (zero '
+            f're-encode on the retry path)')
     from automerge_tpu.utils.metrics import (metrics as _fm,
                                              FAULT_COUNTERS)
     log('fault-counters: ' + ', '.join(
@@ -1267,6 +1355,18 @@ def main():
         'general_sync_docs_per_sec': round(n_gd / t_gbatch, 1),
         'general_sync10k_docs_per_sec': round(n_10k / t_10k, 1),
         'general_sync10k_ops_per_sec': round(n_10k_ops / t_10k, 1),
+        'general_sync10k_wire_docs_per_sec':
+            round(n_10k / t_10k_wire, 1),
+        'general_sync10k_wire_ops_per_sec':
+            round(n_10k_ops / t_10k_wire, 1),
+        'general_sync10k_wire_speedup_x':
+            round(t_10k / t_10k_wire, 2),
+        'general_sync10k_wire_fanout_docs_per_sec':
+            round(n_10k / s10k['t_wire_fanout'], 1),
+        'general_sync10k_wire_cache_hit_rate':
+            round(s10k['cache_hit_rate'], 4),
+        'general_sync10k_wire_emit_native':
+            bool(_amnat.emit_available()),
         'general_sync10k_degraded_ticks_5': deg[0.05][0],
         'general_sync10k_degraded_ticks_20': deg[0.20][0],
         'general_sync10k_degraded_overhead_x_5':
@@ -1275,6 +1375,12 @@ def main():
             round(deg[0.20][2], 2),
         'general_sync10k_degraded_docs_per_sec_20':
             round(n_deg / deg[0.20][1], 1),
+        'general_sync10k_degraded_wire_ticks_20': deg_wire[0.20][0],
+        'general_sync10k_degraded_wire_overhead_x_20':
+            round(deg_wire[0.20][2], 2),
+        'general_sync10k_degraded_wire_retransmit_kb_20':
+            round(deg_wire[0.20][3].get('retransmit_wire_bytes', 0)
+                  / 1024, 1),
         'general_materialize_docs_per_sec': round(n_mat / t_mat_cold,
                                                   1),
         'general_rematerialize_dirty_ms': round(t_mat_dirty * 1e3, 2),

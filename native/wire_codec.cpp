@@ -1668,3 +1668,122 @@ void amst_view_fill(void* h, int64_t* a, int64_t* b, int64_t* c) {
 void amst_view_free(void* h) { delete static_cast<view::View*>(h); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Wire-blob emit (the amwe_* entry points): change rows of a retained
+// block -> compact canonical JSON bytes, the encode side of the
+// zero-re-encode sync tick (automerge_tpu/wire.py encode_change_rows).
+//
+// The host pre-escapes every STRING as a full JSON literal (quotes
+// included) — actor/key/object tables once per block, referenced op
+// values once per emit batch — so this pass only splices spans and
+// formats integers. That makes byte-parity with the Python fallback a
+// construction property rather than a test hope: both sides join the
+// same literals with the same punctuation. Output: one concatenated
+// buffer plus per-row offsets; Python slices it into per-change bytes
+// for the (doc, actor, seq)-keyed encode cache. All input pointers are
+// borrowed and must stay alive until amwe_free.
+
+namespace emitjson {
+
+struct Emitted {
+    std::string out;
+    std::vector<int64_t> offsets;      // n_rows + 1
+};
+
+}  // namespace emitjson
+
+extern "C" {
+
+void* amwe_emit_general(
+    int64_t n_rows, const int64_t* rows,
+    const int32_t* actor, const int32_t* seq,
+    const int32_t* dep_ptr, const int32_t* dep_actor,
+    const int32_t* dep_seq,
+    const int32_t* op_ptr, const int8_t* action, const int32_t* obj,
+    const int8_t* key_kind, const int32_t* key, const int32_t* key_elem,
+    const int32_t* elem, const int32_t* val_local,
+    const char* actors_b, const int64_t* actors_off,
+    const char* keys_b, const int64_t* keys_off,
+    const char* objs_b, const int64_t* objs_off,
+    const char* vals_b, const int64_t* vals_off) {
+    auto* e = new (std::nothrow) emitjson::Emitted();
+    if (!e) return nullptr;
+    static const char* kNames[7] = {"set", "del", "ins", "link",
+                                    "makeMap", "makeList", "makeText"};
+    std::string& o = e->out;
+    e->offsets.reserve(n_rows + 1);
+    e->offsets.push_back(0);
+    auto span = [&](const char* b, const int64_t* off, int64_t i) {
+        o.append(b + off[i], static_cast<size_t>(off[i + 1] - off[i]));
+    };
+    for (int64_t r = 0; r < n_rows; r++) {
+        int64_t c = rows[r];
+        o += "{\"actor\":";
+        span(actors_b, actors_off, actor[c]);
+        o += ",\"seq\":";
+        o += std::to_string(seq[c]);
+        o += ",\"deps\":{";
+        for (int32_t j = dep_ptr[c]; j < dep_ptr[c + 1]; j++) {
+            if (j > dep_ptr[c]) o += ',';
+            span(actors_b, actors_off, dep_actor[j]);
+            o += ':';
+            o += std::to_string(dep_seq[j]);
+        }
+        o += "},\"ops\":[";
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            if (j > op_ptr[c]) o += ',';
+            int8_t a = action[j];
+            o += "{\"action\":\"";
+            o += kNames[a];
+            o += "\",\"obj\":";
+            span(objs_b, objs_off, obj[j]);
+            int8_t kk = key_kind[j];
+            if (kk == kKeyStr) {
+                o += ",\"key\":";
+                span(keys_b, keys_off, key[j]);
+            } else if (kk == kKeyElem) {
+                // "<actor>:<elem>" — the escaped actor literal minus
+                // its closing quote (':' and digits are escape-free)
+                o += ",\"key\":";
+                int64_t s0 = actors_off[key[j]];
+                int64_t s1 = actors_off[key[j] + 1];
+                o.append(actors_b + s0, static_cast<size_t>(s1 - s0 - 1));
+                o += ':';
+                o += std::to_string(key_elem[j]);
+                o += '"';
+            } else if (kk == kKeyHead) {
+                o += ",\"key\":\"_head\"";
+            }
+            if (a == kIns) {
+                o += ",\"elem\":";
+                o += std::to_string(elem[j]);
+            }
+            if (a == kSet || a == kLink) {
+                o += ",\"value\":";
+                int32_t v = val_local[j];
+                if (v < 0) o += "null";
+                else span(vals_b, vals_off, v);
+            }
+            o += '}';
+        }
+        o += "]}";
+        e->offsets.push_back(static_cast<int64_t>(o.size()));
+    }
+    return e;
+}
+
+int64_t amwe_bytes(void* h) {
+    return static_cast<int64_t>(static_cast<emitjson::Emitted*>(h)
+                                    ->out.size());
+}
+
+void amwe_fill(void* h, char* out, int64_t* offsets) {
+    auto* e = static_cast<emitjson::Emitted*>(h);
+    std::memcpy(out, e->out.data(), e->out.size());
+    std::memcpy(offsets, e->offsets.data(), e->offsets.size() * 8);
+}
+
+void amwe_free(void* h) { delete static_cast<emitjson::Emitted*>(h); }
+
+}  // extern "C"

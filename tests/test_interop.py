@@ -1,0 +1,123 @@
+"""Save-file interop: reference transit-JSON saves import and replay.
+
+The reference lineage's ``Automerge.save`` emits the transit-JS encoding
+of the Immutable.js change history; :mod:`automerge_tpu.interop` decodes
+that container into plain changes for the existing replay edges. The
+checked-in fixture is a three-change card-list session (map + list +
+text + links + elem keys + cross-actor deps + the transit write cache),
+written by the transit rules the reader mirrors.
+"""
+
+import json
+import os
+
+import pytest
+
+from automerge_tpu.interop import (ReferenceSaveError,
+                                   load_reference_save)
+from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+FIXTURE = os.path.join(os.path.dirname(__file__), 'fixtures',
+                       'reference_save.transit.json')
+ACTOR_A = 'be3a9238-66f7-4fa8-a612-0d45e3b61b8f'
+ACTOR_B = 'aa329a24-1f69-4d39-9e9b-856a9a30a54b'
+
+
+def fixture_bytes():
+    with open(FIXTURE, 'rb') as f:
+        return f.read()
+
+
+class TestLoadReferenceSave:
+    def test_decodes_change_list(self):
+        changes = load_reference_save(fixture_bytes())
+        assert [(c['actor'], c['seq']) for c in changes] == \
+            [(ACTOR_A, 1), (ACTOR_A, 2), (ACTOR_B, 1)]
+        assert changes[0]['deps'] == {}
+        assert changes[2]['deps'] == {ACTOR_A: 2}
+        assert changes[0]['message'] == 'Initialization'
+        # transit cache back-references resolved: every op decoded to
+        # a plain dict with its real action
+        assert changes[1]['ops'][2] == {
+            'action': 'ins',
+            'obj': '6c7c5e06-dc91-4d31-90d1-3eb2a2f21d30',
+            'key': '_head', 'elem': 1}
+
+    def test_round_trip_through_existing_replay(self):
+        """The whole point: a reference save replays through the
+        unchanged apply edge and materializes the document the
+        reference session built."""
+        changes = load_reference_save(fixture_bytes().decode('utf-8'))
+        ds = GeneralDocSet(1)
+        ds.apply_changes('imported', changes)
+        doc = ds.materialize('imported')
+        assert doc == {'cards': [{'title': 'hello card'}],
+                       'title': 'hi'}
+
+    def test_replay_is_order_tolerant(self):
+        """Causal buffering admits a save whose changes arrive
+        scrambled — same document."""
+        changes = load_reference_save(fixture_bytes())
+        ds = GeneralDocSet(1)
+        ds.apply_changes('imported', changes[::-1])
+        assert ds.materialize('imported') == \
+            {'cards': [{'title': 'hello card'}], 'title': 'hi'}
+
+
+class TestRejections:
+    def test_not_json(self):
+        with pytest.raises(ReferenceSaveError, match='not valid JSON'):
+            load_reference_save(b'\x00transit')
+
+    def test_not_a_change_list(self):
+        with pytest.raises(ReferenceSaveError, match='not a change'):
+            load_reference_save(json.dumps({'~#point': [1, 2]}))
+
+    def test_unsupported_tag_named(self):
+        with pytest.raises(ReferenceSaveError, match='~#cmap'):
+            load_reference_save('["~#cmap",[1,2]]')
+
+    def test_unsupported_action_named(self):
+        blob = ('["~#iL",[["~#iM",["ops",["^0",[["^1",'
+                '["action","makeTable","obj","u1"]]]],'
+                '"actor","a","seq",1,"deps",["^1",[]]]]]]')
+        with pytest.raises(ReferenceSaveError, match='makeTable'):
+            load_reference_save(blob)
+
+    def test_missing_field_named(self):
+        blob = ('["~#iL",[["~#iM",["ops",["^0",[]],'
+                '"actor","a"]]]]')
+        with pytest.raises(ReferenceSaveError, match="'seq'"):
+            load_reference_save(blob)
+
+    def test_dangling_cache_code(self):
+        with pytest.raises(ReferenceSaveError, match='before'):
+            load_reference_save('["^5",[1]]')
+
+
+class TestTransitScalars:
+    def test_escapes_and_typed_scalars(self):
+        blob = json.dumps([
+            '~~tilde', '~:keyword', '~i42', '~d2.5', 'plain'])
+        decoded = load_reference_save.__globals__[
+            '_TransitReader']().read(json.loads(blob))
+        assert decoded == ['~tilde', 'keyword', 42, 2.5, 'plain']
+
+    def test_map_as_array_with_key_cache(self):
+        # plain transit map form: keys >= 4 chars enter the cache and
+        # later occurrences arrive as ^codes
+        blob = '[["^ ","field",1],["^ ","^0",2]]'
+        decoded = load_reference_save.__globals__[
+            '_TransitReader']().read(json.loads(blob))
+        assert decoded == [{'field': 1}, {'field': 2}]
+
+    def test_typed_scalars_do_not_enter_the_cache(self):
+        # transit-js caches only '~:'/'~$'/'~#' prefixes (and map
+        # keys); a long '~i' integer scalar is NOT cached — a reader
+        # that over-caches it desyncs every later ^code reference
+        blob = ('[["^ ","field","~i9007199254740993"],'
+                '["^ ","^0","after"]]')
+        decoded = load_reference_save.__globals__[
+            '_TransitReader']().read(json.loads(blob))
+        assert decoded == [{'field': 9007199254740993},
+                           {'field': 'after'}]

@@ -1,0 +1,462 @@
+"""Wire-path sync parity suite.
+
+The columnar wire protocol (WireConnection: multi-doc binary data
+messages fed by the per-change encode cache) must be OBSERVABLY the
+dict protocol: same change schedules through both converge to
+byte-identical fleets, clock bookkeeping matches, and the dict path
+stays the oracle. Plus the perf contracts the ISSUE pins: each change
+encodes exactly ONCE across an N-peer fan-out (cache-hit counters), a
+tick's data ships as ONE multi-doc message, retransmits re-serve cached
+bytes, and the native emitter is byte-identical to the Python fallback.
+"""
+
+import json
+
+import pytest
+
+from automerge_tpu import native, wire
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.sync import (BatchingConnection, Connection,
+                                GeneralDocSet, MessageRejected,
+                                ResilientConnection, WireConnection)
+from automerge_tpu.sync.chaos import canonical, doc_set_view
+from automerge_tpu.sync.connection import validate_wire_msg
+from automerge_tpu.utils.metrics import metrics
+
+
+def rich_schedule(n_docs=6):
+    """Two-actor rich-doc changes (map + list + text + links + causal
+    chain) per doc — the config-5 shape, small."""
+    per = {}
+    for d in range(n_docs):
+        lst = f'00000000-0000-4000-8000-{d:012x}'
+        txt = f'00000000-0000-4000-8000-{d + 4096:012x}'
+        per[f'doc{d}'] = [
+            {'actor': f'w0-{d}', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': lst},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+                 'value': lst},
+                {'action': 'ins', 'obj': lst, 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': lst, 'key': f'w0-{d}:1',
+                 'value': d},
+                {'action': 'makeText', 'obj': txt},
+                {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                 'value': txt},
+                {'action': 'ins', 'obj': txt, 'key': '_head',
+                 'elem': 1},
+                {'action': 'set', 'obj': txt, 'key': f'w0-{d}:1',
+                 'value': 'h'}]},
+            {'actor': f'w1-{d}', 'seq': 1, 'deps': {f'w0-{d}': 1},
+             'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+                 'value': {'v': d, 'tags': [d, None, True]}},
+                {'action': 'del', 'obj': ROOT_ID, 'key': 'meta'}
+                if d % 3 == 0 else
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+                 'value': d * 1.5}]}]
+    return per
+
+
+def flush_all(*conns):
+    for c in conns:
+        if hasattr(c, 'flush'):
+            c.flush()
+
+
+def pump(ca, cb, ma, mb, rounds=60):
+    """Drive two endpoints over in-memory lists until quiet (flushes
+    included — wire endpoints defer sends to flush)."""
+    for _ in range(rounds):
+        flush_all(ca, cb)
+        if not (ma or mb):
+            break
+        batch = ma[:]
+        ma.clear()
+        for m in batch:
+            cb.receive_msg(m)
+        batch = mb[:]
+        mb.clear()
+        for m in batch:
+            ca.receive_msg(m)
+    flush_all(ca, cb)
+
+
+def replicate(conn_cls, src_sched, dst_sched=None, capacity=16):
+    """One src->dst replication round through `conn_cls`; returns
+    (src, dst)."""
+    src = GeneralDocSet(capacity)
+    src.apply_changes_batch(src_sched)
+    dst = GeneralDocSet(4)
+    if dst_sched:
+        dst.apply_changes_batch(dst_sched)
+    ma, mb = [], []
+    ca = conn_cls(src, ma.append)
+    cb = conn_cls(dst, mb.append)
+    ca.open()
+    cb.open()
+    pump(ca, cb, ma, mb)
+    return src, dst
+
+
+class TestWireParity:
+    """Same schedules through the dict and the wire protocol ->
+    byte-identical fleets (the dict path is the oracle)."""
+
+    def test_wire_matches_dict_protocols(self):
+        sched = rich_schedule()
+        views = {}
+        for name, cls in (('eager', Connection),
+                          ('batching', BatchingConnection),
+                          ('wire', WireConnection)):
+            src, dst = replicate(cls, sched)
+            views[name] = (canonical(doc_set_view(src)),
+                           canonical(doc_set_view(dst)))
+        # every flavor converges src == dst, and all flavors agree
+        for name, (s, d) in views.items():
+            assert s == d, f'{name} fleet did not converge'
+        assert views['wire'] == views['batching'] == views['eager']
+        # ...and they all equal the direct-apply oracle
+        oracle = GeneralDocSet(16)
+        oracle.apply_changes_batch(rich_schedule())
+        assert views['wire'][0] == canonical(doc_set_view(oracle))
+
+    def test_bidirectional_divergent_merge(self):
+        """Divergent concurrent edits on both ends merge identically
+        through either protocol."""
+        src_extra = dict(rich_schedule(4))
+        dst_extra = {'doc1': [
+            {'actor': 'zz-peer', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'peer',
+                 'value': 'B'}]}]}
+        results = {}
+        for name, cls in (('batching', BatchingConnection),
+                          ('wire', WireConnection)):
+            src, dst = replicate(cls, src_extra, dst_extra)
+            results[name] = (canonical(doc_set_view(src)),
+                             canonical(doc_set_view(dst)))
+        assert results['wire'][0] == results['wire'][1]
+        assert results['wire'] == results['batching']
+        src, _ = replicate(WireConnection, src_extra, dst_extra)
+        assert src.materialize('doc1')['peer'] == 'B'
+
+    def test_clock_bookkeeping_protocol_identical(self):
+        """After convergence the wire pair's clock maps equal the dict
+        pair's — the columnar transport changed nothing the protocol
+        can see."""
+        sched = rich_schedule(3)
+        clocks = {}
+        for name, cls in (('dict', BatchingConnection),
+                          ('wire', WireConnection)):
+            src = GeneralDocSet(8)
+            src.apply_changes_batch(sched)
+            dst = GeneralDocSet(4)
+            ma, mb = [], []
+            ca, cb = cls(src, ma.append), cls(dst, mb.append)
+            ca.open()
+            cb.open()
+            pump(ca, cb, ma, mb)
+            clocks[name] = (ca._our_clock, ca._their_clock,
+                            cb._our_clock, cb._their_clock)
+        assert clocks['wire'] == clocks['dict']
+
+    def test_tick_coalesces_into_one_multi_doc_message(self):
+        """A tick's doc_changed follow-ups across k docs ship as ONE
+        wire data message (vs k dict messages)."""
+        src, dst = replicate(WireConnection, rich_schedule(5))
+        ma, mb = [], []
+        ca, cb = WireConnection(src, ma.append), \
+            WireConnection(dst, mb.append)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb)
+        assert not ma and not mb
+        # a fresh tick touching 4 docs
+        tick = {f'doc{d}': [
+            {'actor': f'w2-{d}', 'seq': 1, 'deps': {f'w0-{d}': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'tick',
+                      'value': d}]}] for d in range(4)}
+        src.apply_changes_batch(tick)
+        ca.flush()
+        data_msgs = [m for m in ma
+                     if 'wire' in m and sum(m['counts'])]
+        assert len(data_msgs) == 1
+        msg = data_msgs[0]
+        assert sorted(msg['docs']) == [f'doc{d}' for d in range(4)]
+        assert msg['counts'] == [1, 1, 1, 1]
+        assert len(msg['blob']) == sum(msg['lens'])
+        # and the peer lands them in one flush, converged
+        for m in ma:
+            cb.receive_msg(m)
+        cb.flush()
+        assert dst.materialize('doc2')['tick'] == 2
+
+
+class TestEncodeCache:
+    def test_fanout_encodes_each_change_exactly_once(self):
+        """Three peers served from one src: first serve misses, the
+        fan-out is all hits — N-peer fan-out encodes once."""
+        sched = rich_schedule(4)
+        n_changes = sum(len(c) for c in sched.values())
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(sched)
+        assert src.store.wire_cache_misses == 0
+        for _ in range(3):
+            dst = GeneralDocSet(4)
+            ma, mb = [], []
+            ca = WireConnection(src, ma.append)
+            cb = WireConnection(dst, mb.append)
+            ca.open()
+            cb.open()
+            pump(ca, cb, ma, mb)
+            assert canonical(doc_set_view(dst)) == \
+                canonical(doc_set_view(src))
+            ca.close()
+        assert src.store.wire_cache_misses == n_changes
+        assert src.store.wire_cache_hits == 2 * n_changes
+
+    def test_retransmit_serves_cached_bytes(self):
+        """A dropped wire data envelope retransmits the SAME cached
+        bytes — no re-encode (miss counter frozen), and the counter
+        reports the re-served volume."""
+        src = GeneralDocSet(8)
+        src.apply_changes_batch(rich_schedule(3))
+        dst = GeneralDocSet(4)
+        q01, q10 = [], []
+        c0 = ResilientConnection(src, q01.append, wire=True,
+                                 backoff_base=1, jitter=0)
+        c1 = ResilientConnection(dst, q10.append, wire=True,
+                                 backoff_base=1, jitter=0)
+        c0.open()
+        c1.open()
+        before = metrics.counters.get('sync_retransmit_wire_bytes', 0)
+
+        def is_data(env):
+            p = env.get('payload')
+            return isinstance(p, dict) and 'wire' in p \
+                and sum(p['counts'])
+
+        dropped = 0
+        misses_after_first_encode = None
+        for _ in range(40):
+            c0.flush()
+            c1.flush()
+            for env in q01[:]:
+                q01.remove(env)
+                if dropped == 0 and is_data(env):
+                    dropped += 1           # lose the first data send
+                    misses_after_first_encode = \
+                        src.store.wire_cache_misses
+                    continue
+                c1.receive_msg(env)
+            for env in q10[:]:
+                q10.remove(env)
+                c0.receive_msg(env)
+            c0.tick()
+            c1.tick()
+            if dropped and not q01 and not q10 \
+                    and not c0.in_flight and not c1.in_flight:
+                break
+        # an acked wire envelope is BUFFERED; the apply lands at the
+        # next flush (the batching ack contract)
+        flush_all(c0, c1)
+        assert dropped == 1
+        assert canonical(doc_set_view(dst)) == \
+            canonical(doc_set_view(src))
+        # the retransmit that repaired the drop re-served cache bytes
+        assert src.store.wire_cache_misses == misses_after_first_encode
+        assert metrics.counters.get('sync_retransmit_wire_bytes', 0) \
+            > before
+
+
+class TestEmitParity:
+    def _block(self):
+        store = GeneralDocSet(8).store
+        sched = rich_schedule(5)
+        return store.encode_changes(list(sched.values()))
+
+    @pytest.mark.skipif(not native.emit_available(),
+                        reason='native emitter unavailable')
+    def test_native_matches_python_bytes(self):
+        block = self._block()
+        rows = list(range(block.n_changes))
+        nat = wire.encode_change_rows(block, rows)
+        old = wire._NATIVE_EMIT
+        wire._NATIVE_EMIT = False
+        try:
+            py = wire.encode_change_rows(block, rows)
+        finally:
+            wire._NATIVE_EMIT = old
+        assert nat == py
+
+    def test_round_trips_through_codec(self):
+        block = self._block()
+        rows = list(range(block.n_changes))
+        blobs = wire.encode_change_rows(block, rows)
+        per_doc = [[] for _ in range(block.n_docs)]
+        for c, blob in zip(rows, blobs):
+            per_doc[block.doc[c]].append(blob)
+        data = b'[' + b','.join(
+            b'[' + b','.join(doc) + b']' for doc in per_doc) + b']'
+        reparsed = wire.parse_general_block(
+            data, store=GeneralDocSet(8).store)
+        assert reparsed.to_changes() == block.to_changes()
+        # and each blob IS the canonical change dict
+        assert [json.loads(b) for b in blobs] == \
+            [block.change_dict(c) for c in rows]
+
+    def test_forced_native_raises_when_unavailable(self, monkeypatch):
+        block = self._block()
+        monkeypatch.setattr(native, 'emit_change_rows',
+                            lambda *a, **k: None)
+        monkeypatch.setattr(wire, '_NATIVE_EMIT', True)
+        with pytest.raises(RuntimeError, match='native wire emit'):
+            wire.encode_change_rows(block, [0])
+
+
+class TestValidateWireMsg:
+    def _good(self):
+        blob = b'{"actor":"a","seq":1,"deps":{},"ops":[]}'
+        return {'wire': 1, 'docs': ['d0'], 'clocks': [{'a': 1}],
+                'counts': [1], 'lens': [len(blob)], 'blob': blob}
+
+    def test_accepts_good(self):
+        msg = self._good()
+        assert validate_wire_msg(msg) is msg
+
+    @pytest.mark.parametrize('mutate, match', [
+        (lambda m: m.pop('docs'), 'docs'),
+        (lambda m: m.update(docs=[]), 'docs'),
+        (lambda m: m.update(docs=[7]), 'doc id'),
+        (lambda m: m.update(clocks=[]), 'clocks'),
+        (lambda m: m.update(clocks=[{'a': -1}]), 'clock entry'),
+        (lambda m: m.update(counts=[2]), 'lens'),
+        (lambda m: m.update(counts=[True]), 'count'),
+        (lambda m: m.update(lens=[0], blob=b''), 'length'),
+        (lambda m: m.update(lens=[10_000]), 'blob'),
+        (lambda m: m.update(blob='text'), 'blob'),
+    ])
+    def test_rejects_malformed(self, mutate, match):
+        msg = self._good()
+        mutate(msg)
+        before = metrics.counters.get('sync_msgs_rejected', 0)
+        with pytest.raises(MessageRejected, match=match):
+            validate_wire_msg(msg)
+        assert metrics.counters.get('sync_msgs_rejected', 0) == \
+            before + 1
+
+    def test_connection_rejects_before_buffering(self):
+        dst = GeneralDocSet(4)
+        cb = WireConnection(dst, lambda m: None)
+        msg = self._good()
+        msg['blob'] = b'xx'
+        with pytest.raises(MessageRejected):
+            cb.receive_msg(msg)
+        assert not cb._incoming_wire
+        assert cb._their_clock == {}
+
+
+class TestWireQuarantine:
+    def _poison_msg(self, doc_changes):
+        docs, clocks, counts, lens, chunks = [], [], [], [], []
+        for doc_id, changes in doc_changes.items():
+            blobs = [json.dumps(c, separators=(',', ':')).encode()
+                     for c in changes]
+            docs.append(doc_id)
+            clocks.append({c['actor']: c['seq'] for c in changes})
+            counts.append(len(blobs))
+            lens.extend(len(b) for b in blobs)
+            chunks.extend(blobs)
+        return {'wire': 1, 'docs': docs, 'clocks': clocks,
+                'counts': counts, 'lens': lens,
+                'blob': b''.join(chunks)}
+
+    def test_poisoned_doc_quarantines_others_apply(self):
+        obj = '00000000-0000-4000-8000-00000000aaaa'
+        poison = [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'ins', 'obj': obj, 'key': '_head',
+             'elem': 1}]}]           # duplicate elemId: staging fault
+        good = [{'actor': 'g', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+             'value': 'ok'}]}]
+        ds = GeneralDocSet(4)
+        cb = WireConnection(ds, lambda m: None)
+        cb.receive_msg(self._poison_msg({'bad': poison, 'good': good}))
+        out = cb.flush()
+        assert 'good' in out and 'bad' not in out
+        assert ds.materialize('good') == {'k': 'ok'}
+        assert 'bad' in ds.quarantined
+        assert 'elemId' in ds.quarantined['bad']['error'] or \
+            'element' in ds.quarantined['bad']['error'].lower()
+
+    def test_corrected_redelivery_clears_quarantine(self):
+        obj = '00000000-0000-4000-8000-00000000bbbb'
+        poison = [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'ins', 'obj': obj, 'key': '_head',
+             'elem': 1}]}]
+        fixed = [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'l',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'p:1',
+             'value': 'v'}]}]
+        ds = GeneralDocSet(4)
+        cb = WireConnection(ds, lambda m: None)
+        cb.receive_msg(self._poison_msg({'bad': poison}))
+        cb.flush()
+        assert 'bad' in ds.quarantined
+        cb.receive_msg(self._poison_msg({'bad': fixed}))
+        cb.flush()
+        assert 'bad' not in ds.quarantined
+        assert ds.materialize('bad')['l'] == ['v']
+
+
+class TestFleetStatus:
+    def test_fleet_status_surface(self):
+        ds = GeneralDocSet(8)
+        ds.apply_changes_batch(rich_schedule(3))
+        status = ds.fleet_status()
+        assert status['totals'] == {'docs': 3, 'capacity': 8,
+                                    'quarantined': 0, 'dirty': 3}
+        assert status['docs']['doc1']['clock'] == \
+            {'w0-1': 1, 'w1-1': 1}
+        assert status['docs']['doc1']['dirty'] is True
+        assert status['docs']['doc1']['quarantined'] is None
+        # materializing cleans; a new apply re-dirties exactly one doc
+        ds.materialize_all()
+        status = ds.fleet_status()
+        assert status['totals']['dirty'] == 0
+        ds.apply_changes('doc2', [
+            {'actor': 'w2-2', 'seq': 1, 'deps': {'w0-2': 1}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'z',
+                 'value': 9}]}])
+        status = ds.fleet_status()
+        assert status['totals']['dirty'] == 1
+        assert status['docs']['doc2']['dirty'] is True
+        assert status['docs']['doc0']['dirty'] is False
+
+    def test_fleet_status_reports_quarantine(self):
+        obj = '00000000-0000-4000-8000-00000000cccc'
+        ds = GeneralDocSet(4)
+        ds.apply_changes_batch({'ok': [
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                 'value': 1}]}]})
+        ds.apply_changes_batch(
+            {'bad': [{'actor': 'p', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': obj},
+                {'action': 'ins', 'obj': obj, 'key': '_head',
+                 'elem': 1},
+                {'action': 'ins', 'obj': obj, 'key': '_head',
+                 'elem': 1}]}]}, isolate=True)
+        status = ds.fleet_status()
+        assert status['totals']['quarantined'] == 1
+        assert status['docs']['bad']['quarantined'] is not None
+        assert status['docs']['ok']['quarantined'] is None
