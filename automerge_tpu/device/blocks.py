@@ -613,6 +613,7 @@ class BlockStore:
         # leave a stale body here. With N peers each change encodes
         # once and fans out N times; retransmits reuse the same bytes.
         self._wire_cache = {}
+        self._wire_cache_bytes = 0
         self.wire_cache_hits = 0
         self.wire_cache_misses = 0
         self.log_truncated = False            # True after snapshot resume
@@ -913,12 +914,35 @@ class BlockStore:
                     block, [c for c, _, _, _ in entries])
                 for (c, key, lst, i), blob in zip(entries, encoded):
                     cache[key] = blob
+                    self._wire_cache_bytes += len(blob)
                     lst[i] = blob
+            metrics.set_gauge('sync_wire_cache_bytes',
+                              self._wire_cache_bytes)
         self.wire_cache_misses += n_miss
         self.wire_cache_hits += n_total - n_miss
         metrics.bump('wire_encode_cache_misses', n_miss)
         metrics.bump('wire_encode_cache_hits', n_total - n_miss)
         return out, errors
+
+    def adopt_wire_cache(self, old_store, drop_docs=()):
+        """Carry the per-change encode cache across a store rebuild
+        (doc eviction), DROPPING the evicted docs' entries. Safe under
+        the cache's never-invalidate contract: every surviving entry
+        was created at serve time from a committed retained change of
+        ``old_store``, and this store was rebuilt by re-applying that
+        same retained log — the same ``(doc, actor, seq)`` holds the
+        same change body, so the cached bytes stay exact. Entries of
+        ``drop_docs`` are released with the docs' store rows (an
+        evicted doc that faults back in re-encodes on next serve)."""
+        drop = set(int(d) for d in drop_docs)
+        kept = {k: v for k, v in old_store._wire_cache.items()
+                if k[0] not in drop}
+        self._wire_cache = kept
+        self._wire_cache_bytes = sum(len(v) for v in kept.values())
+        self.wire_cache_hits = old_store.wire_cache_hits
+        self.wire_cache_misses = old_store.wire_cache_misses
+        metrics.set_gauge('sync_wire_cache_bytes',
+                          self._wire_cache_bytes)
 
 
 def init_store(n_docs):

@@ -713,6 +713,41 @@ class GeneralStore(BlockStore):
                 out.setdefault(d, {})[actors[a]] = s
         return out
 
+    # rough per-row costs for the residency estimate: an entry is 7
+    # int32/int64 columns + a bool (~40B host) plus its share of the
+    # value table; a pool node is ~11 host columns plus 2-3 packed
+    # device mirror words; a retained change body is a small dict of
+    # dicts (~128B dominates small ops). The estimate steers the
+    # eviction policy — it only needs to be proportional, not exact.
+    _EST_ENTRY_BYTES = 48
+    _EST_NODE_BYTES = 96
+    _EST_CHANGE_BYTES = 128
+
+    def doc_byte_estimates(self):
+        """Estimated resident bytes PER DOCUMENT (host columns + device
+        mirror + retained change bodies), as an int64 array over the
+        doc axis — the signal the serving layer's memory budget and
+        ``fleet_status`` residency report key on. One bincount pass per
+        state family; O(state), no per-doc loops."""
+        self._commit_pending()
+        self.pool.sync()
+        n = self.n_docs
+        est = np.zeros(n, np.int64)
+        if len(self.e_doc):
+            est += np.bincount(self.e_doc, minlength=n)[:n] * \
+                self._EST_ENTRY_BYTES
+        pool = self.pool
+        if pool.n_nodes:
+            obj_doc_arr, _ = self.obj_arrays()
+            node_docs = obj_doc_arr[pool.obj[:pool.n_nodes]]
+            est += np.bincount(node_docs, minlength=n)[:n] * \
+                self._EST_NODE_BYTES
+        for _, _, docs in self.retained:
+            if len(docs):
+                est += np.bincount(docs, minlength=n)[:n] * \
+                    self._EST_CHANGE_BYTES
+        return est
+
     def obj_arrays(self):
         """(obj_doc, obj_type) as int32 arrays, cached per table size."""
         n = len(self.obj_uuid)

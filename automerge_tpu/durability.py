@@ -114,6 +114,44 @@ def write_snapshot_file(path, payload):
     atomic_write_bytes(path, pack_snapshot(payload))
 
 
+# -- parked-doc shards (cold-doc eviction / quarantine parking) ---------------
+
+PARK_FORMAT = 'automerge-tpu-parked-docs@1'
+
+
+def write_park_shard(path, docs):
+    """Persist one eviction batch's parked docs as a checksummed shard:
+    ``docs`` is ``{doc_id: payload}`` where each payload carries the
+    doc's full change history (``changes``), buffered ``queued``
+    changes, ``clock`` and an optional ``quarantine`` record. Written
+    atomically — a parked doc's shard is the doc's ONLY durable copy
+    once a checkpoint snapshots the fleet without it."""
+    atomic_write_bytes(path, pack_snapshot(json.dumps(
+        {'format': PARK_FORMAT, 'docs': docs},
+        separators=(',', ':'))))
+
+
+def read_park_shard(path):
+    """Load a :func:`write_park_shard` artifact; returns the
+    ``{doc_id: payload}`` map. Raises
+    :class:`~automerge_tpu.snapshot.SnapshotCorruptError` naming the
+    failure on truncation/bit rot/format mismatch."""
+    with open(path, 'rb') as f:
+        payload = unpack_snapshot(f.read())
+    try:
+        obj = json.loads(payload)
+    except ValueError as err:
+        raise SnapshotCorruptError(
+            f'park shard is not valid JSON ({err})') from None
+    if not isinstance(obj, dict) or obj.get('format') != PARK_FORMAT:
+        raise SnapshotCorruptError('not a parked-docs shard')
+    docs = obj.get('docs')
+    if not isinstance(docs, dict):
+        raise SnapshotCorruptError(
+            "park shard: missing field 'docs'")
+    return docs
+
+
 def read_snapshot_file(path):
     """Read + validate a :func:`write_snapshot_file` artifact."""
     with open(path, 'rb') as f:
@@ -231,6 +269,21 @@ class DurableDocSet:
 
     applyChanges = apply_changes
 
+    def apply_wire(self, data, doc_ids=None):
+        """WAL the wire path too: the raw blob is UTF-8 JSON of
+        per-doc change lists, so it journals as text and replays
+        byte-identically (without this, changes acknowledged over a
+        WireConnection would vanish in a crash — the dict path was
+        journaled, the columnar path was not)."""
+        if isinstance(data, (bytes, bytearray)):
+            text = bytes(data).decode('utf-8')
+        else:
+            text = data
+        self.journal.append({'wire': text, 'docs': doc_ids})
+        return self.doc_set.apply_wire(data, doc_ids=doc_ids)
+
+    applyWire = apply_wire
+
     def checkpoint(self):
         """Atomic fleet checkpoint: packed snapshot to a tmp file,
         fsync, rename, THEN journal truncate — a crash between the two
@@ -268,7 +321,24 @@ class DurableDocSet:
             if hasattr(doc_set, 'quarantined') else {}
         valid_end = 0
         for record, end in ChangeJournal._scan(journal_path):
-            doc_set.apply_changes_batch(record['changes'], **kwargs)
+            if 'wire' in record:
+                # wire-path record: replay the raw blob through the
+                # fused path; a poisoned doc falls back to the dict
+                # batch under per-doc isolation (the fused apply rolls
+                # back store-intact), exactly like WireConnection
+                try:
+                    doc_set.apply_wire(record['wire'].encode('utf-8'),
+                                       doc_ids=record['docs'])
+                except Exception:
+                    per_doc = json.loads(record['wire'])
+                    doc_set.apply_changes_batch(
+                        dict(zip(record['docs'] or
+                                 [f'doc-{i}'
+                                  for i in range(len(per_doc))],
+                                 per_doc)), **kwargs)
+            else:
+                doc_set.apply_changes_batch(record['changes'],
+                                            **kwargs)
             valid_end = end
         # drop the torn/corrupt tail NOW: appends after recovery must
         # land on a replayable journal, not be stranded behind garbage

@@ -11,13 +11,16 @@ from .doc_set import DocSet
 from .device_doc_set import DeviceDocSet
 from .dense_doc_set import DenseDocSet
 from .general_doc_set import GeneralDocSet
+from .serving import ServingDocSet
 from .watchable_doc import WatchableDoc
 from .connection import (Connection, BatchingConnection, WireConnection,
                          MessageRejected, validate_msg,
                          validate_wire_msg)
-from .resilient import ResilientConnection
+from .resilient import (ResilientConnection, AdmissionControl,
+                        TokenBucket)
 
 __all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
-           'WatchableDoc', 'Connection', 'BatchingConnection',
-           'WireConnection', 'MessageRejected', 'validate_msg',
-           'validate_wire_msg', 'ResilientConnection']
+           'ServingDocSet', 'WatchableDoc', 'Connection',
+           'BatchingConnection', 'WireConnection', 'MessageRejected',
+           'validate_msg', 'validate_wire_msg', 'ResilientConnection',
+           'AdmissionControl', 'TokenBucket']

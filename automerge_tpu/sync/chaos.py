@@ -78,7 +78,7 @@ class ChaosFleet:
 
     def __init__(self, doc_sets, seed=0, drop=0.0, dup=0.0, delay=0,
                  corrupt=0.0, batching=True, wire=False,
-                 heartbeat_every=8, conn_kwargs=None):
+                 heartbeat_every=8, conn_kwargs=None, admission=None):
         self.doc_sets = list(doc_sets)
         self.rng = random.Random(seed)
         self.drop = drop
@@ -97,6 +97,22 @@ class ChaosFleet:
         self._conn_kwargs.setdefault('heartbeat_every', heartbeat_every)
         if wire:
             self._conn_kwargs['wire'] = True
+        # node-wide admission: ONE AdmissionControl shared by all of a
+        # node's endpoints (the fleet-wide valve; the per-link valve
+        # rides conn_kwargs['admission']). `admission` is kwargs for
+        # every node, or a per-node list (None entries = unmetered)
+        from .resilient import AdmissionControl
+        n_nodes = len(self.doc_sets)
+        if admission is None:
+            self.node_admission = [None] * n_nodes
+        elif isinstance(admission, dict):
+            self.node_admission = [AdmissionControl(**admission)
+                                   for _ in range(n_nodes)]
+        else:
+            self.node_admission = [
+                cfg if cfg is None or
+                isinstance(cfg, AdmissionControl)
+                else AdmissionControl(**cfg) for cfg in admission]
         nodes = range(len(self.doc_sets))
         for a in nodes:
             for b in nodes:
@@ -113,6 +129,7 @@ class ChaosFleet:
         conn = ResilientConnection(
             self.doc_sets[owner], self._sender(owner, peer),
             batching=self.batching,
+            shared_admission=self.node_admission[owner],
             seed=self.rng.randrange(1 << 30), **self._conn_kwargs)
         self.conns[(owner, peer)] = conn
         return conn
@@ -211,9 +228,19 @@ class ChaosFleet:
                 self.conns[(to, frm)].receive_msg(env)
         for conn in self.conns.values():
             conn.tick()
+        for ctrl in self.node_admission:
+            if ctrl is not None:
+                ctrl.tick()            # the shared valve refills ONCE
+                #                        per quantum, not once per link
         if self.batching or self.wire:
             for conn in self.conns.values():
                 conn.flush()
+        # serving doc sets advance their residency clock (last-touch
+        # aging, memory-budget enforcement, quarantine parking)
+        for ds in self.doc_sets:
+            t = getattr(ds, 'tick', None)
+            if t is not None:
+                t()
 
     def pending(self):
         """Traffic still in flight: queued envelopes or unacked sends

@@ -457,7 +457,7 @@ class WireConnection(BatchingConnection):
     wire-capable doc set (GeneralDocSet).
     """
 
-    def __init__(self, doc_set, send_msg):
+    def __init__(self, doc_set, send_msg, max_msg_bytes=None):
         super().__init__(doc_set, send_msg)
         store = getattr(doc_set, 'store', None)
         if not hasattr(doc_set, 'apply_wire') or store is None or \
@@ -467,8 +467,22 @@ class WireConnection(BatchingConnection):
                 '(GeneralDocSet: apply_wire + a store serving '
                 'get_missing_changes_wire); use Connection or '
                 'BatchingConnection for other doc sets')
+        # per-peer flow control: soft cap on one outgoing message's
+        # blob bytes — data spans past the cap carry to the next tick
+        # (re-served from the encode cache, so deferral costs no
+        # re-encode). None = unbounded.
+        self.max_msg_bytes = max_msg_bytes
         self._pending_send = {}       # doc_id -> None (insertion order)
         self._incoming_wire = []
+
+    def open(self):
+        """Advertise every doc WITHOUT materializing handles: the wire
+        ``doc_changed`` only needs the doc id, and a serving doc set
+        must not fault its whole evicted tail back in just because a
+        connection opened."""
+        for doc_id in self._doc_set.doc_ids:
+            self._pending_send[doc_id] = None
+        self._doc_set.register_handler(self.doc_changed)
 
     def maybe_send_changes(self, doc_id):
         """Deferred: data sends coalesce into the tick's single
@@ -599,6 +613,16 @@ class WireConnection(BatchingConnection):
             return
         pending = list(self._pending_send)
         self._pending_send.clear()
+        # serving doc sets fault evicted docs back in before the serve
+        # (a sync touch); docs the peer's clock already covers stay
+        # evicted and report their RECORDED clock instead of the
+        # store's (empty) one
+        ensure = getattr(self._doc_set, 'ensure_resident', None)
+        evicted_clocks = {}
+        if ensure is not None:
+            evicted_clocks = ensure(pending,
+                                    peer_clocks=self._their_clock) \
+                or {}
         store = self._doc_set.store
         id_of = self._doc_set.id_of
         if len(pending) > 16 and hasattr(store, 'clocks_all'):
@@ -610,13 +634,16 @@ class WireConnection(BatchingConnection):
         wants = []                       # (idx, have) for known peers
         for doc_id in pending:
             idx = id_of.get(doc_id)
-            if idx is None:
+            if idx is None or doc_id in evicted_clocks:
                 continue
             if doc_id in self._their_clock:
                 wants.append((idx, self._their_clock[doc_id]))
         served, errors = store.get_missing_changes_wire_batch(
             wants, all_clocks=fleet_clocks) if wants else ({}, {})
         docs, clocks, counts, lens, chunks = [], [], [], [], []
+        blob_bytes = 0
+        data_docs = 0
+        deferred = []
         for doc_id in pending:
             idx = id_of.get(doc_id)
             if idx is None:
@@ -631,7 +658,9 @@ class WireConnection(BatchingConnection):
                     clocks.append({})
                     counts.append(0)
                 continue
-            clock = clock_of(idx)
+            clock = evicted_clocks.get(doc_id)
+            if clock is None:
+                clock = clock_of(idx)
             if idx in errors:
                 self._send_snapshot(
                     doc_id, self._doc_set.get_doc(doc_id), clock,
@@ -639,6 +668,18 @@ class WireConnection(BatchingConnection):
                 continue
             blobs = served.get(idx)
             if blobs:
+                size = sum(len(b) for b in blobs)
+                if self.max_msg_bytes is not None and data_docs and \
+                        blob_bytes + size > self.max_msg_bytes:
+                    # over the per-message byte cap: this doc's data
+                    # span (whole — clocks stay trivially exact) waits
+                    # for the next tick's message. The first data span
+                    # always ships, so an oversize single doc still
+                    # makes progress.
+                    deferred.append(doc_id)
+                    continue
+                blob_bytes += size
+                data_docs += 1
                 clock_union(self._their_clock, doc_id, clock)
                 clock_union(self._our_clock, doc_id, clock)
                 docs.append(doc_id)
@@ -652,6 +693,14 @@ class WireConnection(BatchingConnection):
                 docs.append(doc_id)
                 clocks.append(dict(clock))
                 counts.append(0)
+        if deferred:
+            # carry past the cap to the next tick, in order; the
+            # next serve re-reads the SAME cached encodings
+            for doc_id in deferred:
+                self._pending_send[doc_id] = None
+            metrics.bump('sync_flow_deferred_docs', len(deferred))
+        metrics.set_gauge('sync_flow_backlog_docs',
+                          len(self._pending_send))
         if not docs:
             return
         blob = b''.join(chunks)

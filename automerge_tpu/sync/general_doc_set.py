@@ -293,6 +293,82 @@ class GeneralDocSet:
 
     applyChangesBatch = apply_changes_batch
 
+    # -- cold-doc eviction mechanism (policy lives in ServingDocSet) --------
+
+    def extract_doc_state(self, doc_ids):
+        """The parkable state of each doc in ``doc_ids``: its FULL
+        retained change history (admission order — re-applying it
+        deterministically reproduces the doc, byte-identical), any
+        causally-buffered queued changes, and its clock. Raises the
+        store's retention/truncation ValueError when the history is not
+        fully servable (a snapshot-resumed store cannot park such a
+        doc — its pre-resume change bodies are gone)."""
+        store = self.store
+        store._commit_pending()
+        store.pool.sync()
+        queued = {}                    # idx -> buffered changes
+        want = {self.id_of[d] for d in doc_ids}
+        for d, ch in store.queue:
+            if d in want:
+                queued.setdefault(d, []).append(ch)
+        out = {}
+        for doc_id in doc_ids:
+            idx = self.id_of[doc_id]
+            out[doc_id] = {
+                'doc_id': doc_id,
+                'clock': store.clock_of(idx),
+                'changes': store.get_missing_changes(idx, {}),
+                'queued': queued.get(idx, [])}
+        return out
+
+    def drop_doc_state(self, doc_ids, chunk_docs=512):
+        """Release the store state of ``doc_ids`` (call
+        :meth:`extract_doc_state` FIRST — this drops their history).
+        The shared columnar store cannot excise one doc's rows in
+        place, so the store REBUILDS: every other doc's retained log
+        re-applies (in ``chunk_docs`` fused batches) into a fresh store
+        at its existing index — doc ids, indexes and live handles all
+        stay valid; entry rows, pool nodes, retained bodies, mirror
+        words and encode-cache entries of the dropped docs are
+        released wholesale. Per-doc applied versions carry over, so
+        cached views of the surviving docs keep serving."""
+        drop = {self.id_of[d] for d in doc_ids}
+        old = self.store
+        old._commit_pending()
+        old.pool.sync()
+        new_store = _general.init_store(self.capacity)
+        resident = [i for i in range(len(self.ids)) if i not in drop]
+        for start in range(0, len(resident), chunk_docs):
+            batch = resident[start:start + chunk_docs]
+            per_doc = [[] for _ in range(max(batch) + 1)]
+            any_changes = False
+            for i in batch:
+                changes = old.get_missing_changes(i, {})
+                if changes:
+                    per_doc[i] = changes
+                    any_changes = True
+            if any_changes:
+                block = new_store.encode_changes(per_doc,
+                                                 n_docs=self.capacity)
+                _general.apply_general_block(new_store, block,
+                                             options=self._options)
+        # causally-buffered changes of surviving docs ride along (they
+        # merge into the next apply, exactly as they would have)
+        new_store.queue = [(d, ch) for d, ch in old.queue
+                           if d not in drop]
+        # applied versions carry over so the dirty-doc view cache stays
+        # keyed correctly: surviving docs' cached views remain valid
+        # (identical state), and the NEXT real apply still bumps past
+        # every carried version
+        new_store._doc_version = old._doc_version.copy()
+        new_store._apply_seq = max(old._apply_seq,
+                                   new_store._apply_seq)
+        new_store.adopt_wire_cache(old, drop_docs=drop)
+        self.store = new_store
+        for i in drop:
+            self._views.pop(i, None)
+        self._entry_csr = (None, None, None)
+
     def fleet_status(self):
         """Operator surface over the whole fleet (ROADMAP "Quarantine
         operator surface"): per-doc ``{'clock': {actor: seq},

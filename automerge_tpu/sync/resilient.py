@@ -46,6 +46,107 @@ from .connection import (BatchingConnection, Connection,
 ENVELOPE_VERSION = 1
 
 
+class TokenBucket:
+    """A logical-time DEBT bucket: ``rate`` tokens refill per tick,
+    credit capped at ``burst``; admission requires any POSITIVE
+    balance and charges the full cost, driving the balance negative.
+    The coalesced wire path ships one large message per tick — a
+    threshold bucket would either always admit it (cost clamped) or
+    livelock (cost > burst); debt admits it once and then holds the
+    door shut until the refill pays the debt off, which is exactly
+    "overload degrades to latency"."""
+
+    __slots__ = ('rate', 'burst', 'tokens')
+
+    def __init__(self, rate, burst=None):
+        self.rate = rate
+        self.burst = burst if burst is not None else 4 * rate
+        self.tokens = self.burst
+
+    def tick(self):
+        self.tokens = min(self.burst, self.tokens + self.rate)
+
+    def has(self, cost):
+        return self.tokens > 0
+
+    def take(self, cost):
+        self.tokens -= cost
+
+    def ticks_until(self, cost):
+        """Refill ticks until the balance is positive again — the
+        retry-after hint a denied peer gets."""
+        if self.tokens > 0:
+            return 0
+        return -(-(1 - self.tokens) // max(self.rate, 1))
+
+
+class AdmissionControl:
+    """Admission buckets over INCOMING change payloads — the overload
+    valve of the serving layer. Two meters, both must pass: changes per
+    tick and payload bytes per tick (either may be None = unmetered).
+    A denied envelope gets an explicit ``busy`` reply with a
+    retry-after hint — overload degrades to latency, never to silent
+    loss or divergence (the sender's backoff + the anti-entropy
+    heartbeat repair anything that exhausts its retry budget while the
+    valve is closed).
+
+    One instance per link is per-peer admission; one instance SHARED
+    across all of a node's connections is the fleet-wide cap (the
+    owner must then call :meth:`tick` exactly once per quantum —
+    connections only tick the controllers they own)."""
+
+    def __init__(self, changes_per_tick=None, bytes_per_tick=None,
+                 burst_ticks=4):
+        self.change_bucket = TokenBucket(
+            changes_per_tick, changes_per_tick * burst_ticks) \
+            if changes_per_tick else None
+        self.byte_bucket = TokenBucket(
+            bytes_per_tick, bytes_per_tick * burst_ticks) \
+            if bytes_per_tick else None
+
+    def tick(self):
+        if self.change_bucket is not None:
+            self.change_bucket.tick()
+        if self.byte_bucket is not None:
+            self.byte_bucket.tick()
+
+    def check(self, n_changes, n_bytes):
+        """Retry-after hint in ticks (0 = would admit). Does NOT
+        charge."""
+        retry = 0
+        if self.change_bucket is not None and \
+                not self.change_bucket.has(n_changes):
+            retry = max(retry, self.change_bucket.ticks_until(
+                n_changes))
+        if self.byte_bucket is not None and \
+                not self.byte_bucket.has(n_bytes):
+            retry = max(retry, self.byte_bucket.ticks_until(n_bytes))
+        return retry
+
+    def charge(self, n_changes, n_bytes):
+        if self.change_bucket is not None:
+            self.change_bucket.take(n_changes)
+        if self.byte_bucket is not None:
+            self.byte_bucket.take(n_bytes)
+
+
+def _payload_cost(payload):
+    """(n_changes, n_bytes) admission cost of a logical data message.
+    Wire messages meter their change count and raw blob bytes; dict
+    data messages meter change count only (their byte size is not
+    known without an encode — the change meter is the binding one
+    there). Advertisements/requests cost nothing: the repair loop must
+    never be throttled."""
+    if 'wire' in payload:
+        blob = payload.get('blob')
+        return (sum(payload.get('counts') or ()),
+                len(blob) if isinstance(blob, (bytes, bytearray))
+                else 0)
+    changes = payload.get('changes')
+    return (len(changes) if isinstance(changes, (list, tuple)) else 0,
+            0)
+
+
 def payload_checksum(payload):
     """CRC32 over the canonical JSON encoding of a logical message
     (sorted keys, no whitespace) — both ends compute the same bytes
@@ -68,12 +169,13 @@ def payload_checksum(payload):
 
 
 class _Unacked:
-    __slots__ = ('envelope', 'due', 'attempts')
+    __slots__ = ('envelope', 'due', 'attempts', 'backpressured')
 
     def __init__(self, envelope, due):
         self.envelope = envelope
         self.due = due
         self.attempts = 0
+        self.backpressured = False     # last reply was a busy deferral
 
 
 class ResilientConnection:
@@ -95,12 +197,25 @@ class ResilientConnection:
 
     def __init__(self, doc_set, send_msg, batching=False, wire=False,
                  retry_limit=8, backoff_base=2, backoff_max=64,
-                 jitter=2, heartbeat_every=16, seed=0):
+                 jitter=2, heartbeat_every=16, seed=0,
+                 admission=None, shared_admission=None,
+                 max_msg_bytes=None):
         self._send_raw = send_msg
-        conn_cls = WireConnection if wire else \
-            (BatchingConnection if batching else Connection)
-        self._conn = conn_cls(doc_set, self._send_envelope)
+        if wire:
+            self._conn = WireConnection(doc_set, self._send_envelope,
+                                        max_msg_bytes=max_msg_bytes)
+        else:
+            conn_cls = BatchingConnection if batching else Connection
+            self._conn = conn_cls(doc_set, self._send_envelope)
         self._doc_set = doc_set
+        # admission control: `admission` is this link's own per-peer
+        # controller (an AdmissionControl or its kwargs dict; ticked by
+        # this connection), `shared_admission` the node-wide controller
+        # shared across links (ticked once per quantum by its owner)
+        if isinstance(admission, dict):
+            admission = AdmissionControl(**admission)
+        self.admission = admission
+        self.shared_admission = shared_admission
         self.retry_limit = retry_limit
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
@@ -162,6 +277,53 @@ class ResilientConnection:
         self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'ack',
                         'ack': seq, 'sum': payload_checksum(seq)})
 
+    def _send_busy(self, seq, retry_after):
+        """Admission denied: an EXPLICIT overload reply (not a silent
+        drop) telling the sender when to retry — overload degrades to
+        latency, and the sender's counters make the backpressure
+        visible."""
+        metrics.bump('sync_busy_sent')
+        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'busy',
+                        'seq': seq, 'retry_after': retry_after,
+                        'sum': payload_checksum([seq, retry_after])})
+
+    def _bp_clear(self, rec):
+        """An unacked envelope left the busy-deferred state (acked or
+        dropped): keep the global depth gauge exact."""
+        if rec is not None and rec.backpressured:
+            rec.backpressured = False
+            metrics.bump('sync_backpressure_depth', -1)
+
+    @property
+    def backpressure_depth(self):
+        """Outbound envelopes currently deferred by the peer's busy
+        replies."""
+        return sum(1 for rec in self._sent.values()
+                   if rec.backpressured)
+
+    def _forget_delivery(self, payload):
+        """A data envelope died permanently (retry budget exhausted):
+        roll back the inner connection's OPTIMISTIC their-clock for
+        the docs it carried. ``maybe_send_changes`` unions the local
+        clock into ``_their_clock`` at send time (``_send_snapshot``
+        does too), assuming delivery — without this rollback the
+        peer's next advert/request would be answered with "nothing
+        missing" and the gap could never heal through the normal
+        protocol. Advertisements (``changes``/``snapshot`` both
+        absent) carry no data, so their loss needs no rollback."""
+        if not isinstance(payload, dict):
+            return
+        their = self._conn._their_clock
+        if 'wire' in payload:
+            for doc_id, count in zip(payload.get('docs') or (),
+                                     payload.get('counts') or ()):
+                if count:
+                    their.pop(doc_id, None)
+        elif 'docId' in payload and (
+                payload.get('changes') is not None or
+                payload.get('snapshot') is not None):
+            their.pop(payload['docId'], None)
+
     # -- inbound -------------------------------------------------------------
 
     def _reject(self, reason):
@@ -200,8 +362,11 @@ class ResilientConnection:
                 metrics.bump('sync_checksum_failures')
                 return self._reject(f'ack checksum mismatch '
                                     f'(ack {seq})')
-            self._sent.pop(seq, None)
+            rec = self._sent.pop(seq, None)
+            self._bp_clear(rec)
             return None
+        if kind == 'busy':
+            return self._receive_busy(env)
         if kind == 'hb':
             return self._receive_heartbeat(env)
         if kind != 'data':
@@ -222,6 +387,24 @@ class ResilientConnection:
             self._send_ack(seq)            # the first ack may be lost
             metrics.bump('sync_msgs_duplicate')
             return None
+        # admission control: meter fresh data payloads AFTER integrity
+        # and duplicate checks (a dup was already paid for) and BEFORE
+        # any delivery/buffering. Denial replies busy with a
+        # retry-after hint and neither acks nor consumes the seq — the
+        # sender redelivers once the valve reopens, or its exhausted
+        # budget falls through to the anti-entropy heartbeat.
+        ctrls = [c for c in (self.admission, self.shared_admission)
+                 if c is not None]
+        if ctrls:
+            n_changes, n_bytes = _payload_cost(payload)
+            if n_changes or n_bytes:
+                retry = max(c.check(n_changes, n_bytes)
+                            for c in ctrls)
+                if retry:
+                    self._send_busy(seq, retry)
+                    return None
+                for c in ctrls:
+                    c.charge(n_changes, n_bytes)
         # deliver FIRST, ack on the outcome: an acked seq is consumed
         # forever (dup-suppressed on redelivery), so acking before a
         # failed apply would lose the message at the envelope layer.
@@ -254,6 +437,50 @@ class ResilientConnection:
         self._mark_seen(seq)
         return out
 
+    def _receive_busy(self, env):
+        """The peer's admission valve deferred our data envelope:
+        reschedule it for the hinted tick. A busy reply consumes a
+        retry attempt — a peer that stays overloaded past the budget
+        exhausts exactly like a dead link (counted separately under
+        ``sync_retry_exhausted_backpressure``), and the heartbeat's
+        re-advertisement regenerates the data once admission
+        reopens."""
+        seq = env.get('seq')
+        retry_after = env.get('retry_after')
+        if not isinstance(seq, int) or isinstance(seq, bool) or \
+                not isinstance(retry_after, int) or \
+                isinstance(retry_after, bool) or retry_after < 0:
+            return self._reject(f'busy seq/retry_after malformed: '
+                                f'{seq!r}/{retry_after!r}')
+        if env.get('sum') != payload_checksum([seq, retry_after]):
+            metrics.bump('sync_checksum_failures')
+            return self._reject(f'busy checksum mismatch (seq {seq})')
+        rec = self._sent.get(seq)
+        if rec is None:
+            return None                # already acked/dropped
+        metrics.bump('sync_busy_received')
+        rec.attempts += 1
+        if rec.attempts >= self.retry_limit:
+            del self._sent[seq]
+            self._bp_clear(rec)
+            metrics.bump('sync_retry_exhausted')
+            metrics.bump('sync_retry_exhausted_backpressure')
+            self._forget_delivery(rec.envelope.get('payload'))
+            return None
+        if not rec.backpressured:
+            rec.backpressured = True
+            metrics.bump('sync_backpressure_depth')
+        # the hint is clamped to the backoff ceiling: a hard-shut (or
+        # hostile) peer advertising an enormous retry-after must not
+        # park the envelope forever — bounded re-attempts keep burning
+        # the budget, which is what lets sustained backpressure
+        # exhaust and fall through to the anti-entropy repair
+        rec.due = self._now + \
+            min(max(retry_after, 1), self.backoff_max) + \
+            (self._rng.randrange(self.jitter + 1) if self.jitter
+             else 0)
+        return None
+
     def _receive_heartbeat(self, env):
         clocks = env.get('clocks')
         if not isinstance(clocks, dict):
@@ -262,7 +489,20 @@ class ResilientConnection:
             metrics.bump('sync_checksum_failures')
             return self._reject('heartbeat checksum mismatch')
         metrics.bump('sync_heartbeats_received')
+        doc_set = self._conn._doc_set
+        # membership only: get_doc would mint (and cache) a handle per
+        # advertised doc, ~fleet-size allocations per beat on general/
+        # serving doc sets
+        id_of = getattr(doc_set, 'id_of', None)
+        known = (lambda d: d in id_of) if id_of is not None \
+            else (lambda d: doc_set.get_doc(d) is not None)
         for doc_id, clock in clocks.items():
+            if clock and not known(doc_id):
+                # the beat re-opens the one-shot request suppression:
+                # we requested this doc once but the data never landed
+                # (e.g. the sender's budget exhausted against our own
+                # busy valve) — re-request, bounded by the beat period
+                self._conn._our_clock.pop(doc_id, None)
             try:
                 # a heartbeat entry IS an advertisement: the normal
                 # protocol answers it (request / data / nothing)
@@ -279,6 +519,9 @@ class ResilientConnection:
         envelopes (exponential backoff + jitter, bounded budget) and
         emit the periodic anti-entropy heartbeat."""
         self._now += 1
+        if self.admission is not None:
+            self.admission.tick()      # shared controllers are ticked
+            #                            once per quantum by their owner
         # seqs are minted monotonically and entries only deleted, so
         # dict order IS ascending seq order — no re-sort per quantum
         for seq in list(self._sent):
@@ -291,6 +534,10 @@ class ResilientConnection:
                 # this envelope carried once the link heals
                 del self._sent[seq]
                 metrics.bump('sync_retry_exhausted')
+                if rec.backpressured:
+                    metrics.bump('sync_retry_exhausted_backpressure')
+                self._bp_clear(rec)
+                self._forget_delivery(rec.envelope.get('payload'))
                 continue
             rec.attempts += 1
             rec.due = self._now + self._backoff(rec.attempts)
@@ -316,8 +563,14 @@ class ResilientConnection:
         convergence eventual even when retransmit budgets run out."""
         from .. import frontend as Frontend
         clocks = {}
+        hb = getattr(self._doc_set, 'heartbeat_clocks', None)
         store = getattr(self._doc_set, 'store', None)
-        if store is not None and hasattr(store, 'clocks_all') and \
+        if hb is not None:
+            # serving doc sets advertise evicted docs' RECORDED clocks
+            # without faulting anything in — a heartbeat must never
+            # thrash the residency cache
+            clocks = hb()
+        elif store is not None and hasattr(store, 'clocks_all') and \
                 hasattr(self._doc_set, 'ids'):
             # bulk stores: every clock in ONE pass over the clock rows
             # (per-doc clock_of would pay a searchsorted per document,

@@ -39,11 +39,41 @@ from contextlib import contextmanager
 #                              mismatches caught at load
 FAULT_COUNTERS = (
     'sync_retransmits', 'sync_retransmit_wire_bytes',
-    'sync_retry_exhausted', 'sync_msgs_rejected',
+    'sync_retry_exhausted', 'sync_retry_exhausted_backpressure',
+    'sync_msgs_rejected',
     'sync_msgs_duplicate', 'sync_checksum_failures',
     'sync_heartbeats_sent', 'sync_heartbeats_received',
     'sync_apply_failures', 'sync_docs_quarantined', 'apply_rollbacks',
     'snapshot_checksum_failures')
+
+# Serving/overload counters (the overload-degradation observability
+# contract — the serving layer must shed load VISIBLY, never silently):
+#   sync_busy_sent/_received   admission-control `busy` replies (the
+#                              explicit overload signal, with a
+#                              retry-after hint — never a silent drop)
+#   sync_backpressure_depth    gauge: unacked envelopes currently
+#                              deferred by a peer's busy replies
+#   sync_flow_deferred_docs    data spans carried to the next tick by
+#                              the per-message outgoing byte cap
+#   sync_flow_backlog_docs     gauge: sender-side docs still pending
+#                              after a capped flush
+#   sync_wire_cache_bytes      gauge: resident bytes of the per-change
+#                              encode cache (drops on doc eviction)
+#   serving_evictions          cold docs evicted to durable parked
+#                              snapshots (memory-budget enforcement)
+#   serving_faultins           evicted docs transparently faulted back
+#                              in by a touch
+#   serving_docs_parked        ALERT: stuck quarantined docs aged out
+#                              of the in-memory hold to a parked
+#                              snapshot
+#   serving_evictions_blocked_truncated  eviction skipped because the
+#                              store's change log is snapshot-truncated
+#                              (a parked doc could not be rebuilt)
+SERVING_COUNTERS = (
+    'sync_busy_sent', 'sync_busy_received', 'sync_backpressure_depth',
+    'sync_flow_deferred_docs', 'sync_flow_backlog_docs',
+    'sync_wire_cache_bytes', 'serving_evictions', 'serving_faultins',
+    'serving_docs_parked', 'serving_evictions_blocked_truncated')
 
 
 class Metrics:

@@ -126,7 +126,15 @@ class TestFaultCounters:
         assert set(M.FAULT_COUNTERS) >= {
             'sync_retransmits', 'sync_msgs_rejected',
             'sync_docs_quarantined', 'apply_rollbacks',
-            'snapshot_checksum_failures'}
+            'snapshot_checksum_failures',
+            'sync_retry_exhausted_backpressure'}
+
+    def test_serving_registry_names_are_pinned(self):
+        assert set(M.SERVING_COUNTERS) >= {
+            'sync_busy_sent', 'sync_busy_received',
+            'sync_backpressure_depth', 'sync_flow_deferred_docs',
+            'sync_wire_cache_bytes', 'serving_evictions',
+            'serving_faultins', 'serving_docs_parked'}
 
     def test_rejected_message_counts(self):
         from automerge_tpu.sync.connection import MessageRejected
