@@ -1722,6 +1722,8 @@ class GeneralPatch:
         if self._ready:
             return
         self._ready = True
+        import time
+        _t0 = time.perf_counter()
         store = self.store
         raw = self._raw
         F = len(self.f_obj)
@@ -1841,6 +1843,15 @@ class GeneralPatch:
                     'node_actor': pool_actor[rows],
                     'node_elemc': pool_elemc[rows],
                 }
+        # patch-read closes the tick path: one device fetch + the
+        # winner-dependent column build, measured as a completed span
+        # (the read may run on a different thread than the apply —
+        # span_event parents it under whatever span that thread holds)
+        dt_ms = (time.perf_counter() - _t0) * 1e3
+        metrics.observe('general_patch_read_ms', dt_ms)
+        if metrics.active:
+            metrics.span_event('device.patch_read', dt_ms,
+                               fields=F)
 
     def _plain_mask(self, fis):
         """Fields whose payload is a bare value (no link flag, no
@@ -2012,7 +2023,12 @@ def apply_general_block(store, block, options=None, return_timing=False):
     with store._host_lock:
         txn = _Txn(store)
         try:
-            return _apply_general(store, block, options, return_timing)
+            # the fused-apply span covers admit+stage+dispatch; the
+            # stage/dispatch split is emitted as completed child spans
+            # from the timing points _apply_general already records
+            with metrics.trace_span('device.fused_apply'):
+                return _apply_general(store, block, options,
+                                      return_timing)
         except BaseException:
             # validation errors (ValueError/TypeError) AND unexpected
             # failures (a MemoryError in the native stager, the forced
@@ -2886,6 +2902,15 @@ def _apply_general(store, block, options, return_timing):
     metrics.observe('general_stage_ms',
                     (t2 - t1 - (tc1 - tc0)) * 1e3)
     metrics.observe('general_commit_wait_ms', (tc1 - tc0) * 1e3)
+    if metrics.active:
+        # tick-path taxonomy: admit → stage → dispatch, as completed
+        # child spans of device.fused_apply (explicit durations — the
+        # phases are measured in-line above)
+        metrics.span_event('device.admit', (t1 - t0) * 1e3)
+        metrics.span_event('device.stage',
+                           (t2 - t1 - (tc1 - tc0)) * 1e3,
+                           native=ns is not None)
+        metrics.span_event('device.dispatch', (t3 - t2) * 1e3)
     if return_timing:
         return patch, {'admit': t1 - t0, 'pack': t2 - t1,
                        'commit_wait': tc1 - tc0,

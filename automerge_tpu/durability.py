@@ -32,7 +32,9 @@ is the database-grade split the snapshot docstring already cites
 
 import json
 import os
+import re
 import struct
+import time
 import zlib
 
 from .snapshot import SnapshotCorruptError
@@ -158,6 +160,39 @@ def read_snapshot_file(path):
         return unpack_snapshot(f.read())
 
 
+# -- flight-recorder incident files -------------------------------------------
+
+def dump_incident(recorder, dir_path, kind, **meta):
+    """Dump a :class:`~automerge_tpu.utils.metrics.FlightRecorder`'s
+    retained events to ``<dir_path>/incidents/incident-<seq>-<kind>.
+    jsonl`` — ONE file per incident, written through
+    :func:`atomic_write_bytes` like any snapshot, so an incident file
+    is never torn. A trigger record (``event='incident'`` with
+    ``kind`` + ``meta``) is the file's guaranteed LAST line, so the
+    file itself names what fired it. Returns the path."""
+    inc_dir = os.path.join(dir_path, 'incidents')
+    os.makedirs(inc_dir, exist_ok=True)
+    # max existing seq + 1, NOT file count + 1: an operator pruning an
+    # old incident must never make the next dump overwrite a newer one
+    seq = 1 + max(
+        (int(m.group(1)) for m in
+         (re.match(r'incident-(\d+)-.*\.jsonl$', n)
+          for n in os.listdir(inc_dir)) if m),
+        default=0)
+    path = os.path.join(inc_dir, f'incident-{seq:04d}-{kind}.jsonl')
+    trigger = {'event': 'incident', 'kind': kind, 'ts': time.time(),
+               'mono': time.perf_counter(), **meta}
+    # the trigger rides to the file as dump()'s locally-appended last
+    # line — appending it to the shared ring FIRST would let a
+    # concurrent emit (the async applier thread) land after it and
+    # displace it from the tail. The ring still gets the mark (below)
+    # so later incidents' files show this one in their history.
+    recorder.dump(path, trigger=trigger)
+    recorder(trigger)
+    metrics.bump('incidents_dumped')
+    return path
+
+
 class ChangeJournal:
     """Append-only change journal with per-record length+CRC framing.
 
@@ -181,7 +216,13 @@ class ChangeJournal:
                                        zlib.crc32(payload)) + payload)
         self._f.flush()
         if self.fsync:
+            # journal fsync is the durable write path's latency floor:
+            # the observe series feeds quantile('journal_fsync_ms')
+            # for fleet_status() and the bench's p50/p99 keys
+            t0 = time.perf_counter()
             os.fsync(self._f.fileno())
+            metrics.observe('journal_fsync_ms',
+                            (time.perf_counter() - t0) * 1e3)
 
     def close(self):
         self._f.close()
@@ -299,13 +340,17 @@ class DurableDocSet:
 
     @classmethod
     def recover(cls, dir_path, doc_set_factory, load_snapshot=None,
-                fsync=True):
+                fsync=True, flight_recorder=None):
         """Rebuild after a crash: load the checkpoint if one exists
         (``load_snapshot(payload_bytes)``), else start from
         ``doc_set_factory()``, then replay the journal tail through
         ``apply_changes_batch``. Returns the new :class:`DurableDocSet`
         (its journal keeps the replayed tail until the next
-        :meth:`checkpoint`)."""
+        :meth:`checkpoint`). With a ``flight_recorder`` (subscribed to
+        the metrics bus before the call), the recovery dumps the
+        recorder's retained pre-crash/replay events as an incident
+        file under ``<dir_path>/incidents/`` — the black box of what
+        happened in the seconds before the crash."""
         snap_path = os.path.join(dir_path, cls.SNAPSHOT_FILE)
         doc_set = None
         if load_snapshot is not None and os.path.exists(snap_path):
@@ -320,7 +365,9 @@ class DurableDocSet:
         kwargs = {'isolate': True} \
             if hasattr(doc_set, 'quarantined') else {}
         valid_end = 0
+        n_replayed = 0
         for record, end in ChangeJournal._scan(journal_path):
+            n_replayed += 1
             if 'wire' in record:
                 # wire-path record: replay the raw blob through the
                 # fused path; a poisoned doc falls back to the dict
@@ -355,6 +402,9 @@ class DurableDocSet:
         out.doc_set = doc_set
         out.dir_path = dir_path
         out.journal = ChangeJournal(journal_path, fsync=fsync)
+        if flight_recorder is not None:
+            dump_incident(flight_recorder, dir_path, 'recovery',
+                          replayed_records=n_replayed)
         return out
 
     # -- proxy --------------------------------------------------------------

@@ -126,11 +126,26 @@ class ChaosFleet:
             conn.open()
 
     def _make_conn(self, owner, peer):
+        # every endpoint is peer-scoped (peer_id=node<N>): its counters
+        # land process-wide AND under a per-LINK scope, and a doc set
+        # with a connection registry reports it per-connection in
+        # fleet_status() — the chaos suite exercises the same operator
+        # surface a real deployment reads. The scope carries the owner
+        # node too (node/node<owner>/peer/node<peer>/): every fleet
+        # node shares this one process's registry, so two links
+        # targeting the same node (0->2 and 1->2) must not merge into
+        # one peer/node2/ slice the way they never would across real
+        # hosts
+        from ..utils.metrics import metrics
         conn = ResilientConnection(
             self.doc_sets[owner], self._sender(owner, peer),
             batching=self.batching,
             shared_admission=self.node_admission[owner],
-            seed=self.rng.randrange(1 << 30), **self._conn_kwargs)
+            seed=self.rng.randrange(1 << 30),
+            peer_id=f'node{peer}',
+            scope=metrics.scoped(node=f'node{owner}',
+                                 peer=f'node{peer}'),
+            **self._conn_kwargs)
         self.conns[(owner, peer)] = conn
         return conn
 

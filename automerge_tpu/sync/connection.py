@@ -12,6 +12,8 @@ replicas sharing a mesh sync by collective instead of message
 (:mod:`automerge_tpu.parallel`).
 """
 
+import time
+
 from .. import frontend as Frontend
 from ..common import less_or_equal
 from ..utils.metrics import metrics
@@ -182,6 +184,12 @@ class Connection:
         self._send_msg = send_msg
         self._their_clock = {}
         self._our_clock = {}
+        # per-connection metrics routing: defaults to the process-wide
+        # registry; ResilientConnection swaps in a peer-labeled scope
+        # (metrics.scoped(peer=...)) so this connection's counters land
+        # BOTH process-wide and under peer/<id>/ — the per-connection
+        # surface fleet_status() reports
+        self.metrics = metrics
 
     def open(self):
         for doc_id in self._doc_set.doc_ids:
@@ -196,13 +204,17 @@ class Connection:
         self._our_clock = clock_union(self._our_clock, doc_id, clock)
         if changes is not None:
             msg['changes'] = changes
-        metrics.bump('sync_msgs_sent')
+        self.metrics.bump('sync_msgs_sent')
         if changes is not None:
-            metrics.bump('sync_changes_sent', len(changes))
-        if metrics.active:
-            metrics.emit('sync_send', doc_id=doc_id,
-                         changes=len(changes) if changes else 0)
-        self._send_msg(msg)
+            self.metrics.bump('sync_changes_sent', len(changes))
+        if self.metrics.active:
+            self.metrics.emit('sync_send', doc_id=doc_id,
+                              changes=len(changes) if changes else 0)
+        # the span is open across the transport callback so a resilient
+        # shell stamps this span's id into the envelope's trace field
+        # (the cross-peer correlation parent of the receiver's apply)
+        with self.metrics.trace_span('sync.send', doc_id=doc_id):
+            self._send_msg(msg)
 
     def maybe_send_changes(self, doc_id):
         """(connection.js:58-73). Extension over the reference: when the
@@ -240,13 +252,15 @@ class Connection:
             raise original_err
         clock_union(self._their_clock, doc_id, clock)
         clock_union(self._our_clock, doc_id, clock)
-        metrics.bump('sync_snapshots_sent')
-        metrics.bump('sync_msgs_sent')
-        if metrics.active:
-            metrics.emit('sync_send', doc_id=doc_id, changes=0,
-                         snapshot=True)
-        self._send_msg({'docId': doc_id, 'clock': dict(clock),
-                        'snapshot': payload})
+        self.metrics.bump('sync_snapshots_sent')
+        self.metrics.bump('sync_msgs_sent')
+        if self.metrics.active:
+            self.metrics.emit('sync_send', doc_id=doc_id, changes=0,
+                              snapshot=True)
+        with self.metrics.trace_span('sync.send', doc_id=doc_id,
+                                     snapshot=True):
+            self._send_msg({'docId': doc_id, 'clock': dict(clock),
+                            'snapshot': payload})
 
     def doc_changed(self, doc_id, doc):
         """DocSet handler (connection.js:76-89)."""
@@ -265,10 +279,10 @@ class Connection:
         :class:`MessageRejected` (counted under ``sync_msgs_rejected``)
         and leaves ``_their_clock`` untouched."""
         validate_msg(msg)
-        metrics.bump('sync_msgs_received')
-        if metrics.active:
-            metrics.emit('sync_receive', doc_id=msg.get('docId'),
-                         changes=len(msg.get('changes') or ()))
+        self.metrics.bump('sync_msgs_received')
+        if self.metrics.active:
+            self.metrics.emit('sync_receive', doc_id=msg.get('docId'),
+                              changes=len(msg.get('changes') or ()))
         if 'clock' in msg and msg['clock'] is not None:
             self._their_clock = clock_union(self._their_clock, msg['docId'], msg['clock'])
         if 'snapshot' in msg:
@@ -291,7 +305,7 @@ class Connection:
         resync; the peer gets them through the normal protocol)."""
         from .. import snapshot as _snapshot
         doc_id = msg['docId']
-        metrics.bump('sync_snapshots_received')
+        self.metrics.bump('sync_snapshots_received')
         old_doc = self._doc_set.get_doc(doc_id)
         actor_id = Frontend.get_actor_id(old_doc) if old_doc is not None \
             else None
@@ -355,7 +369,7 @@ class BatchingConnection(Connection):
         if isinstance(msg, dict) and 'changes' in msg \
                 and msg['changes'] is not None:
             validate_msg(msg)
-            metrics.bump('sync_msgs_received')
+            self.metrics.bump('sync_msgs_received')
             if 'clock' in msg and msg['clock'] is not None:
                 self._their_clock = clock_union(
                     self._their_clock, msg['docId'], msg['clock'])
@@ -364,8 +378,12 @@ class BatchingConnection(Connection):
         return super().receive_msg(msg)
 
     def flush(self):
-        """Apply every buffered data message in one batched call;
-        returns {doc_id: doc} for the docs that changed.
+        """Apply the tick's buffered traffic in one batched call;
+        returns {doc_id: doc} for the docs that changed. The timing/
+        tracing template for every batched flavor: subclasses override
+        :meth:`_flush_pending` (is there work?) and :meth:`_flush_work`
+        (do it), never this wrapper, so the ``sync.flush`` span and the
+        ``sync_flush_ms`` series stay consistent across protocols.
 
         Faults are isolated PER DOCUMENT: a doc whose changes raise is
         rolled back (the engines' store-intact-on-error contract) and
@@ -374,6 +392,25 @@ class BatchingConnection(Connection):
         registry when it has one (``GeneralDocSet.quarantined``), else
         on :attr:`quarantined` here; quarantined docs are retriable (a
         corrected later delivery clears the entry)."""
+        if not self._flush_pending():
+            # no-op tick: don't let empty flushes pollute the
+            # sync_flush_ms quantiles or fill the flight recorder
+            return {}
+        t0 = time.perf_counter()
+        with self.metrics.trace_span('sync.flush'):
+            out = self._flush_work()
+        self.metrics.observe('sync_flush_ms',
+                             (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _flush_pending(self):
+        return bool(self._incoming)
+
+    def _flush_work(self):
+        return self._flush_data()
+
+    def _flush_data(self):
+        """The buffered-dict-message half of :meth:`flush`."""
         if not self._incoming:
             return {}
         changes_by_doc = {}
@@ -381,8 +418,8 @@ class BatchingConnection(Connection):
             changes_by_doc.setdefault(msg['docId'], []) \
                 .extend(msg['changes'])
         self._incoming = []
-        metrics.bump('sync_changes_received',
-                     sum(len(c) for c in changes_by_doc.values()))
+        self.metrics.bump('sync_changes_received',
+                          sum(len(c) for c in changes_by_doc.values()))
         apply_batch = getattr(self._doc_set, 'apply_changes_batch', None)
         if apply_batch is not None:
             if hasattr(self._doc_set, 'quarantined'):
@@ -421,10 +458,10 @@ class BatchingConnection(Connection):
             except Exception as err:
                 self.quarantined[doc_id] = {'error': repr(err),
                                             'changes': list(changes)}
-                metrics.bump('sync_docs_quarantined')
-                if metrics.active:
-                    metrics.emit('doc_quarantined', doc_id=doc_id,
-                                 error=repr(err))
+                self.metrics.bump('sync_docs_quarantined')
+                if self.metrics.active:
+                    self.metrics.emit('doc_quarantined', doc_id=doc_id,
+                                      error=repr(err))
         return out
 
     receiveMsg = receive_msg
@@ -506,8 +543,8 @@ class WireConnection(BatchingConnection):
     def receive_msg(self, msg):
         if isinstance(msg, dict) and 'wire' in msg:
             validate_wire_msg(msg)
-            metrics.bump('sync_msgs_received')
-            metrics.bump('sync_wire_msgs_received')
+            self.metrics.bump('sync_msgs_received')
+            self.metrics.bump('sync_wire_msgs_received')
             # clock bookkeeping happens immediately, in arrival order —
             # exactly the dict data path
             for doc_id, clock in zip(msg['docs'], msg['clocks']):
@@ -531,13 +568,18 @@ class WireConnection(BatchingConnection):
 
     receiveMsg = receive_msg
 
-    def flush(self):
+    def _flush_pending(self):
+        return bool(self._incoming or self._incoming_wire
+                    or self._pending_send)
+
+    def _flush_work(self):
         """Apply the tick's buffered data (dict messages through the
         batched dict path, wire blobs through ONE fused apply_wire),
         then assemble and ship the single outgoing multi-doc wire
         message the tick's ``doc_changed`` follow-ups asked for.
-        Returns {doc_id: doc} for the docs that changed."""
-        out = super().flush()
+        Returns {doc_id: doc} for the docs that changed — the body
+        :meth:`BatchingConnection.flush` times and traces."""
+        out = self._flush_data()
         out.update(self._flush_wire())
         self._flush_outgoing()
         return out
@@ -565,7 +607,7 @@ class WireConnection(BatchingConnection):
         self._incoming_wire = []
         if not segs_by_doc:
             return {}
-        metrics.bump('sync_changes_received', n_changes)
+        self.metrics.bump('sync_changes_received', n_changes)
         doc_ids = list(segs_by_doc)
         data = b'[' + b','.join(
             b'[' + b','.join(segs) + b']'
@@ -590,7 +632,7 @@ class WireConnection(BatchingConnection):
                                        self.quarantined)
                     registry[doc_id] = {'error': repr(err),
                                         'changes': []}
-                    metrics.bump('sync_docs_quarantined')
+                    self.metrics.bump('sync_docs_quarantined')
             return self._doc_set.apply_changes_batch(
                 changes_by_doc, isolate=True)
         out = dict(zip(doc_ids, handles))
@@ -611,6 +653,11 @@ class WireConnection(BatchingConnection):
         touched."""
         if not self._pending_send:
             return
+        with self.metrics.trace_span('sync.flush_send',
+                                     pending=len(self._pending_send)):
+            self._flush_outgoing_traced()
+
+    def _flush_outgoing_traced(self):
         pending = list(self._pending_send)
         self._pending_send.clear()
         # serving doc sets fault evicted docs back in before the serve
@@ -638,8 +685,13 @@ class WireConnection(BatchingConnection):
                 continue
             if doc_id in self._their_clock:
                 wants.append((idx, self._their_clock[doc_id]))
-        served, errors = store.get_missing_changes_wire_batch(
-            wants, all_clocks=fleet_clocks) if wants else ({}, {})
+        if wants:
+            with self.metrics.trace_span('wire.serve',
+                                         docs=len(wants)):
+                served, errors = store.get_missing_changes_wire_batch(
+                    wants, all_clocks=fleet_clocks)
+        else:
+            served, errors = {}, {}
         docs, clocks, counts, lens, chunks = [], [], [], [], []
         blob_bytes = 0
         data_docs = 0
@@ -698,18 +750,19 @@ class WireConnection(BatchingConnection):
             # next serve re-reads the SAME cached encodings
             for doc_id in deferred:
                 self._pending_send[doc_id] = None
-            metrics.bump('sync_flow_deferred_docs', len(deferred))
-        metrics.set_gauge('sync_flow_backlog_docs',
-                          len(self._pending_send))
+            self.metrics.bump('sync_flow_deferred_docs',
+                              len(deferred))
+        self.metrics.set_gauge('sync_flow_backlog_docs',
+                               len(self._pending_send))
         if not docs:
             return
         blob = b''.join(chunks)
-        metrics.bump('sync_msgs_sent')
-        metrics.bump('sync_wire_msgs_sent')
-        metrics.bump('sync_changes_sent', len(lens))
-        metrics.bump('sync_wire_bytes_sent', len(blob))
-        if metrics.active:
-            metrics.emit('sync_wire_send', docs=len(docs),
-                         changes=len(lens), blob_bytes=len(blob))
+        self.metrics.bump('sync_msgs_sent')
+        self.metrics.bump('sync_wire_msgs_sent')
+        self.metrics.bump('sync_changes_sent', len(lens))
+        self.metrics.bump('sync_wire_bytes_sent', len(blob))
+        if self.metrics.active:
+            self.metrics.emit('sync_wire_send', docs=len(docs),
+                              changes=len(lens), blob_bytes=len(blob))
         self._send_msg({'wire': 1, 'docs': docs, 'clocks': clocks,
                         'counts': counts, 'lens': lens, 'blob': blob})

@@ -18,6 +18,8 @@ and retained log), with ``materialize()`` building the nested JSON
 view from the entry columns and the insertion-tree pool on demand.
 """
 
+import time as _time
+
 import numpy as np
 
 from .. import frontend as Frontend
@@ -27,6 +29,20 @@ from ..utils.metrics import metrics as _metrics
 _ELEM_BIT = _general._ELEM_BIT
 _TYPE_MAP = _general._TYPE_MAP
 _TYPE_TEXT = _general._TYPE_TEXT
+
+
+def _latency_quantiles(series):
+    """{series: {'p50': ms, 'p99': ms, 'count': n}} for the observe
+    series that have samples — the fleet_status() latency block, read
+    from the very histogram series the bench JSON keys report."""
+    out = {}
+    for name in series:
+        count = _metrics.counters.get(name + '.count', 0)
+        if count:
+            out[name] = {'p50': _metrics.quantile(name, 0.5),
+                         'p99': _metrics.quantile(name, 0.99),
+                         'count': count}
+    return out
 
 
 class _GeneralBackendShim:
@@ -113,6 +129,11 @@ class GeneralDocSet:
         # arrived); entries are retriable via retry_quarantined() and
         # clear on any later successful apply for that doc.
         self.quarantined = {}
+        # peer_id -> ResilientConnection: links that identify
+        # themselves (peer_id=...) register here so fleet_status()
+        # can report per-CONNECTION backpressure/admission state
+        # instead of only process-wide counters
+        self.connections = {}
 
     # -- DocSet surface ------------------------------------------------------
 
@@ -244,6 +265,7 @@ class GeneralDocSet:
         return out
 
     def _apply_batch_fused(self, changes_by_doc):
+        t0 = _time.perf_counter()
         idxs = {self._index(doc_id, create=True): changes
                 for doc_id, changes in changes_by_doc.items()}
         # size to the touched prefix, not the capacity — a sparse tick
@@ -251,10 +273,15 @@ class GeneralDocSet:
         per_doc = [[] for _ in range(max(idxs, default=-1) + 1)]
         for idx, changes in idxs.items():
             per_doc[idx] = list(changes)
-        block = self.store.encode_changes(per_doc,
-                                          n_docs=self.capacity)
-        _general.apply_general_block(self.store, block,
-                                     options=self._options)
+        with _metrics.trace_span('doc_set.apply',
+                                 docs=len(changes_by_doc)):
+            with _metrics.trace_span('admit.encode'):
+                block = self.store.encode_changes(per_doc,
+                                                  n_docs=self.capacity)
+            _general.apply_general_block(self.store, block,
+                                         options=self._options)
+        _metrics.observe('sync_apply_ms',
+                         (_time.perf_counter() - t0) * 1e3)
         out = {}
         for doc_id in changes_by_doc:
             doc = self.get_doc(doc_id)
@@ -283,10 +310,16 @@ class GeneralDocSet:
             if not pending:
                 self.quarantined.pop(doc_id, None)
                 out[doc_id] = self.get_doc(doc_id)
+                if _metrics.active:
+                    _metrics.emit('doc_quarantine_cleared',
+                                  doc_id=doc_id, superseded=True)
                 continue
             try:
                 out.update(self._apply_batch_fused({doc_id: pending}))
                 self.quarantined.pop(doc_id, None)
+                if _metrics.active:
+                    _metrics.emit('doc_quarantine_cleared',
+                                  doc_id=doc_id, superseded=False)
             except Exception as err:
                 self.quarantined[doc_id]['error'] = repr(err)
         return out
@@ -395,7 +428,32 @@ class GeneralDocSet:
                 'totals': {'docs': len(self.ids),
                            'capacity': self.capacity,
                            'quarantined': len(self.quarantined),
-                           'dirty': int(n_dirty)}}
+                           'dirty': int(n_dirty)},
+                # per-CONNECTION backpressure/admission/retransmit
+                # state (every peer-identified ResilientConnection
+                # self-registers) — the ROADMAP item: no more process-
+                # wide-counters-only view of a struggling peer. The
+                # counter slices come from ONE bucketed registry pass
+                # (metrics.groups), not a full scan per link
+                'connections': self._connection_statuses(),
+                # tick-path latencies from the SAME histogram series
+                # the bench's *_p50/*_p99 JSON keys read
+                'latency': _latency_quantiles(
+                    ('sync_apply_ms', 'sync_flush_ms'))}
+
+    def _connection_statuses(self):
+        """Per-connection operator rows, the counter slices pre-
+        bucketed by each link's scope prefix in one registry pass."""
+        conns = self.connections
+        if not conns:
+            return {}
+        prefixes = {pid: getattr(conn.metrics, 'prefix', '')
+                    for pid, conn in conns.items()}
+        buckets = _metrics.groups({p for p in prefixes.values() if p})
+        return {pid: conn.connection_status(
+                    scoped=buckets[prefixes[pid]]
+                    if prefixes[pid] else None)
+                for pid, conn in conns.items()}
 
     fleetStatus = fleet_status
 
@@ -413,7 +471,9 @@ class GeneralDocSet:
         Returns the list of touched :class:`GeneralDocHandle`."""
         from ..wire import parse_general_block
         from ..device.blocks import ChangeBlock
-        block = parse_general_block(data, store=self.store)
+        t0 = _time.perf_counter()
+        with _metrics.trace_span('wire.parse', n_bytes=len(data)):
+            block = parse_general_block(data, store=self.store)
         n = block.n_docs
         if doc_ids is None:
             doc_ids = [f'doc-{i}' for i in range(n)]
@@ -437,8 +497,12 @@ class GeneralDocSet:
                 dup_keys=block._dup_keys, obj=block.obj,
                 key_kind=block.key_kind, key_elem=block.key_elem,
                 elem=block.elem, objs=block.objs)
-        _general.apply_general_block(self.store, block,
-                                     options=self._options)
+        with _metrics.trace_span('doc_set.apply_wire',
+                                 docs=len(doc_ids)):
+            _general.apply_general_block(self.store, block,
+                                         options=self._options)
+        _metrics.observe('sync_apply_ms',
+                         (_time.perf_counter() - t0) * 1e3)
         out = []
         for doc_id in doc_ids:
             doc = self.get_doc(doc_id)
@@ -448,6 +512,22 @@ class GeneralDocSet:
         return out
 
     applyWire = apply_wire
+
+    def register_connection(self, peer_id, conn):
+        """Adopt a peer-identified :class:`~.resilient.
+        ResilientConnection` into the operator surface:
+        :meth:`fleet_status` reports its live backpressure/admission/
+        retransmit state per CONNECTION (the link registers itself
+        when constructed with ``peer_id=``)."""
+        self.connections[peer_id] = conn
+
+    registerConnection = register_connection
+
+    def unregister_connection(self, peer_id, conn):
+        if self.connections.get(peer_id) is conn:
+            del self.connections[peer_id]
+
+    unregisterConnection = unregister_connection
 
     def register_handler(self, handler):
         if handler not in self.handlers:
@@ -665,8 +745,10 @@ class GeneralDocSet:
             # version snapshot BEFORE the build: an apply landing
             # mid-build re-dirties these docs rather than being masked
             dirty_vers = {i: store.doc_version(i) for i in dirty}
-            for i, tree in self._build_batch(dirty).items():
-                self._views[i] = (dirty_vers[i], tree)
+            with _metrics.trace_span('doc_set.materialize',
+                                     dirty=len(dirty)):
+                for i, tree in self._build_batch(dirty).items():
+                    self._views[i] = (dirty_vers[i], tree)
         return [self._views[i][1] for i in idxs]
 
     def materialize_all(self):

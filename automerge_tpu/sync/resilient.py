@@ -7,9 +7,18 @@ wraps either Connection flavor in a degraded-operation shell, the
 robustness layer the ROADMAP's "heavy traffic from millions of users"
 north star requires before multi-host sync can be trusted:
 
-- **Versioned envelope** — every logical message travels as ``{'v': 1,
+- **Versioned envelope** — every logical message travels as ``{'v': 2,
   'kind': 'data', 'seq': n, 'sum': crc32(payload), 'payload': msg}``.
-  Unknown versions and malformed envelopes are counted rejections
+  Version 2 adds the optional ``trace`` correlation field, folded into
+  ``sum`` when present — bumped because a v1 receiver would reject a
+  traced envelope's checksum; v1 envelopes (which never carry
+  ``trace``) stay accepted. The version stamps the SHAPE, not the
+  sender's code: an envelope with no trace field (every ack/busy/hb,
+  and data sent with no observer subscribed) is byte-identical to the
+  v1 protocol and is stamped ``v=1``, so an idle-observer deployment
+  interoperates with not-yet-upgraded peers in BOTH directions; only
+  an envelope actually carrying ``trace`` stamps ``v=2``. Unknown
+  versions and malformed envelopes are counted rejections
   (``sync_msgs_rejected``), never crashes.
 - **Checksum** — CRC32 over the canonical-JSON payload; a corrupted
   message is dropped (``sync_checksum_failures``) and NOT acked, so the
@@ -37,13 +46,21 @@ perfectly reproducible from a seed.
 
 import json
 import random
+import time
 import zlib
 
 from ..utils.metrics import metrics
 from .connection import (BatchingConnection, Connection,
                          MessageRejected, WireConnection)
 
-ENVELOPE_VERSION = 1
+BASE_VERSION = 1
+ENVELOPE_VERSION = 2
+# v1: no trace field. v2: checksummed `trace` rides the envelope.
+# Accept both; STAMP by shape — an untraced envelope (acks, busy,
+# heartbeats, and data with no observer subscribed) is byte-identical
+# to the v1 protocol and ships as v=1 so a v1 receiver still accepts
+# it; only an envelope that actually carries `trace` ships as v=2.
+ACCEPTED_VERSIONS = frozenset((BASE_VERSION, ENVELOPE_VERSION))
 
 
 class TokenBucket:
@@ -168,14 +185,41 @@ def payload_checksum(payload):
                                  separators=(',', ':')).encode())
 
 
+def envelope_checksum(payload, trace=None):
+    """The checksum a data envelope carries: the payload checksum,
+    with the optional ``trace`` correlation field folded in exactly
+    like any other header field — a bit flipped in the trace ids is a
+    checksum failure (dropped unacked, repaired by retransmit), never
+    a silently corrupted trace tree. ``trace=None`` (an envelope from
+    a pre-trace sender, or an idle-observer send) degrades to the
+    plain payload checksum, so old envelopes stay acceptable."""
+    head = payload_checksum(payload)
+    if trace is None:
+        return head
+    return zlib.crc32(json.dumps(trace, sort_keys=True,
+                                 separators=(',', ':')).encode(), head)
+
+
+def _valid_trace(trace):
+    """A well-formed envelope trace field: ``{'t': trace_id, 's':
+    span_id}`` with int ids."""
+    return (isinstance(trace, dict) and
+            isinstance(trace.get('t'), int) and
+            not isinstance(trace.get('t'), bool) and
+            isinstance(trace.get('s'), int) and
+            not isinstance(trace.get('s'), bool))
+
+
 class _Unacked:
-    __slots__ = ('envelope', 'due', 'attempts', 'backpressured')
+    __slots__ = ('envelope', 'due', 'attempts', 'backpressured',
+                 'bp_since')
 
     def __init__(self, envelope, due):
         self.envelope = envelope
         self.due = due
         self.attempts = 0
         self.backpressured = False     # last reply was a busy deferral
+        self.bp_since = None           # perf_counter at first busy
 
 
 class ResilientConnection:
@@ -199,7 +243,7 @@ class ResilientConnection:
                  retry_limit=8, backoff_base=2, backoff_max=64,
                  jitter=2, heartbeat_every=16, seed=0,
                  admission=None, shared_admission=None,
-                 max_msg_bytes=None):
+                 max_msg_bytes=None, peer_id=None, scope=None):
         self._send_raw = send_msg
         if wire:
             self._conn = WireConnection(doc_set, self._send_envelope,
@@ -208,6 +252,32 @@ class ResilientConnection:
             conn_cls = BatchingConnection if batching else Connection
             self._conn = conn_cls(doc_set, self._send_envelope)
         self._doc_set = doc_set
+        # per-connection metrics scope: with a peer_id, every counter
+        # this link (and its inner connection) bumps ALSO lands under
+        # peer/<id>/ — the per-connection operator surface
+        # fleet_status() reads back via the doc set's connection
+        # registry (register_connection, when the doc set has one).
+        # `scope` overrides the default peer label when peer ids alone
+        # would collide in ONE process's registry — e.g. the chaos
+        # harness hosts every node in-process, so two different links
+        # targeting the same node must not share a peer/<id>/ slice
+        self.peer_id = peer_id
+        if scope is not None:
+            self.metrics = scope
+        elif peer_id is not None:
+            self.metrics = metrics.scoped(peer=peer_id)
+        else:
+            self.metrics = metrics
+        self._conn.metrics = self.metrics
+        if peer_id is not None:
+            register = getattr(doc_set, 'register_connection', None)
+            if register is not None:
+                register(peer_id, self)
+        # envelope trace refs of the tick's buffered deliveries: the
+        # flush-apply span links back to the sender spans whose data
+        # it merges (cross-peer correlation for the BATCHED paths; the
+        # eager path nests directly under the adopted remote parent)
+        self._deferred_links = []
         # admission control: `admission` is this link's own per-peer
         # controller (an AdmissionControl or its kwargs dict; ticked by
         # this connection), `shared_admission` the node-wide controller
@@ -242,7 +312,22 @@ class ResilientConnection:
     def open(self):
         self._conn.open()
 
-    def close(self):
+    def close(self, drop_scope=False):
+        """Detach from the doc set's connection registry and close the
+        inner connection. The link's ``peer/<id>/`` counter slice is
+        KEPT by default (post-mortem reads); ``drop_scope=True``
+        deletes it — the hook for long-lived processes whose peers
+        churn under fresh ids, where dead slices would otherwise grow
+        the registry without bound."""
+        if self.peer_id is not None:
+            unregister = getattr(self._doc_set,
+                                 'unregister_connection', None)
+            if unregister is not None:
+                unregister(self.peer_id, self)
+        if drop_scope:
+            drop = getattr(self.metrics, 'drop', None)
+            if drop is not None:
+                drop()
         self._conn.close()
 
     def flush(self):
@@ -250,7 +335,20 @@ class ResilientConnection:
         messages (see :meth:`BatchingConnection.flush
         <automerge_tpu.sync.connection.BatchingConnection.flush>`)."""
         flush = getattr(self._conn, 'flush', None)
-        return flush() if flush is not None else {}
+        if flush is None:
+            return {}
+        if self.metrics.active and self._deferred_links:
+            # the flush-apply span LINKS to the sender spans of every
+            # buffered envelope it merges — the batched half of the
+            # cross-peer trace tree (fan-in: several senders' data can
+            # land in one fused apply)
+            links = self._deferred_links
+            self._deferred_links = []
+            with self.metrics.trace_span('sync.flush_deliver',
+                                         links=links):
+                return flush()
+        self._deferred_links = []
+        return flush()
 
     # -- outbound ------------------------------------------------------------
 
@@ -262,11 +360,27 @@ class ResilientConnection:
 
     def _send_envelope(self, msg):
         """The inner connection's send callback: wrap, remember for
-        retransmission, ship."""
+        retransmission, ship. With an observer subscribed, the
+        envelope carries the sender's current span in a compact
+        ``trace`` field (``{'t': trace_id, 's': span_id}`` — the
+        flush/send span this message was assembled under), folded
+        into the envelope checksum like any header field; a receiver
+        adopts it as the remote parent of its delivery spans, which
+        is what stitches one tick's fan-out into a single
+        reconstructable cross-peer tree. Idle observers ship exactly
+        the old envelope shape, and retransmits re-ship the stored
+        envelope bytes — the trace field never re-stamps."""
         self._send_seq += 1
-        env = {'v': ENVELOPE_VERSION, 'kind': 'data',
-               'seq': self._send_seq, 'sum': payload_checksum(msg),
-               'payload': msg}
+        trace = None
+        if self.metrics.active:
+            current = self.metrics.current_trace()
+            if current is not None:
+                trace = {'t': current[0], 's': current[1]}
+        env = {'v': ENVELOPE_VERSION if trace else BASE_VERSION,
+               'kind': 'data', 'seq': self._send_seq, 'payload': msg}
+        if trace is not None:
+            env['trace'] = trace
+        env['sum'] = envelope_checksum(msg, trace)
         self._sent[self._send_seq] = _Unacked(
             env, self._now + self._backoff(0))
         self._send_raw(env)
@@ -274,7 +388,7 @@ class ResilientConnection:
     def _send_ack(self, seq):
         # acks are integrity-checked too: a corrupted ack must not
         # cancel retransmission of a DIFFERENT live envelope
-        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'ack',
+        self._send_raw({'v': BASE_VERSION, 'kind': 'ack',
                         'ack': seq, 'sum': payload_checksum(seq)})
 
     def _send_busy(self, seq, retry_after):
@@ -282,17 +396,27 @@ class ResilientConnection:
         drop) telling the sender when to retry — overload degrades to
         latency, and the sender's counters make the backpressure
         visible."""
-        metrics.bump('sync_busy_sent')
-        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'busy',
+        self.metrics.bump('sync_busy_sent')
+        if self.metrics.active:
+            self.metrics.emit('sync_busy', seq=seq,
+                              retry_after=retry_after)
+        self._send_raw({'v': BASE_VERSION, 'kind': 'busy',
                         'seq': seq, 'retry_after': retry_after,
                         'sum': payload_checksum([seq, retry_after])})
 
     def _bp_clear(self, rec):
         """An unacked envelope left the busy-deferred state (acked or
-        dropped): keep the global depth gauge exact."""
+        dropped): keep the global depth gauge exact, and record how
+        long it sat deferred (the ``sync_busy_wait_ms`` series —
+        monotonic clock, like every duration here)."""
         if rec is not None and rec.backpressured:
             rec.backpressured = False
-            metrics.bump('sync_backpressure_depth', -1)
+            self.metrics.bump('sync_backpressure_depth', -1)
+            if rec.bp_since is not None:
+                self.metrics.observe(
+                    'sync_busy_wait_ms',
+                    (time.perf_counter() - rec.bp_since) * 1e3)
+                rec.bp_since = None
 
     @property
     def backpressure_depth(self):
@@ -327,9 +451,9 @@ class ResilientConnection:
     # -- inbound -------------------------------------------------------------
 
     def _reject(self, reason):
-        metrics.bump('sync_msgs_rejected')
-        if metrics.active:
-            metrics.emit('envelope_rejected', reason=reason)
+        self.metrics.bump('sync_msgs_rejected')
+        if self.metrics.active:
+            self.metrics.emit('envelope_rejected', reason=reason)
         return None
 
     def _seen(self, seq):
@@ -350,7 +474,7 @@ class ResilientConnection:
         if not isinstance(env, dict):
             return self._reject(
                 f'envelope is {type(env).__name__}, not a dict')
-        if env.get('v') != ENVELOPE_VERSION:
+        if env.get('v') not in ACCEPTED_VERSIONS:
             return self._reject(
                 f'unsupported envelope version {env.get("v")!r}')
         kind = env.get('kind')
@@ -359,7 +483,7 @@ class ResilientConnection:
             if not isinstance(seq, int) or isinstance(seq, bool):
                 return self._reject(f'ack seq is not an int: {seq!r}')
             if env.get('sum') != payload_checksum(seq):
-                metrics.bump('sync_checksum_failures')
+                self.metrics.bump('sync_checksum_failures')
                 return self._reject(f'ack checksum mismatch '
                                     f'(ack {seq})')
             rec = self._sent.pop(seq, None)
@@ -378,14 +502,22 @@ class ResilientConnection:
         payload = env.get('payload')
         if not isinstance(payload, dict):
             return self._reject('data envelope has no payload dict')
-        if env.get('sum') != payload_checksum(payload):
+        # the optional trace field is covered by the checksum exactly
+        # like the payload: absent (an old/idle-observer envelope) the
+        # sum degrades to the plain payload checksum, malformed or
+        # bit-flipped it fails the sum and the envelope drops unacked
+        trace = env.get('trace')
+        if trace is not None and not _valid_trace(trace):
+            return self._reject(f'data trace field malformed: '
+                                f'{trace!r}')
+        if env.get('sum') != envelope_checksum(payload, trace):
             # NOT acked: the sender's retransmit re-delivers intact
-            metrics.bump('sync_checksum_failures')
+            self.metrics.bump('sync_checksum_failures')
             return self._reject(f'payload checksum mismatch (seq '
                                 f'{seq})')
         if self._seen(seq):
             self._send_ack(seq)            # the first ack may be lost
-            metrics.bump('sync_msgs_duplicate')
+            self.metrics.bump('sync_msgs_duplicate')
             return None
         # admission control: meter fresh data payloads AFTER integrity
         # and duplicate checks (a dup was already paid for) and BEFORE
@@ -414,7 +546,7 @@ class ResilientConnection:
         # really apply), so flush-time failures are repaired at the
         # quarantine layer, not by envelope retransmit
         try:
-            out = self._conn.receive_msg(payload)
+            out = self._deliver(env, payload, trace)
         except MessageRejected:
             # schema-invalid at ORIGIN (checksum passed): retransmits
             # cannot fix it, so ack + consume the seq; counted by the
@@ -428,14 +560,47 @@ class ResilientConnection:
             # retransmit redelivers and a transient cause heals; a
             # permanent one exhausts the budget and falls to the
             # anti-entropy loop. Either way the sync loop survives.
-            metrics.bump('sync_apply_failures')
-            if metrics.active:
-                metrics.emit('sync_apply_failure', seq=seq,
-                             error=repr(err))
+            self.metrics.bump('sync_apply_failures')
+            if self.metrics.active:
+                self.metrics.emit('sync_apply_failure', seq=seq,
+                                  error=repr(err))
             return None
         self._send_ack(seq)
         self._mark_seen(seq)
         return out
+
+    def _deliver(self, env, payload, trace):
+        """Hand one fresh, integrity-checked data payload to the inner
+        protocol, under the sender's trace context when one rode the
+        envelope: the eager path's apply spans nest directly beneath
+        the remote parent; the batched paths buffer, so the (trace,
+        span) ref is remembered and LINKED from the tick's flush span
+        (:meth:`flush`). No observer, no overhead: straight
+        delivery."""
+        if not self.metrics.active or trace is None:
+            return self._conn.receive_msg(payload)
+        ref = (trace['t'], trace['s'])
+        before = self._buffered_depth()
+        with self.metrics.trace_context(*ref):
+            with self.metrics.trace_span('envelope.recv',
+                                         seq=env.get('seq')):
+                out = self._conn.receive_msg(payload)
+        # link only what the flush will actually merge: a rejected or
+        # failed payload contributes nothing (the exception skips
+        # this), and an eagerly-handled one (snapshot, clock-only
+        # advertisement) already traced under envelope.recv — only a
+        # delivery that grew the inner buffers rides the tick's
+        # flush-deliver links
+        if self._buffered_depth() > before:
+            self._deferred_links.append(ref)
+        return out
+
+    def _buffered_depth(self):
+        """How many messages the inner connection is holding for its
+        next flush (0 for the eager flavor, which buffers nothing)."""
+        conn = self._conn
+        return (len(getattr(conn, '_incoming', ())) +
+                len(getattr(conn, '_incoming_wire', ())))
 
     def _receive_busy(self, env):
         """The peer's admission valve deferred our data envelope:
@@ -453,23 +618,28 @@ class ResilientConnection:
             return self._reject(f'busy seq/retry_after malformed: '
                                 f'{seq!r}/{retry_after!r}')
         if env.get('sum') != payload_checksum([seq, retry_after]):
-            metrics.bump('sync_checksum_failures')
+            self.metrics.bump('sync_checksum_failures')
             return self._reject(f'busy checksum mismatch (seq {seq})')
         rec = self._sent.get(seq)
         if rec is None:
             return None                # already acked/dropped
-        metrics.bump('sync_busy_received')
+        self.metrics.bump('sync_busy_received')
         rec.attempts += 1
         if rec.attempts >= self.retry_limit:
             del self._sent[seq]
             self._bp_clear(rec)
-            metrics.bump('sync_retry_exhausted')
-            metrics.bump('sync_retry_exhausted_backpressure')
+            self.metrics.bump('sync_retry_exhausted')
+            self.metrics.bump('sync_retry_exhausted_backpressure')
             self._forget_delivery(rec.envelope.get('payload'))
+            # same event the timeout path emits: a flight-recorder
+            # incident must show backpressure-driven exhaustion too
+            if self.metrics.active:
+                self.metrics.emit('sync_retry_exhausted', seq=seq)
             return None
         if not rec.backpressured:
             rec.backpressured = True
-            metrics.bump('sync_backpressure_depth')
+            rec.bp_since = time.perf_counter()
+            self.metrics.bump('sync_backpressure_depth')
         # the hint is clamped to the backoff ceiling: a hard-shut (or
         # hostile) peer advertising an enormous retry-after must not
         # park the envelope forever — bounded re-attempts keep burning
@@ -486,9 +656,9 @@ class ResilientConnection:
         if not isinstance(clocks, dict):
             return self._reject('heartbeat has no clocks dict')
         if env.get('sum') != payload_checksum(clocks):
-            metrics.bump('sync_checksum_failures')
+            self.metrics.bump('sync_checksum_failures')
             return self._reject('heartbeat checksum mismatch')
-        metrics.bump('sync_heartbeats_received')
+        self.metrics.bump('sync_heartbeats_received')
         doc_set = self._conn._doc_set
         # membership only: get_doc would mint (and cache) a handle per
         # advertised doc, ~fleet-size allocations per beat on general/
@@ -502,6 +672,9 @@ class ResilientConnection:
                 # we requested this doc once but the data never landed
                 # (e.g. the sender's budget exhausted against our own
                 # busy valve) — re-request, bounded by the beat period
+                if doc_id in self._conn._our_clock and \
+                        self.metrics.active:
+                    self.metrics.emit('heartbeat_heal', doc_id=doc_id)
                 self._conn._our_clock.pop(doc_id, None)
             try:
                 # a heartbeat entry IS an advertisement: the normal
@@ -533,15 +706,19 @@ class ResilientConnection:
                 # heartbeat's re-advertisement regenerates whatever
                 # this envelope carried once the link heals
                 del self._sent[seq]
-                metrics.bump('sync_retry_exhausted')
+                self.metrics.bump('sync_retry_exhausted')
                 if rec.backpressured:
-                    metrics.bump('sync_retry_exhausted_backpressure')
+                    self.metrics.bump(
+                        'sync_retry_exhausted_backpressure')
                 self._bp_clear(rec)
                 self._forget_delivery(rec.envelope.get('payload'))
+                if self.metrics.active:
+                    self.metrics.emit('sync_retry_exhausted',
+                                      seq=seq)
                 continue
             rec.attempts += 1
             rec.due = self._now + self._backoff(rec.attempts)
-            metrics.bump('sync_retransmits')
+            self.metrics.bump('sync_retransmits')
             payload = rec.envelope.get('payload')
             if isinstance(payload, dict) and \
                     isinstance(payload.get('blob'), (bytes, bytearray)):
@@ -549,8 +726,11 @@ class ResilientConnection:
                 # encode cache served the first time — this counter is
                 # the degraded-link bench's "bytes re-served with zero
                 # re-encode" figure
-                metrics.bump('sync_retransmit_wire_bytes',
-                             len(payload['blob']))
+                self.metrics.bump('sync_retransmit_wire_bytes',
+                                  len(payload['blob']))
+            if self.metrics.active:
+                self.metrics.emit('sync_retransmit', seq=seq,
+                                  attempt=rec.attempts)
             self._send_raw(rec.envelope)
         if self.heartbeat_every and \
                 self._now % self.heartbeat_every == 0:
@@ -589,8 +769,8 @@ class ResilientConnection:
                 clocks[doc_id] = dict(state.clock)
         if not clocks:
             return
-        metrics.bump('sync_heartbeats_sent')
-        self._send_raw({'v': ENVELOPE_VERSION, 'kind': 'hb',
+        self.metrics.bump('sync_heartbeats_sent')
+        self._send_raw({'v': BASE_VERSION, 'kind': 'hb',
                         'sum': payload_checksum(clocks),
                         'clocks': clocks})
 
@@ -598,6 +778,53 @@ class ResilientConnection:
     def in_flight(self):
         """Unacked outbound envelopes (retransmission candidates)."""
         return len(self._sent)
+
+    # -- operator surface ----------------------------------------------------
+
+    def connection_status(self, scoped=None):
+        """This link's slice of the operator surface (what a doc set's
+        ``fleet_status()`` reports per CONNECTION instead of only via
+        process-wide counters): live protocol state plus — when the
+        link is peer-scoped — the peer's own counter slice
+        (``peer/<id>/``). Admission debt is the negative token balance
+        the debt buckets are currently paying off (0 = open valve).
+        ``scoped`` lets a caller polling MANY links (fleet_status)
+        hand in this link's pre-bucketed counter slice from one
+        registry pass instead of paying a full-registry scan per
+        connection."""
+        if scoped is None:
+            scoped = self.metrics.group() \
+                if self.peer_id is not None else {}
+
+        def debt_of(ctrl):
+            if ctrl is None:
+                return None
+            out = {}
+            for label, bucket in (('changes', ctrl.change_bucket),
+                                  ('bytes', ctrl.byte_bucket)):
+                if bucket is not None:
+                    out[label] = max(0, -bucket.tokens)
+            return out
+
+        return {
+            'peer': self.peer_id,
+            'in_flight': len(self._sent),
+            'backpressure_depth': self.backpressure_depth,
+            'busy_sent': scoped.get('sync_busy_sent', 0),
+            'busy_received': scoped.get('sync_busy_received', 0),
+            'retransmits': scoped.get('sync_retransmits', 0),
+            'retry_exhausted': scoped.get('sync_retry_exhausted', 0),
+            'msgs_sent': scoped.get('sync_msgs_sent', 0),
+            'msgs_received': scoped.get('sync_msgs_received', 0),
+            'flow_backlog_docs':
+                len(getattr(self._conn, '_pending_send', ()) or ()),
+            'flow_deferred_docs':
+                scoped.get('sync_flow_deferred_docs', 0),
+            'admission_debt': debt_of(self.admission),
+            'shared_admission_debt': debt_of(self.shared_admission),
+        }
+
+    connectionStatus = connection_status
 
     # camelCase aliases (reference API style)
     receiveMsg = receive_msg

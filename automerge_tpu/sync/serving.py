@@ -52,10 +52,11 @@ import os
 import time
 
 from ..device import general as _general
-from ..durability import read_park_shard, write_park_shard
+from ..durability import (dump_incident, read_park_shard,
+                          write_park_shard)
 from ..utils.metrics import metrics
 from .general_doc_set import (GeneralDocHandle, _GeneralState,
-                              GeneralDocSet)
+                              GeneralDocSet, _latency_quantiles)
 
 
 def _covers(have, clock):
@@ -109,12 +110,17 @@ class ServingDocSet:
     ``park_quarantined_after`` / ``park_quarantined_bytes`` — age (in
     ticks) and stored-changes size caps that park a stuck quarantined
     doc (None = keep the unbounded in-memory hold).
+    ``flight_recorder`` — a :class:`~automerge_tpu.utils.metrics.
+    FlightRecorder`; when given, it is subscribed to the metrics bus
+    and its retained events dump as an incident file (under
+    ``<dir_path>/incidents/``) the FIRST time each doc quarantines —
+    the black box of the seconds before the poison landed.
     """
 
     def __init__(self, doc_set, dir_path, memory_budget_bytes=None,
                  low_watermark=0.75, check_every=32, shard_docs=64,
                  park_quarantined_after=None,
-                 park_quarantined_bytes=None):
+                 park_quarantined_bytes=None, flight_recorder=None):
         inner = getattr(doc_set, 'doc_set', doc_set)
         if not isinstance(inner, GeneralDocSet):
             raise TypeError(
@@ -144,7 +150,10 @@ class ServingDocSet:
         self._n_faultins = 0
         self._n_parked = 0
         self.resident_bytes = 0
-        self.faultin_ms = []           # last fault-in latencies (ms)
+        self.flight_recorder = flight_recorder
+        if flight_recorder is not None:
+            metrics.subscribe(flight_recorder)   # idempotent
+        self._incident_seen = set()    # docs whose quarantine dumped
         self._reconcile_park_dir()
 
     # -- recovery ------------------------------------------------------------
@@ -198,13 +207,27 @@ class ServingDocSet:
         reconciliation. Journal records for docs evicted at crash time
         replay onto the empty store (causally buffering what needs the
         parked history) and complete on the doc's first fault-in — no
-        acknowledged change is ever lost."""
+        acknowledged change is ever lost. With a ``flight_recorder``
+        in ``serving_kwargs``, the recorder is subscribed up front (so
+        the replay's own events are retained) and dumped as ONE
+        recovery incident file once the stack is reconciled."""
         from ..durability import DurableDocSet
+        recorder = serving_kwargs.get('flight_recorder')
+        if recorder is not None:
+            metrics.subscribe(recorder)
         durable = DurableDocSet.recover(
             dir_path,
             lambda: GeneralDocSet(capacity, options=options),
             load_snapshot=GeneralDocSet.load_snapshot, fsync=fsync)
-        return cls(durable, dir_path, **serving_kwargs)
+        out = cls(durable, dir_path, **serving_kwargs)
+        if recorder is not None:
+            dump_incident(recorder, dir_path, 'recovery',
+                          evicted=len(out._evicted),
+                          quarantined=len(out.inner.quarantined))
+            # a recovered quarantine hold is not a FRESH incident —
+            # only a new poisoning after this point dumps again
+            out._incident_seen.update(out.inner.quarantined)
+        return out
 
     # -- proxy surface -------------------------------------------------------
 
@@ -240,11 +263,25 @@ class ServingDocSet:
             lt[doc_id] = t
 
     def _after_write(self):
+        if self.flight_recorder is not None:
+            self._check_incidents()
         self._ops_since_check += 1
         if self.memory_budget_bytes is not None and \
                 self._ops_since_check >= self.check_every:
             self._ops_since_check = 0
             self._enforce_budget()
+
+    def _check_incidents(self):
+        """Dump the flight recorder on the FIRST quarantine of each
+        doc — one incident file per doc, ever (a retry loop on a
+        poisoned doc must not fill the disk with identical dumps)."""
+        for doc_id in self.inner.quarantined:
+            if doc_id not in self._incident_seen:
+                self._incident_seen.add(doc_id)
+                dump_incident(
+                    self.flight_recorder, self.dir_path, 'quarantine',
+                    doc_id=doc_id,
+                    error=self.inner.quarantined[doc_id].get('error'))
 
     # -- residency -----------------------------------------------------------
 
@@ -288,6 +325,19 @@ class ServingDocSet:
         (the apply path is deterministic on the change set), parked
         quarantine records return to the in-memory registry."""
         t0 = time.perf_counter()
+        span = metrics.trace_span('serving.fault_in',
+                                  docs=len(doc_ids))
+        with span:
+            self._fault_in_traced(doc_ids)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._n_faultins += len(doc_ids)
+        metrics.bump('serving_faultins', len(doc_ids))
+        metrics.observe('serving_faultin_ms', dt_ms)
+        if metrics.active:
+            metrics.emit('serving_faultin', n=len(doc_ids),
+                         docs=list(doc_ids[:64]))
+
+    def _fault_in_traced(self, doc_ids):
         inner = self.inner
         store = inner.store
         by_shard = {}
@@ -324,18 +374,20 @@ class ServingDocSet:
         for doc_id in doc_ids:
             self._evicted.pop(doc_id, None)
             self._last_touch[doc_id] = self._tick
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        self._n_faultins += len(doc_ids)
-        metrics.bump('serving_faultins', len(doc_ids))
-        metrics.observe('serving_faultin_ms', dt_ms)
-        if len(self.faultin_ms) < 4096:
-            self.faultin_ms.append(dt_ms)
 
     def _evict(self, doc_ids, parked=False):
         """Park ``doc_ids`` to durable shards, then release their
         store state. The shard write lands (atomic, fsync'd) BEFORE
         the drop — a crash anywhere leaves either the old in-memory
         truth (disk state unchanged) or a complete shard."""
+        with metrics.trace_span('serving.evict', docs=len(doc_ids),
+                                parked=parked):
+            self._evict_traced(doc_ids, parked)
+        if metrics.active:
+            metrics.emit('serving_evict', n=len(doc_ids),
+                         parked=parked, docs=list(doc_ids[:64]))
+
+    def _evict_traced(self, doc_ids, parked):
         inner = self.inner
         payloads = inner.extract_doc_state(doc_ids)
         for doc_id in doc_ids:
@@ -441,6 +493,8 @@ class ServingDocSet:
         self.maintenance()
 
     def maintenance(self):
+        if self.flight_recorder is not None:
+            self._check_incidents()
         self._park_stuck_quarantine()
         self._enforce_budget()
 
@@ -617,6 +671,25 @@ class ServingDocSet:
             'wire_cache_bytes': self.inner.store._wire_cache_bytes,
             'backpressure_depth':
                 counters.get('sync_backpressure_depth', 0)})
+        # the serving-side latency series join the inner sync ones —
+        # all read from the SAME histograms the bench's p50/p99 keys
+        # report (no private timers anywhere on this surface)
+        status['latency'].update(_latency_quantiles(
+            ('serving_faultin_ms', 'sync_busy_wait_ms',
+             'journal_fsync_ms')))
         return status
 
     fleetStatus = fleet_status
+
+    def close(self):
+        """Detach from the process-wide metrics bus (unsubscribe this
+        set's flight recorder so a discarded serving stack does not
+        keep the no-subscriber fast path off, nor the recorder alive,
+        for the rest of the process) AND close the wrapped doc set —
+        this override would otherwise shadow the durable stack's
+        journal-handle close behind ``__getattr__``. Idempotent."""
+        if self.flight_recorder is not None:
+            metrics.unsubscribe(self.flight_recorder)
+        inner_close = getattr(self.doc_set, 'close', None)
+        if inner_close is not None:
+            inner_close()

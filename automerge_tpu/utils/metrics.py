@@ -1,20 +1,41 @@
-"""Observability: counters, a structured event stream, profiler hooks.
+"""Observability: counters, histograms, spans, a structured event stream.
 
 The reference has no tracing/metrics at all (SURVEY.md §5: zero logging
 calls; its only introspection is getHistory/inspect and DocSet handler
-callbacks). This module adds the observability layer the TPU build is
-specified to carry: cheap process-wide counters (ops applied, changes
-applied, conflicts detected, queue depth, device batch occupancy), a
-structured event stream for subscribers, and a context manager bridging
-to the JAX profiler for on-device tracing.
+callbacks). This module is the observability layer the TPU build is
+specified to carry:
+
+- **Counters / gauges** — cheap process-wide counts (ops applied,
+  changes applied, conflicts detected, queue depth, every fault and
+  serving counter in the registries below).
+- **Histograms** — :meth:`Metrics.observe` series keep fixed log-spaced
+  buckets alongside count/sum/max, so :meth:`Metrics.quantile` serves
+  p50/p99 for apply, flush, fault-in, busy-wait and journal-fsync
+  latencies OUTSIDE of bench runs — ``fleet_status()`` and ``bench_*``
+  report from the SAME series.
+- **Spans** — :meth:`Metrics.trace_span` is a context manager emitting
+  one ``span`` event per exit (name, trace/span/parent ids, duration
+  from ``perf_counter``). Spans nest per thread; a remote parent adopts
+  via :meth:`Metrics.trace_context` — the cross-peer causal correlation
+  the sync envelopes carry (``sync/resilient.py``).
+- **Event stream** — :meth:`Metrics.emit` calls every subscriber
+  synchronously; :class:`FlightRecorder` is the bounded ring-buffer
+  subscriber the serving layer dumps on incidents (crash recovery,
+  first quarantine of a doc).
+- **Scoped views** — :meth:`Metrics.scoped` returns a labeled child
+  whose writes land BOTH process-wide and under ``peer/<id>/<name>`` —
+  the per-connection metrics surface ``fleet_status()`` reports.
 
 Everything is no-op-cheap when nothing subscribes: counter bumps are one
-dict add; events are only materialized if a subscriber is registered.
+dict add; events are only materialized if a subscriber is registered;
+``trace_span`` returns a shared null context manager.
 """
 
+import math
+import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
 
 # Fault-path counters (the degraded-operation observability contract —
@@ -59,10 +80,15 @@ FAULT_COUNTERS = (
 #                              after a capped flush
 #   sync_wire_cache_bytes      gauge: resident bytes of the per-change
 #                              encode cache (drops on doc eviction)
+#   sync_busy_wait_ms          observe series: wall time an envelope
+#                              spent deferred by busy replies before
+#                              its eventual ack
 #   serving_evictions          cold docs evicted to durable parked
 #                              snapshots (memory-budget enforcement)
 #   serving_faultins           evicted docs transparently faulted back
 #                              in by a touch
+#   serving_faultin_ms         observe series: fault-in latency
+#   serving_resident_bytes     gauge: estimated resident fleet bytes
 #   serving_docs_parked        ALERT: stuck quarantined docs aged out
 #                              of the in-memory hold to a parked
 #                              snapshot
@@ -72,21 +98,150 @@ FAULT_COUNTERS = (
 SERVING_COUNTERS = (
     'sync_busy_sent', 'sync_busy_received', 'sync_backpressure_depth',
     'sync_flow_deferred_docs', 'sync_flow_backlog_docs',
-    'sync_wire_cache_bytes', 'serving_evictions', 'serving_faultins',
+    'sync_wire_cache_bytes', 'sync_busy_wait_ms',
+    'serving_evictions', 'serving_faultins', 'serving_faultin_ms',
+    'serving_resident_bytes',
     'serving_docs_parked', 'serving_evictions_blocked_truncated')
+
+# Sync traffic counters + latency series (the steady-state half of the
+# sync_/serving_ namespace — everything that is neither a fault nor an
+# overload signal lives here, so the registry-drift guard in
+# tests/test_metrics.py can assert the THREE registries together cover
+# every literal sync_/serving_ name bumped anywhere in the package):
+#   sync_msgs_sent/_received         logical protocol messages
+#   sync_changes_sent/_received      change payloads inside them
+#   sync_snapshots_sent/_received    snapshot fallbacks for truncated
+#                                    logs
+#   sync_wire_msgs_sent/_received    multi-doc columnar data messages
+#   sync_wire_bytes_sent             their blob bytes
+#   sync_apply_ms                    observe series: doc-set fused
+#                                    apply latency (dict + wire paths)
+#   sync_flush_ms                    observe series: connection flush
+#                                    latency (apply + outgoing send)
+SYNC_COUNTERS = (
+    'sync_msgs_sent', 'sync_msgs_received',
+    'sync_changes_sent', 'sync_changes_received',
+    'sync_snapshots_sent', 'sync_snapshots_received',
+    'sync_wire_msgs_sent', 'sync_wire_msgs_received',
+    'sync_wire_bytes_sent', 'sync_apply_ms', 'sync_flush_ms')
+
+
+# -- histogram geometry --------------------------------------------------------
+#
+# Fixed log-spaced buckets shared by every observe series: bucket b
+# covers (LO * R^(b-1), LO * R^b], b=0 holds everything <= LO. With
+# LO=1e-3 and R=1.25 the 96 buckets span 1 microsecond to ~27 minutes
+# on a millisecond-unit series at +-12% quantile resolution — plenty
+# for latency reporting, and one int list per series (created lazily)
+# keeps observe at O(1) memory.
+HIST_LO = 1e-3
+HIST_RATIO = 1.25
+HIST_BUCKETS = 96
+_LOG_RATIO = math.log(HIST_RATIO)
+
+
+def _bucket_of(value):
+    if value <= HIST_LO:
+        return 0
+    return min(int(math.log(value / HIST_LO) / _LOG_RATIO) + 1,
+               HIST_BUCKETS - 1)
+
+
+def _bucket_value(b):
+    """Representative value of bucket ``b`` (geometric midpoint)."""
+    if b <= 0:
+        return HIST_LO
+    return HIST_LO * HIST_RATIO ** (b - 0.5)
+
+
+class _NullSpan:
+    """The shared no-subscriber span: enter/exit are attribute-free
+    no-ops, so an idle observer costs one truthiness check per
+    ``trace_span`` call site."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: ids minted at ``__enter__``, duration from
+    ``perf_counter`` (monotonic — wall clocks are for event
+    timestamps, never durations), emitted as one ``span`` event at
+    ``__exit__``. Spans nest per THREAD (the async applier thread gets
+    its own stack); a root span's trace id is its own span id."""
+
+    __slots__ = ('_m', 'name', 'trace', 'span', 'parent', '_attrs',
+                 '_links', '_t0')
+
+    def __init__(self, m, name, links, attrs):
+        self._m = m
+        self.name = name
+        self._links = links
+        self._attrs = attrs
+
+    def __enter__(self):
+        m = self._m
+        with m._lock:
+            m._span_seq += 1
+            sid = m._span_seq
+        stack = m._span_stack()
+        if stack:
+            self.trace, self.parent = stack[-1]
+        else:
+            self.trace, self.parent = sid, 0
+        self.span = sid
+        stack.append((self.trace, sid))
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, err, tb):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        stack = self._m._span_stack()
+        if stack and stack[-1][1] == self.span:
+            stack.pop()
+        fields = dict(self._attrs)
+        if self._links:
+            fields['links'] = [list(ln) for ln in self._links]
+        if err is not None:
+            fields['error'] = repr(err)
+        self._m.emit('span', name=self.name, trace=self.trace,
+                     span=self.span, parent=self.parent,
+                     dur_ms=dur_ms, **fields)
+        return False
 
 
 class Metrics:
-    """One counter registry + event bus (a process-wide default lives at
-    module level; tests can construct private instances)."""
+    """One counter registry + histogram store + span source + event bus
+    (a process-wide default lives at module level; tests can construct
+    private instances)."""
 
     def __init__(self):
         self.counters = defaultdict(int)
+        self._hists = {}               # series name -> [bucket counts]
         self._subscribers = []
         # counter updates are read-modify-write; the async applier
         # thread (device.general) and the main thread share this
-        # registry, so the updates take a (cheap, per-batch) lock
+        # registry, so the updates take a (cheap, per-batch) lock.
+        # The subscriber list mutates ONLY under this lock too, by
+        # swap-on-write — emit iterates a snapshot reference, so a
+        # concurrent subscribe/unsubscribe can never corrupt the walk
         self._lock = threading.Lock()
+        # span/trace ids are minted by incrementing from a random
+        # 48-bit-aligned base, NOT from 0: two hosts exchanging trace
+        # context through envelopes (cross-peer correlation) must not
+        # collide on ids minted independently — sequential-from-zero
+        # ids would merge unrelated trees the moment a second process
+        # joins the fleet
+        self._span_seq = int.from_bytes(os.urandom(6), 'big') << 16
+        self._tls = threading.local()
 
     # -- counters ----------------------------------------------------------
 
@@ -101,18 +256,49 @@ class Metrics:
     def observe(self, name, value):
         """Record one sample of a duration/size series: keeps count,
         sum and max under ``<name>.count`` / ``.sum`` / ``.max`` (the
-        staging-time counters of the general engine ride this). Cheap:
-        three dict writes, no history retained."""
+        staging-time counters of the general engine ride this) PLUS a
+        fixed log-spaced bucket histogram serving
+        :meth:`quantile` — ``fleet_status()`` p50/p99s and the bench's
+        ``*_p50``/``*_p99`` JSON keys read the same series. Cheap:
+        three dict writes, one log, one list add; no sample history
+        retained."""
         with self._lock:
-            self.counters[name + '.count'] += 1
-            self.counters[name + '.sum'] += value
-            if value > self.counters[name + '.max']:
-                self.counters[name + '.max'] = value
+            self._observe_locked(name, value)
+
+    def _observe_locked(self, name, value):
+        self.counters[name + '.count'] += 1
+        self.counters[name + '.sum'] += value
+        if value > self.counters[name + '.max']:
+            self.counters[name + '.max'] = value
+        hist = self._hists.get(name)
+        if hist is None:
+            hist = self._hists[name] = [0] * HIST_BUCKETS
+        hist[_bucket_of(value)] += 1
 
     def mean(self, name):
         """Mean of an :meth:`observe` series (0.0 when empty)."""
         n = self.counters.get(name + '.count', 0)
         return self.counters.get(name + '.sum', 0) / n if n else 0.0
+
+    def quantile(self, name, q):
+        """Quantile ``q`` (0..1) of an :meth:`observe` series from its
+        log-spaced buckets (+-12% bucket resolution; 0.0 when the
+        series is empty). ``quantile('sync_apply_ms', 0.99)`` is the
+        live p99 the bench and ``fleet_status()`` both report."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                return 0.0
+            total = sum(hist)
+            if not total:
+                return 0.0
+            target = max(1, math.ceil(q * total))
+            acc = 0
+            for b, n in enumerate(hist):
+                acc += n
+                if acc >= target:
+                    return _bucket_value(b)
+            return _bucket_value(HIST_BUCKETS - 1)
 
     def snapshot(self):
         # same lock as bump(): dict(d) iterates, and the async applier
@@ -125,36 +311,307 @@ class Metrics:
         bench-summary view of counter families like the general
         engine's per-variant apply counts (`general_variant_*_applies`)
         and mirror format conversions (`general_mirror_convert_*`),
-        which make a fleet silently running a slow fallback visible."""
+        which make a fleet silently running a slow fallback visible.
+        Also the per-peer read: ``group('peer/<id>/')`` is one
+        connection's counters (see :meth:`scoped`)."""
         with self._lock:
             return {name[len(prefix):]: value
                     for name, value in self.counters.items()
                     if name.startswith(prefix)}
 
+    def groups(self, prefixes):
+        """``{prefix: {suffix: value}}`` for many prefixes in ONE
+        registry pass — a caller polling every per-connection scope
+        (``fleet_status()``) must not pay a full-registry scan per
+        link, which goes quadratic in fleet size as each link's scan
+        walks every other link's counters."""
+        buckets = {p: {} for p in prefixes}
+        by_len = defaultdict(set)
+        for p in buckets:
+            by_len[len(p)].add(p)
+        with self._lock:
+            for name, value in self.counters.items():
+                for ln, heads in by_len.items():
+                    head = name[:ln]
+                    if head in heads:
+                        buckets[head][name[ln:]] = value
+        return buckets
+
     def reset(self):
         with self._lock:
             self.counters.clear()
+            self._hists.clear()
+
+    def reset_series(self, name):
+        """Clear ONE observe series (histogram + count/sum/max) — the
+        bench uses this to scope a measured phase without wiping the
+        whole registry."""
+        with self._lock:
+            self._hists.pop(name, None)
+            for suffix in ('.count', '.sum', '.max'):
+                self.counters.pop(name + suffix, None)
+
+    # -- scoped child views ------------------------------------------------
+
+    def scoped(self, **labels):
+        """A labeled child view: every ``bump``/``set_gauge``/
+        ``observe`` lands BOTH process-wide and under the label prefix
+        (``metrics.scoped(peer='p1').bump('sync_retransmits')`` writes
+        ``sync_retransmits`` AND ``peer/p1/sync_retransmits``), and
+        every ``emit`` carries the labels as event fields. This is the
+        per-connection surface: the aggregate dashboards keep working,
+        and ``fleet_status()`` reads one peer's slice via
+        ``group('peer/<id>/')``."""
+        prefix = ''.join(f'{k}/{v}/' for k, v in sorted(labels.items()))
+        return _ScopedMetrics(self, prefix, labels)
+
+    def drop_scope(self, prefix):
+        """Delete every counter under a scope prefix (``peer/<id>/``).
+        Scoped slices are plain registry keys, so they outlive their
+        connection by design (post-mortem reads after ``close()``);
+        a long-lived process whose peers churn under FRESH ids calls
+        this (usually via ``ResilientConnection.close(
+        drop_scope=True)``) so dead slices cannot grow the registry
+        without bound. Aggregate counters are untouched."""
+        if not prefix:
+            return
+        with self._lock:
+            for name in [n for n in self.counters
+                         if n.startswith(prefix)]:
+                del self.counters[name]
 
     # -- event stream ------------------------------------------------------
 
     def subscribe(self, handler):
-        """handler(event: dict) — called synchronously on every emit."""
-        if handler not in self._subscribers:
-            self._subscribers.append(handler)
+        """handler(event: dict) — called synchronously on every emit.
+        Thread-safe: the list swaps under the registry lock, so a
+        subscribe racing an emit on another thread sees either the old
+        or the new list, never a half-mutated one."""
+        with self._lock:
+            if handler not in self._subscribers:
+                self._subscribers = self._subscribers + [handler]
 
     def unsubscribe(self, handler):
-        self._subscribers = [h for h in self._subscribers if h != handler]
+        with self._lock:
+            self._subscribers = [h for h in self._subscribers
+                                 if h != handler]
 
     @property
     def active(self):
         return bool(self._subscribers)
 
     def emit(self, event, **fields):
+        subscribers = self._subscribers    # swap-on-write snapshot
+        if not subscribers:
+            return
+        # ts (wall clock) is the event TIMESTAMP; mono (perf_counter)
+        # is for durations/ordering — wall clocks step under NTP, so
+        # subtracting two ts values is never a duration
+        record = {'event': event, 'ts': time.time(),
+                  'mono': time.perf_counter(), **fields}
+        for handler in subscribers:
+            handler(record)
+
+    # -- spans -------------------------------------------------------------
+
+    def _span_stack(self):
+        stack = getattr(self._tls, 'spans', None)
+        if stack is None:
+            stack = self._tls.spans = []
+        return stack
+
+    def trace_span(self, name, links=None, **attrs):
+        """Context manager tracing one tick-path stage. Same contract
+        as :meth:`emit`: with no subscriber this returns a shared
+        null span (one truthiness check, no allocation beyond the
+        caller's kwargs). With a subscriber, entering mints a span id,
+        nests under the thread's current span (or starts a new trace),
+        and exiting emits ONE ``span`` event carrying name,
+        trace/span/parent ids, ``dur_ms`` (monotonic), optional
+        ``links`` (cross-trace references, e.g. the envelopes a batched
+        flush merged) and the given attrs."""
+        if not self._subscribers:
+            return _NULL_SPAN
+        return _Span(self, name, links, attrs)
+
+    def current_trace(self):
+        """(trace_id, span_id) of the calling thread's innermost open
+        span, or None — what an envelope stamps into its ``trace``
+        field at send time."""
+        stack = getattr(self._tls, 'spans', None)
+        return stack[-1] if stack else None
+
+    def span_event(self, name, dur_ms, **attrs):
+        """Emit one COMPLETED span with an explicitly measured
+        duration, parented under the calling thread's current span —
+        for phases whose timing is already captured in-line (the
+        device stage/dispatch split inside the fused apply) where
+        wrapping hundreds of lines in a context manager would obscure
+        the code. No-op without subscribers."""
         if not self._subscribers:
             return
-        record = {'event': event, 'ts': time.time(), **fields}
-        for handler in list(self._subscribers):
-            handler(record)
+        with self._lock:
+            self._span_seq += 1
+            sid = self._span_seq
+        cur = self.current_trace()
+        trace, parent = cur if cur is not None else (sid, 0)
+        self.emit('span', name=name, trace=trace, span=sid,
+                  parent=parent, dur_ms=dur_ms, **attrs)
+
+    @contextmanager
+    def trace_context(self, trace_id, span_id):
+        """Adopt a REMOTE parent: spans opened inside become children
+        of ``(trace_id, span_id)`` — the receive half of cross-peer
+        causal correlation (the sender's flush span id arrives in the
+        envelope's ``trace`` field). No-op without subscribers."""
+        if not self._subscribers:
+            yield
+            return
+        stack = self._span_stack()
+        frame = (trace_id, span_id)
+        stack.append(frame)
+        try:
+            yield
+        finally:
+            if stack and stack[-1] == frame:
+                stack.pop()
+
+
+class _ScopedMetrics:
+    """See :meth:`Metrics.scoped`. Shares the parent's lock, span
+    stack and subscriber list — a scope is a WRITE prefix, not a
+    separate registry."""
+
+    __slots__ = ('_parent', 'prefix', 'labels')
+
+    def __init__(self, parent, prefix, labels):
+        self._parent = parent
+        self.prefix = prefix
+        self.labels = labels
+
+    @property
+    def active(self):
+        return self._parent.active
+
+    @property
+    def counters(self):
+        return self._parent.counters
+
+    def bump(self, name, value=1):
+        parent = self._parent
+        with parent._lock:
+            parent.counters[name] += value
+            parent.counters[self.prefix + name] += value
+
+    def set_gauge(self, name, value):
+        parent = self._parent
+        with parent._lock:
+            parent.counters[name] = value
+            parent.counters[self.prefix + name] = value
+
+    def observe(self, name, value):
+        """Aggregate series gets the full histogram treatment; the
+        scoped copy keeps count/sum/max only (per-peer quantiles would
+        cost a bucket list per peer per series — the per-peer mean/max
+        is the operator signal, the aggregate holds the tails)."""
+        parent = self._parent
+        with parent._lock:
+            parent._observe_locked(name, value)
+            scoped = self.prefix + name
+            parent.counters[scoped + '.count'] += 1
+            parent.counters[scoped + '.sum'] += value
+            if value > parent.counters[scoped + '.max']:
+                parent.counters[scoped + '.max'] = value
+
+    def emit(self, event, **fields):
+        self._parent.emit(event, **self.labels, **fields)
+
+    def trace_span(self, name, links=None, **attrs):
+        if not self._parent._subscribers:
+            return _NULL_SPAN
+        return _Span(self._parent, name, links,
+                     {**self.labels, **attrs})
+
+    def span_event(self, name, dur_ms, **attrs):
+        self._parent.span_event(name, dur_ms, **self.labels, **attrs)
+
+    def current_trace(self):
+        return self._parent.current_trace()
+
+    def trace_context(self, trace_id, span_id):
+        return self._parent.trace_context(trace_id, span_id)
+
+    def group(self, prefix=None):
+        """This scope's counters (``prefix=None``), or the parent's
+        ``group(prefix)``."""
+        return self._parent.group(self.prefix if prefix is None
+                                  else prefix)
+
+    def mean(self, name):
+        parent = self._parent
+        scoped = self.prefix + name
+        n = parent.counters.get(scoped + '.count', 0)
+        return parent.counters.get(scoped + '.sum', 0) / n if n \
+            else 0.0
+
+    def quantile(self, name, q):
+        return self._parent.quantile(name, q)
+
+    def snapshot(self):
+        return self._parent.snapshot()
+
+    def drop(self):
+        """Remove this scope's counter slice from the shared registry
+        (see :meth:`Metrics.drop_scope`) — the peer-churn hook."""
+        self._parent.drop_scope(self.prefix)
+
+
+class FlightRecorder:
+    """Bounded ring-buffer event subscriber: retains the last
+    ``capacity`` events (spans included) and dumps them as JSON-lines
+    — the black box the serving layer writes out on an incident
+    (crash recovery, first quarantine of a doc), one file per
+    incident, atomically like a snapshot.
+
+    Subscribe it like any handler (``metrics.subscribe(recorder)``);
+    it is itself callable. Thread-safe: the applier thread and the
+    main thread both emit."""
+
+    def __init__(self, capacity=4096):
+        self.capacity = capacity
+        self._buf = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def __call__(self, event):
+        with self._lock:
+            self._buf.append(event)
+
+    def events(self):
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+
+    def dump(self, path, trigger=None):
+        """Write the retained events (oldest first) to ``path`` as
+        JSON-lines via the snapshot layer's atomic write (tmp + fsync
+        + rename) — an incident file is never torn. ``trigger`` (if
+        given) is appended to the snapshot LOCALLY, so it is the
+        file's last line even while another thread keeps emitting
+        into the ring. Returns the event count. Non-JSON values
+        serialize via ``repr``."""
+        import json
+        from ..durability import atomic_write_bytes
+        events = self.events()
+        if trigger is not None:
+            events.append(trigger)
+        lines = '\n'.join(json.dumps(e, sort_keys=True, default=repr)
+                          for e in events)
+        atomic_write_bytes(path, (lines + '\n').encode()
+                           if events else b'')
+        return len(events)
 
 
 metrics = Metrics()
@@ -169,6 +626,12 @@ bump = metrics.bump
 set_gauge = metrics.set_gauge
 observe = metrics.observe
 mean = metrics.mean
+quantile = metrics.quantile
+trace_span = metrics.trace_span
+trace_context = metrics.trace_context
+current_trace = metrics.current_trace
+span_event = metrics.span_event
+scoped = metrics.scoped
 
 
 @contextmanager

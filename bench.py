@@ -556,6 +556,12 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
             len(got['items']) == list_ops
 
     one_round(False)                       # warm the fleet shapes
+    # scope the latency histograms to the MEASURED rounds: the p50/p99
+    # JSON keys below read the very same observe series fleet_status()
+    # serves (no private timers — ISSUE 7 contract)
+    from automerge_tpu.utils.metrics import metrics as _m
+    _m.reset_series('sync_apply_ms')
+    _m.reset_series('sync_flush_ms')
     t0 = time.perf_counter()
     n_msgs, dst = one_round(False)
     t_dict = time.perf_counter() - t0
@@ -585,7 +591,11 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     return {'n_docs': n_docs, 'n_ops': n_ops, 'n_changes': n_changes,
             'n_msgs_dict': n_msgs, 't_dict': t_dict,
             'n_msgs_wire': n_msgs_w, 't_wire': t_wire,
-            't_wire_fanout': t_fan, 'cache_hit_rate': hit_rate}
+            't_wire_fanout': t_fan, 'cache_hit_rate': hit_rate,
+            'apply_ms_p50': _m.quantile('sync_apply_ms', 0.5),
+            'apply_ms_p99': _m.quantile('sync_apply_ms', 0.99),
+            'flush_ms_p50': _m.quantile('sync_flush_ms', 0.5),
+            'flush_ms_p99': _m.quantile('sync_flush_ms', 0.99)}
 
 
 def bench_degraded_link(n_docs=10240, list_ops=22,
@@ -726,22 +736,88 @@ def bench_serving(n_docs=10240, list_ops=22, hot_docs=64, rounds=24,
     ds.materialize_many([f'doc{rng.randrange(hot_docs, n_docs)}'
                          for _ in range(tail_touches)])
     ds.tick()
-    ds.faultin_ms.clear()
+    # measured-phase scope for the fault-in latency histogram: the
+    # p50/p99 below come from the SAME `serving_faultin_ms` series
+    # fleet_status() reports (the private timer list is gone)
+    _sm.reset_series('serving_faultin_ms')
 
     t_hot_degraded, t_all, touched = phase(rounds + 3)
     evictions = ds._n_evictions
-    lat = sorted(ds.faultin_ms)
-    p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)] if lat else 0.0
     shutil.rmtree(tmp, ignore_errors=True)
     return {'n_docs': n_docs,
             'docs_per_sec': touched / t_all,
             'hot_unbounded_s': t_hot_unbounded,
             'hot_degraded_s': t_hot_degraded,
             'degraded_ratio': t_hot_degraded / t_hot_unbounded,
-            'faultin_ms_p99': p99,
+            'faultin_ms_p50': _sm.quantile('serving_faultin_ms', 0.5),
+            'faultin_ms_p99': _sm.quantile('serving_faultin_ms',
+                                           0.99),
             'faultins': ds._n_faultins,
             'evictions': evictions,
             'evicted_frac': evicted_frac}
+
+
+# The idle-observer budget: with NO subscriber every instrumented
+# call site in the tick path costs one truthiness check plus a shared
+# null context manager (metrics._NULL_SPAN) — nanoseconds, not
+# microseconds. This constant is the pre-instrumentation tolerance the
+# CI smoke asserts against: if a refactor makes the no-subscriber path
+# allocate or lock, the per-site cost blows through it and the guard
+# fails before a BENCH run ever shows the regression.
+IDLE_OBSERVER_NS_PER_SITE = 3000
+
+
+def bench_observer_overhead(n=200000):
+    """The no-subscriber fast path of the observability layer: times
+    the three instrumented site shapes (``trace_span`` null span,
+    ``active``-gated ``emit``, bare ``bump``) with nothing subscribed
+    and asserts each stays under ``IDLE_OBSERVER_NS_PER_SITE`` — the
+    executable form of "an idle-observer ``bench_general_sync_10k``
+    runs within noise of the pre-instrumentation constant"."""
+    from automerge_tpu.utils.metrics import Metrics
+    m = Metrics()
+    assert not m.active
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with m.trace_span('guard', doc_id='d'):
+            pass
+    t_span = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if m.active:
+            m.emit('guard', a=1)
+    t_emit = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m.bump('guard_counter')
+    t_bump = (time.perf_counter() - t0) / n * 1e9
+    worst = max(t_span, t_emit, t_bump)
+    assert worst < IDLE_OBSERVER_NS_PER_SITE, (
+        f'idle-observer site cost {worst:.0f} ns/site exceeds the '
+        f'{IDLE_OBSERVER_NS_PER_SITE} ns budget (span {t_span:.0f}, '
+        f'emit {t_emit:.0f}, bump {t_bump:.0f}) — the no-subscriber '
+        f'fast path regressed')
+    return {'span_ns': t_span, 'emit_ns': t_emit, 'bump_ns': t_bump}
+
+
+def smoke():
+    """CI smoke invocation (``python bench.py --smoke``): the
+    idle-observer overhead guard alone — no jax import, no device
+    work, one JSON line on stdout."""
+    guard = bench_observer_overhead()
+    log(f'observer-overhead[no subscriber]: '
+        f'trace_span {guard["span_ns"]:.0f} ns, emit '
+        f'{guard["emit_ns"]:.0f} ns, bump {guard["bump_ns"]:.0f} ns '
+        f'per site (budget {IDLE_OBSERVER_NS_PER_SITE} ns) — idle '
+        f'observers ride the null-span fast path')
+    print(json.dumps({
+        'smoke': 'observer_overhead',
+        'observer_span_ns': round(guard['span_ns'], 1),
+        'observer_emit_ns': round(guard['emit_ns'], 1),
+        'observer_bump_ns': round(guard['bump_ns'], 1),
+        'observer_budget_ns': IDLE_OBSERVER_NS_PER_SITE,
+    }), flush=True)
 
 
 def bench_general_materialize_10k(n_docs=10240, list_ops=22,
@@ -1307,6 +1383,12 @@ def main():
         f'served from the encode cache — '
         f'{s10k["cache_hit_rate"] * 100:.0f}% hit rate, '
         f'{s10k["n_changes"]} changes each encoded exactly once)')
+    log(f'docset-sync[general 10k latency, histogram series]: apply '
+        f'p50 {s10k["apply_ms_p50"]:.1f} / p99 '
+        f'{s10k["apply_ms_p99"]:.1f} ms, flush p50 '
+        f'{s10k["flush_ms_p50"]:.1f} / p99 {s10k["flush_ms_p99"]:.1f} '
+        f'ms — quantile() over the same sync_apply_ms/sync_flush_ms '
+        f'series fleet_status() reports')
 
     (n_deg, deg_clean_ticks, t_deg_clean, deg, t_deg_wire_clean,
      deg_wire) = bench_degraded_link()
@@ -1337,6 +1419,13 @@ def main():
         f'({serving["faultins"]} fault-ins, '
         f'{serving["evictions"]} evictions — cold docs are a cache, '
         f'not a capacity bound)')
+
+    guard = bench_observer_overhead()
+    log(f'observer-overhead[no subscriber]: trace_span '
+        f'{guard["span_ns"]:.0f} ns, emit {guard["emit_ns"]:.0f} ns, '
+        f'bump {guard["bump_ns"]:.0f} ns per site (budget '
+        f'{IDLE_OBSERVER_NS_PER_SITE} ns) — every number above ran '
+        f'with an idle observer on the null-span fast path')
 
     from automerge_tpu.utils.metrics import (metrics as _fm,
                                              FAULT_COUNTERS,
@@ -1474,6 +1563,10 @@ def main():
             round(n_10k / s10k['t_wire_fanout'], 1),
         'general_sync10k_wire_cache_hit_rate':
             round(s10k['cache_hit_rate'], 4),
+        'general_sync10k_apply_ms_p50': round(s10k['apply_ms_p50'], 2),
+        'general_sync10k_apply_ms_p99': round(s10k['apply_ms_p99'], 2),
+        'general_sync10k_flush_ms_p50': round(s10k['flush_ms_p50'], 2),
+        'general_sync10k_flush_ms_p99': round(s10k['flush_ms_p99'], 2),
         'general_sync10k_wire_emit_native':
             bool(_amnat.emit_available()),
         'general_sync10k_degraded_ticks_5': deg[0.05][0],
@@ -1491,6 +1584,7 @@ def main():
             round(deg_wire[0.20][3].get('retransmit_wire_bytes', 0)
                   / 1024, 1),
         'serving_docs_per_sec': round(serving['docs_per_sec'], 1),
+        'serving_faultin_ms_p50': round(serving['faultin_ms_p50'], 2),
         'serving_faultin_ms_p99': round(serving['faultin_ms_p99'], 2),
         'serving_evictions': serving['evictions'],
         'serving_faultins': serving['faultins'],
@@ -1503,10 +1597,14 @@ def main():
         'trace_general_fmt': trace_fmt,
         'dense_breakdown_ms': {k: round(v * 1e3, 2)
                                for k, v in bd.items()},
+        'observer_overhead_span_ns': round(guard['span_ns'], 1),
         'resolve_hbm_frac': round(res_hbm, 4),
         'rga_hbm_frac': round(rga_hbm, 4),
     }), flush=True)
 
 
 if __name__ == '__main__':
-    main()
+    if '--smoke' in sys.argv[1:]:
+        smoke()
+    else:
+        main()

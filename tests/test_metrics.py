@@ -1,5 +1,10 @@
 """Observability layer: counters, event stream, and hook integration."""
 
+import json
+import os
+import re
+import threading
+
 import pytest
 
 import automerge_tpu as A
@@ -36,6 +41,323 @@ class TestRegistry:
         m.unsubscribe(seen.append)
         m.emit('after', a=2)
         assert len(seen) == 1
+
+
+class TestHistograms:
+    """`observe` keeps log-spaced buckets; `quantile` serves p50/p99
+    from them — the same series fleet_status() and the bench report."""
+
+    def test_quantiles_within_bucket_resolution(self):
+        m = M.Metrics()
+        for v in range(1, 1001):
+            m.observe('lat', float(v))
+        assert m.quantile('lat', 0.5) == pytest.approx(500, rel=0.15)
+        assert m.quantile('lat', 0.99) == pytest.approx(990, rel=0.15)
+        assert m.quantile('lat', 0.99) >= m.quantile('lat', 0.5)
+        assert m.mean('lat') == pytest.approx(500.5)
+        assert m.counters['lat.max'] == 1000.0
+
+    def test_empty_series_is_zero(self):
+        m = M.Metrics()
+        assert m.quantile('nope', 0.5) == 0.0
+        m.bump('lat.count')            # count with no histogram
+        assert m.quantile('lat', 0.5) == 0.0
+
+    def test_extreme_values_clamp_to_edge_buckets(self):
+        m = M.Metrics()
+        m.observe('lat', 0.0)          # below LO -> bucket 0
+        m.observe('lat', 1e12)         # beyond span -> last bucket
+        assert m.quantile('lat', 0.0) == M.HIST_LO
+        assert m.quantile('lat', 1.0) > 1e5
+
+    def test_reset_series_clears_one_series_only(self):
+        m = M.Metrics()
+        m.observe('a', 1.0)
+        m.observe('b', 2.0)
+        m.reset_series('a')
+        assert m.quantile('a', 0.5) == 0.0
+        assert 'a.count' not in m.counters
+        assert m.quantile('b', 0.5) > 0
+        assert m.counters['b.count'] == 1
+
+    def test_bucket_mapping_is_monotone(self):
+        prev = -1
+        for v in (0.0001, 0.001, 0.01, 0.5, 1.0, 30.0, 1e4, 1e9):
+            b = M._bucket_of(v)
+            assert b >= prev
+            prev = b
+        assert M._bucket_of(1e99) == M.HIST_BUCKETS - 1
+
+
+class TestSpans:
+    def test_idle_observer_gets_shared_null_span(self):
+        m = M.Metrics()
+        assert m.trace_span('a') is m.trace_span('b', doc_id='x')
+        with m.trace_span('a'):
+            assert m.current_trace() is None   # null span: no stack
+
+    def test_nesting_mints_linked_ids(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        with m.trace_span('outer', doc_id='d'):
+            with m.trace_span('inner'):
+                pass
+        by_name = {e['name']: e for e in events
+                   if e['event'] == 'span'}
+        outer, inner = by_name['outer'], by_name['inner']
+        assert outer['parent'] == 0
+        assert outer['trace'] == outer['span']
+        assert inner['trace'] == outer['trace']
+        assert inner['parent'] == outer['span']
+        assert inner['dur_ms'] >= 0
+        assert outer['doc_id'] == 'd'
+
+    def test_current_trace_and_remote_adoption(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        assert m.current_trace() is None
+        with m.trace_context(42, 7):
+            assert m.current_trace() == (42, 7)
+            with m.trace_span('child'):
+                pass
+        assert m.current_trace() is None
+        child = next(e for e in events if e['event'] == 'span')
+        assert child['trace'] == 42 and child['parent'] == 7
+
+    def test_span_error_is_recorded_and_propagates(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        with pytest.raises(ValueError):
+            with m.trace_span('boom'):
+                raise ValueError('x')
+        span = next(e for e in events if e['event'] == 'span')
+        assert 'ValueError' in span['error']
+
+    def test_span_event_parents_under_current(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        m.span_event('orphan', 1.5)
+        with m.trace_span('parent'):
+            m.span_event('phase', 2.5, native=True)
+        spans = {e['name']: e for e in events if e['event'] == 'span'}
+        assert spans['orphan']['parent'] == 0
+        assert spans['phase']['trace'] == spans['parent']['trace']
+        assert spans['phase']['parent'] == spans['parent']['span']
+        assert spans['phase']['dur_ms'] == 2.5
+        assert spans['phase']['native'] is True
+
+    def test_span_links_serialized(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        with m.trace_span('flush', links=[(3, 4), (5, 6)]):
+            pass
+        span = next(e for e in events if e['event'] == 'span')
+        assert span['links'] == [[3, 4], [5, 6]]
+
+    def test_events_carry_wall_and_mono_clocks(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        m.emit('e')
+        assert 'ts' in events[0] and 'mono' in events[0]
+
+
+class TestScopedViews:
+    def test_bump_and_gauge_write_both_levels(self):
+        m = M.Metrics()
+        s = m.scoped(peer='p1')
+        s.bump('sync_retransmits')
+        s.bump('sync_retransmits', 2)
+        s.set_gauge('depth', 5)
+        assert m.counters['sync_retransmits'] == 3
+        assert m.counters['peer/p1/sync_retransmits'] == 3
+        assert m.counters['peer/p1/depth'] == 5
+        assert s.group() == {'sync_retransmits': 3, 'depth': 5}
+
+    def test_observe_aggregate_histogram_scoped_stats(self):
+        m = M.Metrics()
+        s = m.scoped(peer='p1')
+        s.observe('lat', 10.0)
+        s.observe('lat', 20.0)
+        # quantiles come from the AGGREGATE histogram
+        assert s.quantile('lat', 0.5) == m.quantile('lat', 0.5) > 0
+        # the scoped slice keeps count/sum/max only
+        assert m.counters['peer/p1/lat.count'] == 2
+        assert m.counters['peer/p1/lat.sum'] == 30.0
+        assert s.mean('lat') == 15.0
+        assert 'peer/p1/lat' not in m._hists
+
+    def test_emit_carries_labels(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        m.scoped(peer='p9').emit('busy', seq=3)
+        assert events[0]['peer'] == 'p9' and events[0]['seq'] == 3
+
+    def test_drop_scope_removes_slice_keeps_aggregate(self):
+        """The peer-churn hook: dropping a scope deletes its slice
+        (counters AND observe stats) but never the aggregates, and
+        other peers' slices survive."""
+        m = M.Metrics()
+        s1, s2 = m.scoped(peer='p1'), m.scoped(peer='p2')
+        s1.bump('sync_retransmits')
+        s1.observe('lat', 10.0)
+        s2.bump('sync_retransmits')
+        s1.drop()
+        assert not [n for n in m.counters if n.startswith('peer/p1/')]
+        assert m.counters['sync_retransmits'] == 2
+        assert m.counters['lat.count'] == 1
+        assert m.counters['peer/p2/sync_retransmits'] == 1
+        s1.drop()                          # idempotent
+        m.drop_scope('')                   # no-op guard: empty prefix
+        assert m.counters['sync_retransmits'] == 2
+
+    def test_scoped_span_attrs_include_labels(self):
+        m = M.Metrics()
+        events = []
+        m.subscribe(events.append)
+        with m.scoped(peer='p2').trace_span('sync.flush'):
+            pass
+        span = next(e for e in events if e['event'] == 'span')
+        assert span['peer'] == 'p2'
+
+
+class TestSubscriberThreadSafety:
+    """Satellite: subscriber-list mutation takes the registry lock
+    (swap-on-write); a subscribe/unsubscribe churning on one thread
+    never corrupts an emit iterating on another."""
+
+    def test_concurrent_subscribe_emit(self):
+        m = M.Metrics()
+        seen = []
+        errors = []
+        stop = threading.Event()
+
+        def emitter():
+            try:
+                while not stop.is_set():
+                    m.emit('tick', n=1)
+            except Exception as err:     # pragma: no cover
+                errors.append(err)
+
+        m.subscribe(seen.append)         # the stable subscriber
+        thread = threading.Thread(target=emitter)
+        thread.start()
+        try:
+            churn = [(lambda e, i=i: None) for i in range(20)]
+            for _ in range(300):
+                for h in churn:
+                    m.subscribe(h)
+                for h in churn:
+                    m.unsubscribe(h)
+        finally:
+            stop.set()
+            thread.join()
+        assert not errors
+        assert seen and all(e['event'] == 'tick' for e in seen)
+        # churned handlers are all gone; the stable one remains
+        assert m._subscribers == [seen.append]
+
+
+class TestMeanGroupEdgeCases:
+    def test_mean_empty_series(self):
+        m = M.Metrics()
+        assert m.mean('never_observed') == 0.0
+
+    def test_mean_single_and_running(self):
+        m = M.Metrics()
+        m.observe('x', 4.0)
+        assert m.mean('x') == 4.0
+        m.observe('x', 0.0)
+        assert m.mean('x') == 2.0
+        assert m.counters['x.max'] == 4.0
+
+    def test_group_no_match_and_prefix_strip(self):
+        m = M.Metrics()
+        assert m.group('zzz_') == {}
+        m.bump('fam_a')
+        m.bump('fam_b', 3)
+        m.bump('other')
+        assert m.group('fam_') == {'a': 1, 'b': 3}
+        # empty prefix is the whole registry
+        assert m.group('')['other'] == 1
+
+
+class TestFlightRecorder:
+    def test_ring_retains_last_n(self):
+        rec = M.FlightRecorder(capacity=4)
+        m = M.Metrics()
+        m.subscribe(rec)
+        for i in range(10):
+            m.emit('e', i=i)
+        assert [e['i'] for e in rec.events()] == [6, 7, 8, 9]
+        rec.clear()
+        assert rec.events() == []
+
+    def test_dump_json_lines_atomic(self, tmp_path):
+        rec = M.FlightRecorder(capacity=8)
+        m = M.Metrics()
+        m.subscribe(rec)
+        m.emit('a', x=1)
+        m.emit('b', blob=b'bytes')      # non-JSON value -> repr
+        path = tmp_path / 'box.jsonl'
+        assert rec.dump(str(path)) == 2
+        lines = [json.loads(ln)
+                 for ln in path.read_text().splitlines()]
+        assert [e['event'] for e in lines] == ['a', 'b']
+        assert 'bytes' in lines[1]['blob']
+        rec.clear()
+        assert rec.dump(str(path)) == 0
+        assert path.read_text() == ''
+
+
+class TestRegistryDriftGuard:
+    """Satellite: every literal sync_/serving_ counter name bumped
+    anywhere in automerge_tpu/ must appear in one of the three
+    registries — a silently added name fails here, not in a dashboard
+    six weeks later."""
+
+    NAME_RE = re.compile(
+        r"(?:bump|set_gauge|observe)\(\s*'((?:sync|serving)_"
+        r"[a-z0-9_]+)'")
+
+    def _package_names(self):
+        pkg = os.path.dirname(M.__file__)         # automerge_tpu/utils
+        pkg = os.path.dirname(pkg)                # automerge_tpu/
+        names = set()
+        for root, dirs, files in os.walk(pkg):
+            dirs[:] = [d for d in dirs if d != '__pycache__']
+            for fname in files:
+                if fname.endswith('.py'):
+                    with open(os.path.join(root, fname)) as f:
+                        names |= set(self.NAME_RE.findall(f.read()))
+        return names
+
+    def test_every_bumped_name_is_registered(self):
+        bumped = self._package_names()
+        assert bumped, 'guard regex found no counter sites at all'
+        registered = set(M.FAULT_COUNTERS) | set(M.SERVING_COUNTERS) \
+            | set(M.SYNC_COUNTERS)
+        missing = bumped - registered
+        assert not missing, (
+            f'sync_/serving_ counters bumped in automerge_tpu/ but '
+            f'absent from FAULT_COUNTERS/SERVING_COUNTERS/'
+            f'SYNC_COUNTERS: {sorted(missing)}')
+
+    def test_no_registered_name_is_dead(self):
+        """The reverse direction: a registered sync_/serving_ name no
+        call site bumps is a stale registry entry."""
+        bumped = self._package_names()
+        registered = set(M.FAULT_COUNTERS) | set(M.SERVING_COUNTERS) \
+            | set(M.SYNC_COUNTERS)
+        dead = {n for n in registered
+                if n.startswith(('sync_', 'serving_'))} - bumped
+        assert not dead, f'registered but never bumped: {sorted(dead)}'
 
 
 class TestBackendIntegration:
@@ -136,6 +458,13 @@ class TestFaultCounters:
             'sync_wire_cache_bytes', 'serving_evictions',
             'serving_faultins', 'serving_docs_parked'}
 
+    def test_sync_registry_names_are_pinned(self):
+        assert set(M.SYNC_COUNTERS) >= {
+            'sync_msgs_sent', 'sync_msgs_received',
+            'sync_changes_sent', 'sync_changes_received',
+            'sync_wire_msgs_sent', 'sync_wire_bytes_sent',
+            'sync_apply_ms', 'sync_flush_ms'}
+
     def test_rejected_message_counts(self):
         from automerge_tpu.sync.connection import MessageRejected
         ds = A.DocSet()
@@ -194,3 +523,15 @@ class TestProfilerBridge:
         import jax.numpy as jnp
         with M.profile_trace(name='test-block'):
             jnp.zeros(4).sum()
+
+    def test_log_dir_trace_writes_artifacts(self, tmp_path):
+        """The other branch: a log_dir wraps the block in a full
+        device trace and leaves profile artifacts on disk."""
+        import jax
+        import jax.numpy as jnp
+        log_dir = str(tmp_path / 'trace')
+        with M.profile_trace(log_dir=log_dir):
+            jax.block_until_ready(jnp.ones(8).sum())
+        dumped = [os.path.join(r, f)
+                  for r, _, fs in os.walk(log_dir) for f in fs]
+        assert dumped, 'jax.profiler.trace wrote no artifacts'

@@ -665,6 +665,17 @@ class TestOverloadChaos:
 
 
 class TestServingDurability:
+    def test_close_closes_journal_handle(self, tmp_path):
+        """ServingDocSet.close() must reach the durable stack's
+        journal close — the serving override would otherwise shadow
+        DurableDocSet.close behind __getattr__ and leak the file
+        handle for the process lifetime."""
+        ds = _seed_serving(tmp_path, durable=True)
+        assert not ds.doc_set.journal._f.closed
+        ds.close()
+        assert ds.doc_set.journal._f.closed
+        ds.close()                     # idempotent
+
     def test_checkpoint_evict_crash_recover(self, tmp_path):
         """A checkpoint taken while docs are evicted leaves the parked
         shard as their only durable copy; recovery reconciles snapshot
