@@ -75,6 +75,24 @@ def _intern(table, index, item):
 _MISSING = object()
 
 
+def change_hash(change):
+    """Canonical 64-bit content hash of one reference-format change
+    dict — the unit the per-doc state digest XOR-folds. Hashing the
+    sorted-key compact JSON makes the value independent of dict
+    ordering and of which wire path delivered the change (the dict
+    protocol, the columnar blob and a journal replay all reconstruct
+    the same canonical form), so two replicas holding the same change
+    content always agree — and an "evil twin" (same ``(actor, seq)``,
+    different ops) never does."""
+    import hashlib
+    import json
+    payload = json.dumps(change, sort_keys=True,
+                         separators=(',', ':'), default=str)
+    return int.from_bytes(
+        hashlib.blake2b(payload.encode('utf-8'),
+                        digest_size=8).digest(), 'big')
+
+
 class LazyValues:
     """Op values as byte spans into a wire buffer, JSON-decoded on first
     access (the native wire codec never parses values — most are never
@@ -618,6 +636,22 @@ class BlockStore:
         self.wire_cache_misses = 0
         self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
+        # per-doc state digest: XOR fold of the content hashes of every
+        # ADMITTED change (order-independent — both replicas of a
+        # converged doc hold the same change set, so equal clocks must
+        # mean equal digests; a mismatch is silent divergence). The
+        # fold is LAZY: admission appends (block, rows, docs) refs here
+        # (one list append per apply — nothing on the hot path), and
+        # the first digest read folds them in, so the amortized cost is
+        # one canonical hash per change, paid off the apply path like
+        # the wire-encode cache.
+        self._digest = np.zeros(n_docs, np.uint64)
+        self._digest_pending = []
+        # False when the digest history is unreconstructable (a resumed
+        # snapshot that predates the digest field) — such a store must
+        # not advertise digests (a zero digest vs a real one would be a
+        # false divergence alarm)
+        self._digest_valid = True
 
     # -- interning / lookup helpers -----------------------------------------
 
@@ -943,6 +977,45 @@ class BlockStore:
         self.wire_cache_misses = old_store.wire_cache_misses
         metrics.set_gauge('sync_wire_cache_bytes',
                           self._wire_cache_bytes)
+
+    # -- per-doc state digests ----------------------------------------------
+
+    def _fold_digests(self):
+        """Fold the admission-time pending refs into the digest array.
+        The array is replaced (copy-on-fold), never mutated in place,
+        so a rollback snapshot holding the pre-fold reference stays
+        valid, and concurrent readers see either the old or the new
+        fold, never a half-applied one."""
+        pending, self._digest_pending = self._digest_pending, []
+        if not pending:
+            return
+        dig = self._digest.copy()
+        for block, rows, docs in pending:
+            for c, d in zip(rows.tolist(), docs.tolist()):
+                dig[d] ^= np.uint64(change_hash(block.change_dict(c)))
+        self._digest = dig
+
+    def digest_of(self, d):
+        """The incremental state digest of document ``d`` (0 = no
+        admitted changes)."""
+        self._fold_digests()
+        return int(self._digest[d])
+
+    def digests_all(self):
+        """The whole digest array (uint64, doc axis) after folding —
+        the heartbeat surface reads every doc at once."""
+        self._fold_digests()
+        return self._digest
+
+    def digest_recompute(self, d):
+        """O(doc) from-scratch digest over the retained log — the
+        parity oracle for the incremental fold (raises the usual
+        retention errors when the log cannot serve the full
+        history)."""
+        out = 0
+        for change in self.get_missing_changes(d, {}):
+            out ^= change_hash(change)
+        return out
 
 
 def init_store(n_docs):
@@ -1436,6 +1509,12 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
         raise
     for c in np.flatnonzero(leftover):
         store.queue.append((int(block.doc[c]), block.change_dict(c)))
+    if len(adm_order):
+        # state-digest maintenance: remember the admitted rows; the
+        # content hashes fold lazily on the first digest read (one
+        # list append here — nothing on the apply hot path)
+        store._digest_pending.append((block, adm_order,
+                                      block.doc[adm_order]))
     if store.retain_log and len(adm_order):
         # doc-sorted, ADMISSION order within each doc (the causal order
         # get_missing_changes promises); stored whole — per-doc slices

@@ -515,6 +515,9 @@ class DenseMapStore:
             # resumed store can sync peers forward from here, but not
             # across the snapshot boundary
             host.log_truncated = True
+            # dense snapshots do not carry state digests: the resumed
+            # host store must not advertise zeros as real digests
+            host._digest_valid = False
             if 'slot_actor' in z:
                 store.slot_actor = z['slot_actor']
                 store.slot_count = z['slot_count']

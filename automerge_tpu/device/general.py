@@ -337,6 +337,11 @@ class _Txn:
                           pool.pos_sorted, pool.pos_row)
         self.pool_n = (pool.n_of.copy(), pool.max_elem_of.copy(),
                        pool.max_tree, pool.max_elem)
+        # digest fold is copy-on-fold and reads never interleave an
+        # apply, so the array REFERENCE plus the pending length is a
+        # complete rollback record — no per-apply copy
+        self.digest = store._digest
+        self.n_digest_pending = len(store._digest_pending)
 
     def rollback(self, store):
         pool = store.pool
@@ -384,6 +389,8 @@ class _Txn:
          pool.pos_row) = self.pool_cols
         (pool.n_of, pool.max_elem_of, pool.max_tree,
          pool.max_elem) = self.pool_n
+        store._digest = self.digest
+        del store._digest_pending[self.n_digest_pending:]
 
 
 class GeneralStore(BlockStore):
@@ -487,6 +494,8 @@ class GeneralStore(BlockStore):
         self._commit_pending()
         self.pool.sync()
         self.log_sorted_keys()       # fold pending appends into l_order
+        self._fold_digests()         # change bodies are dropped below —
+        #                              the digest must be folded NOW
         pool = self.pool
         meta = {'format': 'automerge-tpu-general-snapshot@1',
                 'n_docs': self.n_docs,
@@ -514,6 +523,7 @@ class GeneralStore(BlockStore):
             p_visible=pool.visible, p_vis_index=pool.vis_index,
             p_pos_sorted=pool.pos_sorted, p_pos_row=pool.pos_row,
             p_n_of=pool.n_of, p_max_elem_of=pool.max_elem_of,
+            digest=self._digest,
             meta=np.frombuffer(_json2.dumps(meta).encode(),
                                dtype=np.uint8))
         return buf.getvalue()
@@ -578,6 +588,13 @@ class GeneralStore(BlockStore):
             # change bodies are not serialized: peers sync forward
             # from here, not across the snapshot boundary
             store.log_truncated = True
+            # state digests ride the snapshot (they cannot be refolded
+            # once the bodies are gone); a pre-digest snapshot resumes
+            # with digests INVALID — it must not advertise zeros
+            if 'digest' in z:
+                store._digest = z['digest']
+            else:
+                store._digest_valid = False
             # the device mirror must carry the RESTORED visibility: the
             # lazy first-apply path treats a None mirror as an empty
             # store and would re-stage every node hidden (r5 review:
@@ -680,6 +697,8 @@ class GeneralStore(BlockStore):
                 [self._root_row, np.full(pad, -1, np.int64)])
             self._doc_version = np.concatenate(
                 [self._doc_version, np.zeros(pad, np.int64)])
+            self._digest = np.concatenate(
+                [self._digest, np.zeros(pad, np.uint64)])
             self.n_docs = n_docs
 
     # -- objects -------------------------------------------------------------

@@ -193,6 +193,23 @@ def dump_incident(recorder, dir_path, kind, **meta):
     return path
 
 
+def load_incident(path):
+    """Read a :func:`dump_incident` file back: ``(events, trigger)``
+    where ``trigger`` is the final ``event='incident'`` record naming
+    what fired the dump (None for a pre-trigger or hand-made file).
+    The inverse operators and tools consume — ``tools/trace_report.py``
+    turns the same lines into a Chrome-trace file."""
+    events = []
+    with open(path, 'r', encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    trigger = events[-1] if events and \
+        events[-1].get('event') == 'incident' else None
+    return events, trigger
+
+
 class ChangeJournal:
     """Append-only change journal with per-record length+CRC framing.
 

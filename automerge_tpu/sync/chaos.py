@@ -59,6 +59,27 @@ def canonical(view):
     return json.dumps(view, sort_keys=True, default=str)
 
 
+def assert_digest_parity(doc_set):
+    """Assert the incremental per-doc state digests equal an O(doc)
+    recompute over the retained log, for every doc of a general-store
+    doc set — the maintenance-correctness oracle the chaos schedules
+    run after converging (no-op for doc sets without digests, or for
+    snapshot-truncated stores whose history cannot be recomputed)."""
+    store = getattr(doc_set, 'store', None)
+    if store is None or not hasattr(store, 'digests_all'):
+        return
+    if store.log_truncated or not store._digest_valid:
+        return
+    digs = store.digests_all()
+    for doc_id in doc_set.doc_ids:
+        idx = doc_set.id_of[doc_id]
+        got = int(digs[idx])
+        want = store.digest_recompute(idx)
+        assert got == want, (
+            f'digest drift on {doc_id!r}: incremental {got:#x} != '
+            f'recomputed {want:#x}')
+
+
 class ChaosFleet:
     """N peers over a full-mesh adversarial fabric.
 
@@ -291,6 +312,45 @@ class ChaosFleet:
         reused across fleets, e.g. by the bench's loss-rate sweep)."""
         for conn in self.conns.values():
             conn.close()
+
+    # -- fault injection beyond the transport --------------------------------
+
+    def inject_silent_divergence(self, node, doc_id, changes):
+        """Mutate ONE replica's store out-of-band: apply ``changes``
+        directly to ``node``'s doc set, bypassing the fabric entirely
+        (no envelope, no checksum — exactly the logic-level corruption
+        the transport layer cannot see). The injection is SILENT end
+        to end: the node's endpoints never see the apply (their
+        ``doc_changed`` handlers are detached around it) and are then
+        told the peer already covers the new clock — so injecting an
+        "evil twin" of a change another replica holds (same ``(actor,
+        seq)``, other content) leaves every clock EQUAL, the normal
+        protocol ships nothing, and the replicas stay silently
+        diverged forever. Only the heartbeat digest audit can catch
+        it."""
+        from .connection import clock_union
+        ds = self.doc_sets[node]
+        owned = [c for (o, _p), c in self.conns.items() if o == node]
+        inners = [getattr(c, '_conn', c) for c in owned]
+        for inner in inners:
+            ds.unregister_handler(inner.doc_changed)
+        try:
+            out = ds.apply_changes(doc_id, changes)
+        finally:
+            for inner in inners:
+                ds.register_handler(inner.doc_changed)
+        clock = ds.clock_of_id(doc_id) if \
+            hasattr(ds, 'clock_of_id') else {}
+        for conn, inner in zip(owned, inners):
+            clock_union(inner._their_clock, doc_id, clock)
+            clock_union(inner._our_clock, doc_id, clock)
+            pend = getattr(inner, '_pending_send', None)
+            if pend is not None:
+                pend.pop(doc_id, None)
+            acked = getattr(conn, '_peer_acked', None)
+            if acked is not None:
+                clock_union(acked, doc_id, clock)
+        return out
 
     # -- crash/restart -------------------------------------------------------
 

@@ -31,6 +31,32 @@ _TYPE_MAP = _general._TYPE_MAP
 _TYPE_TEXT = _general._TYPE_TEXT
 
 
+def _covers(have, clock):
+    """True when clock ``have`` covers every (actor, seq) of
+    ``clock``."""
+    return all(have.get(a, 0) >= s for a, s in clock.items())
+
+
+# Health thresholds: {signal: (degraded_at, critical_at)} — a signal
+# at or above a bound pushes the fleet into that state (None disables
+# the bound). Every signal is a CURRENT value (gauges, live counts,
+# per-evaluation deltas), so health RECOVERS when pressure lifts;
+# `diverged` is the exception by design — a silently diverged replica
+# stays critical until an operator resolves it (`clear_divergence`).
+DEFAULT_HEALTH_THRESHOLDS = {
+    'replication_lag_ops': (10_000, 1_000_000),
+    'lagging_docs': (1_000, 100_000),
+    'convergence_ms_p99': (30_000.0, None),
+    'quarantined': (1, 64),
+    'diverged': (1, 1),
+    'retry_exhausted': (1, 64),      # delta since the last evaluation
+    'admission_debt': (64, 65_536),
+    'backpressure_depth': (16, 4_096),
+    'parked': (1, 64),
+}
+_HEALTH_RANK = {'green': 0, 'degraded': 1, 'critical': 2}
+
+
 def _latency_quantiles(series):
     """{series: {'p50': ms, 'p99': ms, 'count': n}} for the observe
     series that have samples — the fleet_status() latency block, read
@@ -134,6 +160,35 @@ class GeneralDocSet:
         # can report per-CONNECTION backpressure/admission state
         # instead of only process-wide counters
         self.connections = {}
+        # vectorized twin of the view cache's versions: _view_ver[i]
+        # is the applied version the cached view of doc i was built at
+        # (-1 = no view) — fleet_status() derives the dirty TOTAL from
+        # one numpy compare against the store's _doc_version instead
+        # of a per-doc Python loop over clean docs
+        self._view_ver = np.full(capacity, -1, np.int64)
+        # divergence audit registry: doc_id -> {'peer', 'local_digest',
+        # 'remote_digest', 'clock'} — silently diverged replicas a
+        # heartbeat digest compare reported (sync_divergence_detected).
+        # Deliberately sticky: health stays critical until an operator
+        # resolves the divergence and calls clear_divergence()
+        self.diverged = {}
+        # convergence-latency tracking: doc_id -> perf_counter of its
+        # latest local apply while peers were registered; cleared (and
+        # observed into sync_convergence_ms) once every registered
+        # peer's acked clock covers the doc's clock
+        self._births = {}
+        # health/SLO rollup state (fleet_status()['health']);
+        # health_extra (callable -> dict) merges wrapper-layer signals
+        # (the serving layer's parked count), health_incident fires on
+        # every state transition (the serving layer dumps the flight
+        # recorder on first entry to critical)
+        self.health_thresholds = dict(DEFAULT_HEALTH_THRESHOLDS)
+        self.health_extra = None
+        self.health_incident = None
+        self._health_state = 'green'
+        # baseline for the retry_exhausted delta signal: the sum over
+        # THIS doc set's registered links' scoped slices (none yet)
+        self._health_last_exhausted = 0
 
     # -- DocSet surface ------------------------------------------------------
 
@@ -177,6 +232,9 @@ class GeneralDocSet:
         if new_capacity <= self.capacity:
             return
         self.store.grow_docs(new_capacity)
+        self._view_ver = np.concatenate(
+            [self._view_ver,
+             np.full(new_capacity - self.capacity, -1, np.int64)])
         self.capacity = new_capacity
 
     def get_doc(self, doc_id):
@@ -282,6 +340,7 @@ class GeneralDocSet:
                                          options=self._options)
         _metrics.observe('sync_apply_ms',
                          (_time.perf_counter() - t0) * 1e3)
+        self._note_births(changes_by_doc)
         out = {}
         for doc_id in changes_by_doc:
             doc = self.get_doc(doc_id)
@@ -326,6 +385,137 @@ class GeneralDocSet:
 
     applyChangesBatch = apply_changes_batch
 
+    # -- convergence / divergence observability ------------------------------
+
+    def _note_births(self, doc_ids):
+        """Stamp the convergence birth of a local apply: the
+        ``sync_convergence_ms`` series measures from here to the tick
+        every registered peer's acked clock covers the doc. Free when
+        no peer-identified connection is registered (the bench's raw
+        Connection fleets, standalone doc sets). Assumes the
+        full-replication topology every fleet here uses: a doc some
+        registered peer never replicates keeps its birth pending —
+        truthfully, the fleet has not converged it — and shows up in
+        ``pending_births``; births drop when the last connection
+        unregisters."""
+        if not self.connections:
+            return
+        t = _time.perf_counter()
+        births = self._births
+        for doc_id in doc_ids:
+            births[doc_id] = t
+
+    def note_peer_ack(self, doc_ids):
+        """A registered link folded new acked clocks for ``doc_ids``:
+        close out any birth the whole fleet now covers. O(notified
+        docs x peers); called by :class:`~.resilient.
+        ResilientConnection` on acks, data clocks and heartbeats."""
+        births = self._births
+        if not births or not self.connections:
+            return
+        conns = list(self.connections.values())
+        store = self.store
+        now = _time.perf_counter()
+        for doc_id in doc_ids:
+            t0 = births.get(doc_id)
+            if t0 is None:
+                continue
+            idx = self.id_of.get(doc_id)
+            if idx is None:
+                continue
+            clock = store.clock_of(idx)
+            if not clock:
+                continue
+            if all(_covers(c.acked_clock(doc_id), clock)
+                   for c in conns):
+                del births[doc_id]
+                _metrics.observe('sync_convergence_ms',
+                                 (now - t0) * 1e3)
+
+    notePeerAck = note_peer_ack
+
+    def convergence_watermark(self, doc_ids=None):
+        """``{doc_id: clock}`` — per doc, the minimum clock EVERY
+        registered live peer has acked (the fleet convergence
+        watermark: everything at or below it is fully replicated).
+        Empty clocks mean some peer has acked nothing for the doc.
+        O(docs x peers) — an operator read, not a tick-path one."""
+        conns = list(self.connections.values())
+        out = {}
+        for doc_id in (self.ids if doc_ids is None else doc_ids):
+            if not conns:
+                out[doc_id] = {}
+                continue
+            acked = [c.acked_clock(doc_id) for c in conns]
+            floor = {}
+            for actor in acked[0]:
+                lo = min(a.get(actor, 0) for a in acked)
+                if lo:
+                    floor[actor] = lo
+            out[doc_id] = floor
+        return out
+
+    convergenceWatermark = convergence_watermark
+
+    def clock_of_id(self, doc_id):
+        """The doc's clock by id (the divergence audit's compare
+        key)."""
+        idx = self.id_of.get(doc_id)
+        return self.store.clock_of(idx) if idx is not None else {}
+
+    def digest_of_id(self, doc_id):
+        """The doc's incremental state digest, or None when digests
+        are unavailable (unknown doc, a pre-digest snapshot
+        resume)."""
+        if not getattr(self.store, '_digest_valid', False):
+            return None
+        idx = self.id_of.get(doc_id)
+        return self.store.digest_of(idx) if idx is not None else None
+
+    def heartbeat_digests(self):
+        """``{doc_id: digest}`` for the anti-entropy beat (non-zero
+        digests only — a doc with no admitted changes has nothing to
+        audit), or None when this store's digest history is
+        unreconstructable (then heartbeats stay wire-identical v1)."""
+        store = self.store
+        if not getattr(store, '_digest_valid', False):
+            return None
+        digs = store.digests_all()
+        return {doc_id: int(digs[i])
+                for i, doc_id in enumerate(self.ids) if digs[i]}
+
+    def note_divergence(self, doc_id, peer=None, local_digest=None,
+                        remote_digest=None, clock=None):
+        """Record one silently diverged doc (report, don't guess:
+        neither side quarantines — the digest proves disagreement, not
+        which replica is right). Returns True when the record is NEW
+        for this (doc, peer) pair — the held record accumulates every
+        reporting peer, so two auditing peers alternating heartbeats
+        count once EACH, never once per beat."""
+        held = self.diverged.get(doc_id)
+        if held is not None:
+            if peer in held['peers']:
+                return False
+            held['peers'].append(peer)
+            return True
+        self.diverged[doc_id] = {
+            'peer': peer, 'peers': [peer],
+            'local_digest': local_digest,
+            'remote_digest': remote_digest, 'clock': clock}
+        return True
+
+    noteDivergence = note_divergence
+
+    def clear_divergence(self, doc_id=None):
+        """Operator hook: drop the sticky divergence record(s) after
+        resolving them (e.g. resyncing one side from a snapshot)."""
+        if doc_id is None:
+            self.diverged.clear()
+        else:
+            self.diverged.pop(doc_id, None)
+
+    clearDivergence = clear_divergence
+
     # -- cold-doc eviction mechanism (policy lives in ServingDocSet) --------
 
     def extract_doc_state(self, doc_ids):
@@ -344,6 +534,7 @@ class GeneralDocSet:
         for d, ch in store.queue:
             if d in want:
                 queued.setdefault(d, []).append(ch)
+        digests_ok = getattr(store, '_digest_valid', False)
         out = {}
         for doc_id in doc_ids:
             idx = self.id_of[doc_id]
@@ -351,7 +542,11 @@ class GeneralDocSet:
                 'doc_id': doc_id,
                 'clock': store.clock_of(idx),
                 'changes': store.get_missing_changes(idx, {}),
-                'queued': queued.get(idx, [])}
+                'queued': queued.get(idx, []),
+                # the recorded digest keeps the divergence audit (and
+                # its heartbeat advertisement) truthful while the doc
+                # is parked; fault-in refolds it from the replay
+                'digest': store.digest_of(idx) if digests_ok else None}
         return out
 
     def drop_doc_state(self, doc_ids, chunk_docs=512):
@@ -396,50 +591,185 @@ class GeneralDocSet:
         new_store._doc_version = old._doc_version.copy()
         new_store._apply_seq = max(old._apply_seq,
                                    new_store._apply_seq)
+        # the rebuild refolded surviving docs' digests from their
+        # replayed logs; an invalid source history stays invalid
+        new_store._digest_valid = old._digest_valid
         new_store.adopt_wire_cache(old, drop_docs=drop)
         self.store = new_store
         for i in drop:
             self._views.pop(i, None)
+            self._view_ver[i] = -1
         self._entry_csr = (None, None, None)
 
-    def fleet_status(self):
+    def fleet_status(self, docs=True):
         """Operator surface over the whole fleet (ROADMAP "Quarantine
-        operator surface"): per-doc ``{'clock': {actor: seq},
-        'quarantined': error-repr-or-None, 'dirty': bool}`` plus fleet
-        totals, without reaching into the registry or the store.
-        ``dirty`` means the cached materialized view is stale (none
-        built yet, or applies landed since) — the docs the next
-        ``materialize_all`` will actually rebuild. Read-only and
-        cheap: one pass over the clock rows, one dict probe per doc."""
+        operator surface"): fleet totals, per-connection state, live
+        latency quantiles, the convergence summary and the health
+        rollup — plus, with ``docs=True``, the per-doc ``{'clock':
+        {actor: seq}, 'quarantined': error-repr-or-None, 'dirty':
+        bool}`` map (``dirty`` = the cached materialized view is
+        stale). The TOTALS are served from incrementally-maintained
+        state (registry counters, one vectorized view-version compare)
+        — ``fleet_status(docs=False)`` does no per-doc Python work at
+        all, so a monitoring loop polling a 10240-doc fleet stays
+        O(connections), not O(fleet)."""
         store = self.store
-        clocks = store.clocks_all()
-        docs = {}
-        n_dirty = 0
-        for idx, doc_id in enumerate(self.ids):
-            hit = self._views.get(idx)
-            dirty = hit is None or hit[0] != store.doc_version(idx)
-            n_dirty += dirty
-            held = self.quarantined.get(doc_id)
-            docs[doc_id] = {
-                'clock': dict(clocks.get(idx, {})),
-                'quarantined': held['error'] if held else None,
-                'dirty': bool(dirty)}
-        return {'docs': docs,
-                'totals': {'docs': len(self.ids),
-                           'capacity': self.capacity,
-                           'quarantined': len(self.quarantined),
-                           'dirty': int(n_dirty)},
-                # per-CONNECTION backpressure/admission/retransmit
-                # state (every peer-identified ResilientConnection
-                # self-registers) — the ROADMAP item: no more process-
-                # wide-counters-only view of a struggling peer. The
-                # counter slices come from ONE bucketed registry pass
-                # (metrics.groups), not a full scan per link
-                'connections': self._connection_statuses(),
-                # tick-path latencies from the SAME histogram series
-                # the bench's *_p50/*_p99 JSON keys read
-                'latency': _latency_quantiles(
-                    ('sync_apply_ms', 'sync_flush_ms'))}
+        n = len(self.ids)
+        # dirty total: ONE numpy compare of the cached-view versions
+        # against the store's applied versions (no per-doc probes)
+        n_dirty = int((self._view_ver[:n] !=
+                       store._doc_version[:n]).sum()) if n else 0
+        connections = self._connection_statuses()
+        out = {'totals': {'docs': n,
+                          'capacity': self.capacity,
+                          'quarantined': len(self.quarantined),
+                          'diverged': len(self.diverged),
+                          'dirty': n_dirty},
+               # per-CONNECTION backpressure/admission/retransmit/lag
+               # state (every peer-identified ResilientConnection
+               # self-registers) — the counter slices come from ONE
+               # bucketed registry pass (metrics.groups), not a full
+               # scan per link
+               'connections': connections,
+               # tick-path latencies from the SAME histogram series
+               # the bench's *_p50/*_p99 JSON keys read
+               'latency': _latency_quantiles(
+                   ('sync_apply_ms', 'sync_flush_ms',
+                    'sync_convergence_ms')),
+               'convergence': self._convergence_summary(),
+               'health': self.evaluate_health()}
+        if docs:
+            clocks = store.clocks_all()
+            doc_map = {}
+            for idx, doc_id in enumerate(self.ids):
+                held = self.quarantined.get(doc_id)
+                doc_map[doc_id] = {
+                    'clock': dict(clocks.get(idx, {})),
+                    'quarantined': held['error'] if held else None,
+                    'dirty': bool(self._view_ver[idx] !=
+                                  store._doc_version[idx])}
+            out['docs'] = doc_map
+        return out
+
+    def _link_lag(self):
+        """``(lag_ops_total, lagging_docs_max)`` from the per-link
+        gauges the heartbeats refresh — O(connections) registry reads,
+        no per-doc work."""
+        counters = _metrics.counters
+        lag = 0
+        lagging = 0
+        for conn in self.connections.values():
+            prefix = getattr(conn.metrics, 'prefix', '')
+            lag += counters.get(
+                prefix + 'sync_replication_lag_ops', 0)
+            lagging = max(lagging, counters.get(
+                prefix + 'sync_lagging_docs', 0))
+        return lag, lagging
+
+    def _convergence_summary(self):
+        """The replication-convergence block of :meth:`fleet_status`:
+        per-link lag rolled up (worst link binds the fleet), pending
+        convergence births, and the sticky divergence records."""
+        lag, lagging = self._link_lag()
+        return {'replication_lag_ops': lag,
+                'lagging_docs': lagging,
+                'pending_births': len(self._births),
+                'convergence_ms_p99':
+                    _metrics.quantile('sync_convergence_ms', 0.99),
+                'diverged': {d: dict(rec)
+                             for d, rec in self.diverged.items()}}
+
+    # -- health / SLO rollup -------------------------------------------------
+
+    def _health_signals(self):
+        """The current-state signal set the thresholds grade. Every
+        entry is a live value (gauges refresh per heartbeat; counts
+        are current registry sizes; ``retry_exhausted`` is the delta
+        since the previous evaluation), so the rollup recovers as
+        pressure lifts. O(connections) — never O(fleet), so the
+        serving tick can evaluate every quantum."""
+        debt = 0
+        backpressure = 0
+        exhausted = 0
+        counters = _metrics.counters
+        # live per-connection reads, O(connections)
+        for conn in self.connections.values():
+            for ctrl in (conn.admission, conn.shared_admission):
+                if ctrl is None:
+                    continue
+                for bucket in (ctrl.change_bucket, ctrl.byte_bucket):
+                    if bucket is not None:
+                        debt = max(debt, -min(0, bucket.tokens))
+            backpressure += conn.backpressure_depth
+            # THIS doc set's links only (the peer-scoped slices, like
+            # _link_lag) — the process-wide counter would bleed another
+            # co-resident fleet's exhaustions into this one's health
+            exhausted += counters.get(
+                getattr(conn.metrics, 'prefix', '') +
+                'sync_retry_exhausted', 0)
+        lag, lagging = self._link_lag()
+        delta = exhausted - self._health_last_exhausted
+        self._health_last_exhausted = exhausted
+        signals = {'replication_lag_ops': lag,
+                   'lagging_docs': lagging,
+                   'convergence_ms_p99':
+                       _metrics.quantile('sync_convergence_ms', 0.99),
+                   'quarantined': len(self.quarantined),
+                   'diverged': len(self.diverged),
+                   'retry_exhausted': max(0, delta),
+                   'admission_debt': debt,
+                   'backpressure_depth': backpressure,
+                   'parked': 0}
+        if self.health_extra is not None:
+            signals.update(self.health_extra())
+        return signals
+
+    def evaluate_health(self):
+        """Compute the green/degraded/critical rollup from the
+        configurable :attr:`health_thresholds`, record the state
+        transition (a ``health_transition`` event + the
+        ``fleet_health_state``/``fleet_health_transitions`` metrics)
+        and fire the :attr:`health_incident` hook — the serving layer
+        dumps a flight-recorder incident on first entry to critical.
+        Called by :meth:`fleet_status` and by the serving tick."""
+        signals = self._health_signals()
+        state = 'green'
+        reasons = []
+        for name, value in signals.items():
+            bounds = self.health_thresholds.get(name)
+            if not bounds or value is None:
+                continue
+            degraded_at, critical_at = bounds
+            if critical_at is not None and value >= critical_at:
+                level = 'critical'
+            elif degraded_at is not None and value >= degraded_at:
+                level = 'degraded'
+            else:
+                continue
+            reasons.append(f'{name}={value:g} >= {level} threshold')
+            if _HEALTH_RANK[level] > _HEALTH_RANK[state]:
+                state = level
+        previous = self._health_state
+        if state != previous:
+            self._health_state = state
+            _metrics.bump('fleet_health_transitions')
+            _metrics.set_gauge('fleet_health_state',
+                               _HEALTH_RANK[state])
+            if _metrics.active:
+                _metrics.emit('health_transition', previous=previous,
+                              state=state, reasons=reasons)
+            if self.health_incident is not None:
+                self.health_incident(previous, state, signals,
+                                     reasons)
+        return {'state': state, 'reasons': reasons,
+                'signals': signals,
+                'thresholds': dict(self.health_thresholds)}
+
+    evaluateHealth = evaluate_health
+
+    def health(self):
+        """The health rollup alone (one evaluation)."""
+        return self.evaluate_health()
 
     def _connection_statuses(self):
         """Per-connection operator rows, the counter slices pre-
@@ -503,6 +833,7 @@ class GeneralDocSet:
                                          options=self._options)
         _metrics.observe('sync_apply_ms',
                          (_time.perf_counter() - t0) * 1e3)
+        self._note_births(doc_ids)
         out = []
         for doc_id in doc_ids:
             doc = self.get_doc(doc_id)
@@ -526,6 +857,11 @@ class GeneralDocSet:
     def unregister_connection(self, peer_id, conn):
         if self.connections.get(peer_id) is conn:
             del self.connections[peer_id]
+            if not self.connections:
+                # no peers left to ack anything: pending convergence
+                # births can never close — drop them instead of
+                # reporting a forever-growing pending_births figure
+                self._births.clear()
 
     unregisterConnection = unregister_connection
 
@@ -668,6 +1004,7 @@ class GeneralDocSet:
             return hit[1]
         tree = self._build_single(idx)
         self._views[idx] = (ver, tree)
+        self._view_ver[idx] = ver
         return tree
 
     def _build_single(self, idx):
@@ -749,6 +1086,7 @@ class GeneralDocSet:
                                      dirty=len(dirty)):
                 for i, tree in self._build_batch(dirty).items():
                     self._views[i] = (dirty_vers[i], tree)
+                    self._view_ver[i] = dirty_vers[i]
         return [self._views[i][1] for i in idxs]
 
     def materialize_all(self):

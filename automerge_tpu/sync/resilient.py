@@ -51,15 +51,20 @@ import zlib
 
 from ..utils.metrics import metrics
 from .connection import (BatchingConnection, Connection,
-                         MessageRejected, WireConnection)
+                         MessageRejected, WireConnection, clock_union)
 
 BASE_VERSION = 1
 ENVELOPE_VERSION = 2
-# v1: no trace field. v2: checksummed `trace` rides the envelope.
-# Accept both; STAMP by shape — an untraced envelope (acks, busy,
-# heartbeats, and data with no observer subscribed) is byte-identical
-# to the v1 protocol and ships as v=1 so a v1 receiver still accepts
-# it; only an envelope that actually carries `trace` ships as v=2.
+# v1: no trace/digests field. v2: a checksummed `trace` rides a data
+# envelope, or `digests`+`dsum` ride a heartbeat. Accept both; STAMP
+# by shape — an envelope carrying neither (acks, busy, untraced data,
+# undigested heartbeats) is byte-identical to the v1 protocol and
+# ships as v=1 so a v1 receiver still accepts it; only an envelope
+# actually carrying the optional field ships as v=2. A heartbeat's
+# main `sum` stays the plain clocks checksum even when digested, so a
+# v2-accepting receiver that predates digests still validates and
+# heals from digested beats (it just ignores the fields it doesn't
+# know).
 ACCEPTED_VERSIONS = frozenset((BASE_VERSION, ENVELOPE_VERSION))
 
 
@@ -200,6 +205,32 @@ def envelope_checksum(payload, trace=None):
                                  separators=(',', ':')).encode(), head)
 
 
+def digest_checksum(digests, clocks_sum):
+    """The checksum guarding a heartbeat's optional ``digests`` map,
+    SEEDED by the beat's clocks checksum so the digests bind to
+    exactly these clocks. It rides a separate ``dsum`` field — the
+    main ``sum`` stays the plain clocks checksum a v1-era receiver
+    validates, so a DIGESTED beat still heals old peers (they verify
+    the clocks and ignore the fields they don't know); a new receiver
+    verifies ``dsum`` too, and a bit flipped in a digest drops only
+    the audit for that beat (the next beat repeats it), never the
+    clocks and never a false divergence alarm."""
+    return zlib.crc32(json.dumps(digests, sort_keys=True,
+                                 separators=(',', ':')).encode(),
+                      clocks_sum)
+
+
+def _valid_digests(digests):
+    """A well-formed heartbeat digest map: ``{doc_id: uint64}``."""
+    if not isinstance(digests, dict):
+        return False
+    for doc_id, dig in digests.items():
+        if not isinstance(doc_id, str) or not isinstance(dig, int) \
+                or isinstance(dig, bool) or dig < 0:
+            return False
+    return True
+
+
 def _valid_trace(trace):
     """A well-formed envelope trace field: ``{'t': trace_id, 's':
     span_id}`` with int ids."""
@@ -243,7 +274,8 @@ class ResilientConnection:
                  retry_limit=8, backoff_base=2, backoff_max=64,
                  jitter=2, heartbeat_every=16, seed=0,
                  admission=None, shared_admission=None,
-                 max_msg_bytes=None, peer_id=None, scope=None):
+                 max_msg_bytes=None, peer_id=None, scope=None,
+                 hb_digests=True):
         self._send_raw = send_msg
         if wire:
             self._conn = WireConnection(doc_set, self._send_envelope,
@@ -302,6 +334,18 @@ class ResilientConnection:
         # floor, leaving the set O(messages since the loss) until the
         # session re-establishes — acceptable for session-scoped links
         self._recv_above = set()
+        # replication-lag tracking: the peer's ACKED clocks — folded
+        # only from what the peer itself confirmed (its clock adverts,
+        # heartbeats, and the payload clock of every data envelope it
+        # acked), never from this side's optimistic sends — so
+        # `replication_lag()` measures what the peer has really durably
+        # received, and the doc set's convergence watermark is the
+        # minimum clock EVERY live peer has acked
+        self._peer_acked = {}          # doc_id -> {actor: seq}
+        # heartbeats advertise per-doc state digests when the doc set
+        # maintains them (divergence audit); hb_digests=False pins the
+        # v1 heartbeat shape
+        self.hb_digests = hb_digests
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -448,6 +492,69 @@ class ResilientConnection:
                 payload.get('snapshot') is not None):
             their.pop(payload['docId'], None)
 
+    # -- replication lag / convergence ---------------------------------------
+
+    def _fold_acked(self, payload):
+        """Fold the clocks ``payload`` proves the peer holds into the
+        acked map and notify the doc set (its convergence watermark
+        and the ``sync_convergence_ms`` series advance on exactly
+        these events). Called for clocks the peer ADVERTISED (its data
+        messages and heartbeats) and for the payload clock of every
+        data envelope the peer acked. An ack counts as received even
+        if the apply later quarantines — quarantine is loudly visible
+        through its own counters, and the peer's next heartbeat keeps
+        this map truthful upward."""
+        if not isinstance(payload, dict):
+            return
+        docs = []
+        if 'wire' in payload:
+            for doc_id, clock in zip(payload.get('docs') or (),
+                                     payload.get('clocks') or ()):
+                if isinstance(doc_id, str) and isinstance(clock, dict):
+                    clock_union(self._peer_acked, doc_id, clock)
+                    docs.append(doc_id)
+        else:
+            doc_id = payload.get('docId')
+            clock = payload.get('clock')
+            if isinstance(doc_id, str) and isinstance(clock, dict):
+                clock_union(self._peer_acked, doc_id, clock)
+                docs.append(doc_id)
+        if docs:
+            self._note_acked(docs)
+
+    def _note_acked(self, doc_ids):
+        note = getattr(self._doc_set, 'note_peer_ack', None)
+        if note is not None:
+            note(doc_ids)
+
+    def acked_clock(self, doc_id):
+        """The highest clock the peer has confirmed for ``doc_id``
+        (empty when it never mentioned the doc)."""
+        return self._peer_acked.get(doc_id, {})
+
+    def replication_lag(self, clocks=None):
+        """``(lag_ops, lagging_docs)`` of this link: the change seqs
+        the peer has not acked across the fleet, and the docs where it
+        is behind — derived entirely from the clocks both ends already
+        exchange (Okapi's cheap causal metadata, PAPERS.md). ``clocks``
+        lets the heartbeat share its one fleet clock sweep."""
+        if clocks is None:
+            clocks = self._local_clocks()
+        lag = 0
+        lagging = 0
+        for doc_id, clock in clocks.items():
+            acked = self._peer_acked.get(doc_id)
+            if acked:
+                d = sum(s - a for s, a in
+                        ((s, acked.get(actor, 0))
+                         for actor, s in clock.items()) if s > a)
+            else:
+                d = sum(clock.values())
+            if d:
+                lag += d
+                lagging += 1
+        return lag, lagging
+
     # -- inbound -------------------------------------------------------------
 
     def _reject(self, reason):
@@ -488,6 +595,10 @@ class ResilientConnection:
                                     f'(ack {seq})')
             rec = self._sent.pop(seq, None)
             self._bp_clear(rec)
+            if rec is not None:
+                # the peer confirmed this envelope: the payload clock
+                # it carried is now ACKED — the lag/convergence signal
+                self._fold_acked(rec.envelope.get('payload'))
             return None
         if kind == 'busy':
             return self._receive_busy(env)
@@ -515,6 +626,10 @@ class ResilientConnection:
             self.metrics.bump('sync_checksum_failures')
             return self._reject(f'payload checksum mismatch (seq '
                                 f'{seq})')
+        # the clocks an integrity-checked data payload advertises are
+        # the peer's own state — fold them into the acked map
+        # (duplicates carry the same clocks; the union is idempotent)
+        self._fold_acked(payload)
         if self._seen(seq):
             self._send_ack(seq)            # the first ack may be lost
             self.metrics.bump('sync_msgs_duplicate')
@@ -658,8 +773,27 @@ class ResilientConnection:
         if env.get('sum') != payload_checksum(clocks):
             self.metrics.bump('sync_checksum_failures')
             return self._reject('heartbeat checksum mismatch')
+        # the optional digest map is advisory: malformed or
+        # dsum-mismatched digests drop ONLY the audit for this beat
+        # (counted; the next beat repeats them) — the clocks above
+        # already verified and still heal normally
+        digests = env.get('digests')
+        if digests is not None and (
+                not _valid_digests(digests) or
+                env.get('dsum') != digest_checksum(digests,
+                                                   env['sum'])):
+            self.metrics.bump('sync_checksum_failures')
+            digests = None
         self.metrics.bump('sync_heartbeats_received')
         doc_set = self._conn._doc_set
+        # a heartbeat is the peer's authoritative state advert: every
+        # clock it carries is ACKED (the lag/convergence signal)
+        for doc_id, clock in clocks.items():
+            if isinstance(clock, dict):
+                clock_union(self._peer_acked, doc_id, clock)
+        self._note_acked(list(clocks))
+        if digests:
+            self._audit_digests(clocks, digests)
         # membership only: get_doc would mint (and cache) a handle per
         # advertised doc, ~fleet-size allocations per beat on general/
         # serving doc sets
@@ -684,6 +818,44 @@ class ResilientConnection:
             except MessageRejected:
                 pass
         return None
+
+    def _audit_digests(self, clocks, digests):
+        """The divergence audit: a doc whose advertised clock EQUALS
+        the local clock holds — by the CRDT convergence contract —
+        byte-identical state, so its state digests must match too.
+        Equal clocks with unequal digests is silent divergence (an
+        out-of-band mutation, an evil-twin change, bit rot below the
+        checksums): bump ``sync_divergence_detected``, record it on
+        the doc set's ``diverged`` registry (which dumps a flight-
+        recorder incident on serving stacks) and quarantine NEITHER
+        side — the digest says the replicas disagree, not which one is
+        right. Report, don't guess. Docs whose clocks differ are just
+        lag (the normal protocol is still converging them) and are
+        never compared."""
+        doc_set = self._doc_set
+        digest_of = getattr(doc_set, 'digest_of_id', None)
+        clock_of = getattr(doc_set, 'clock_of_id', None)
+        if digest_of is None or clock_of is None:
+            return
+        for doc_id, remote in digests.items():
+            clock = clocks.get(doc_id)
+            if not isinstance(clock, dict) or clock != clock_of(doc_id):
+                continue               # lag, not divergence
+            local = digest_of(doc_id)
+            if local is None or local == remote:
+                continue
+            note = getattr(doc_set, 'note_divergence', None)
+            fresh = note(doc_id, peer=self.peer_id,
+                         local_digest=local, remote_digest=remote,
+                         clock=dict(clock)) if note is not None \
+                else True
+            if fresh:
+                self.metrics.bump('sync_divergence_detected')
+                if self.metrics.active:
+                    self.metrics.emit('sync_divergence',
+                                      doc_id=doc_id,
+                                      local_digest=local,
+                                      remote_digest=remote)
 
     # -- logical time --------------------------------------------------------
 
@@ -736,11 +908,10 @@ class ResilientConnection:
                 self._now % self.heartbeat_every == 0:
             self.heartbeat()
 
-    def heartbeat(self):
-        """Re-advertise every local doc's current clock in one
-        unreliable envelope (loss is fine: the next beat repeats it).
-        This is the Demers-style anti-entropy loop that makes
-        convergence eventual even when retransmit budgets run out."""
+    def _local_clocks(self):
+        """Every local doc's truthful clock in one pass — what the
+        heartbeat advertises and what the lag derivation compares the
+        acked map against."""
         from .. import frontend as Frontend
         clocks = {}
         hb = getattr(self._doc_set, 'heartbeat_clocks', None)
@@ -767,12 +938,45 @@ class ResilientConnection:
                 if state is None:
                     continue
                 clocks[doc_id] = dict(state.clock)
+        return clocks
+
+    def heartbeat(self):
+        """Re-advertise every local doc's current clock in one
+        unreliable envelope (loss is fine: the next beat repeats it).
+        This is the Demers-style anti-entropy loop that makes
+        convergence eventual even when retransmit budgets run out.
+
+        The beat also refreshes this link's replication-lag gauges
+        (local clocks vs the peer's acked map — one sweep the clock
+        collection already paid for) and, when the doc set maintains
+        per-doc state digests, attaches them for the divergence audit.
+        A digested heartbeat stamps v=2 with the digests under their
+        own seeded ``dsum`` (the main ``sum`` stays the plain clocks
+        checksum, so even a digest-unaware v2 receiver heals from it);
+        an undigested one is byte-identical to the v1 protocol — mixed
+        fleets interoperate unchanged in both directions."""
+        clocks = self._local_clocks()
         if not clocks:
             return
+        # per-link lag gauges ride the beat: the scoped write lands
+        # both process-wide and under peer/<id>/, and fleet_status()
+        # health reads the per-link slices
+        lag, lagging = self.replication_lag(clocks)
+        self.metrics.set_gauge('sync_replication_lag_ops', lag)
+        self.metrics.set_gauge('sync_lagging_docs', lagging)
+        digests = None
+        if self.hb_digests:
+            hb_dig = getattr(self._doc_set, 'heartbeat_digests', None)
+            if hb_dig is not None:
+                digests = hb_dig() or None
         self.metrics.bump('sync_heartbeats_sent')
-        self._send_raw({'v': BASE_VERSION, 'kind': 'hb',
-                        'sum': payload_checksum(clocks),
-                        'clocks': clocks})
+        env = {'v': ENVELOPE_VERSION if digests else BASE_VERSION,
+               'kind': 'hb', 'sum': payload_checksum(clocks),
+               'clocks': clocks}
+        if digests is not None:
+            env['digests'] = digests
+            env['dsum'] = digest_checksum(digests, env['sum'])
+        self._send_raw(env)
 
     @property
     def in_flight(self):
@@ -814,6 +1018,12 @@ class ResilientConnection:
             'busy_received': scoped.get('sync_busy_received', 0),
             'retransmits': scoped.get('sync_retransmits', 0),
             'retry_exhausted': scoped.get('sync_retry_exhausted', 0),
+            # lag gauges refresh on each heartbeat (stale by at most
+            # one beat period); acked_docs is live
+            'replication_lag_ops':
+                scoped.get('sync_replication_lag_ops', 0),
+            'lagging_docs': scoped.get('sync_lagging_docs', 0),
+            'acked_docs': len(self._peer_acked),
             'msgs_sent': scoped.get('sync_msgs_sent', 0),
             'msgs_received': scoped.get('sync_msgs_received', 0),
             'flow_backlog_docs':

@@ -154,6 +154,11 @@ class ServingDocSet:
         if flight_recorder is not None:
             metrics.subscribe(flight_recorder)   # idempotent
         self._incident_seen = set()    # docs whose quarantine dumped
+        # health rollup wiring: the inner doc set owns the state
+        # machine; this layer contributes the serving signals (parked
+        # docs) and captures incidents on first entry to critical
+        self.inner.health_extra = self._serving_health_signals
+        self.inner.health_incident = self._health_incident
         self._reconcile_park_dir()
 
     # -- recovery ------------------------------------------------------------
@@ -191,6 +196,7 @@ class ServingDocSet:
                 q = payload.get('quarantine')
                 self._evicted[doc_id] = {
                     'clock': dict(park_clock),
+                    'digest': payload.get('digest'),
                     'error': q['error'] if q else None}
                 if have:
                     merge_now.append(doc_id)
@@ -411,6 +417,7 @@ class ServingDocSet:
             q = payloads[doc_id].get('quarantine')
             self._evicted[doc_id] = {
                 'clock': payloads[doc_id]['clock'],
+                'digest': payloads[doc_id].get('digest'),
                 'error': q['error'] if q else None}
         self._n_evictions += len(doc_ids)
         metrics.bump('serving_evictions', len(doc_ids))
@@ -497,6 +504,9 @@ class ServingDocSet:
             self._check_incidents()
         self._park_stuck_quarantine()
         self._enforce_budget()
+        # health transitions are recorded per quantum, not only when
+        # an operator happens to poll fleet_status() — O(connections)
+        self.inner.evaluate_health()
 
     # -- DocSet surface (every public entry is a touch) ----------------------
 
@@ -609,6 +619,81 @@ class ServingDocSet:
                 else dict(by_idx.get(idx, {}))
         return clocks
 
+    def digest_of_id(self, doc_id):
+        """The doc's state digest WITHOUT faulting it in: the digest
+        recorded at eviction for parked docs, the incremental store
+        digest otherwise (None when unavailable — the divergence audit
+        then skips the doc rather than comparing a stale zero)."""
+        rec = self._evicted.get(doc_id)
+        if rec is not None:
+            return rec.get('digest')
+        return self.inner.digest_of_id(doc_id)
+
+    def clock_of_id(self, doc_id):
+        """The doc's clock WITHOUT faulting it in (evicted docs serve
+        their recorded eviction-time clock) — the divergence audit's
+        compare key, so a parked doc still gets audited against the
+        state it was parked with."""
+        rec = self._evicted.get(doc_id)
+        if rec is not None:
+            return dict(rec['clock'])
+        return self.inner.clock_of_id(doc_id)
+
+    def heartbeat_digests(self):
+        """The divergence-audit twin of :meth:`heartbeat_clocks`:
+        resident docs serve the incremental store digests, evicted
+        docs their RECORDED eviction-time digest — never a
+        fault-in."""
+        store = self.inner.store
+        if not getattr(store, '_digest_valid', False):
+            return None
+        digs = store.digests_all()
+        out = {}
+        for idx, doc_id in enumerate(self.inner.ids):
+            rec = self._evicted.get(doc_id)
+            if rec is not None:
+                dig = rec.get('digest')
+            else:
+                dig = int(digs[idx])
+            if dig:
+                out[doc_id] = dig
+        return out
+
+    def note_divergence(self, doc_id, **meta):
+        """Record a heartbeat-detected silent divergence (see
+        :meth:`GeneralDocSet.note_divergence <automerge_tpu.sync.
+        general_doc_set.GeneralDocSet.note_divergence>`) and dump the
+        flight recorder as a divergence incident the first time each
+        (doc, peer) pair reports — the black box of the beats before
+        the replicas disagreed. Neither side quarantines."""
+        fresh = self.inner.note_divergence(doc_id, **meta)
+        if fresh and self.flight_recorder is not None:
+            dump_incident(
+                self.flight_recorder, self.dir_path, 'divergence',
+                doc_id=doc_id, peer=meta.get('peer'),
+                local_digest=meta.get('local_digest'),
+                remote_digest=meta.get('remote_digest'))
+        return fresh
+
+    noteDivergence = note_divergence
+
+    # -- health --------------------------------------------------------------
+
+    def _serving_health_signals(self):
+        """The serving layer's contribution to the health rollup:
+        parked (stuck-quarantine) docs. O(evicted), never O(fleet)."""
+        return {'parked': sum(1 for rec in self._evicted.values()
+                              if rec.get('error'))}
+
+    def _health_incident(self, previous, state, signals, reasons):
+        """First entry to critical dumps the flight recorder — the
+        seconds of events that led the fleet over the line."""
+        if state == 'critical' and self.flight_recorder is not None:
+            dump_incident(self.flight_recorder, self.dir_path,
+                          'critical', previous=previous,
+                          reasons=reasons,
+                          signals={k: v for k, v in signals.items()})
+
     # -- durability ----------------------------------------------------------
 
     def checkpoint(self):
@@ -634,34 +719,37 @@ class ServingDocSet:
 
     # -- operator surface ----------------------------------------------------
 
-    def fleet_status(self):
-        """The serving-layer operator surface: the inner per-doc
-        status plus residency (``resident``/``evicted``/``parked``
-        state, last-touch tick, estimated resident bytes) and fleet
-        totals (resident/evicted/parked counts, eviction/fault-in
-        tallies, resident and encode-cache bytes, budget,
-        backpressure depth)."""
-        status = self.inner.fleet_status()
+    def fleet_status(self, docs=True):
+        """The serving-layer operator surface: the inner status plus
+        residency totals (resident/evicted/parked counts,
+        eviction/fault-in tallies, resident and encode-cache bytes,
+        budget, backpressure depth) — and, with ``docs=True``, the
+        per-doc decoration (``resident``/``evicted``/``parked`` state,
+        last-touch tick, estimated resident bytes). Totals come from
+        incrementally-maintained state and vectorized estimates:
+        ``fleet_status(docs=False)`` never loops over clean resident
+        docs."""
+        status = self.inner.fleet_status(docs=docs)
         est = self.inner.store.doc_byte_estimates()
-        n_resident = n_parked = 0
-        for idx, doc_id in enumerate(self.inner.ids):
-            doc = status['docs'][doc_id]
-            rec = self._evicted.get(doc_id)
-            if rec is None:
-                n_resident += 1
-                doc['state'] = 'resident'
-                doc['resident_bytes'] = int(est[idx])
-            else:
-                doc['state'] = 'parked' if rec.get('error') \
-                    else 'evicted'
-                n_parked += doc['state'] == 'parked'
-                doc['clock'] = dict(rec['clock'])
-                doc['quarantined'] = rec.get('error')
-                doc['resident_bytes'] = 0
-            doc['last_touch'] = self._last_touch.get(doc_id, -1)
-        counters = metrics.snapshot()
+        n_parked = sum(1 for rec in self._evicted.values()
+                       if rec.get('error'))
+        if docs:
+            for idx, doc_id in enumerate(self.inner.ids):
+                doc = status['docs'][doc_id]
+                rec = self._evicted.get(doc_id)
+                if rec is None:
+                    doc['state'] = 'resident'
+                    doc['resident_bytes'] = int(est[idx])
+                else:
+                    doc['state'] = 'parked' if rec.get('error') \
+                        else 'evicted'
+                    doc['clock'] = dict(rec['clock'])
+                    doc['quarantined'] = rec.get('error')
+                    doc['resident_bytes'] = 0
+                doc['last_touch'] = self._last_touch.get(doc_id, -1)
+        counters = metrics.counters
         status['totals'].update({
-            'resident': n_resident,
+            'resident': len(self.inner.ids) - len(self._evicted),
             'evicted': len(self._evicted) - n_parked,
             'parked': n_parked,
             'evictions': self._n_evictions,

@@ -125,6 +125,33 @@ SYNC_COUNTERS = (
     'sync_wire_msgs_sent', 'sync_wire_msgs_received',
     'sync_wire_bytes_sent', 'sync_apply_ms', 'sync_flush_ms')
 
+# Convergence/health counters (the replication-observability contract:
+# how far behind is each peer, are any replicas silently diverged, and
+# is the fleet healthy right now):
+#   sync_replication_lag_ops   gauge (per heartbeat, per link): change
+#                              seqs the peer has not acked yet
+#   sync_lagging_docs          gauge: docs where the peer is behind
+#   sync_convergence_ms        observe series: change birth (local
+#                              apply) -> every registered peer's acked
+#                              clock covers it (full-fleet ack)
+#   sync_divergence_detected   equal clocks, unequal state digests on
+#                              a heartbeat — a silently diverged
+#                              replica (reported, never quarantined)
+#   fleet_health_state         gauge: 0 green / 1 degraded / 2 critical
+#   fleet_health_transitions   health-state changes recorded (each one
+#                              also emits a `health_transition` event)
+CONVERGENCE_COUNTERS = (
+    'sync_replication_lag_ops', 'sync_lagging_docs',
+    'sync_convergence_ms', 'sync_divergence_detected',
+    'fleet_health_state', 'fleet_health_transitions')
+
+# Every registered counter/gauge/series name, in one tuple — the
+# telemetry exporter (automerge_tpu/telemetry.py) renders ALL of these
+# even when never bumped, and tests/test_metrics.py asserts none is
+# silently unexported.
+ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
+                          SYNC_COUNTERS + CONVERGENCE_COUNTERS)
+
 
 # -- histogram geometry --------------------------------------------------------
 #

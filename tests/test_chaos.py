@@ -15,7 +15,8 @@ import automerge_tpu as am
 from automerge_tpu.common import ROOT_ID
 from automerge_tpu.durability import DurableDocSet
 from automerge_tpu.sync import DocSet, GeneralDocSet
-from automerge_tpu.sync.chaos import ChaosFleet, canonical, doc_set_view
+from automerge_tpu.sync.chaos import (ChaosFleet, assert_digest_parity,
+                                      canonical, doc_set_view)
 from automerge_tpu.sync.resilient import (ResilientConnection,
                                           payload_checksum)
 from automerge_tpu.utils.metrics import metrics
@@ -121,20 +122,37 @@ class TestChaosConvergence:
 
     def test_general_fleet_full_chaos(self):
         """The general-store fleet run: rich docs through
-        BatchingConnection ticks under every fault at once."""
+        BatchingConnection ticks under every fault at once. After
+        convergence the incremental state digests must equal an O(doc)
+        recompute on every peer (the digest-maintenance parity oracle)
+        and the heartbeat digest audit must have flagged NOTHING — a
+        transport-faulted but correctly-converged fleet is not
+        divergence."""
         clean = clean_views(general_fleet, True)
+        before = metrics.counters.get('sync_divergence_detected', 0)
         fleet = ChaosFleet(general_fleet(), seed=42, drop=0.15,
                            dup=0.1, delay=2, corrupt=0.1,
                            batching=True)
         fleet.run(max_ticks=2000)
         assert [canonical(v) for v in fleet.views()] == clean
+        for ds in fleet.doc_sets:
+            assert_digest_parity(ds)
+            assert not ds.diverged
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) == before
 
     def test_general_fleet_eager_chaos(self):
         clean = clean_views(general_fleet, False)
+        before = metrics.counters.get('sync_divergence_detected', 0)
         fleet = ChaosFleet(general_fleet(), seed=43, drop=0.15,
                            dup=0.1, delay=2, batching=False)
         fleet.run(max_ticks=2000)
         assert [canonical(v) for v in fleet.views()] == clean
+        for ds in fleet.doc_sets:
+            assert_digest_parity(ds)
+            assert not ds.diverged
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) == before
 
     def test_general_fleet_wire_chaos(self):
         """The acceptance schedules with ResilientConnection carrying
@@ -145,6 +163,8 @@ class TestChaosConvergence:
         not semantics."""
         clean = clean_views(general_fleet, True)      # dict-path oracle
         before = metrics.counters.get('sync_checksum_failures', 0)
+        div_before = metrics.counters.get('sync_divergence_detected',
+                                          0)
         fleet = ChaosFleet(general_fleet(), seed=44, drop=0.15,
                            dup=0.1, delay=2, corrupt=0.2,
                            batching=True, wire=True)
@@ -156,6 +176,16 @@ class TestChaosConvergence:
         # corruption was caught at the envelope layer, never as a
         # poisoned apply
         assert not any(ds.quarantined for ds in fleet.doc_sets)
+        # digest parity across the WIRE delivery path (blob -> codec
+        # -> fused apply must fold the same canonical hashes the dict
+        # path does), and zero divergence false positives even with a
+        # corrupting fabric (a flipped digest bit is a checksum
+        # failure, never an alarm)
+        for ds in fleet.doc_sets:
+            assert_digest_parity(ds)
+            assert not ds.diverged
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) == div_before
 
     def test_general_fleet_wire_partition_heal(self):
         """Divergent concurrent edits across a healed partition merge
@@ -185,6 +215,235 @@ class TestChaosConvergence:
             assert v['doc0']['side0'] == 'A'
             assert v['doc0']['side1'] == 'B'
         assert len({canonical(v) for v in fleet.views()}) == 1
+        # a healed partition is concurrent-edit MERGE, not divergence:
+        # the digest audit stays quiet and parity holds on every peer
+        for ds in fleet.doc_sets:
+            assert_digest_parity(ds)
+            assert not ds.diverged
+
+
+EVIL_OBJ = '00000000-0000-4000-8000-00000000ee11'
+
+
+def _evil_twin(value):
+    """Two calls with different ``value`` make an evil-twin pair: the
+    same ``(actor, seq)`` identity, different op content — applied to
+    two replicas they leave the clocks EQUAL while the states
+    differ."""
+    return [{'actor': 'evil', 'seq': 1, 'deps': {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': 'twin',
+         'value': value}]}]
+
+
+class TestDivergenceAudit:
+    """Satellite: silent logic-level divergence (out-of-band store
+    mutation, checksums intact) is detected by the heartbeat digest
+    audit within one heartbeat interval — reported, never
+    quarantined — with zero false positives (asserted on every
+    pre-existing chaos schedule above)."""
+
+    HB = 4
+
+    def _diverge(self, wire):
+        fleet = ChaosFleet(general_fleet(), seed=77, batching=True,
+                           wire=wire, heartbeat_every=self.HB)
+        fleet.run(max_ticks=500)
+        before = metrics.counters.get('sync_divergence_detected', 0)
+        sent_before = fleet.stats['sent']
+        fleet.inject_silent_divergence(0, 'doc0', _evil_twin('A'))
+        fleet.inject_silent_divergence(1, 'doc0', _evil_twin('B'))
+        # the clocks are equal everywhere: the data path ships NOTHING
+        # for the diverged doc — only heartbeats flow
+        for _ in range(self.HB + 2):   # one interval + delivery
+            fleet.tick()
+        return fleet, before, sent_before
+
+    @pytest.mark.parametrize('wire', [False, True])
+    def test_detected_within_one_heartbeat(self, wire):
+        fleet, before, _ = self._diverge(wire)
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) >= before + 2
+        for ds in fleet.doc_sets:
+            rec = ds.diverged['doc0']
+            assert rec['local_digest'] != rec['remote_digest']
+            assert rec['clock']['evil'] == 1
+            # report, don't guess: NEITHER side quarantined
+            assert not ds.quarantined
+        fleet.close()
+
+    def test_counted_once_not_once_per_heartbeat(self):
+        fleet, before, _ = self._diverge(False)
+        first = metrics.counters.get('sync_divergence_detected', 0)
+        for _ in range(3 * self.HB):
+            fleet.tick()                # more heartbeats, same record
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) == first
+        fleet.close()
+
+    def test_counted_once_per_peer_not_ping_pong(self):
+        """The dedup is per (doc, peer): a second peer reporting the
+        same doc counts once more, but alternating peers must never
+        re-count (the held record accumulates reporters instead of
+        overwriting the last one)."""
+        ds = GeneralDocSet(4)
+        assert ds.note_divergence('d', peer='p1', local_digest=1,
+                                  remote_digest=2, clock={'a': 1})
+        assert not ds.note_divergence('d', peer='p1')
+        assert ds.note_divergence('d', peer='p2')
+        assert not ds.note_divergence('d', peer='p1')   # no ping-pong
+        assert not ds.note_divergence('d', peer='p2')
+        assert ds.diverged['d']['peers'] == ['p1', 'p2']
+        assert ds.diverged['d']['local_digest'] == 1
+
+    def test_three_node_fleet_counts_once_per_pair(self):
+        """Three replicas all pairwise diverged: every ordered (node,
+        peer) pair detects exactly once — further heartbeats never
+        re-count."""
+        fleet = ChaosFleet(general_fleet(n_peers=3), seed=81,
+                           batching=True, heartbeat_every=self.HB)
+        fleet.run(max_ticks=800)
+        before = metrics.counters.get('sync_divergence_detected', 0)
+        for node, val in enumerate(('A', 'B', 'C')):
+            fleet.inject_silent_divergence(node, 'doc0',
+                                           _evil_twin(val))
+        for _ in range(self.HB + 2):
+            fleet.tick()
+        first = metrics.counters.get('sync_divergence_detected', 0)
+        assert first >= before + 6     # 3 nodes x 2 peers each
+        for _ in range(3 * self.HB):
+            fleet.tick()
+        assert metrics.counters.get(
+            'sync_divergence_detected', 0) == first
+        for ds in fleet.doc_sets:
+            assert len(ds.diverged['doc0']['peers']) == 2
+        fleet.close()
+
+    def test_health_goes_critical_and_operator_clears(self):
+        fleet, _, _ = self._diverge(False)
+        ds = fleet.doc_sets[0]
+        health = ds.fleet_status(docs=False)['health']
+        assert health['state'] == 'critical'
+        assert any('diverged' in r for r in health['reasons'])
+        # sticky by design: still critical after more quiet ticks...
+        for _ in range(2 * self.HB):
+            fleet.tick()
+        assert ds.evaluate_health()['state'] == 'critical'
+        # ...until the operator resolves it.  (Clearing on ONE node
+        # only frees that node; the next heartbeat re-detects because
+        # the replicas really are still diverged — so quiet the link
+        # first, exactly what a real resync would do.)
+        fleet.close()
+        for peer in fleet.doc_sets:
+            peer.clear_divergence('doc0')
+        assert ds.evaluate_health()['state'] == 'green'
+
+    def test_divergence_dumps_incident_on_serving(self, tmp_path):
+        from automerge_tpu.sync.serving import ServingDocSet
+        from automerge_tpu.utils.metrics import FlightRecorder
+        from automerge_tpu.durability import load_incident
+        sets = general_fleet()
+        serving = ServingDocSet(sets[0], str(tmp_path / 'srv'),
+                                flight_recorder=FlightRecorder(256))
+        fleet = ChaosFleet([serving, sets[1]], seed=78,
+                           batching=True, heartbeat_every=self.HB)
+        fleet.run(max_ticks=500)
+        fleet.inject_silent_divergence(0, 'doc0', _evil_twin('A'))
+        fleet.inject_silent_divergence(1, 'doc0', _evil_twin('B'))
+        for _ in range(self.HB + 2):
+            fleet.tick()
+        assert 'doc0' in serving.diverged
+        files = sorted((tmp_path / 'srv' / 'incidents').glob(
+            '*divergence*'))
+        assert files, 'no divergence incident dumped'
+        events, trigger = load_incident(str(files[0]))
+        assert trigger is not None
+        assert trigger['kind'] == 'divergence'
+        assert trigger['doc_id'] == 'doc0'
+        assert trigger['local_digest'] != trigger['remote_digest']
+        fleet.close()
+
+    def test_undigested_fleet_interop(self):
+        """Mixed-version interop: endpoints with digests disabled ship
+        the v1 heartbeat BYTE-IDENTICAL to the old protocol and the
+        fleet still converges byte-identically to the clean oracle."""
+        clean = clean_views(general_fleet, True)
+        fleet = ChaosFleet(general_fleet(), seed=79, drop=0.1,
+                           batching=True, heartbeat_every=self.HB,
+                           conn_kwargs={'hb_digests': False})
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == clean
+        fleet.close()
+
+    def test_undigested_heartbeat_is_v1_wire_identical(self):
+        """The envelope shape gate: a digestless heartbeat (disabled,
+        or a doc set that keeps no digests) carries v=1 and the plain
+        clocks checksum — no `digests` key at all — so a v1-only
+        receiver accepts it unchanged."""
+        sent = []
+        sets = general_fleet(n_peers=1)
+        conn = ResilientConnection(sets[0], sent.append,
+                                   batching=True, hb_digests=False)
+        conn.open()
+        conn.heartbeat()
+        env = sent[-1]
+        assert env['v'] == 1
+        assert 'digests' not in env
+        assert env['sum'] == payload_checksum(env['clocks'])
+        conn.close()
+        # digests ON: same clocks, v=2, digests under their own dsum —
+        # the main sum STAYS the plain clocks checksum, so a v2
+        # receiver that predates digests validates this beat unchanged
+        sent2 = []
+        conn2 = ResilientConnection(sets[0], sent2.append,
+                                    batching=True)
+        conn2.open()
+        conn2.heartbeat()
+        env2 = sent2[-1]
+        assert env2['v'] == 2 and env2['digests']
+        assert env2['clocks'] == env['clocks']
+        assert env2['sum'] == payload_checksum(env2['clocks'])
+        from automerge_tpu.sync.resilient import digest_checksum
+        assert env2['dsum'] == digest_checksum(env2['digests'],
+                                               env2['sum'])
+        conn2.close()
+
+    def test_tampered_digests_drop_audit_not_clocks(self):
+        """A bit flipped in the digest map is a counted checksum
+        failure that skips ONLY the audit — the verified clocks still
+        heal, and no false divergence is ever recorded."""
+        sets = general_fleet(n_peers=2)
+        a_out, b_out = [], []
+        ca = ResilientConnection(sets[0], a_out.append, batching=True)
+        cb = ResilientConnection(sets[1], b_out.append, batching=True)
+        ca.open()
+        cb.open()
+        ca.heartbeat()
+        env = a_out[-1]
+        assert env['kind'] == 'hb' and env['digests']
+        doc = next(iter(env['digests']))
+        env['digests'][doc] ^= 1               # silent bit flip
+        before = metrics.counters.get('sync_checksum_failures', 0)
+        hb_before = metrics.counters.get('sync_heartbeats_received', 0)
+        cb.receive_msg(env)
+        assert metrics.counters.get('sync_checksum_failures', 0) == \
+            before + 1
+        assert metrics.counters.get('sync_heartbeats_received', 0) == \
+            hb_before + 1                      # clocks still processed
+        assert not sets[1].diverged            # never a false alarm
+        ca.close()
+        cb.close()
+
+    def test_mixed_digested_and_plain_endpoints_converge(self):
+        """One side digested, one side not: the digested side's v2
+        heartbeats land on an endpoint whose doc set never compares
+        (plain DocSets have no digest surface), the plain side's v1
+        beats land on the digested one — both directions converge."""
+        clean = clean_views(frontend_fleet, True)
+        fleet = ChaosFleet(frontend_fleet(), seed=80, drop=0.1,
+                           batching=True, heartbeat_every=self.HB)
+        fleet.run(max_ticks=2000)
+        assert [canonical(v) for v in fleet.views()] == clean
+        fleet.close()
 
 
 class TestResilientTransport:

@@ -317,13 +317,13 @@ class TestFlightRecorder:
 
 
 class TestRegistryDriftGuard:
-    """Satellite: every literal sync_/serving_ counter name bumped
-    anywhere in automerge_tpu/ must appear in one of the three
+    """Satellite: every literal sync_/serving_/fleet_ counter name
+    bumped anywhere in automerge_tpu/ must appear in one of the four
     registries — a silently added name fails here, not in a dashboard
     six weeks later."""
 
     NAME_RE = re.compile(
-        r"(?:bump|set_gauge|observe)\(\s*'((?:sync|serving)_"
+        r"(?:bump|set_gauge|observe)\(\s*'((?:sync|serving|fleet)_"
         r"[a-z0-9_]+)'")
 
     def _package_names(self):
@@ -341,23 +341,48 @@ class TestRegistryDriftGuard:
     def test_every_bumped_name_is_registered(self):
         bumped = self._package_names()
         assert bumped, 'guard regex found no counter sites at all'
-        registered = set(M.FAULT_COUNTERS) | set(M.SERVING_COUNTERS) \
-            | set(M.SYNC_COUNTERS)
+        registered = set(M.ALL_COUNTER_REGISTRIES)
         missing = bumped - registered
         assert not missing, (
-            f'sync_/serving_ counters bumped in automerge_tpu/ but '
-            f'absent from FAULT_COUNTERS/SERVING_COUNTERS/'
-            f'SYNC_COUNTERS: {sorted(missing)}')
+            f'sync_/serving_/fleet_ counters bumped in automerge_tpu/ '
+            f'but absent from FAULT_COUNTERS/SERVING_COUNTERS/'
+            f'SYNC_COUNTERS/CONVERGENCE_COUNTERS: {sorted(missing)}')
 
     def test_no_registered_name_is_dead(self):
-        """The reverse direction: a registered sync_/serving_ name no
-        call site bumps is a stale registry entry."""
+        """The reverse direction: a registered sync_/serving_/fleet_
+        name no call site bumps is a stale registry entry."""
         bumped = self._package_names()
-        registered = set(M.FAULT_COUNTERS) | set(M.SERVING_COUNTERS) \
-            | set(M.SYNC_COUNTERS)
+        registered = set(M.ALL_COUNTER_REGISTRIES)
         dead = {n for n in registered
-                if n.startswith(('sync_', 'serving_'))} - bumped
+                if n.startswith(('sync_', 'serving_', 'fleet_'))} \
+            - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
+
+    def test_registries_are_disjoint(self):
+        """A name in two registries would double-render in the
+        exporter's zero-fill pass."""
+        seen = set()
+        for reg in (M.FAULT_COUNTERS, M.SERVING_COUNTERS,
+                    M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS):
+            dup = seen & set(reg)
+            assert not dup, f'registered twice: {sorted(dup)}'
+            seen |= set(reg)
+
+    def test_every_registered_metric_is_exported(self):
+        """Satellite: every registered counter/gauge/series renders in
+        the Prometheus exposition even on a FRESH registry — a
+        dashboard keyed on a registered name can never silently read
+        nothing."""
+        from automerge_tpu import telemetry
+        text = telemetry.render_prometheus(M.Metrics())
+        for name in M.ALL_COUNTER_REGISTRIES:
+            metric = name
+            if name.endswith('_ms'):
+                assert f'{metric}_count' in text, name
+                assert f'{metric}_bucket' in text, name
+            else:
+                assert re.search(rf'^{metric}(\{{| )', text,
+                                 re.M), name
 
 
 class TestBackendIntegration:
@@ -464,6 +489,12 @@ class TestFaultCounters:
             'sync_changes_sent', 'sync_changes_received',
             'sync_wire_msgs_sent', 'sync_wire_bytes_sent',
             'sync_apply_ms', 'sync_flush_ms'}
+
+    def test_convergence_registry_names_are_pinned(self):
+        assert set(M.CONVERGENCE_COUNTERS) >= {
+            'sync_replication_lag_ops', 'sync_lagging_docs',
+            'sync_convergence_ms', 'sync_divergence_detected',
+            'fleet_health_state', 'fleet_health_transitions'}
 
     def test_rejected_message_counts(self):
         from automerge_tpu.sync.connection import MessageRejected

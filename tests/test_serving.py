@@ -632,6 +632,56 @@ class TestOverloadChaos:
         assert dst._n_evictions > 0
         assert [canonical(v) for v in fleet.views()] == want
 
+    def test_health_transitions_under_squeeze(self, tmp_path):
+        """Acceptance: fleet_status()['health'] transitions under the
+        squeeze-to-25% schedule. The fleet starts green; the squeeze
+        plus a metered burst drives admission debt over a (tightened)
+        critical bound — the serving tick records the transition and
+        dumps a flight-recorder incident on FIRST entry to critical —
+        and once pressure lifts and the fleet reconverges, health
+        recovers to green. Convergence stays byte-identical."""
+        from automerge_tpu.utils.metrics import FlightRecorder
+        want = self._clean(bursts=range(2, 8))
+        src = _seed_serving(tmp_path / 'src', n_docs=self.N)
+        dst = ServingDocSet(GeneralDocSet(32), str(tmp_path / 'dst'),
+                            flight_recorder=FlightRecorder(256))
+        fleet = ChaosFleet([src, dst], seed=26, batching=True,
+                           wire=True, heartbeat_every=4,
+                           admission=[None, {'changes_per_tick': 3,
+                                             'burst_ticks': 2}])
+        fleet.run(max_ticks=1200)
+        assert dst.fleet_status(docs=False)['health']['state'] == \
+            'green'
+        trans_before = metrics.counters.get(
+            'fleet_health_transitions', 0)
+        # the squeeze: budget to 25%, and thresholds tight enough
+        # that the metered burst's admission debt is CRITICAL (the
+        # thresholds are configurable SLOs by design)
+        total = int(dst.store.doc_byte_estimates()[
+            :len(dst.ids)].sum())
+        dst.memory_budget_bytes = total // 4
+        dst.low_watermark = 0.9
+        dst.inner.health_thresholds['admission_debt'] = (1, 4)
+        states = set()
+        for seq in range(2, 8):
+            src.apply_changes_batch(self._burst(seq))
+            fleet.tick()
+            states.add(dst._health_state)
+        assert dst._n_evictions >= 0.75 * self.N
+        assert 'critical' in states
+        # first entry to critical dumped the recorder
+        files = sorted((tmp_path / 'dst' / 'incidents').glob(
+            '*critical*'))
+        assert files, 'no critical incident dumped'
+        # pressure lifts: the fleet reconverges and health recovers
+        fleet.run(max_ticks=4000)
+        for _ in range(8):
+            fleet.tick()               # buckets refill to credit
+        assert dst.evaluate_health()['state'] == 'green'
+        assert metrics.counters.get('fleet_health_transitions', 0) \
+            >= trans_before + 2        # green->critical->...->green
+        assert [canonical(v) for v in fleet.views()] == want
+
     @pytest.mark.parametrize('force', [False, True])
     def test_memory_squeeze_forced_native(self, tmp_path, force):
         """CI forced-native lane: the squeeze schedule with the native
@@ -807,3 +857,59 @@ class TestFleetStatus:
         totals = ds.fleet_status()['totals']
         assert totals['evicted'] == 8 and totals['resident'] == 0
         assert totals['evictions'] == 8 and totals['fault_ins'] == 0
+
+    def test_status_totals_need_no_per_doc_probes(self, tmp_path):
+        """Satellite bugfix regression: ``fleet_status(docs=False)``
+        serves every total from incrementally-maintained state — no
+        per-doc Python probe runs, even through the serving wrapper
+        (the per-doc store readers are boom-patched to prove it)."""
+        ds = _seed_serving(tmp_path)
+        ds.materialize_many(list(ds.inner.ids))
+        store = ds.store
+
+        def boom(*a, **k):
+            raise AssertionError(
+                'per-doc store probe on a docs=False status poll')
+
+        for name in ('clock_of', 'doc_version', 'clocks_all'):
+            setattr(store, name, boom)     # instance-attr shadowing
+        try:
+            st = ds.fleet_status(docs=False)
+        finally:
+            for name in ('clock_of', 'doc_version', 'clocks_all'):
+                delattr(store, name)
+        assert 'docs' not in st
+        assert st['totals']['docs'] == 8
+        assert st['totals']['dirty'] == 0
+        assert st['totals']['resident'] == 8
+        assert st['health']['state'] == 'green'
+
+    def test_status_poll_is_o_connections_at_10k(self):
+        """The 10240-doc shape of the same regression: one batch
+        apply seeds the fleet, then a ``docs=False`` poll runs with
+        the per-doc readers boom-patched (O(connections) + one numpy
+        compare, never O(fleet) Python), while ``docs=True`` still
+        yields the full per-doc map."""
+        n = 10240
+        ds = GeneralDocSet(n)
+        ds.apply_changes_batch({
+            f'doc{d}': [{'actor': f'a{d}', 'seq': 1, 'deps': {},
+                         'ops': [{'action': 'set', 'obj': ROOT_ID,
+                                  'key': 'v', 'value': d}]}]
+            for d in range(n)})
+        store = ds.store
+
+        def boom(*a, **k):
+            raise AssertionError('per-doc probe at 10k')
+
+        for name in ('clock_of', 'doc_version', 'clocks_all'):
+            setattr(store, name, boom)
+        try:
+            st = ds.fleet_status(docs=False)
+        finally:
+            for name in ('clock_of', 'doc_version', 'clocks_all'):
+                delattr(store, name)
+        assert st['totals']['docs'] == n
+        assert st['totals']['dirty'] == n      # nothing materialized
+        assert ds.fleet_status()['docs'][f'doc{n - 1}'][
+            'clock'] == {f'a{n - 1}': 1}

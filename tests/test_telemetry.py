@@ -1,0 +1,201 @@
+"""Telemetry-export validation: the CI gate for satellite 5 of
+ISSUE 8 — `render_prometheus()` output must parse line-by-line as
+Prometheus text exposition (version 0.0.4), and `dump_chrome_trace()`
+output must load as Chrome-trace JSON referencing only declared
+pids/tids. Both are validated against a LIVE fleet run (spans,
+histograms, scoped per-connection counters), not a synthetic registry.
+"""
+
+import json
+import re
+
+import pytest
+
+from automerge_tpu import telemetry
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.durability import dump_incident, load_incident
+from automerge_tpu.sync import GeneralDocSet
+from automerge_tpu.sync.chaos import ChaosFleet
+from automerge_tpu.utils import metrics as M
+from automerge_tpu.utils.metrics import FlightRecorder, metrics
+
+# Prometheus text exposition grammar, the subset the exporter emits:
+# `# TYPE <name> <type>` comments and `name[{labels}] value` samples.
+_METRIC = r'[a-zA-Z_:][a-zA-Z0-9_:]*'
+_TYPE_LINE = re.compile(rf'^# TYPE {_METRIC} '
+                        r'(counter|gauge|histogram|summary|untyped)$')
+_LABEL = rf'{_METRIC}="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_SAMPLE_LINE = re.compile(
+    rf'^{_METRIC}(?:\{{{_LABEL}(?:,{_LABEL})*\}})? '
+    r'-?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|inf|nan)$', re.I)
+
+
+def validate_exposition(text):
+    """Parse ``text`` line-by-line; returns the set of sample metric
+    names. Raises AssertionError on the first malformed line — the
+    exact check CI runs."""
+    assert text.endswith('\n'), 'exposition must end with a newline'
+    names = set()
+    for i, line in enumerate(text.splitlines()):
+        if line.startswith('#'):
+            assert _TYPE_LINE.match(line), \
+                f'line {i + 1}: malformed comment: {line!r}'
+            continue
+        assert _SAMPLE_LINE.match(line), \
+            f'line {i + 1}: malformed sample: {line!r}'
+        names.add(re.match(_METRIC, line).group(0))
+    return names
+
+
+def validate_chrome_trace(obj):
+    """The Chrome-trace/Perfetto shape gate: traceEvents is a list,
+    every event's phase is known, every X/i event references a
+    (pid, tid) lane that a metadata record declared, X durations are
+    non-negative. Returns (n_spans, n_instants)."""
+    assert isinstance(obj, dict) and 'traceEvents' in obj
+    declared = set()
+    for e in obj['traceEvents']:
+        if e['ph'] == 'M':
+            declared.add((e['pid'], e['tid']))
+    n_spans = n_instants = 0
+    for e in obj['traceEvents']:
+        assert e['ph'] in ('M', 'X', 'i'), e
+        if e['ph'] == 'M':
+            continue
+        assert (e['pid'], e['tid']) in declared, \
+            f'event references undeclared lane: {e}'
+        assert isinstance(e['ts'], (int, float))
+        if e['ph'] == 'X':
+            assert e['dur'] >= 0
+            n_spans += 1
+        else:
+            n_instants += 1
+    return n_spans, n_instants
+
+
+def _run_fleet(recorder=None):
+    """A small chaotic fleet run that exercises counters, scoped
+    per-connection slices, histograms and (with a recorder) spans."""
+    sets = [GeneralDocSet(8) for _ in range(2)]
+    sets[0].apply_changes_batch(
+        {f'doc{i}': [{'actor': f'a{i}', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+             'value': i}]}] for i in range(3)})
+    if recorder is not None:
+        metrics.subscribe(recorder)
+    try:
+        fleet = ChaosFleet(sets, seed=9, drop=0.1, batching=True,
+                           heartbeat_every=4)
+        fleet.run(max_ticks=500)
+        fleet.close()
+    finally:
+        if recorder is not None:
+            metrics.unsubscribe(recorder)
+
+
+class TestPrometheusExposition:
+    def test_live_registry_parses_line_by_line(self):
+        _run_fleet()
+        names = validate_exposition(telemetry.render_prometheus())
+        # the run's counters are there, and the peer/<id>/ scopes
+        # re-expressed as labels merged into the bare names
+        assert 'sync_msgs_sent' in names
+        assert 'sync_heartbeats_sent' in names
+        text = telemetry.render_prometheus()
+        assert re.search(r'sync_msgs_sent\{.*peer="node\d".*\} \d',
+                         text)
+
+    def test_histograms_are_cumulative_with_shared_edges(self):
+        m = M.Metrics()
+        for v in (0.5, 2.0, 2.1, 50.0):
+            m.observe('x_ms', v)
+        text = telemetry.render_prometheus(m, registered=())
+        validate_exposition(text)
+        counts = [int(mt.group(1)) for mt in re.finditer(
+            r'x_ms_bucket\{le="[^"]*"\} (\d+)', text)]
+        assert counts == sorted(counts), 'buckets must be cumulative'
+        assert counts[-1] == 4
+        assert 'x_ms_count 4' in text
+        # +Inf is the final bucket, equal to _count
+        assert re.search(r'x_ms_bucket\{le="\+Inf"\} 4', text)
+        # the le edges come from the shared geometry
+        assert telemetry.bucket_edges()[0] == M.HIST_LO
+
+    def test_every_registered_name_renders_on_fresh_registry(self):
+        names = validate_exposition(
+            telemetry.render_prometheus(M.Metrics()))
+        for name in M.ALL_COUNTER_REGISTRIES:
+            want = name + '_count' if name.endswith('_ms') else name
+            assert want in names, f'{name} silently unexported'
+
+    def test_scope_prefixes_become_labels(self):
+        m = M.Metrics()
+        m.scoped(peer='p1').bump('sync_retransmits')
+        m.scoped(node='n0', peer='n1').bump('sync_retransmits')
+        text = telemetry.render_prometheus(m, registered=())
+        validate_exposition(text)
+        assert 'sync_retransmits{peer="p1"} 1' in text
+        assert 'sync_retransmits{node="n0",peer="n1"} 1' in text
+        # the aggregate (unscoped) write is its own sample
+        assert re.search(r'^sync_retransmits 2$', text, re.M)
+
+    def test_weird_names_and_label_values_stay_legal(self):
+        m = M.Metrics()
+        m.bump('device.stage-ms')              # dots/dashes sanitize
+        m.scoped(peer='a"b\\c\nd').bump('sync_x')
+        validate_exposition(
+            telemetry.render_prometheus(m, registered=()))
+
+
+class TestChromeTrace:
+    def test_live_span_dump_validates(self):
+        rec = FlightRecorder(4096)
+        _run_fleet(recorder=rec)
+        obj = telemetry.dump_chrome_trace(rec)
+        n_spans, n_instants = validate_chrome_trace(obj)
+        assert n_spans > 0, 'fleet run produced no spans'
+        # every span lane is a declared trace lane
+        json.dumps(obj)                        # fully serializable
+
+    def test_atomic_path_write_round_trips(self, tmp_path):
+        rec = FlightRecorder(1024)
+        _run_fleet(recorder=rec)
+        path = tmp_path / 'trace.json'
+        telemetry.dump_chrome_trace(rec, path=str(path))
+        with open(path, 'r', encoding='utf-8') as f:
+            validate_chrome_trace(json.load(f))
+
+    def test_garbage_events_are_skipped_not_fatal(self):
+        events = [
+            {'event': 'span', 'ts': 1.0, 'dur_ms': 2.0, 'trace': 7,
+             'name': 'ok'},
+            {'event': 'span', 'ts': 'bad'},      # no numeric ts
+            {'event': 'span', 'ts': 2.0, 'dur_ms': -1},   # negative
+            'not a dict',
+            {'event': 'doc_quarantined', 'ts': 3.0, 'doc_id': 'd'},
+        ]
+        obj = telemetry.dump_chrome_trace(events)
+        n_spans, n_instants = validate_chrome_trace(obj)
+        assert (n_spans, n_instants) == (1, 1)
+
+    def test_incident_file_to_trace_report(self, tmp_path):
+        """The operator pipeline: incident JSONL (flight-recorder
+        dump) -> tools/trace_report.py -> loadable Chrome trace."""
+        import sys
+        sys.path.insert(0, 'tools')
+        try:
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        rec = FlightRecorder(1024)
+        _run_fleet(recorder=rec)
+        inc = dump_incident(rec, str(tmp_path), 'test',
+                            doc_id='doc0')
+        events, trigger = load_incident(inc)
+        assert trigger['kind'] == 'test'
+        out = tmp_path / 'out.json'
+        assert trace_report.main([inc, '-o', str(out)]) == 0
+        with open(out, 'r', encoding='utf-8') as f:
+            n_spans, n_instants = validate_chrome_trace(json.load(f))
+        assert n_spans > 0
+        assert n_instants > 0                  # the trigger record

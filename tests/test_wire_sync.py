@@ -424,7 +424,8 @@ class TestFleetStatus:
         ds.apply_changes_batch(rich_schedule(3))
         status = ds.fleet_status()
         assert status['totals'] == {'docs': 3, 'capacity': 8,
-                                    'quarantined': 0, 'dirty': 3}
+                                    'quarantined': 0, 'diverged': 0,
+                                    'dirty': 3}
         assert status['docs']['doc1']['clock'] == \
             {'w0-1': 1, 'w1-1': 1}
         assert status['docs']['doc1']['dirty'] is True

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""trace_report: incident JSONL / span dumps -> one Chrome-trace file.
+
+The flight recorder dumps incidents as JSON-lines
+(``<dir>/incidents/incident-<seq>-<kind>.jsonl``) and any subscriber
+can log the raw event stream the same way. This tool folds one or
+more such files into a single Chrome-trace/Perfetto JSON file:
+
+    python tools/trace_report.py -o trace.json \
+        state/incidents/incident-0001-quarantine.jsonl [more.jsonl...]
+
+then load ``trace.json`` in chrome://tracing or
+https://ui.perfetto.dev — spans group into one lane per trace id
+(cross-peer ticks line up), every other event shows as an instant.
+Lines that are not valid JSON (a hand-edited file, a torn copy) are
+counted and skipped, never fatal.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_events(paths):
+    """Events from JSONL files, in file order; returns
+    (events, skipped_line_count)."""
+    events = []
+    skipped = 0
+    for path in paths:
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if isinstance(event, dict):
+                    events.append(event)
+                else:
+                    skipped += 1
+    return events, skipped
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Convert incident/event JSONL dumps to a '
+                    'Chrome-trace JSON file.')
+    parser.add_argument('inputs', nargs='+',
+                        help='incident .jsonl files (flight-recorder '
+                             'dumps or raw event logs)')
+    parser.add_argument('-o', '--output', required=True,
+                        help='Chrome-trace JSON output path')
+    args = parser.parse_args(argv)
+
+    sys.path.insert(0, __file__.rsplit('/', 2)[0])
+    from automerge_tpu.telemetry import dump_chrome_trace
+
+    events, skipped = load_events(args.inputs)
+    trace = dump_chrome_trace(events, path=args.output)
+    n_spans = sum(1 for e in trace['traceEvents']
+                  if e.get('ph') == 'X')
+    n_instants = sum(1 for e in trace['traceEvents']
+                     if e.get('ph') == 'i')
+    print(f'{args.output}: {n_spans} spans, {n_instants} instants '
+          f'from {len(events)} events'
+          + (f' ({skipped} unparseable lines skipped)' if skipped
+             else ''))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
