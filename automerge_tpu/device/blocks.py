@@ -75,6 +75,16 @@ def _intern(table, index, item):
 _MISSING = object()
 
 
+def _wire_entry_bytes(entry):
+    """Resident byte size of one encode-cache entry: v1 entries are
+    plain JSON bytes, v2 entries are ``(body, lits)`` columnar
+    pairs."""
+    if isinstance(entry, (bytes, bytearray)):
+        return len(entry)
+    body, lits = entry
+    return len(body) + sum(len(l) for l in lits)
+
+
 def change_hash(change):
     """Canonical 64-bit content hash of one reference-format change
     dict — the unit the per-doc state digest XOR-folds. Hashing the
@@ -120,10 +130,13 @@ class LazyValues:
         v = self._cache.get(i, _MISSING)
         if v is _MISSING:
             s = self._starts[i]
-            v = None if s < 0 else _json.loads(
-                self._buf[s:self._ends[i]].decode('utf-8'))
+            v = None if s < 0 else self._decode(
+                self._buf[s:self._ends[i]])
             self._cache[i] = v
         return v
+
+    def _decode(self, raw):
+        return _json.loads(raw.decode('utf-8'))
 
     def __iter__(self):
         for i in range(len(self._starts)):
@@ -139,7 +152,22 @@ class LazyValues:
         buf = b''.join(
             self._buf[self._starts[i]:self._ends[i]]
             for i in np.flatnonzero(keep))
-        return LazyValues(buf, new_starts, new_ends)
+        return type(self)(buf, new_starts, new_ends)
+
+
+class TaggedValues(LazyValues):
+    """Op values as TAGGED binary spans into a columnar v2 wire
+    container (tag byte + payload — see ``wire.py``'s literal tags),
+    decoded lazily on first access like their JSON twins. Only the
+    composite tag (6) touches a JSON decoder, and only when such a
+    value is actually materialized — the v2 apply path itself is
+    JSON-free."""
+
+    __slots__ = ()
+
+    def _decode(self, raw):
+        from .. import wire as _wire
+        return _wire.decode_tagged_literal(raw)
 
 
 class ValueTable:
@@ -630,7 +658,12 @@ class BlockStore:
         # references a COMMITTED change — a rolled-back apply can never
         # leave a stale body here. With N peers each change encodes
         # once and fans out N times; retransmits reuse the same bytes.
+        # Two formats share the contract: v1 entries are compact JSON
+        # bytes, v2 entries (_wire_cache_v2) are columnar
+        # ``(body, lits)`` pairs — a mixed-version fleet encodes each
+        # change at most once PER FORMAT.
         self._wire_cache = {}
+        self._wire_cache_v2 = {}
         self._wire_cache_bytes = 0
         self.wire_cache_hits = 0
         self.wire_cache_misses = 0
@@ -849,22 +882,25 @@ class BlockStore:
         return [block.change_dict(c) for block, c, _, _
                 in self._missing_retained(d, have_deps)]
 
-    def get_missing_changes_wire(self, d, have_deps):
+    def get_missing_changes_wire(self, d, have_deps, version=1):
         """The wire-path twin of :meth:`get_missing_changes`: the same
-        missing changes, as their compact JSON encodings (one ``bytes``
+        missing changes, as their compact wire encodings (one entry
         per change, admission order) served from the per-change encode
-        cache. On a miss the encodings build in one batched emit per
-        retained block (native C++ when available) and stay cached
-        forever — a fan-out to N peers (or a retransmit) re-serves the
-        same bytes with zero re-encode. Raises exactly the
-        retention/truncation errors of the dict path."""
+        cache — ``version=1`` JSON bytes, ``version=2`` columnar
+        ``(body, lits)`` pairs. On a miss the encodings build in one
+        batched emit per retained block (native C++ when available)
+        and stay cached forever — a fan-out to N peers (or a
+        retransmit) re-serves the same bytes with zero re-encode.
+        Raises exactly the retention/truncation errors of the dict
+        path."""
         blobs, errors = self.get_missing_changes_wire_batch(
-            [(d, have_deps)])
+            [(d, have_deps)], version=version)
         if d in errors:
             raise errors[d]
         return blobs[d]
 
-    def get_missing_changes_wire_batch(self, wants, all_clocks=None):
+    def get_missing_changes_wire_batch(self, wants, all_clocks=None,
+                                       version=1):
         """Fleet-grained wire serve: ``wants`` is ``[(doc,
         have_deps)]``; returns ``({doc: [bytes, ...]}, {doc: error})``
         where ``error`` is the retention/truncation ValueError the dict
@@ -922,7 +958,8 @@ class BlockStore:
                 sels[d] = self._missing_retained(d, have_deps)
             except ValueError as err:
                 errors[d] = err
-        cache = self._wire_cache
+        cache = self._wire_cache if version == 1 else \
+            self._wire_cache_v2
         out = {}
         # one cache probe per change: misses record their output slot
         # and are patched in place after the per-block batched emit
@@ -942,13 +979,14 @@ class BlockStore:
         n_miss = 0
         if misses:
             from .. import wire as _wire
+            encoder = _wire.encode_change_rows if version == 1 \
+                else _wire.encode_change_rows_columnar
             for block, entries in misses.values():
                 n_miss += len(entries)
-                encoded = _wire.encode_change_rows(
-                    block, [c for c, _, _, _ in entries])
+                encoded = encoder(block, [c for c, _, _, _ in entries])
                 for (c, key, lst, i), blob in zip(entries, encoded):
                     cache[key] = blob
-                    self._wire_cache_bytes += len(blob)
+                    self._wire_cache_bytes += _wire_entry_bytes(blob)
                     lst[i] = blob
             metrics.set_gauge('sync_wire_cache_bytes',
                               self._wire_cache_bytes)
@@ -959,24 +997,39 @@ class BlockStore:
         return out, errors
 
     def adopt_wire_cache(self, old_store, drop_docs=()):
-        """Carry the per-change encode cache across a store rebuild
-        (doc eviction), DROPPING the evicted docs' entries. Safe under
-        the cache's never-invalidate contract: every surviving entry
-        was created at serve time from a committed retained change of
-        ``old_store``, and this store was rebuilt by re-applying that
-        same retained log — the same ``(doc, actor, seq)`` holds the
-        same change body, so the cached bytes stay exact. Entries of
-        ``drop_docs`` are released with the docs' store rows (an
-        evicted doc that faults back in re-encodes on next serve)."""
+        """Carry the per-change encode caches (both wire formats)
+        across a store rebuild (doc eviction), DROPPING the evicted
+        docs' entries. Safe under the cache's never-invalidate
+        contract: every surviving entry was created at serve time from
+        a committed retained change of ``old_store``, and this store
+        was rebuilt by re-applying that same retained log — the same
+        ``(doc, actor, seq)`` holds the same change body, so the
+        cached bytes stay exact. Entries of ``drop_docs`` are released
+        with the docs' store rows (an evicted doc that faults back in
+        re-encodes on next serve)."""
         drop = set(int(d) for d in drop_docs)
         kept = {k: v for k, v in old_store._wire_cache.items()
                 if k[0] not in drop}
+        kept2 = {k: v for k, v in old_store._wire_cache_v2.items()
+                 if k[0] not in drop}
         self._wire_cache = kept
-        self._wire_cache_bytes = sum(len(v) for v in kept.values())
+        self._wire_cache_v2 = kept2
+        self._wire_cache_bytes = \
+            sum(len(v) for v in kept.values()) + \
+            sum(_wire_entry_bytes(v) for v in kept2.values())
         self.wire_cache_hits = old_store.wire_cache_hits
         self.wire_cache_misses = old_store.wire_cache_misses
         metrics.set_gauge('sync_wire_cache_bytes',
                           self._wire_cache_bytes)
+
+    def clear_wire_cache(self):
+        """Drop every cached change encoding (both formats) — a bench/
+        test hook; the caches refill lazily at next serve."""
+        self._wire_cache.clear()
+        self._wire_cache_v2.clear()
+        self._wire_cache_bytes = 0
+        self.wire_cache_hits = self.wire_cache_misses = 0
+        metrics.set_gauge('sync_wire_cache_bytes', 0)
 
     # -- per-doc state digests ----------------------------------------------
 

@@ -328,16 +328,25 @@ class DurableDocSet:
     applyChanges = apply_changes
 
     def apply_wire(self, data, doc_ids=None):
-        """WAL the wire path too: the raw blob is UTF-8 JSON of
-        per-doc change lists, so it journals as text and replays
-        byte-identically (without this, changes acknowledged over a
-        WireConnection would vanish in a crash — the dict path was
-        journaled, the columnar path was not)."""
-        if isinstance(data, (bytes, bytearray)):
-            text = bytes(data).decode('utf-8')
+        """WAL the wire path too, so it replays byte-identically
+        (without this, changes acknowledged over a WireConnection
+        would vanish in a crash — the dict path was journaled, the
+        columnar path was not). v1 payloads are UTF-8 JSON and journal
+        as text; columnar v2 containers are binary and journal
+        base64-armored (the journal record framing is JSON)."""
+        from .wire import COLUMNAR_MAGIC
+        if isinstance(data, (bytes, bytearray)) and \
+                bytes(data[:4]) == COLUMNAR_MAGIC:
+            import base64
+            self.journal.append(
+                {'wireb64': base64.b64encode(bytes(data)).decode(
+                    'ascii'), 'docs': doc_ids})
         else:
-            text = data
-        self.journal.append({'wire': text, 'docs': doc_ids})
+            if isinstance(data, (bytes, bytearray)):
+                text = bytes(data).decode('utf-8')
+            else:
+                text = data
+            self.journal.append({'wire': text, 'docs': doc_ids})
         return self.doc_set.apply_wire(data, doc_ids=doc_ids)
 
     applyWire = apply_wire
@@ -385,16 +394,24 @@ class DurableDocSet:
         n_replayed = 0
         for record, end in ChangeJournal._scan(journal_path):
             n_replayed += 1
-            if 'wire' in record:
-                # wire-path record: replay the raw blob through the
+            if 'wire' in record or 'wireb64' in record:
+                # wire-path record: replay the raw payload through the
                 # fused path; a poisoned doc falls back to the dict
                 # batch under per-doc isolation (the fused apply rolls
                 # back store-intact), exactly like WireConnection
+                if 'wireb64' in record:
+                    import base64
+                    raw = base64.b64decode(record['wireb64'])
+                else:
+                    raw = record['wire'].encode('utf-8')
                 try:
-                    doc_set.apply_wire(record['wire'].encode('utf-8'),
-                                       doc_ids=record['docs'])
+                    doc_set.apply_wire(raw, doc_ids=record['docs'])
                 except Exception:
-                    per_doc = json.loads(record['wire'])
+                    if 'wireb64' in record:
+                        from .wire import columnar_container_to_changes
+                        per_doc = columnar_container_to_changes(raw)
+                    else:
+                        per_doc = json.loads(record['wire'])
                     doc_set.apply_changes_batch(
                         dict(zip(record['docs'] or
                                  [f'doc-{i}'

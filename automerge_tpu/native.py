@@ -722,3 +722,96 @@ def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
         lib.amst_free(h)
         return None
     return GeneralStagedPlanes(lib, h, keep)
+
+
+# ---------------------------------------------------------------------------
+# Native columnar v2 codec (the amwe_emit_columnar / amst_parse_columnar
+# entry points of libamwire.so): the JSON-free binary wire format.
+# Emit returns varint column bodies plus per-change global ref lists —
+# the host maps refs to tagged literal bytes (wire.py), so the Python
+# fallback is byte-identical by construction. Parse fills the same
+# Parsed struct the JSON parsers fill (extracted via the amwc_*
+# accessors in wire._extract_block).
+
+_COLUMNAR_LIB = None
+_COLUMNAR_ATTEMPTED = False
+
+
+def _bind_columnar(lib):
+    lib.amwe_emit_columnar.argtypes = [
+        _i64, _P64,                                  # rows
+        _P32, _P32, _P32, _P32, _P32,                # change columns
+        _P32, _P8, _P32, _P8, _P32, _P32, _P32,      # op columns
+        _P32]                                        # value column
+    lib.amwe_emit_columnar.restype = ctypes.c_void_p
+    lib.amwe_col_bytes.argtypes = [ctypes.c_void_p]
+    lib.amwe_col_bytes.restype = _i64
+    lib.amwe_col_refs.argtypes = [ctypes.c_void_p]
+    lib.amwe_col_refs.restype = _i64
+    lib.amwe_col_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  _P64, _P64, _P64]
+    lib.amwe_col_fill.restype = None
+    lib.amwe_col_free.argtypes = [ctypes.c_void_p]
+    lib.amwe_col_free.restype = None
+    lib.amst_parse_columnar.argtypes = [ctypes.c_char_p, _i64]
+    lib.amst_parse_columnar.restype = ctypes.c_void_p
+    return lib
+
+
+def columnar_lib():
+    """The columnar v2 codec library, or None (no native codec / stale
+    binary without the columnar symbols /
+    AUTOMERGE_TPU_NATIVE_COLUMNAR=0)."""
+    global _COLUMNAR_LIB, _COLUMNAR_ATTEMPTED
+    if _COLUMNAR_ATTEMPTED:
+        return _COLUMNAR_LIB
+    _COLUMNAR_ATTEMPTED = True
+    if os.environ.get('AUTOMERGE_TPU_NATIVE_COLUMNAR', '1') == '0':
+        return None
+    from . import wire as _wire
+    lib = _wire._load()
+    if lib is None:
+        return None
+    try:
+        _COLUMNAR_LIB = _bind_columnar(lib)
+    except AttributeError:
+        _COLUMNAR_LIB = None         # stale .so predating the codec
+    return _COLUMNAR_LIB
+
+
+def columnar_available():
+    return columnar_lib() is not None
+
+
+def emit_columnar_rows(block, rows_arr):
+    """Native columnar emit of general-block change rows: one
+    ``(body bytes, global ref list)`` per row, or None when the library
+    is unavailable (the caller falls back to the Python emitter)."""
+    lib = columnar_lib()
+    if lib is None:
+        return None
+    h = lib.amwe_emit_columnar(
+        len(rows_arr), _p64(rows_arr),
+        _p32(block.actor), _p32(block.seq),
+        _p32(block.dep_ptr), _p32(block.dep_actor),
+        _p32(block.dep_seq),
+        _p32(block.op_ptr), _p8(block.action), _p32(block.obj),
+        _p8(block.key_kind), _p32(block.key), _p32(block.key_elem),
+        _p32(block.elem), _p32(block.value))
+    if not h:
+        raise MemoryError('native columnar emit allocation failed')
+    try:
+        nbytes = int(lib.amwe_col_bytes(h))
+        n_refs = int(lib.amwe_col_refs(h))
+        buf = ctypes.create_string_buffer(max(nbytes, 1))
+        body_off = _np.empty(len(rows_arr) + 1, _np.int64)
+        refs = _np.empty(max(n_refs, 1), _np.int64)
+        refs_off = _np.empty(len(rows_arr) + 1, _np.int64)
+        lib.amwe_col_fill(h, buf, _p64(body_off), _p64(refs),
+                          _p64(refs_off))
+        raw = buf.raw[:nbytes]
+    finally:
+        lib.amwe_col_free(h)
+    return [(raw[body_off[i]:body_off[i + 1]],
+             refs[refs_off[i]:refs_off[i + 1]].tolist())
+            for i in range(len(rows_arr))]

@@ -99,7 +99,8 @@ class ChaosFleet:
 
     def __init__(self, doc_sets, seed=0, drop=0.0, dup=0.0, delay=0,
                  corrupt=0.0, batching=True, wire=False,
-                 heartbeat_every=8, conn_kwargs=None, admission=None):
+                 heartbeat_every=8, conn_kwargs=None, admission=None,
+                 wire_version=None):
         self.doc_sets = list(doc_sets)
         self.rng = random.Random(seed)
         self.drop = drop
@@ -118,6 +119,14 @@ class ChaosFleet:
         self._conn_kwargs.setdefault('heartbeat_every', heartbeat_every)
         if wire:
             self._conn_kwargs['wire'] = True
+        # per-node wire-format version: an int pins every node, a list
+        # pins per node (None entries = the build default) — the
+        # mixed-version interop schedules run v1 and v2 peers in ONE
+        # fleet and must still converge byte-identically
+        if wire_version is None or isinstance(wire_version, int):
+            self.node_wire_version = [wire_version] * len(self.doc_sets)
+        else:
+            self.node_wire_version = list(wire_version)
         # node-wide admission: ONE AdmissionControl shared by all of a
         # node's endpoints (the fleet-wide valve; the per-link valve
         # rides conn_kwargs['admission']). `admission` is kwargs for
@@ -158,6 +167,9 @@ class ChaosFleet:
         # one peer/node2/ slice the way they never would across real
         # hosts
         from ..utils.metrics import metrics
+        kwargs = dict(self._conn_kwargs)
+        if self.wire and self.node_wire_version[owner] is not None:
+            kwargs['wire_version'] = self.node_wire_version[owner]
         conn = ResilientConnection(
             self.doc_sets[owner], self._sender(owner, peer),
             batching=self.batching,
@@ -166,7 +178,7 @@ class ChaosFleet:
             peer_id=f'node{peer}',
             scope=metrics.scoped(node=f'node{owner}',
                                  peer=f'node{peer}'),
-            **self._conn_kwargs)
+            **kwargs)
         self.conns[(owner, peer)] = conn
         return conn
 
@@ -215,13 +227,20 @@ class ChaosFleet:
             env['kind'] = 'garbage'
         elif mode == 4:
             payload = env.get('payload')
-            blob = payload.get('blob') if isinstance(payload, dict) \
+            # flip one bit in a binary payload section — blob or the
+            # v2 literal tab, both under the CRC32-over-bytes checksum
+            field = self.rng.choice(('blob', 'tab'))
+            part = payload.get(field) if isinstance(payload, dict) \
                 else None
-            if isinstance(blob, (bytes, bytearray)) and len(blob):
-                i = self.rng.randrange(len(blob))
-                payload['blob'] = blob[:i] + \
-                    bytes([blob[i] ^ (1 << self.rng.randrange(8))]) + \
-                    blob[i + 1:]
+            if not isinstance(part, (bytes, bytearray)) or not part:
+                field = 'blob'
+                part = payload.get(field) if isinstance(payload, dict) \
+                    else None
+            if isinstance(part, (bytes, bytearray)) and len(part):
+                i = self.rng.randrange(len(part))
+                payload[field] = part[:i] + \
+                    bytes([part[i] ^ (1 << self.rng.randrange(8))]) + \
+                    part[i + 1:]
             else:
                 env['sum'] = -1
         else:

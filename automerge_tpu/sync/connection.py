@@ -98,17 +98,33 @@ def validate_msg(msg):
 def validate_wire_msg(msg):
     """Validate the multi-doc WIRE data-message schema (the columnar
     counterpart of a per-doc ``{docId, clock, changes}`` dict message):
-    ``docs`` a non-empty list of doc-id strings; ``clocks`` an aligned
-    list of ``str -> non-negative int`` clock dicts; ``counts`` an
-    aligned list of per-doc change counts; ``lens`` the per-change byte
+    ``wire`` the format version (1 = JSON-blob spans, 2 = columnar
+    binary spans + a shared ``tab`` literal table); ``docs`` a
+    non-empty list of doc-id strings; ``clocks`` an aligned list of
+    ``str -> non-negative int`` clock dicts; ``counts`` an aligned
+    list of per-doc change counts; ``lens`` the per-change byte
     lengths (``sum(counts)`` of them); ``blob`` the concatenated change
-    encodings (``sum(lens)`` bytes). Change CONTENT is not inspected
-    here — the blob rides under a CRC32 envelope checksum
-    (:func:`~automerge_tpu.sync.resilient.payload_checksum`) and parses
-    at flush, where a poisoned document lands in quarantine. Raises
-    :class:`MessageRejected` on the first violation; returns ``msg``."""
+    encodings (``sum(lens)`` bytes); ``maxv`` (optional) the sender's
+    highest spoken format version — the negotiation stamp. Change
+    CONTENT is not inspected here — blob and tab ride under a CRC32
+    envelope checksum (:func:`~automerge_tpu.sync.resilient.
+    payload_checksum`) and parse at flush, where a poisoned document
+    lands in quarantine. Raises :class:`MessageRejected` on the first
+    violation; returns ``msg``."""
     if not isinstance(msg, dict):
         _reject(f'wire message is {type(msg).__name__}, not a dict')
+    version = msg.get('wire')
+    if version not in (1, 2) or isinstance(version, bool):
+        _reject(f'wire version is not 1 or 2: {version!r}')
+    maxv = msg.get('maxv')
+    if maxv is not None and (not isinstance(maxv, int)
+                             or isinstance(maxv, bool) or maxv < 1):
+        _reject(f'wire maxv is not a positive int: {maxv!r}')
+    if version == 2:
+        tab = msg.get('tab')
+        if not isinstance(tab, (bytes, bytearray)):
+            _reject(f'wire v2 tab is not bytes: '
+                    f'{type(tab).__name__}')
     docs = msg.get('docs')
     if not isinstance(docs, (list, tuple)) or not docs:
         _reject(f'wire docs is not a non-empty list: {docs!r}')
@@ -161,6 +177,18 @@ def validate_wire_msg(msg):
         _reject(f'wire blob carries {len(blob)} bytes, lens claim '
                 f'{total}')
     return msg
+
+
+# highest wire-blob format this build speaks: 2 = columnar binary
+# spans + shared literal tables (JSON-free receive path); 1 = the
+# PR 5 JSON-blob spans, kept for mixed-fleet interop and pinnable via
+# WireConnection(wire_version=1)
+WIRE_VERSION = 2
+
+# the flow-control sizing unit for served encode-cache entries — the
+# ONE sizing rule, shared with the cache-byte accounting in
+# device/blocks.py so the two can never drift
+from ..device.blocks import _wire_entry_bytes as _entry_bytes  # noqa: E402,E501
 
 
 def clock_union(clock_map, doc_id, clock):
@@ -494,7 +522,8 @@ class WireConnection(BatchingConnection):
     wire-capable doc set (GeneralDocSet).
     """
 
-    def __init__(self, doc_set, send_msg, max_msg_bytes=None):
+    def __init__(self, doc_set, send_msg, max_msg_bytes=None,
+                 wire_version=WIRE_VERSION):
         super().__init__(doc_set, send_msg)
         store = getattr(doc_set, 'store', None)
         if not hasattr(doc_set, 'apply_wire') or store is None or \
@@ -504,11 +533,27 @@ class WireConnection(BatchingConnection):
                 '(GeneralDocSet: apply_wire + a store serving '
                 'get_missing_changes_wire); use Connection or '
                 'BatchingConnection for other doc sets')
+        if wire_version not in (1, 2):
+            raise ValueError(
+                f'wire_version must be 1 or 2, got {wire_version!r}')
         # per-peer flow control: soft cap on one outgoing message's
         # blob bytes — data spans past the cap carry to the next tick
         # (re-served from the encode cache, so deferral costs no
         # re-encode). None = unbounded.
         self.max_msg_bytes = max_msg_bytes
+        # wire-format version negotiation (the PR 7/8 v-stamp pattern:
+        # the stamp rides the messages themselves, no extra handshake).
+        # `wire_version` is the highest format THIS side speaks; every
+        # outgoing wire message from a v2-capable sender carries
+        # `maxv`, and data ships in min(ours, the peer's advertised
+        # maxv). A v1-only peer never advertises, so it pins the
+        # sender to v1 framing; and because data only ever flows to a
+        # peer we have HEARD from (their_clock gates the serve), the
+        # first data message always follows at least one incoming
+        # message — a pure-v2 pair negotiates up before any data
+        # ships, costing zero v1 round-trips.
+        self.wire_version = wire_version
+        self._peer_wire_version = 1
         self._pending_send = {}       # doc_id -> None (insertion order)
         self._incoming_wire = []
 
@@ -543,8 +588,20 @@ class WireConnection(BatchingConnection):
     def receive_msg(self, msg):
         if isinstance(msg, dict) and 'wire' in msg:
             validate_wire_msg(msg)
+            if msg['wire'] > self.wire_version:
+                # a peer shipped a format newer than this side speaks —
+                # reject loudly (a conforming sender never does this:
+                # it pins to the receiver's advertised maxv)
+                _reject(f"wire version {msg['wire']} not spoken here "
+                        f"(max {self.wire_version})")
+            maxv = msg.get('maxv')
+            if isinstance(maxv, int) and not isinstance(maxv, bool) \
+                    and maxv > self._peer_wire_version:
+                self._peer_wire_version = min(maxv, self.wire_version)
             self.metrics.bump('sync_msgs_received')
             self.metrics.bump('sync_wire_msgs_received')
+            if msg['wire'] >= 2:
+                self.metrics.bump('sync_wire_v2_msgs_received')
             # clock bookkeeping happens immediately, in arrival order —
             # exactly the dict data path
             for doc_id, clock in zip(msg['docs'], msg['clocks']):
@@ -586,47 +643,90 @@ class WireConnection(BatchingConnection):
 
     def _flush_wire(self):
         """Merge the buffered wire blobs per document and apply in one
-        fused codec->stager pass."""
+        fused codec->stager pass per FORMAT: v1 JSON spans concatenate
+        into the JSON multi-doc shape, v2 columnar spans (plus their
+        messages' shared literal tabs) stitch into one binary container
+        — the zero-``json.loads`` path. A mixed-version tick (v1 and v2
+        peers buffered together) costs at most one fused apply per
+        format."""
         if not self._incoming_wire:
             return {}
-        segs_by_doc = {}
+        segs_by_doc = {}                 # v1: doc_id -> [json bytes]
+        spans_by_doc = {}                # v2: doc_id -> [(tab_i, span)]
+        tabs = []
         n_changes = 0
         for msg in self._incoming_wire:
             blob, lens = msg['blob'], msg['lens']
+            v2 = msg['wire'] >= 2
+            if v2:
+                tab_i = len(tabs)
+                tabs.append(bytes(msg['tab']))
             pos = 0
             k = 0
             for doc_id, count in zip(msg['docs'], msg['counts']):
                 if not count:
                     continue
-                segs = segs_by_doc.setdefault(doc_id, [])
+                if v2:
+                    segs = spans_by_doc.setdefault(doc_id, [])
+                else:
+                    segs = segs_by_doc.setdefault(doc_id, [])
                 for ln in lens[k:k + count]:
-                    segs.append(blob[pos:pos + ln])
+                    span = blob[pos:pos + ln]
+                    segs.append((tab_i, span) if v2 else span)
                     pos += ln
                 k += count
                 n_changes += count
         self._incoming_wire = []
-        if not segs_by_doc:
+        if not segs_by_doc and not spans_by_doc:
             return {}
         self.metrics.bump('sync_changes_received', n_changes)
+        out = {}
+        if segs_by_doc:
+            def decode_v1(segs):
+                import json as _json
+                return _json.loads(
+                    (b'[' + b','.join(segs) + b']').decode('utf-8'))
+
+            data = b'[' + b','.join(
+                b'[' + b','.join(segs) + b']'
+                for segs in segs_by_doc.values()) + b']'
+            out.update(self._apply_wire_isolated(
+                data, segs_by_doc, decode_v1))
+        if spans_by_doc:
+            from .. import wire as _wire
+
+            def decode_v2(spans):
+                data_1 = _wire.build_columnar_container(tabs, [spans])
+                return _wire.columnar_container_to_changes(data_1)[0]
+
+            data = _wire.build_columnar_container(
+                tabs, list(spans_by_doc.values()))
+            out.update(self._apply_wire_isolated(
+                data, spans_by_doc, decode_v2))
+        retry = getattr(self._doc_set, 'retry_quarantined', None)
+        if retry is not None:
+            held = [d for d in out if d in self._doc_set.quarantined]
+            if held:
+                retry(held)
+        return out
+
+    def _apply_wire_isolated(self, data, segs_by_doc, decode_doc):
+        """One fused ``apply_wire`` with the per-document quarantine
+        fallback: a fused-apply fault rolls back (store-intact-on-
+        error) and the payload re-delivers doc by doc through the dict
+        batch path, which isolates and quarantines the poisoned ones.
+        ``decode_doc`` turns one doc's raw spans back into dict
+        changes; a doc whose spans do not even decode (impossible
+        under the checksummed envelope transport) quarantines with no
+        retriable body."""
         doc_ids = list(segs_by_doc)
-        data = b'[' + b','.join(
-            b'[' + b','.join(segs) + b']'
-            for segs in segs_by_doc.values()) + b']'
         try:
             handles = self._doc_set.apply_wire(data, doc_ids=doc_ids)
         except Exception:
-            # the fused wire apply rolled back (store-intact-on-error):
-            # re-deliver through the dict batch path, which isolates
-            # per document and quarantines the poisoned ones. A change
-            # whose bytes do not even decode (impossible under the
-            # checksummed envelope transport) quarantines its doc with
-            # no retriable body.
-            import json as _json
             changes_by_doc = {}
             for doc_id, segs in segs_by_doc.items():
                 try:
-                    changes_by_doc[doc_id] = _json.loads(
-                        (b'[' + b','.join(segs) + b']').decode('utf-8'))
+                    changes_by_doc[doc_id] = decode_doc(segs)
                 except (ValueError, UnicodeDecodeError) as err:
                     registry = getattr(self._doc_set, 'quarantined',
                                        self.quarantined)
@@ -635,13 +735,7 @@ class WireConnection(BatchingConnection):
                     self.metrics.bump('sync_docs_quarantined')
             return self._doc_set.apply_changes_batch(
                 changes_by_doc, isolate=True)
-        out = dict(zip(doc_ids, handles))
-        retry = getattr(self._doc_set, 'retry_quarantined', None)
-        if retry is not None:
-            held = [d for d in out if d in self._doc_set.quarantined]
-            if held:
-                retry(held)
-        return out
+        return dict(zip(doc_ids, handles))
 
     def _flush_outgoing(self):
         """Assemble and ship the tick's single multi-doc wire message:
@@ -660,6 +754,10 @@ class WireConnection(BatchingConnection):
     def _flush_outgoing_traced(self):
         pending = list(self._pending_send)
         self._pending_send.clear()
+        # the negotiated DATA format for this peer: v2 columnar once
+        # the peer has advertised maxv >= 2, v1 JSON spans until then
+        # (and forever, against a v1-only peer)
+        version = min(self.wire_version, self._peer_wire_version)
         # serving doc sets fault evicted docs back in before the serve
         # (a sync touch); docs the peer's clock already covers stay
         # evicted and report their RECORDED clock instead of the
@@ -687,12 +785,19 @@ class WireConnection(BatchingConnection):
                 wants.append((idx, self._their_clock[doc_id]))
         if wants:
             with self.metrics.trace_span('wire.serve',
-                                         docs=len(wants)):
+                                         docs=len(wants)) as span:
                 served, errors = store.get_missing_changes_wire_batch(
-                    wants, all_clocks=fleet_clocks)
+                    wants, all_clocks=fleet_clocks, version=version)
+                if self.metrics.active:
+                    # the serve span carries the byte volume it served
+                    # (trace_report's per-tick wire MB/s) — summed only
+                    # under an observer, the idle path stays free
+                    span.set(bytes=sum(
+                        _entry_bytes(e) for blobs in served.values()
+                        for e in blobs))
         else:
             served, errors = {}, {}
-        docs, clocks, counts, lens, chunks = [], [], [], [], []
+        docs, clocks, counts, chunks = [], [], [], []
         blob_bytes = 0
         data_docs = 0
         deferred = []
@@ -720,7 +825,7 @@ class WireConnection(BatchingConnection):
                 continue
             blobs = served.get(idx)
             if blobs:
-                size = sum(len(b) for b in blobs)
+                size = sum(_entry_bytes(b) for b in blobs)
                 if self.max_msg_bytes is not None and data_docs and \
                         blob_bytes + size > self.max_msg_bytes:
                     # over the per-message byte cap: this doc's data
@@ -737,7 +842,6 @@ class WireConnection(BatchingConnection):
                 docs.append(doc_id)
                 clocks.append(dict(clock))
                 counts.append(len(blobs))
-                lens.extend(len(b) for b in blobs)
                 chunks.extend(blobs)
                 continue
             if clock != self._our_clock.get(doc_id, {}):
@@ -756,13 +860,36 @@ class WireConnection(BatchingConnection):
                                len(self._pending_send))
         if not docs:
             return
-        blob = b''.join(chunks)
+        # assemble the data payload. Zero-data messages (pure
+        # advertisement/request bundles) keep the v1 SHAPE whatever
+        # the negotiated version — the v-stamp marks the payload
+        # format, exactly the envelope-v pattern; `maxv` rides every
+        # message a v2-capable sender ships, which is the whole
+        # negotiation.
+        if chunks and version >= 2:
+            from .. import wire as _wire
+            spans, tab = _wire.assemble_columnar_spans(chunks)
+            lens = [len(s) for s in spans]
+            blob = b''.join(spans)
+            msg = {'wire': 2, 'docs': docs, 'clocks': clocks,
+                   'counts': counts, 'lens': lens, 'blob': blob,
+                   'tab': tab}
+            self.metrics.bump('sync_wire_v2_msgs_sent')
+            payload_bytes = len(blob) + len(tab)
+        else:
+            lens = [len(b) for b in chunks]
+            blob = b''.join(chunks)
+            msg = {'wire': 1, 'docs': docs, 'clocks': clocks,
+                   'counts': counts, 'lens': lens, 'blob': blob}
+            payload_bytes = len(blob)
+        if self.wire_version >= 2:
+            msg['maxv'] = self.wire_version
         self.metrics.bump('sync_msgs_sent')
         self.metrics.bump('sync_wire_msgs_sent')
         self.metrics.bump('sync_changes_sent', len(lens))
-        self.metrics.bump('sync_wire_bytes_sent', len(blob))
+        self.metrics.bump('sync_wire_bytes_sent', payload_bytes)
         if self.metrics.active:
             self.metrics.emit('sync_wire_send', docs=len(docs),
-                              changes=len(lens), blob_bytes=len(blob))
-        self._send_msg({'wire': 1, 'docs': docs, 'clocks': clocks,
-                        'counts': counts, 'lens': lens, 'blob': blob})
+                              changes=len(lens), v=msg['wire'],
+                              blob_bytes=payload_bytes)
+        self._send_msg(msg)

@@ -788,22 +788,32 @@ class GeneralDocSet:
     fleetStatus = fleet_status
 
     def apply_wire(self, data, doc_ids=None):
-        """Batched admission straight from WIRE BYTES: the JSON text of
-        per-document change lists (``[[change, ...], ...]``) runs
-        through the native codec (C++ JSON -> columns, key kinds
-        resolved against this store's object table) and then the native
-        stager inside one fused apply — no per-op Python on the whole
-        path. ``doc_ids`` names the documents the arrays correspond to
-        (defaults to positional ``doc-<i>`` ids, created on first
-        touch). Falls back to the pure-Python edge when the codec
-        library is unavailable.
+        """Batched admission straight from WIRE BYTES: either the JSON
+        text of per-document change lists (``[[change, ...], ...]``,
+        native codec with key kinds resolved against this store's
+        object table) or a columnar v2 container (``AMW2`` magic —
+        varint op columns + shared literal tables, parsed with ZERO
+        JSON anywhere), then the native stager inside one fused apply
+        — no per-op Python on the whole path. ``doc_ids`` names the
+        documents the arrays correspond to (defaults to positional
+        ``doc-<i>`` ids, created on first touch). Falls back to the
+        pure-Python edges when the codec library is unavailable.
 
         Returns the list of touched :class:`GeneralDocHandle`."""
-        from ..wire import parse_general_block
+        from ..wire import (COLUMNAR_MAGIC, parse_columnar_block,
+                            parse_general_block)
         from ..device.blocks import ChangeBlock
         t0 = _time.perf_counter()
-        with _metrics.trace_span('wire.parse', n_bytes=len(data)):
-            block = parse_general_block(data, store=self.store)
+        columnar = isinstance(data, (bytes, bytearray, memoryview)) \
+            and bytes(data[:4]) == COLUMNAR_MAGIC
+        with _metrics.trace_span('wire.parse', n_bytes=len(data),
+                                 v=2 if columnar else 1):
+            if columnar:
+                block = parse_columnar_block(data)
+            else:
+                block = parse_general_block(data, store=self.store)
+            _metrics.observe('sync_wire_parse_ms',
+                             (_time.perf_counter() - t0) * 1e3)
         n = block.n_docs
         if doc_ids is None:
             doc_ids = [f'doc-{i}' for i in range(n)]

@@ -160,10 +160,12 @@ def _payload_cost(payload):
     there). Advertisements/requests cost nothing: the repair loop must
     never be throttled."""
     if 'wire' in payload:
-        blob = payload.get('blob')
-        return (sum(payload.get('counts') or ()),
-                len(blob) if isinstance(blob, (bytes, bytearray))
-                else 0)
+        n_bytes = 0
+        for field in ('blob', 'tab'):
+            part = payload.get(field)
+            if isinstance(part, (bytes, bytearray)):
+                n_bytes += len(part)
+        return (sum(payload.get('counts') or ()), n_bytes)
     changes = payload.get('changes')
     return (len(changes) if isinstance(changes, (list, tuple)) else 0,
             0)
@@ -175,16 +177,21 @@ def payload_checksum(payload):
     regardless of dict ordering.
 
     A WIRE data message carries its change payload as a binary
-    ``blob``: that blob is checksummed DIRECTLY (CRC32 over the raw
-    bytes, folded into the header checksum as ``blob_crc32``) instead
-    of riding through ``json.dumps`` — integrity for megabytes of
-    change data at memcpy speed, and the reason corrupt-blob envelopes
-    are caught before the codec ever parses them."""
+    ``blob`` (and, v2, a binary literal-table ``tab``): those bytes
+    are checksummed DIRECTLY (CRC32 over the raw bytes, folded into
+    the header checksum as ``blob_crc32``/``tab_crc32``) instead of
+    riding through ``json.dumps`` — integrity for megabytes of change
+    data at memcpy speed, and the reason corrupt-blob envelopes are
+    caught before the codec ever parses them. A v1 message (no tab)
+    checksums byte-identically to the pre-v2 protocol."""
     if isinstance(payload, dict):
-        blob = payload.get('blob')
-        if isinstance(blob, (bytes, bytearray)):
-            head = {k: v for k, v in payload.items() if k != 'blob'}
-            head['blob_crc32'] = zlib.crc32(blob)
+        binary = {f: payload[f] for f in ('blob', 'tab')
+                  if isinstance(payload.get(f), (bytes, bytearray))}
+        if binary:
+            head = {k: v for k, v in payload.items()
+                    if k not in binary}
+            for field, part in binary.items():
+                head[f'{field}_crc32'] = zlib.crc32(part)
             payload = head
     return zlib.crc32(json.dumps(payload, sort_keys=True,
                                  separators=(',', ':')).encode())
@@ -275,11 +282,14 @@ class ResilientConnection:
                  jitter=2, heartbeat_every=16, seed=0,
                  admission=None, shared_admission=None,
                  max_msg_bytes=None, peer_id=None, scope=None,
-                 hb_digests=True):
+                 hb_digests=True, wire_version=None):
         self._send_raw = send_msg
         if wire:
+            kwargs = {} if wire_version is None \
+                else {'wire_version': wire_version}
             self._conn = WireConnection(doc_set, self._send_envelope,
-                                        max_msg_bytes=max_msg_bytes)
+                                        max_msg_bytes=max_msg_bytes,
+                                        **kwargs)
         else:
             conn_cls = BatchingConnection if batching else Connection
             self._conn = conn_cls(doc_set, self._send_envelope)
@@ -898,8 +908,11 @@ class ResilientConnection:
                 # encode cache served the first time — this counter is
                 # the degraded-link bench's "bytes re-served with zero
                 # re-encode" figure
-                self.metrics.bump('sync_retransmit_wire_bytes',
-                                  len(payload['blob']))
+                n = len(payload['blob'])
+                tab = payload.get('tab')
+                if isinstance(tab, (bytes, bytearray)):
+                    n += len(tab)
+                self.metrics.bump('sync_retransmit_wire_bytes', n)
             if self.metrics.active:
                 self.metrics.emit('sync_retransmit', seq=seq,
                                   attempt=rec.attempts)

@@ -113,7 +113,14 @@ SERVING_COUNTERS = (
 #   sync_snapshots_sent/_received    snapshot fallbacks for truncated
 #                                    logs
 #   sync_wire_msgs_sent/_received    multi-doc columnar data messages
-#   sync_wire_bytes_sent             their blob bytes
+#   sync_wire_v2_msgs_sent/_received the columnar-binary (v2) subset —
+#                                    a mixed fleet's format mix is the
+#                                    gap between the two pairs
+#   sync_wire_bytes_sent             their payload bytes (blob + v2
+#                                    literal tab)
+#   sync_wire_parse_ms               observe series: wire-blob ->
+#                                    ChangeBlock codec latency (the
+#                                    bench parse p50/p99 keys)
 #   sync_apply_ms                    observe series: doc-set fused
 #                                    apply latency (dict + wire paths)
 #   sync_flush_ms                    observe series: connection flush
@@ -123,7 +130,9 @@ SYNC_COUNTERS = (
     'sync_changes_sent', 'sync_changes_received',
     'sync_snapshots_sent', 'sync_snapshots_received',
     'sync_wire_msgs_sent', 'sync_wire_msgs_received',
-    'sync_wire_bytes_sent', 'sync_apply_ms', 'sync_flush_ms')
+    'sync_wire_v2_msgs_sent', 'sync_wire_v2_msgs_received',
+    'sync_wire_bytes_sent', 'sync_wire_parse_ms',
+    'sync_apply_ms', 'sync_flush_ms')
 
 # Convergence/health counters (the replication-observability contract:
 # how far behind is each peer, are any replicas silently diverged, and
@@ -194,6 +203,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def set(self, **attrs):
+        """No-op twin of :meth:`_Span.set`."""
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -228,6 +240,12 @@ class _Span:
         stack.append((self.trace, sid))
         self._t0 = time.perf_counter()
         return self
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span (e.g. the byte count
+        a ``wire.serve`` only knows after the serve) — folded into the
+        single ``span`` event at exit."""
+        self._attrs.update(attrs)
 
     def __exit__(self, etype, err, tb):
         dur_ms = (time.perf_counter() - self._t0) * 1e3

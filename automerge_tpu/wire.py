@@ -33,7 +33,8 @@ import numpy as np
 from .common import ROOT_ID
 from .device.blocks import (
     ChangeBlock, LazyValues, _SET, _INS, _LINK,
-    _GEN_ACTION_CODES, _KEY_STR, _KEY_ELEM, _KEY_HEAD)
+    _GEN_ACTION_CODES, _KEY_STR, _KEY_ELEM, _KEY_HEAD, _KEY_NONE,
+    _intern)
 
 _LIB = None
 _LOAD_ATTEMPTED = False
@@ -193,7 +194,7 @@ def _table(lib, h, n_fn, bytes_fn, fill_fn):
             for i in range(n)]
 
 
-def _extract_block(lib, h, data, general):
+def _extract_block(lib, h, data, general, values_cls=LazyValues):
     err = lib.amwc_error(h)
     if err:
         raise ValueError('wire parse failed: ' + err.decode('utf-8'))
@@ -242,7 +243,7 @@ def _extract_block(lib, h, data, general):
                  'objs': _table(lib, h, lib.amwc_n_objs,
                                 lib.amwc_objs_bytes, lib.amwc_fill_objs)}
 
-    values = LazyValues(data, starts, ends)
+    values = values_cls(data, starts, ends)
     return ChangeBlock(n_docs, doc, actor, seq, dep_ptr, dep_actor,
                        dep_seq, op_ptr, action, key, value, actors, keys,
                        values, dup_keys=dup_keys, **extra)
@@ -482,3 +483,586 @@ def encode_change_rows(block, rows):
 
 parseChangeBlock = parse_change_block
 parseGeneralBlock = parse_general_block
+
+
+# ---------------------------------------------------------------------------
+# Columnar wire blob v2: the JSON-free binary change encoding (emit AND
+# parse twins of the amwe_emit_columnar / amst_parse_columnar entry
+# points in native/wire_codec.cpp — see the format comment there; the
+# layout constants below are the single Python-side source of truth).
+#
+# A change's cached encoding is ``(body, lits)``: a varint/delta-packed
+# column body referencing a LOCAL literal list, plus the tagged literal
+# bytes themselves (first-occurrence order over actor, deps, then each
+# op's obj/key/value). The per-peer message layer interns every change's
+# literals into ONE shared table per message (`assemble_columnar_spans`)
+# — an actor uuid referenced by a thousand changes ships once — and the
+# receive side stitches the tick's messages into one container
+# (`build_columnar_container`) that parses straight into a ChangeBlock
+# with zero `json.loads` (`parse_columnar_block`). The native emitter
+# returns bodies + global ref lists and the HOST maps refs to literal
+# bytes, so the pure-Python emitter below is byte-identical by
+# construction: same two-pass walk, same varints, same tables.
+
+import struct as _struct
+
+COLUMNAR_MAGIC = b'AMW2'
+
+# literal tags (match native/wire_codec.cpp)
+_TAG_STR, _TAG_INT, _TAG_FLOAT = 0, 1, 2
+_TAG_TRUE, _TAG_FALSE, _TAG_NULL, _TAG_JSON = 3, 4, 5, 6
+
+# force switch (tests/CI): None = auto, True = the native columnar
+# codec must serve general blocks (raise instead of falling back),
+# False = pure Python both directions
+_NATIVE_COLUMNAR = None
+
+
+def _uv(out, v):
+    """Append one unsigned LEB128 varint."""
+    while v >= 0x80:
+        out.append(0x80 | (v & 0x7F))
+        v >>= 7
+    out.append(v)
+
+
+def _sv(out, v):
+    """Append one zigzag-signed varint."""
+    _uv(out, (v << 1) if v >= 0 else ((-v << 1) - 1))
+
+
+class _ColReader:
+    """Bounds-checked varint reader over one bytes object (the Python
+    twin of the C++ ColReader — same failure messages' spirit, always
+    ValueError, never an IndexError escape)."""
+
+    __slots__ = ('buf', 'pos', 'end')
+
+    def __init__(self, buf, pos=0, end=None):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def fail(self, msg):
+        raise ValueError(
+            f'columnar parse failed: {msg} at byte {self.pos}')
+
+    def uv(self):
+        v = 0
+        shift = 0
+        while self.pos < self.end:
+            b = self.buf[self.pos]
+            self.pos += 1
+            if shift >= 63 and b > 1:
+                self.fail('varint overflow')
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+        self.fail('truncated varint')
+
+    def sv(self):
+        u = self.uv()
+        return (u >> 1) ^ -(u & 1)
+
+    def u32(self, what):
+        u = self.uv()
+        if u > 0x7FFFFFFF:
+            self.fail(what)
+        return u
+
+
+def encode_tagged_literal(val):
+    """One host value as tagged literal bytes (tag + payload).
+    Scalars get compact binary forms; dict/list composites fall back to
+    canonical JSON, decoded lazily at materialize time — never on the
+    apply path."""
+    if val is None:
+        return b'\x05'
+    if val is True:
+        return b'\x03'
+    if val is False:
+        return b'\x04'
+    cls = val.__class__
+    if cls is int:
+        out = bytearray([_TAG_INT])
+        _sv(out, val)
+        return bytes(out)
+    if cls is float:
+        return b'\x02' + _struct.pack('<d', val)
+    if cls is str:
+        return b'\x00' + val.encode('utf-8')
+    return b'\x06' + _json_lit(val)
+
+
+def decode_tagged_literal(raw):
+    """Tagged literal bytes -> host value (the TaggedValues decoder)."""
+    tag = raw[0]
+    if tag == _TAG_STR:
+        return raw[1:].decode('utf-8')
+    if tag == _TAG_INT:
+        # host-side only, so arbitrary precision is fine (the 64-bit
+        # overflow cap guards the container FRAMING varints, where
+        # Python and C++ must agree; a value literal never crosses C)
+        u = 0
+        shift = 0
+        for b in raw[1:]:
+            u |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (u >> 1) ^ -(u & 1)
+    if tag == _TAG_FLOAT:
+        return _struct.unpack('<d', raw[1:9])[0]
+    if tag == _TAG_TRUE:
+        return True
+    if tag == _TAG_FALSE:
+        return False
+    if tag == _TAG_NULL:
+        return None
+    if tag == _TAG_JSON:
+        return json.loads(raw[1:].decode('utf-8'))
+    raise ValueError(f'unknown literal tag {tag}')
+
+
+def _block_tagged_lits(block):
+    """Tagged string-literal tables (actors, keys, objs) of a block,
+    built once and cached alongside the JSON literals on
+    ``block._wire_lits``."""
+    cache = block._wire_lits
+    if cache is None:
+        _block_lits(block)                   # creates the dict
+        cache = block._wire_lits
+    tagged = cache.get('tagged')
+    if tagged is None:
+        tagged = cache['tagged'] = (
+            [b'\x00' + s.encode('utf-8') for s in block.actors],
+            [b'\x00' + s.encode('utf-8') for s in block.keys],
+            [b'\x00' + s.encode('utf-8') for s in block.objs])
+    return tagged
+
+
+def _tagged_value_lits(block, use, v):
+    """{value row: tagged literal bytes} for every value the selected
+    ops reference — the v2 twin of :func:`_value_lits`, with the same
+    content-level dedup."""
+    vids = np.unique(v[use]) if len(v) else np.zeros(0, np.int32)
+    take = getattr(block.values, 'take', None)
+    vals = take(vids) if take is not None \
+        else [block.values[int(i)] for i in vids.tolist()]
+    out = {}
+    memo = {}
+    for i, val in zip(vids.tolist(), vals):
+        key = (val.__class__, val)
+        try:
+            blob = memo.get(key)
+        except TypeError:                  # unhashable (dict/list)
+            out[i] = encode_tagged_literal(val)
+            continue
+        if blob is None:
+            blob = memo[key] = encode_tagged_literal(val)
+        out[i] = blob
+    return out
+
+
+# ref kinds of the per-change literal lists ((kind << 32) | index)
+_REF_ACTOR, _REF_KEY, _REF_OBJ, _REF_VAL = 0, 1, 2, 3
+
+
+def _emit_columnar_py(block, c):
+    """One change row's ``(body, refs)`` — keep step-identical with
+    amwe_emit_columnar (same two-pass ref walk, same varint columns)."""
+    seen = {}
+    refs = []
+
+    def local(kind, idx):
+        k = (kind << 32) | int(idx)
+        i = seen.get(k)
+        if i is None:
+            i = seen[k] = len(refs)
+            refs.append(k)
+        return i
+
+    action, obj, key_kind, key = block.action, block.obj, \
+        block.key_kind, block.key
+    ops = range(block.op_ptr[c], block.op_ptr[c + 1])
+    # pass 1: canonical ref order (the change actor is always local 0)
+    local(_REF_ACTOR, block.actor[c])
+    for j in range(block.dep_ptr[c], block.dep_ptr[c + 1]):
+        local(_REF_ACTOR, block.dep_actor[j])
+    for j in ops:
+        a = int(action[j])
+        local(_REF_OBJ, obj[j])
+        kk = int(key_kind[j])
+        if kk == _KEY_STR:
+            local(_REF_KEY, key[j])
+        elif kk == _KEY_ELEM:
+            local(_REF_ACTOR, key[j])
+        if a in (_SET, _LINK) and block.value[j] >= 0:
+            local(_REF_VAL, block.value[j])
+    # pass 2: body columns
+    o = bytearray()
+    _uv(o, int(block.seq[c]))
+    _uv(o, int(block.dep_ptr[c + 1] - block.dep_ptr[c]))
+    for j in range(block.dep_ptr[c], block.dep_ptr[c + 1]):
+        _uv(o, local(_REF_ACTOR, block.dep_actor[j]))
+        _uv(o, int(block.dep_seq[j]))
+    _uv(o, len(ops))
+    for j in ops:
+        o.append((int(key_kind[j]) << 4) | int(action[j]))
+    prev = 0
+    for j in ops:
+        lo = local(_REF_OBJ, obj[j])
+        _sv(o, lo - prev)
+        prev = lo
+    prev_e = 0
+    for j in ops:
+        kk = int(key_kind[j])
+        if kk == _KEY_STR:
+            _uv(o, local(_REF_KEY, key[j]))
+        elif kk == _KEY_ELEM:
+            _uv(o, local(_REF_ACTOR, key[j]))
+            ke = int(block.key_elem[j])
+            _sv(o, ke - prev_e)
+            prev_e = ke
+    prev_i = 0
+    for j in ops:
+        if int(action[j]) != _INS:
+            continue
+        el = int(block.elem[j])
+        _sv(o, el - prev_i)
+        prev_i = el
+    for j in ops:
+        a = int(action[j])
+        if a not in (_SET, _LINK):
+            continue
+        vrow = int(block.value[j])
+        _uv(o, local(_REF_VAL, vrow) + 1 if vrow >= 0 else 0)
+    return bytes(o), refs
+
+
+def _refs_to_lits(refs, tagged, vlits):
+    """Map one change's global ref list to its literal byte tuple."""
+    a_t, k_t, o_t = tagged
+    out = []
+    for ref in refs:
+        kind, idx = ref >> 32, ref & 0xFFFFFFFF
+        if kind == _REF_ACTOR:
+            out.append(a_t[idx])
+        elif kind == _REF_KEY:
+            out.append(k_t[idx])
+        elif kind == _REF_OBJ:
+            out.append(o_t[idx])
+        else:
+            out.append(vlits[idx])
+    return tuple(out)
+
+
+def encode_change_rows_columnar(block, rows):
+    """Encode change rows of a general ``block`` in columnar v2 form —
+    one ``(body, lits)`` pair per row, native C++ when available,
+    byte-identical Python fallback otherwise. ``_NATIVE_COLUMNAR =
+    True`` raises instead of falling back (the CI forced-native
+    lane)."""
+    if not block.is_general():
+        raise TypeError('columnar v2 encodes general blocks only')
+    rows_arr = np.asarray([int(r) for r in rows], np.int64)
+    tagged = _block_tagged_lits(block)
+    sel, use, v = _op_selection(block, rows_arr)
+    vlits = _tagged_value_lits(block, use, v)
+    emitted = None
+    if _NATIVE_COLUMNAR is not False:
+        from . import native as _native
+        emitted = _native.emit_columnar_rows(block, rows_arr)
+        if emitted is None and _NATIVE_COLUMNAR is True:
+            raise RuntimeError(
+                'native columnar codec forced (_NATIVE_COLUMNAR=True) '
+                'but the library is unavailable')
+    if emitted is None:
+        emitted = [_emit_columnar_py(block, c)
+                   for c in rows_arr.tolist()]
+    return [(body, _refs_to_lits(refs, tagged, vlits))
+            for body, refs in emitted]
+
+
+def assemble_columnar_spans(entries):
+    """Assemble cached ``(body, lits)`` entries into one message:
+    returns ``(spans, tab)`` — per-change span bytes (remap + body)
+    plus the message-level shared literal table that deduplicates every
+    change's literals by CONTENT. Pure splicing: the bodies ship
+    verbatim from the encode cache; only the small remap header is
+    per-message."""
+    tab_index = {}
+    tab_list = []
+    spans = []
+    for body, lits in entries:
+        buf = bytearray()
+        _uv(buf, len(lits))
+        prev = 0
+        for lit in lits:
+            idx = tab_index.get(lit)
+            if idx is None:
+                idx = tab_index[lit] = len(tab_list)
+                tab_list.append(lit)
+            _sv(buf, idx - prev)
+            prev = idx
+        buf += body
+        spans.append(bytes(buf))
+    t = bytearray()
+    _uv(t, len(tab_list))
+    for lit in tab_list:
+        _uv(t, len(lit))
+        t += lit
+    return spans, bytes(t)
+
+
+def build_columnar_container(tabs, spans_by_doc):
+    """Stitch one receive tick's worth of v2 messages into the single
+    container ``parse_columnar_block`` consumes: ``tabs`` is the
+    message literal tables, ``spans_by_doc`` one list of
+    ``(tab_idx, span)`` per document (container doc order = the
+    caller's doc_ids order)."""
+    out = bytearray(COLUMNAR_MAGIC)
+    _uv(out, len(tabs))
+    for tab in tabs:
+        _uv(out, len(tab))
+        out += tab
+    _uv(out, len(spans_by_doc))
+    for spans in spans_by_doc:
+        _uv(out, len(spans))
+        for tab_idx, span in spans:
+            _uv(out, tab_idx)
+            _uv(out, len(span))
+            out += span
+    return bytes(out)
+
+
+def _parse_columnar_py(data):
+    """Pure-Python columnar container parse -> general ChangeBlock
+    (the fallback twin of amst_parse_columnar: same bounds checks, same
+    column conventions, TaggedValues for the lazy value spans)."""
+    from .device.blocks import TaggedValues
+    r = _ColReader(data)
+    if len(data) < 4 or data[:4] != COLUMNAR_MAGIC:
+        r.fail('bad columnar magic')
+    r.pos = 4
+    n_tabs = r.uv()
+    if n_tabs > len(data):
+        r.fail('tab count exceeds container')
+    tabs = []
+    for _ in range(n_tabs):
+        nbytes = r.uv()
+        if nbytes > r.end - r.pos:
+            r.fail('tab length exceeds container')
+        t = _ColReader(data, pos=r.pos, end=r.pos + nbytes)
+        r.pos += nbytes
+        n_entries = t.uv()
+        if n_entries > nbytes:
+            t.fail('tab entry count exceeds tab bytes')
+        spans = []
+        for _ in range(n_entries):
+            llen = t.uv()
+            if llen == 0 or llen > t.end - t.pos:
+                t.fail('bad literal length')
+            spans.append((t.pos, t.pos + llen))
+            t.pos += llen
+        if t.pos != t.end:
+            t.fail('trailing bytes in tab')
+        tabs.append((spans, {}))             # spans + interning memo
+
+    actors, actor_of = [], {}
+    keys, key_of = [], {}
+    objs, obj_of = [ROOT_ID], {ROOT_ID: 0}
+    doc, actor, seq = [], [], []
+    dep_ptr, dep_actor, dep_seq = [0], [], []
+    op_ptr, action, key, value = [0], [], [], []
+    obj_col, key_kind, key_elem, elem = [], [], [], []
+    vstart, vend = [], []
+
+    def intern_str(tab, entry, table, index, memo_key):
+        spans, memo = tab
+        hit = memo.get((memo_key, entry))
+        if hit is not None:
+            return hit
+        s, e = spans[entry]
+        if data[s] != _TAG_STR:
+            raise ValueError(
+                'columnar parse failed: string literal expected '
+                f'at byte {s}')
+        i = _intern(table, index, data[s + 1:e].decode('utf-8'))
+        memo[(memo_key, entry)] = i
+        return i
+
+    n_docs = r.uv()
+    if n_docs > len(data):
+        r.fail('doc count exceeds container')
+    for d in range(n_docs):
+        n_changes = r.uv()
+        if n_changes > r.end - r.pos + 1:
+            r.fail('change count exceeds container')
+        for _ in range(n_changes):
+            tab_idx = r.uv()
+            if tab_idx >= n_tabs:
+                r.fail('tab index out of range')
+            tab = tabs[tab_idx]
+            nbytes = r.uv()
+            if nbytes > r.end - r.pos:
+                r.fail('span length exceeds container')
+            s = _ColReader(data, pos=r.pos, end=r.pos + nbytes)
+            r.pos += nbytes
+            n_lits = s.uv()
+            if n_lits == 0 or n_lits > nbytes:
+                s.fail('bad literal count')
+            locals_ = []
+            prev_t = 0
+            for _ in range(n_lits):
+                prev_t += s.sv()
+                if not 0 <= prev_t < len(tab[0]):
+                    s.fail('literal index out of range')
+                locals_.append(prev_t)
+            actor_id = intern_str(tab, locals_[0], actors, actor_of,
+                                  'a')
+            seq_v = s.u32('change seq out of range (must fit int32)')
+            n_deps = s.uv()
+            if n_deps > nbytes:
+                s.fail('bad dep count')
+            for _ in range(n_deps):
+                al = s.uv()
+                if al >= n_lits:
+                    s.fail('dep actor out of range')
+                dep_actor.append(intern_str(tab, locals_[al], actors,
+                                            actor_of, 'a'))
+                dep_seq.append(
+                    s.u32('dep seq out of range (must fit int32)'))
+            n_ops = s.uv()
+            if n_ops > nbytes:
+                s.fail('op count exceeds span')
+            acts, kinds = [], []
+            for _ in range(n_ops):
+                if s.pos >= s.end:
+                    s.fail('truncated action column')
+                b = data[s.pos]
+                s.pos += 1
+                a, kk = b & 0x0F, b >> 4
+                if a > 6 or kk > _KEY_NONE:
+                    s.fail('bad action/kind byte')
+                acts.append(a)
+                kinds.append(kk)
+            action.extend(acts)
+            key_kind.extend(kinds)
+            prev_o = 0
+            for i in range(n_ops):
+                prev_o += s.sv()
+                if not 0 <= prev_o < n_lits:
+                    s.fail('obj literal out of range')
+                obj_col.append(intern_str(tab, locals_[prev_o], objs,
+                                          obj_of, 'o'))
+            prev_e = 0
+            for i in range(n_ops):
+                kk = kinds[i]
+                if kk == _KEY_STR:
+                    kl = s.uv()
+                    if kl >= n_lits:
+                        s.fail('key literal out of range')
+                    key.append(intern_str(tab, locals_[kl], keys,
+                                          key_of, 'k'))
+                    key_elem.append(0)
+                elif kk == _KEY_ELEM:
+                    al = s.uv()
+                    if al >= n_lits:
+                        s.fail('elem-key actor out of range')
+                    key.append(intern_str(tab, locals_[al], actors,
+                                          actor_of, 'a'))
+                    prev_e += s.sv()
+                    if not 0 <= prev_e <= 0x7FFFFFFF:
+                        s.fail('element counter out of range')
+                    key_elem.append(prev_e)
+                else:
+                    key.append(-1)
+                    key_elem.append(0)
+            prev_i = 0
+            for i in range(n_ops):
+                if acts[i] != _INS:
+                    elem.append(0)
+                    continue
+                prev_i += s.sv()
+                if not 0 <= prev_i <= 0x7FFFFFFF:
+                    s.fail('ins elem out of range')
+                elem.append(prev_i)
+            for i in range(n_ops):
+                if acts[i] not in (_SET, _LINK):
+                    value.append(-1)
+                    continue
+                u = s.uv()
+                value.append(len(vstart))
+                if u == 0:
+                    vstart.append(-1)
+                    vend.append(-1)
+                else:
+                    if u - 1 >= n_lits:
+                        s.fail('value literal out of range')
+                    # tab spans already start AT the tag byte
+                    vs, ve = tab[0][locals_[u - 1]]
+                    vstart.append(vs)
+                    vend.append(ve)
+            if s.pos != s.end:
+                s.fail('trailing bytes in change span')
+            doc.append(d)
+            actor.append(actor_id)
+            seq.append(seq_v)
+            dep_ptr.append(len(dep_actor))
+            op_ptr.append(len(action))
+    if r.pos != r.end:
+        r.fail('trailing bytes in container')
+
+    values = TaggedValues(data, np.asarray(vstart, np.int64),
+                          np.asarray(vend, np.int64))
+    return ChangeBlock(
+        n_docs, np.asarray(doc, np.int32), np.asarray(actor, np.int32),
+        np.asarray(seq, np.int32), np.asarray(dep_ptr, np.int32),
+        np.asarray(dep_actor, np.int32), np.asarray(dep_seq, np.int32),
+        np.asarray(op_ptr, np.int32), np.asarray(action, np.int8),
+        np.asarray(key, np.int32), np.asarray(value, np.int32),
+        actors, keys, values,
+        obj=np.asarray(obj_col, np.int32),
+        key_kind=np.asarray(key_kind, np.int8),
+        key_elem=np.asarray(key_elem, np.int32),
+        elem=np.asarray(elem, np.int32), objs=objs)
+
+
+def parse_columnar_block(data):
+    """Parse a columnar v2 container into a general
+    :class:`~automerge_tpu.device.blocks.ChangeBlock` — the JSON-free
+    receive edge (native ``amst_parse_columnar`` when available;
+    ``_NATIVE_COLUMNAR = True`` raises instead of falling back). No
+    store is consulted: key kinds ship explicitly in the format."""
+    if isinstance(data, (bytearray, memoryview)):
+        data = bytes(data)
+    if _NATIVE_COLUMNAR is not False:
+        from . import native as _native
+        lib = _native.columnar_lib()
+        if lib is not None:
+            from .device.blocks import TaggedValues
+            h = lib.amst_parse_columnar(data, len(data))
+            if not h:
+                raise MemoryError('columnar codec allocation failed')
+            try:
+                return _extract_block(lib, h, data, general=True,
+                                      values_cls=TaggedValues)
+            finally:
+                lib.amwc_free(h)
+        if _NATIVE_COLUMNAR is True:
+            raise RuntimeError(
+                'native columnar codec forced (_NATIVE_COLUMNAR=True) '
+                'but the library is unavailable')
+    return _parse_columnar_py(data)
+
+
+def columnar_container_to_changes(data):
+    """Decode a v2 container back to per-document dict change lists —
+    the quarantine-isolation and journal-replay fallback (NOT the hot
+    path; the fused apply consumes the block directly)."""
+    return parse_columnar_block(data).to_changes()
+
+
+parseColumnarBlock = parse_columnar_block

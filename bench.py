@@ -517,12 +517,14 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     src.apply_changes_batch(
         {f'doc{d}': per_doc[d] for d in range(n_docs)})
 
-    def one_round(wire):
+    def one_round(wire, version=2):
         dst = GeneralDocSet(1024)          # auto-grows to the fleet
         msgs_a, msgs_b = [], []
         if wire:
-            ca = WireConnection(src, msgs_a.append)
-            cb = WireConnection(dst, msgs_b.append)
+            ca = WireConnection(src, msgs_a.append,
+                                wire_version=version)
+            cb = WireConnection(dst, msgs_b.append,
+                                wire_version=version)
         else:
             ca = Connection(src, msgs_a.append)
             cb = BatchingConnection(dst, msgs_b.append)
@@ -567,19 +569,33 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     t_dict = time.perf_counter() - t0
     check(dst)
 
-    # wire COLD: the encode cache starts empty, the round pays one
-    # encode per change (native emit) plus the binary transport
+    # wire v1 (JSON-blob spans) COLD: the format-ratio baseline — the
+    # same fleet, the same protocol, per-change JSON inside the blob
     store = src.store
-    store._wire_cache.clear()
-    store._wire_cache_bytes = 0
-    store.wire_cache_hits = store.wire_cache_misses = 0
+    store.clear_wire_cache()
+    sent0 = _m.counters.get('sync_wire_bytes_sent', 0)
+    t0 = time.perf_counter()
+    _, dst = one_round(True, version=1)
+    t_wire_v1 = time.perf_counter() - t0
+    v1_bytes = _m.counters.get('sync_wire_bytes_sent', 0) - sent0
+    check(dst)
+
+    # wire v2 (columnar binary) COLD: the encode cache starts empty,
+    # the round pays one columnar encode per change (native emit) plus
+    # the binary transport
+    store.clear_wire_cache()
+    sent0 = _m.counters.get('sync_wire_bytes_sent', 0)
     t0 = time.perf_counter()
     n_msgs_w, dst = one_round(True)
     t_wire = time.perf_counter() - t0
+    v2_bytes = _m.counters.get('sync_wire_bytes_sent', 0) - sent0
     check(dst)
     assert store.wire_cache_misses == n_changes
 
-    # wire FAN-OUT: a second peer re-serves every change from cache
+    # wire v2 FAN-OUT: a second peer re-serves every change from
+    # cache; the parse p50/p99 keys read THIS warm round (the
+    # degraded-bench convention — cold rounds pay XLA/shape churn)
+    _m.reset_series('sync_wire_parse_ms')
     t0 = time.perf_counter()
     _, dst = one_round(True)
     t_fan = time.perf_counter() - t0
@@ -591,7 +607,14 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     return {'n_docs': n_docs, 'n_ops': n_ops, 'n_changes': n_changes,
             'n_msgs_dict': n_msgs, 't_dict': t_dict,
             'n_msgs_wire': n_msgs_w, 't_wire': t_wire,
-            't_wire_fanout': t_fan, 'cache_hit_rate': hit_rate,
+            't_wire_v1': t_wire_v1, 't_wire_fanout': t_fan,
+            'cache_hit_rate': hit_rate,
+            'wire_v1_bytes': v1_bytes, 'wire_v2_bytes': v2_bytes,
+            'wire_v2_ratio': v1_bytes / max(v2_bytes, 1),
+            'wire_v2_parse_ms_p50':
+                _m.quantile('sync_wire_parse_ms', 0.5),
+            'wire_v2_parse_ms_p99':
+                _m.quantile('sync_wire_parse_ms', 0.99),
             'apply_ms_p50': _m.quantile('sync_apply_ms', 0.5),
             'apply_ms_p99': _m.quantile('sync_apply_ms', 0.99),
             'flush_ms_p50': _m.quantile('sync_flush_ms', 0.5),
@@ -940,11 +963,15 @@ def bench_general_snapshot_resume(n_docs=10000):
     return n_docs, len(blob), t_load
 
 
-def bench_wire_parse(n_docs=2048):
-    """Native wire edge: raw JSON change batch -> columnar block."""
+def bench_wire_parse(n_docs=2048, gen_docs=1024, gen_list_ops=22):
+    """Native wire edge: raw JSON change batch -> columnar block, plus
+    the columnar-v2 lane — the SAME general changes as one binary
+    container vs one JSON blob: parse MB/s of each and the bytes-vs-
+    JSON compression ratio."""
     import json
-    from automerge_tpu import wire
+    from automerge_tpu import native, wire
     from automerge_tpu.device import blocks as blk
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
 
     block = gen_block_workload(n_docs=n_docs)
     data = json.dumps(block.to_changes()).encode()
@@ -958,7 +985,36 @@ def bench_wire_parse(n_docs=2048):
     t0 = time.perf_counter()
     blk.ChangeBlock.from_changes(json.loads(data.decode()))
     t_py = time.perf_counter() - t0
-    return len(data), block.n_ops, t_nat, t_py
+
+    # columnar v2 lane: a GENERAL workload (lists + links + causal
+    # chains — the sync-tick shape), encoded once each way
+    per_doc = _gen_mixed_docs(gen_docs, gen_list_ops)
+    gblock = GeneralDocSet(gen_docs).store.encode_changes(per_doc)
+    jdata = json.dumps(gblock.to_changes(),
+                       separators=(',', ':')).encode()
+    rows = list(range(gblock.n_changes))
+    entries = wire.encode_change_rows_columnar(gblock, rows)
+    spans, tab = wire.assemble_columnar_spans(entries)
+    per = [[] for _ in range(gblock.n_docs)]
+    for c, span in zip(rows, spans):
+        per[gblock.doc[c]].append((0, span))
+    cdata = wire.build_columnar_container([tab], per)
+    col = {'json_bytes': len(jdata), 'v2_bytes': len(cdata),
+           'ratio': len(jdata) / max(len(cdata), 1),
+           'n_ops': gblock.n_ops,
+           'native': native.columnar_available()}
+    wire.parse_columnar_block(cdata)       # warm
+    t0 = time.perf_counter()
+    wire.parse_columnar_block(cdata)
+    col['t_parse'] = time.perf_counter() - t0
+    # the general-schema JSON parse of the SAME changes — the receive
+    # path v2 replaces (161-235 MB/s in earlier rounds)
+    store = GeneralDocSet(gen_docs).store
+    wire.parse_general_block(jdata, store=store)   # warm
+    t0 = time.perf_counter()
+    wire.parse_general_block(jdata, store=store)
+    col['t_parse_json'] = time.perf_counter() - t0
+    return len(data), block.n_ops, t_nat, t_py, col
 
 
 def bench_snapshot_resume(n_changes=20000, n_keys=8):
@@ -1399,6 +1455,14 @@ def main():
         f'served from the encode cache — '
         f'{s10k["cache_hit_rate"] * 100:.0f}% hit rate, '
         f'{s10k["n_changes"]} changes each encoded exactly once)')
+    log(f'docset-sync[general 10k wire FORMAT v2]: columnar binary '
+        f'{s10k["wire_v2_bytes"] >> 10} KiB on the wire vs '
+        f'{s10k["wire_v1_bytes"] >> 10} KiB JSON-blob v1 '
+        f'({s10k["wire_v2_ratio"]:.1f}x smaller); v1 lane '
+        f'{s10k["t_wire_v1"]:.3f}s, v2 lane {t_10k_wire:.3f}s; warm '
+        f'v2 parse p50 {s10k["wire_v2_parse_ms_p50"]:.1f} / p99 '
+        f'{s10k["wire_v2_parse_ms_p99"]:.1f} ms (sync_wire_parse_ms '
+        f'series, zero json.loads on the v2 receive path)')
     log(f'docset-sync[general 10k latency, histogram series]: apply '
         f'p50 {s10k["apply_ms_p50"]:.1f} / p99 '
         f'{s10k["apply_ms_p99"]:.1f} ms, flush p50 '
@@ -1469,7 +1533,7 @@ def main():
         f'({t_mat_cold / max(t_mat_dirty, 1e-9):.0f}x over cold — '
         f'the view cache serves every clean doc)')
 
-    wb, wops, t_nat, t_py = bench_wire_parse()
+    wb, wops, t_nat, t_py, wcol = bench_wire_parse()
     if t_nat is not None:
         log(f'wire-parse[native codec]: {wb >> 20} MiB JSON / {wops} ops — '
             f'native {t_nat * 1e3:.0f} ms ({wb / t_nat / 1e6:.0f} MB/s), '
@@ -1477,6 +1541,18 @@ def main():
     else:
         log(f'wire-parse: native codec unavailable (no g++/.so); '
             f'python edge {t_py * 1e3:.0f} ms for {wb >> 20} MiB')
+    log(f'wire-parse[columnar v2]: same {wcol["n_ops"]} general ops — '
+        f'{wcol["v2_bytes"] >> 10} KiB binary vs '
+        f'{wcol["json_bytes"] >> 10} KiB JSON '
+        f'({wcol["ratio"]:.1f}x smaller); v2 parse '
+        f'{wcol["t_parse"] * 1e3:.1f} ms '
+        f'({wcol["v2_bytes"] / wcol["t_parse"] / 1e6:.0f} MB/s raw, '
+        f'{wcol["json_bytes"] / wcol["t_parse"] / 1e6:.0f} MB/s '
+        f'JSON-equivalent) vs general-JSON parse '
+        f'{wcol["t_parse_json"] * 1e3:.1f} ms '
+        f'({wcol["json_bytes"] / wcol["t_parse_json"] / 1e6:.0f} MB/s)'
+        f' -> {wcol["t_parse_json"] / wcol["t_parse"]:.1f}x, '
+        f'{"native" if wcol["native"] else "PYTHON-FALLBACK"} codec')
 
     n_hist, t_log_load, t_snap_load, sz_log, sz_snap = \
         bench_snapshot_resume()
@@ -1585,6 +1661,18 @@ def main():
             round(n_10k / s10k['t_wire_fanout'], 1),
         'general_sync10k_wire_cache_hit_rate':
             round(s10k['cache_hit_rate'], 4),
+        'general_sync10k_wire_v2_bytes': s10k['wire_v2_bytes'],
+        'general_sync10k_wire_v1_bytes': s10k['wire_v1_bytes'],
+        'wire_v2_compression_ratio': round(s10k['wire_v2_ratio'], 2),
+        'general_sync10k_wire_v2_parse_ms_p50':
+            round(s10k['wire_v2_parse_ms_p50'], 2),
+        'general_sync10k_wire_v2_parse_ms_p99':
+            round(s10k['wire_v2_parse_ms_p99'], 2),
+        'wire_parse_v2_mb_per_sec':
+            round(wcol['v2_bytes'] / wcol['t_parse'] / 1e6, 1),
+        'wire_parse_v2_json_equiv_mb_per_sec':
+            round(wcol['json_bytes'] / wcol['t_parse'] / 1e6, 1),
+        'wire_parse_v2_native': bool(wcol['native']),
         'general_sync10k_apply_ms_p50': round(s10k['apply_ms_p50'], 2),
         'general_sync10k_apply_ms_p99': round(s10k['apply_ms_p99'], 2),
         'general_sync10k_flush_ms_p50': round(s10k['flush_ms_p50'], 2),

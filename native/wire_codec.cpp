@@ -1787,3 +1787,539 @@ void amwe_fill(void* h, char* out, int64_t* offsets) {
 void amwe_free(void* h) { delete static_cast<emitjson::Emitted*>(h); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Columnar wire blob v2 (the amwe_emit_columnar / amst_parse_columnar
+// entry points): the JSON-free binary change encoding of the sync tick.
+//
+// One change encodes as a varint/delta-packed COLUMN body referencing a
+// LOCAL literal list (first-occurrence order over actor, deps, then each
+// op's obj/key/value refs); the message layer deduplicates every
+// change's literals into ONE shared tagged table per message, so an
+// actor uuid that appears in a thousand changes ships once. The emit
+// side returns bodies plus per-change global REF lists ((kind<<32)|idx
+// into the block's actor/key/obj/value tables) — the HOST maps refs to
+// tagged literal bytes, so arbitrary-precision ints and canonical JSON
+// composites never cross the C boundary, and the pure-Python emitter is
+// byte-identical by construction (same two-pass walk, same varints).
+//
+// The parse side consumes the multi-message container the receiving
+// WireConnection assembles:
+//
+//   container := "AMW2"
+//                uvarint n_tabs  { uvarint nbytes  tab }*
+//                uvarint n_docs  { uvarint n_changes
+//                                  { uvarint tab_idx
+//                                    uvarint nbytes  span }* }*
+//   tab       := uvarint n_entries { uvarint nbytes  tag payload }*
+//   span      := uvarint n_lits { svarint delta(table index) }*  body
+//   body      := uvarint seq
+//                uvarint n_deps { uvarint actor_local  uvarint seq }*
+//                uvarint n_ops
+//                { (key_kind<<4 | action) byte }*            action col
+//                { svarint delta(obj_local) }*               obj col
+//                { STR: uvarint key_local                    key col
+//                  ELEM: uvarint actor_local
+//                        svarint delta(key_elem) }*
+//                { ins: svarint delta(elem) }*               elem col
+//                { set/link: uvarint val_local+1 | 0 }*      value col
+//
+// and fills the SAME Parsed struct the JSON parsers fill, so the
+// existing amwc_* accessors extract it into a ChangeBlock and the
+// native stager consumes it — zero JSON anywhere on the receive path.
+// Literal tags: 0 utf8 string, 1 zigzag int, 2 float64 LE, 3 true,
+// 4 false, 5 null, 6 canonical-JSON composite (decoded lazily on the
+// Python side, never here). Every read is bounds-checked: a torn or
+// hostile container sets Parsed.error, never crashes.
+
+namespace {
+
+constexpr int8_t kLitStr = 0;
+
+struct ColEmitted {
+    std::string body;                  // concatenated change bodies
+    std::vector<int64_t> body_off;     // n_rows + 1
+    std::vector<int64_t> refs;         // (kind<<32)|idx, per local lit
+    std::vector<int64_t> refs_off;     // n_rows + 1
+};
+
+inline void put_uv(std::string& o, uint64_t v) {
+    while (v >= 0x80) {
+        o += static_cast<char>(0x80 | (v & 0x7F));
+        v >>= 7;
+    }
+    o += static_cast<char>(v);
+}
+
+inline void put_sv(std::string& o, int64_t v) {
+    put_uv(o, (static_cast<uint64_t>(v) << 1)
+                  ^ static_cast<uint64_t>(v >> 63));
+}
+
+struct ColReader {
+    const uint8_t* p;
+    const uint8_t* end;
+    const uint8_t* base;
+    std::string err;
+
+    bool fail(const char* msg) {
+        if (err.empty())
+            err = std::string(msg) + " at byte "
+                + std::to_string(p - base);
+        return false;
+    }
+    bool uv(uint64_t& out) {
+        uint64_t v = 0;
+        int shift = 0;
+        while (p < end) {
+            uint8_t b = *p++;
+            if (shift >= 63 && b > 1)
+                return fail("varint overflow");
+            v |= static_cast<uint64_t>(b & 0x7F) << shift;
+            if (!(b & 0x80)) { out = v; return true; }
+            shift += 7;
+        }
+        return fail("truncated varint");
+    }
+    bool sv(int64_t& out) {
+        uint64_t u;
+        if (!uv(u)) return false;
+        out = static_cast<int64_t>(u >> 1)
+            ^ -static_cast<int64_t>(u & 1);
+        return true;
+    }
+    bool u32(const char* what, int64_t& out) {
+        uint64_t u;
+        if (!uv(u)) return false;
+        if (u > 0x7FFFFFFFULL) return fail(what);
+        out = static_cast<int64_t>(u);
+        return true;
+    }
+};
+
+// duplicate-assignment detection per change (exactly the
+// resolve_general_kinds cells pass — the lazily computed Python flag
+// agrees; see ChangeBlock.has_dup_keys)
+void detect_dup_fields(Parsed& out) {
+    std::vector<std::pair<uint64_t, uint64_t>> cells;
+    for (size_t ci = 0; ci + 1 < out.op_ptr.size() && !out.dup_keys;
+         ci++) {
+        cells.clear();
+        for (int32_t j = out.op_ptr[ci]; j < out.op_ptr[ci + 1]; j++) {
+            int8_t a = out.action[j];
+            if (a != kSet && a != kDel && a != kLink) continue;
+            uint64_t hi = (static_cast<uint64_t>(out.obj[j]) << 1)
+                        | (out.key_kind[j] == kKeyElem ? 1u : 0u);
+            uint64_t lo = out.key_kind[j] == kKeyElem
+                ? ((static_cast<uint64_t>(out.key[j]) << 32)
+                   | static_cast<uint32_t>(out.key_elem[j]))
+                : static_cast<uint64_t>(out.key[j]);
+            cells.emplace_back(hi, lo);
+        }
+        std::sort(cells.begin(), cells.end());
+        for (size_t k = 1; k < cells.size(); k++)
+            if (cells[k] == cells[k - 1]) {
+                out.dup_keys = true;
+                break;
+            }
+    }
+}
+
+// one parsed literal table: (tag, payload span) per entry, plus lazy
+// per-table interning memos so a string referenced by many changes
+// interns once
+struct ColTab {
+    std::vector<int8_t> tag;
+    std::vector<int64_t> start, end;   // payload spans (tag excluded)
+    std::vector<int32_t> a_memo, k_memo, o_memo;
+};
+
+bool intern_lit(const ColTab& tab, std::vector<int32_t>& memo,
+                int32_t entry, const char* base, Interner& table,
+                ColReader& r, int32_t& out) {
+    if (tab.tag[entry] != kLitStr)
+        return r.fail("string literal expected");
+    int32_t id = memo[entry];
+    if (id < 0)
+        id = memo[entry] = table.intern(
+            std::string(base + tab.start[entry], base + tab.end[entry]));
+    out = id;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Emit change rows of a retained general block in columnar v2 form.
+// Returns bodies (varint columns referencing LOCAL literal ids) plus
+// the per-change global ref lists the host maps to tagged literal
+// bytes. Two passes per change, both in the SAME row-major ref order
+// (actor, deps, then per op: obj, key, value) — the pure-Python
+// fallback walks identically, which is what makes the two emitters
+// byte-identical by construction.
+void* amwe_emit_columnar(
+    int64_t n_rows, const int64_t* rows,
+    const int32_t* actor, const int32_t* seq,
+    const int32_t* dep_ptr, const int32_t* dep_actor,
+    const int32_t* dep_seq,
+    const int32_t* op_ptr, const int8_t* action, const int32_t* obj,
+    const int8_t* key_kind, const int32_t* key, const int32_t* key_elem,
+    const int32_t* elem, const int32_t* value) {
+    auto* e = new (std::nothrow) ColEmitted();
+    if (!e) return nullptr;
+    e->body_off.reserve(n_rows + 1);
+    e->refs_off.reserve(n_rows + 1);
+    e->body_off.push_back(0);
+    e->refs_off.push_back(0);
+    std::unordered_map<int64_t, int32_t> seen;
+    std::string& o = e->body;
+    for (int64_t r = 0; r < n_rows; r++) {
+        int64_t c = rows[r];
+        seen.clear();
+        size_t ref_base = e->refs.size();
+        auto local = [&](int kind, int64_t idx) -> int32_t {
+            int64_t k = (static_cast<int64_t>(kind) << 32) | idx;
+            auto it = seen.find(k);
+            if (it != seen.end()) return it->second;
+            int32_t id = static_cast<int32_t>(e->refs.size() - ref_base);
+            seen.emplace(k, id);
+            e->refs.push_back(k);
+            return id;
+        };
+        // pass 1: intern every ref in canonical order (the change's
+        // actor is ALWAYS local 0 — the body never stores it)
+        local(0, actor[c]);
+        for (int32_t j = dep_ptr[c]; j < dep_ptr[c + 1]; j++)
+            local(0, dep_actor[j]);
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            int8_t a = action[j];
+            local(2, obj[j]);
+            int8_t kk = key_kind[j];
+            if (kk == kKeyStr) local(1, key[j]);
+            else if (kk == kKeyElem) local(0, key[j]);
+            if ((a == kSet || a == kLink) && value[j] >= 0)
+                local(3, value[j]);
+        }
+        // pass 2: write the body columns
+        put_uv(o, static_cast<uint64_t>(seq[c]));
+        put_uv(o, static_cast<uint64_t>(dep_ptr[c + 1] - dep_ptr[c]));
+        for (int32_t j = dep_ptr[c]; j < dep_ptr[c + 1]; j++) {
+            put_uv(o, static_cast<uint64_t>(local(0, dep_actor[j])));
+            put_uv(o, static_cast<uint64_t>(dep_seq[j]));
+        }
+        int32_t n_ops = op_ptr[c + 1] - op_ptr[c];
+        put_uv(o, static_cast<uint64_t>(n_ops));
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++)
+            o += static_cast<char>((key_kind[j] << 4) | action[j]);
+        int64_t prev = 0;
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            int64_t lo = local(2, obj[j]);
+            put_sv(o, lo - prev);
+            prev = lo;
+        }
+        int64_t prev_e = 0;
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            int8_t kk = key_kind[j];
+            if (kk == kKeyStr) {
+                put_uv(o, static_cast<uint64_t>(local(1, key[j])));
+            } else if (kk == kKeyElem) {
+                put_uv(o, static_cast<uint64_t>(local(0, key[j])));
+                put_sv(o, key_elem[j] - prev_e);
+                prev_e = key_elem[j];
+            }
+        }
+        int64_t prev_i = 0;
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            if (action[j] != kIns) continue;
+            put_sv(o, elem[j] - prev_i);
+            prev_i = elem[j];
+        }
+        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+            int8_t a = action[j];
+            if (a != kSet && a != kLink) continue;
+            put_uv(o, value[j] >= 0
+                          ? static_cast<uint64_t>(local(3, value[j])) + 1
+                          : 0);
+        }
+        e->body_off.push_back(static_cast<int64_t>(o.size()));
+        e->refs_off.push_back(static_cast<int64_t>(e->refs.size()));
+    }
+    return e;
+}
+
+int64_t amwe_col_bytes(void* h) {
+    return static_cast<int64_t>(static_cast<ColEmitted*>(h)->body.size());
+}
+
+int64_t amwe_col_refs(void* h) {
+    return static_cast<int64_t>(static_cast<ColEmitted*>(h)->refs.size());
+}
+
+void amwe_col_fill(void* h, char* body, int64_t* body_off,
+                   int64_t* refs, int64_t* refs_off) {
+    auto* e = static_cast<ColEmitted*>(h);
+    std::memcpy(body, e->body.data(), e->body.size());
+    std::memcpy(body_off, e->body_off.data(), e->body_off.size() * 8);
+    if (!e->refs.empty())
+        std::memcpy(refs, e->refs.data(), e->refs.size() * 8);
+    std::memcpy(refs_off, e->refs_off.data(), e->refs_off.size() * 8);
+}
+
+void amwe_col_free(void* h) { delete static_cast<ColEmitted*>(h); }
+
+// Parse a columnar v2 container into the SAME Parsed struct the JSON
+// parsers fill (extract through the amwc_* accessors, free with
+// amwc_free). Value spans point at tagged literal bytes (tag byte
+// included) inside the container — decoded lazily host-side, so the
+// whole parse is JSON-free. Every count and index is bounds-checked;
+// malformed input sets Parsed.error.
+void* amst_parse_columnar(const char* buf, int64_t len) {
+    auto* out = new (std::nothrow) Parsed();
+    if (!out) return nullptr;
+    out->general = true;
+    out->objs.intern(std::string(kRootId));    // objs[0] = ROOT, always
+    const uint8_t* base = reinterpret_cast<const uint8_t*>(buf);
+    ColReader r{base, base + len, base, {}};
+    auto bail = [&](const char* msg) -> void* {
+        out->error = r.err.empty()
+            ? std::string(msg) + " at byte "
+                  + std::to_string(r.p - r.base)
+            : r.err;
+        return out;
+    };
+    if (len < 4 || std::memcmp(buf, "AMW2", 4) != 0)
+        return bail("bad columnar magic");
+    r.p += 4;
+
+    uint64_t n_tabs;
+    if (!r.uv(n_tabs)) return bail("bad tab count");
+    if (n_tabs > static_cast<uint64_t>(len))
+        return bail("tab count exceeds container");
+    std::vector<ColTab> tabs(static_cast<size_t>(n_tabs));
+    for (auto& tab : tabs) {
+        uint64_t nbytes;
+        if (!r.uv(nbytes)) return bail("bad tab length");
+        if (nbytes > static_cast<uint64_t>(r.end - r.p))
+            return bail("tab length exceeds container");
+        ColReader t{r.p, r.p + nbytes, base, {}};
+        r.p += nbytes;
+        uint64_t n_entries;
+        if (!t.uv(n_entries)) { r.err = t.err; return bail("bad tab"); }
+        if (n_entries > nbytes)
+            return bail("tab entry count exceeds tab bytes");
+        tab.tag.reserve(static_cast<size_t>(n_entries));
+        for (uint64_t i = 0; i < n_entries; i++) {
+            uint64_t llen;
+            if (!t.uv(llen)) { r.err = t.err; return bail("bad tab"); }
+            if (llen == 0 || llen > static_cast<uint64_t>(t.end - t.p))
+                return bail("bad literal length");
+            tab.tag.push_back(static_cast<int8_t>(*t.p));
+            tab.start.push_back(t.p + 1 - base);
+            tab.end.push_back(t.p + llen - base);
+            t.p += llen;
+        }
+        if (t.p != t.end) return bail("trailing bytes in tab");
+        tab.a_memo.assign(tab.tag.size(), -1);
+        tab.k_memo.assign(tab.tag.size(), -1);
+        tab.o_memo.assign(tab.tag.size(), -1);
+    }
+
+    uint64_t n_docs;
+    if (!r.uv(n_docs)) return bail("bad doc count");
+    if (n_docs > static_cast<uint64_t>(len))
+        return bail("doc count exceeds container");
+    std::vector<int32_t> locals;      // local id -> tab entry
+    for (uint64_t d = 0; d < n_docs; d++) {
+        uint64_t n_changes;
+        if (!r.uv(n_changes)) return bail("bad change count");
+        if (n_changes > static_cast<uint64_t>(r.end - r.p) + 1)
+            return bail("change count exceeds container");
+        for (uint64_t ci = 0; ci < n_changes; ci++) {
+            uint64_t tab_idx, nbytes;
+            if (!r.uv(tab_idx)) return bail("bad tab index");
+            if (tab_idx >= n_tabs) return bail("tab index out of range");
+            ColTab& tab = tabs[static_cast<size_t>(tab_idx)];
+            int32_t n_entries = static_cast<int32_t>(tab.tag.size());
+            if (!r.uv(nbytes)) return bail("bad span length");
+            if (nbytes > static_cast<uint64_t>(r.end - r.p))
+                return bail("span length exceeds container");
+            ColReader s{r.p, r.p + nbytes, base, {}};
+            r.p += nbytes;
+            auto sbail = [&]() -> void* {
+                out->error = s.err.empty() ? "bad change span" : s.err;
+                return out;
+            };
+            // remap: local literal ids -> tab entries (delta varints)
+            uint64_t n_lits;
+            if (!s.uv(n_lits)) return sbail();
+            if (n_lits == 0 || n_lits > nbytes)
+                { s.fail("bad literal count"); return sbail(); }
+            locals.assign(static_cast<size_t>(n_lits), 0);
+            int64_t prev_t = 0;
+            for (uint64_t i = 0; i < n_lits; i++) {
+                int64_t dlt;
+                if (!s.sv(dlt)) return sbail();
+                prev_t += dlt;
+                if (prev_t < 0 || prev_t >= n_entries)
+                    { s.fail("literal index out of range");
+                      return sbail(); }
+                locals[static_cast<size_t>(i)] =
+                    static_cast<int32_t>(prev_t);
+            }
+            auto lit_of = [&](uint64_t lo) -> int32_t {
+                return locals[static_cast<size_t>(lo)];
+            };
+            // change header: actor (local 0 by construction), seq, deps
+            int32_t actor_id;
+            if (!intern_lit(tab, tab.a_memo, lit_of(0), buf,
+                            out->actors, s, actor_id))
+                return sbail();
+            int64_t seq_v;
+            if (!s.u32("change seq out of range (must fit int32)",
+                       seq_v))
+                return sbail();
+            uint64_t n_deps;
+            if (!s.uv(n_deps)) return sbail();
+            if (n_deps > nbytes)
+                { s.fail("bad dep count"); return sbail(); }
+            for (uint64_t i = 0; i < n_deps; i++) {
+                uint64_t al;
+                int64_t ds;
+                if (!s.uv(al)) return sbail();
+                if (al >= n_lits)
+                    { s.fail("dep actor out of range"); return sbail(); }
+                int32_t dep_id;
+                if (!intern_lit(tab, tab.a_memo, lit_of(al), buf,
+                                out->actors, s, dep_id))
+                    return sbail();
+                if (!s.u32("dep seq out of range (must fit int32)", ds))
+                    return sbail();
+                out->dep_actor.push_back(dep_id);
+                out->dep_seq.push_back(static_cast<int32_t>(ds));
+            }
+            uint64_t n_ops;
+            if (!s.uv(n_ops)) return sbail();
+            if (n_ops > nbytes)
+                { s.fail("op count exceeds span"); return sbail(); }
+            size_t op0 = out->action.size();
+            // action column (packed with the key kind)
+            for (uint64_t i = 0; i < n_ops; i++) {
+                if (s.p >= s.end)
+                    { s.fail("truncated action column"); return sbail(); }
+                uint8_t b = *s.p++;
+                int8_t a = static_cast<int8_t>(b & 0x0F);
+                int8_t kk = static_cast<int8_t>(b >> 4);
+                if (a > kMakeText || kk > kKeyNone)
+                    { s.fail("bad action/kind byte"); return sbail(); }
+                out->action.push_back(a);
+                out->key_kind.push_back(kk);
+                out->obj.push_back(-1);
+                out->key.push_back(-1);
+                out->key_elem.push_back(0);
+                out->elem.push_back(0);
+                out->value.push_back(-1);
+            }
+            // obj column
+            int64_t prev_o = 0;
+            for (uint64_t i = 0; i < n_ops; i++) {
+                int64_t dlt;
+                if (!s.sv(dlt)) return sbail();
+                prev_o += dlt;
+                if (prev_o < 0 || prev_o >= static_cast<int64_t>(n_lits))
+                    { s.fail("obj literal out of range");
+                      return sbail(); }
+                int32_t obj_id;
+                if (!intern_lit(tab, tab.o_memo, lit_of(prev_o), buf,
+                                out->objs, s, obj_id))
+                    return sbail();
+                out->obj[op0 + i] = obj_id;
+            }
+            // key column
+            int64_t prev_e = 0;
+            for (uint64_t i = 0; i < n_ops; i++) {
+                int8_t kk = out->key_kind[op0 + i];
+                if (kk == kKeyStr) {
+                    uint64_t kl;
+                    if (!s.uv(kl)) return sbail();
+                    if (kl >= n_lits)
+                        { s.fail("key literal out of range");
+                          return sbail(); }
+                    int32_t key_id;
+                    if (!intern_lit(tab, tab.k_memo, lit_of(kl), buf,
+                                    out->keys, s, key_id))
+                        return sbail();
+                    out->key[op0 + i] = key_id;
+                } else if (kk == kKeyElem) {
+                    uint64_t al;
+                    int64_t dlt;
+                    if (!s.uv(al)) return sbail();
+                    if (al >= n_lits)
+                        { s.fail("elem-key actor out of range");
+                          return sbail(); }
+                    int32_t ka_id;
+                    if (!intern_lit(tab, tab.a_memo, lit_of(al), buf,
+                                    out->actors, s, ka_id))
+                        return sbail();
+                    if (!s.sv(dlt)) return sbail();
+                    prev_e += dlt;
+                    if (prev_e < 0 || prev_e > 0x7FFFFFFFLL)
+                        { s.fail("element counter out of range");
+                          return sbail(); }
+                    out->key[op0 + i] = ka_id;
+                    out->key_elem[op0 + i] =
+                        static_cast<int32_t>(prev_e);
+                }
+            }
+            // elem column (ins ops only)
+            int64_t prev_i = 0;
+            for (uint64_t i = 0; i < n_ops; i++) {
+                if (out->action[op0 + i] != kIns) continue;
+                int64_t dlt;
+                if (!s.sv(dlt)) return sbail();
+                prev_i += dlt;
+                if (prev_i < 0 || prev_i > 0x7FFFFFFFLL)
+                    { s.fail("ins elem out of range"); return sbail(); }
+                out->elem[op0 + i] = static_cast<int32_t>(prev_i);
+            }
+            // value column (set/link ops only)
+            for (uint64_t i = 0; i < n_ops; i++) {
+                int8_t a = out->action[op0 + i];
+                if (a != kSet && a != kLink) continue;
+                uint64_t u;
+                if (!s.uv(u)) return sbail();
+                out->value[op0 + i] =
+                    static_cast<int32_t>(out->vstart.size());
+                if (u == 0) {
+                    out->vstart.push_back(-1);
+                    out->vend.push_back(-1);
+                } else {
+                    if (u - 1 >= n_lits)
+                        { s.fail("value literal out of range");
+                          return sbail(); }
+                    int32_t ent = lit_of(u - 1);
+                    // span INCLUDES the tag byte — the host decoder
+                    // dispatches on it
+                    out->vstart.push_back(tab.start[ent] - 1);
+                    out->vend.push_back(tab.end[ent]);
+                }
+            }
+            if (s.p != s.end)
+                { s.fail("trailing bytes in change span");
+                  return sbail(); }
+            out->doc.push_back(static_cast<int32_t>(d));
+            out->actor.push_back(actor_id);
+            out->seq.push_back(static_cast<int32_t>(seq_v));
+            out->dep_ptr.push_back(
+                static_cast<int32_t>(out->dep_actor.size()));
+            out->op_ptr.push_back(
+                static_cast<int32_t>(out->action.size()));
+        }
+    }
+    if (r.p != r.end) return bail("trailing bytes in container");
+    out->n_docs = static_cast<int64_t>(n_docs);
+    detect_dup_fields(*out);
+    return out;
+}
+
+}  // extern "C"

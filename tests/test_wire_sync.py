@@ -461,3 +461,264 @@ class TestFleetStatus:
         assert status['totals']['quarantined'] == 1
         assert status['docs']['bad']['quarantined'] is not None
         assert status['docs']['ok']['quarantined'] is None
+
+
+class TestWireV2Interop:
+    """Wire-format v2 negotiation + mixed-fleet interop: v2<->v2 pairs
+    ship columnar data, a v1-only receiver pins the sender to v1
+    framing (the PR 7/8 v-stamp pattern — the stamp rides the
+    messages, no extra handshake round-trips), and mixed fleets stay
+    byte-identical to the dict oracle under chaos."""
+
+    def _pump_recorded(self, src, dst, dst_version=2, src_version=2):
+        ma, mb, rec = [], [], []
+        ca = WireConnection(src, ma.append, wire_version=src_version)
+        cb = WireConnection(dst, mb.append, wire_version=dst_version)
+        ca.open()
+        cb.open()
+        for _ in range(60):
+            flush_all(ca, cb)
+            if not (ma or mb):
+                break
+            for m in ma[:]:
+                ma.remove(m)
+                rec.append(m)
+                cb.receive_msg(m)
+            for m in mb[:]:
+                mb.remove(m)
+                ca.receive_msg(m)
+        flush_all(ca, cb)
+        return rec
+
+    def test_v2_pair_ships_columnar_data(self):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule())
+        dst = GeneralDocSet(4)
+        rec = self._pump_recorded(src, dst)
+        assert canonical(doc_set_view(src)) == \
+            canonical(doc_set_view(dst))
+        data = [m for m in rec if 'wire' in m and sum(m['counts'])]
+        assert data and all(m['wire'] == 2 for m in data)
+        assert all(isinstance(m['tab'], bytes) and m['tab']
+                   for m in data)
+        # negotiation costs zero v1 data round-trips: data only ever
+        # flows to a peer we have heard from, so maxv lands first
+        assert all(m.get('maxv') == 2 for m in rec if 'wire' in m)
+
+    def test_v1_receiver_pins_sender_to_v1(self):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule())
+        dst = GeneralDocSet(4)
+        rec = self._pump_recorded(src, dst, dst_version=1)
+        assert canonical(doc_set_view(src)) == \
+            canonical(doc_set_view(dst))
+        data = [m for m in rec if 'wire' in m and sum(m['counts'])]
+        assert data and all(m['wire'] == 1 for m in data)
+        assert all('tab' not in m for m in data)
+
+    def test_v1_and_v2_converge_identically(self):
+        views = {}
+        for version in (1, 2):
+            src = GeneralDocSet(16)
+            src.apply_changes_batch(rich_schedule())
+            dst = GeneralDocSet(4)
+            self._pump_recorded(src, dst, dst_version=version,
+                                src_version=version)
+            views[version] = (canonical(doc_set_view(src)),
+                              canonical(doc_set_view(dst)))
+        assert views[1] == views[2]
+        assert views[1][0] == views[1][1]
+
+    def test_newer_version_than_spoken_is_rejected(self):
+        dst = GeneralDocSet(4)
+        cb = WireConnection(dst, lambda m: None, wire_version=1)
+        blob = b'{"actor":"a","seq":1,"deps":{},"ops":[]}'
+        msg = {'wire': 2, 'docs': ['d0'], 'clocks': [{'a': 1}],
+               'counts': [1], 'lens': [len(blob)], 'blob': blob,
+               'tab': b'\x00'}
+        with pytest.raises(MessageRejected, match='not spoken'):
+            cb.receive_msg(msg)
+        assert not cb._incoming_wire and cb._their_clock == {}
+
+    def test_v2_receive_path_is_json_free(self, monkeypatch):
+        """The acceptance assertion: no json.loads reachable from
+        apply_wire for v2 messages — the whole receive flush runs with
+        json.loads booby-trapped."""
+        import json as _json
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(4))
+        dst = GeneralDocSet(4)
+        ma, mb = [], []
+        ca = WireConnection(src, ma.append)
+        cb = WireConnection(dst, mb.append)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb, rounds=2)     # negotiation: adverts only
+        ca.flush()
+        data = [m for m in ma if 'wire' in m and sum(m['counts'])]
+        assert data and data[0]['wire'] == 2
+
+        def boom(*a, **k):
+            raise AssertionError('json.loads on the v2 receive path')
+
+        for m in ma:
+            cb.receive_msg(m)
+        monkeypatch.setattr(_json, 'loads', boom)
+        try:
+            cb.flush()
+        finally:
+            monkeypatch.undo()
+        assert dst.materialize('doc2')['items'] == [2]
+
+    def test_mixed_version_chaos_byte_identical(self):
+        """A 3-node fleet with one v1-pinned peer under drop + corrupt
+        chaos converges byte-identically to the clean all-v2 run."""
+        from automerge_tpu.sync.chaos import ChaosFleet
+
+        def build():
+            a = GeneralDocSet(8)
+            a.apply_changes_batch(rich_schedule(4))
+            b = GeneralDocSet(8)
+            b.apply_changes_batch({'doc1': [
+                {'actor': 'zz-b', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID, 'key': 'b',
+                     'value': 'B'}]}]})
+            return [a, b, GeneralDocSet(8)]
+
+        clean = ChaosFleet(build(), seed=7, wire=True)
+        clean.run(max_ticks=300)
+        want = [canonical(v) for v in clean.views()]
+        clean.close()
+
+        chaotic = ChaosFleet(build(), seed=8, drop=0.25, dup=0.1,
+                             corrupt=0.15, delay=2, wire=True,
+                             wire_version=[2, 1, 2])
+        chaotic.run(max_ticks=2000)
+        got = [canonical(v) for v in chaotic.views()]
+        chaotic.close()
+        assert got == want
+        # corrupt v2 payloads were caught by the envelope CRC, never
+        # quarantined
+        for ds in chaotic.doc_sets:
+            assert not ds.quarantined
+
+    def test_v2_fanout_encodes_each_change_exactly_once(self):
+        sched = rich_schedule(4)
+        n_changes = sum(len(c) for c in sched.values())
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(sched)
+        for _ in range(3):
+            dst = GeneralDocSet(4)
+            ma, mb = [], []
+            ca = WireConnection(src, ma.append)
+            cb = WireConnection(dst, mb.append)
+            ca.open()
+            cb.open()
+            pump(ca, cb, ma, mb)
+            assert canonical(doc_set_view(dst)) == \
+                canonical(doc_set_view(src))
+            ca.close()
+        # all three peers negotiated v2: the v2 cache filled once, the
+        # fan-out was all hits, and the v1 cache never populated
+        assert src.store.wire_cache_misses == n_changes
+        assert src.store.wire_cache_hits == 2 * n_changes
+        assert not src.store._wire_cache
+        assert len(src.store._wire_cache_v2) == n_changes
+
+    def test_v2_retransmit_reships_stored_envelope(self):
+        """A dropped v2 data envelope retransmits the SAME stored
+        bytes (blob + tab counted, miss counter frozen)."""
+        src = GeneralDocSet(8)
+        src.apply_changes_batch(rich_schedule(3))
+        dst = GeneralDocSet(4)
+        q01, q10 = [], []
+        c0 = ResilientConnection(src, q01.append, wire=True,
+                                 backoff_base=1, jitter=0)
+        c1 = ResilientConnection(dst, q10.append, wire=True,
+                                 backoff_base=1, jitter=0)
+        c0.open()
+        c1.open()
+        before = metrics.counters.get('sync_retransmit_wire_bytes', 0)
+
+        def is_v2_data(env):
+            p = env.get('payload')
+            return isinstance(p, dict) and p.get('wire') == 2 \
+                and sum(p['counts'])
+
+        dropped = 0
+        misses_after = None
+        dropped_bytes = 0
+        for _ in range(40):
+            c0.flush()
+            c1.flush()
+            for env in q01[:]:
+                q01.remove(env)
+                if dropped == 0 and is_v2_data(env):
+                    dropped += 1
+                    misses_after = src.store.wire_cache_misses
+                    dropped_bytes = len(env['payload']['blob']) + \
+                        len(env['payload']['tab'])
+                    continue
+                c1.receive_msg(env)
+            for env in q10[:]:
+                q10.remove(env)
+                c0.receive_msg(env)
+            c0.tick()
+            c1.tick()
+            if dropped and not q01 and not q10 \
+                    and not c0.in_flight and not c1.in_flight:
+                break
+        flush_all(c0, c1)
+        assert dropped == 1
+        assert canonical(doc_set_view(dst)) == \
+            canonical(doc_set_view(src))
+        assert src.store.wire_cache_misses == misses_after
+        assert metrics.counters.get('sync_retransmit_wire_bytes', 0) \
+            >= before + dropped_bytes
+
+
+class TestValidateWireV2Msg:
+    def _good_v2(self):
+        blob = b'\x01\x00some-span-bytes'
+        return {'wire': 2, 'maxv': 2, 'docs': ['d0'],
+                'clocks': [{'a': 1}], 'counts': [1],
+                'lens': [len(blob)], 'blob': blob, 'tab': b'\x00'}
+
+    def test_accepts_good(self):
+        msg = self._good_v2()
+        assert validate_wire_msg(msg) is msg
+
+    @pytest.mark.parametrize('mutate, match', [
+        (lambda m: m.update(wire=3), 'version'),
+        (lambda m: m.update(wire=True), 'version'),
+        (lambda m: m.pop('tab'), 'tab'),
+        (lambda m: m.update(tab='text'), 'tab'),
+        (lambda m: m.update(maxv=0), 'maxv'),
+        (lambda m: m.update(maxv='two'), 'maxv'),
+    ])
+    def test_rejects_malformed(self, mutate, match):
+        msg = self._good_v2()
+        mutate(msg)
+        with pytest.raises(MessageRejected, match=match):
+            validate_wire_msg(msg)
+
+
+class TestWireV2ForcedNative:
+    @pytest.mark.skipif(not native.columnar_available(),
+                        reason='native columnar codec unavailable')
+    @pytest.mark.parametrize('force', [True, False])
+    def test_v2_fleet_converges_under_forced_codec(self, force):
+        """CI forced lanes: a full v2 replication with the columnar
+        codec pinned native (raise-on-fallback) and pinned pure-Python
+        — both converge byte-identically to the dict oracle."""
+        prev = wire._NATIVE_COLUMNAR
+        wire._NATIVE_COLUMNAR = force
+        try:
+            src, dst = replicate(WireConnection, rich_schedule())
+            got = canonical(doc_set_view(dst))
+        finally:
+            wire._NATIVE_COLUMNAR = prev
+        oracle = GeneralDocSet(16)
+        oracle.apply_changes_batch(rich_schedule())
+        assert got == canonical(doc_set_view(oracle))
+        assert canonical(doc_set_view(src)) == got

@@ -1,0 +1,410 @@
+"""Columnar wire-blob v2 codec suite.
+
+The varint/delta binary format must be EXACTLY two things: byte-
+identical between the native emitter and the pure-Python fallback
+(parity is a construction property — same two-pass walk, same varints
+— but the fuzz here is what keeps it honest), and bit-exact through
+emit -> message assembly -> container -> parse on both the native and
+Python parse paths, including the boundary widths the packed formats
+pin (int32 seq/elem counters, thousands-of-actors tables, negative
+deltas). Corrupt containers must FAIL the parse loudly (ValueError) —
+in production the envelope CRC catches corruption first, but the codec
+itself is the last line and must never crash or mis-parse silently.
+"""
+
+import json
+import random
+import struct
+
+import pytest
+
+from automerge_tpu import native, wire
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+
+def _encode_block(per_doc_lists):
+    return GeneralDocSet(max(len(per_doc_lists), 2)).store \
+        .encode_changes(per_doc_lists)
+
+
+def _container_of(block, rows=None):
+    """Emit rows of a block and assemble ONE v2 container the way a
+    single-message tick would."""
+    rows = list(range(block.n_changes)) if rows is None else rows
+    entries = wire.encode_change_rows_columnar(block, rows)
+    spans, tab = wire.assemble_columnar_spans(entries)
+    per_doc = [[] for _ in range(block.n_docs)]
+    for c, span in zip(rows, spans):
+        per_doc[block.doc[c]].append((0, span))
+    return wire.build_columnar_container([tab], per_doc)
+
+
+def rich_doc(d, n_items=3):
+    lst = f'00000000-0000-4000-8000-{d:012x}'
+    txt = f'00000000-0000-4000-8000-{d + 4096:012x}'
+    ops = [
+        {'action': 'makeList', 'obj': lst},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+         'value': lst},
+        {'action': 'ins', 'obj': lst, 'key': '_head', 'elem': 1}]
+    for i in range(2, n_items + 1):
+        ops.append({'action': 'ins', 'obj': lst,
+                    'key': f'w0-{d}:{i - 1}', 'elem': i})
+    for i in range(1, n_items + 1):
+        ops.append({'action': 'set', 'obj': lst,
+                    'key': f'w0-{d}:{i}', 'value': i * 10})
+    ops += [
+        {'action': 'makeText', 'obj': txt},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+         'value': txt},
+        {'action': 'ins', 'obj': txt, 'key': '_head', 'elem': 1},
+        {'action': 'set', 'obj': txt, 'key': f'w0-{d}:1',
+         'value': 'h'}]
+    return [
+        {'actor': f'w0-{d}', 'seq': 1, 'deps': {}, 'ops': ops},
+        {'actor': f'w1-{d}', 'seq': 1, 'deps': {f'w0-{d}': 1},
+         'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+             'value': {'v': d, 'tags': [d, None, True]}},
+            {'action': 'del', 'obj': ROOT_ID, 'key': 'meta'}
+            if d % 3 == 0 else
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+             'value': d * 1.5}]}]
+
+
+class TestVarints:
+    @pytest.mark.parametrize('v', [
+        0, 1, 127, 128, 129, 16383, 16384, 2 ** 31 - 1, 2 ** 32,
+        2 ** 62])
+    def test_unsigned_roundtrip(self, v):
+        out = bytearray()
+        wire._uv(out, v)
+        assert wire._ColReader(bytes(out)).uv() == v
+
+    @pytest.mark.parametrize('v', [
+        0, 1, -1, 63, -64, 64, -65, 2 ** 31 - 1, -(2 ** 31),
+        2 ** 62, -(2 ** 62)])
+    def test_signed_roundtrip(self, v):
+        out = bytearray()
+        wire._sv(out, v)
+        assert wire._ColReader(bytes(out)).sv() == v
+
+    def test_truncated_varint_raises(self):
+        with pytest.raises(ValueError, match='truncated varint'):
+            wire._ColReader(b'\x80\x80').uv()
+
+
+class TestTaggedLiterals:
+    @pytest.mark.parametrize('val', [
+        None, True, False, 0, 1, -1, 42, 2 ** 40, -(2 ** 40),
+        2 ** 80,                        # arbitrary precision survives
+        0.0, -0.5, 1.5, 1e300, float('inf'),
+        '', 'hello', 'uniçøde \U0001f600',
+        {'nested': [1, None, True]}, [1, 'two', {'three': 3}]])
+    def test_roundtrip(self, val):
+        raw = wire.encode_tagged_literal(val)
+        back = wire.decode_tagged_literal(raw)
+        assert back == val and type(back) is type(val)
+
+    def test_int_float_bool_stay_distinct(self):
+        # 1, 1.0 and True compare equal in Python; their literals
+        # must not collapse (the JSON path keeps them distinct too)
+        lits = {wire.encode_tagged_literal(v) for v in (1, 1.0, True)}
+        assert len(lits) == 3
+
+    def test_float_is_bit_exact(self):
+        v = struct.unpack('<d', b'\x01\x02\x03\x04\x05\x06\x07\x08')[0]
+        raw = wire.encode_tagged_literal(v)
+        assert struct.pack('<d', wire.decode_tagged_literal(raw)) == \
+            struct.pack('<d', v)
+
+    def test_unknown_tag_raises(self):
+        with pytest.raises(ValueError, match='unknown literal tag'):
+            wire.decode_tagged_literal(b'\x2a')
+
+
+class TestEmitParity:
+    def _block(self):
+        return _encode_block([rich_doc(d) for d in range(5)])
+
+    @pytest.mark.skipif(not native.columnar_available(),
+                        reason='native columnar codec unavailable')
+    def test_native_matches_python_bytes(self):
+        block = self._block()
+        rows = list(range(block.n_changes))
+        nat = wire.encode_change_rows_columnar(block, rows)
+        old = wire._NATIVE_COLUMNAR
+        wire._NATIVE_COLUMNAR = False
+        try:
+            py = wire.encode_change_rows_columnar(block, rows)
+        finally:
+            wire._NATIVE_COLUMNAR = old
+        assert nat == py                   # bodies AND literal tuples
+
+    def test_forced_native_raises_when_unavailable(self, monkeypatch):
+        block = self._block()
+        monkeypatch.setattr(native, 'emit_columnar_rows',
+                            lambda *a, **k: None)
+        monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', True)
+        with pytest.raises(RuntimeError, match='native columnar'):
+            wire.encode_change_rows_columnar(block, [0])
+
+    def test_forced_native_parse_raises_when_unavailable(
+            self, monkeypatch):
+        data = _container_of(self._block())
+        monkeypatch.setattr(native, 'columnar_lib', lambda: None)
+        monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', True)
+        with pytest.raises(RuntimeError, match='native columnar'):
+            wire.parse_columnar_block(data)
+
+
+class TestRoundTrip:
+    def _assert_roundtrip(self, block):
+        data = _container_of(block)
+        want = block.to_changes()
+        got_native = wire.parse_columnar_block(data).to_changes()
+        assert got_native == want
+        old = wire._NATIVE_COLUMNAR
+        wire._NATIVE_COLUMNAR = False
+        try:
+            got_py = wire.parse_columnar_block(data).to_changes()
+        finally:
+            wire._NATIVE_COLUMNAR = old
+        assert got_py == want
+        return data
+
+    def test_rich_blocks_roundtrip(self):
+        block = _encode_block([rich_doc(d) for d in range(6)])
+        data = self._assert_roundtrip(block)
+        # and the binary form is substantially smaller than the JSON
+        jdata = json.dumps(block.to_changes(),
+                           separators=(',', ':')).encode()
+        assert len(jdata) / len(data) >= 3.0
+
+    def test_multi_tab_container(self):
+        """Two messages' spans + tabs stitch into one container (the
+        receive-tick merge shape) and parse per message table."""
+        b1 = _encode_block([rich_doc(0)])
+        b2 = _encode_block([rich_doc(0, n_items=5)[1:]])
+        e1 = wire.encode_change_rows_columnar(
+            b1, range(b1.n_changes))
+        e2 = wire.encode_change_rows_columnar(
+            b2, range(b2.n_changes))
+        s1, t1 = wire.assemble_columnar_spans(e1)
+        s2, t2 = wire.assemble_columnar_spans(e2)
+        data = wire.build_columnar_container(
+            [t1, t2], [[(0, s) for s in s1] + [(1, s) for s in s2]])
+        got = wire.parse_columnar_block(data).to_changes()
+        assert got == [b1.to_changes()[0] + b2.to_changes()[0]]
+
+    def test_boundary_widths(self):
+        """int32-max seq/elem counters, negative elem deltas and a
+        WIDE-scale actor table all survive bit-exact."""
+        obj = '00000000-0000-4000-8000-0000000000ff'
+        changes = [
+            {'actor': 'a-wide', 'seq': 0x7FFFFFFF, 'deps': {}, 'ops': [
+                {'action': 'makeList', 'obj': obj},
+                {'action': 'ins', 'obj': obj, 'key': '_head',
+                 'elem': 0x7FFFFFFF},
+                # descending counters: the delta column goes negative
+                {'action': 'ins', 'obj': obj,
+                 'key': f'a-wide:{0x7FFFFFFF}', 'elem': 7},
+                {'action': 'set', 'obj': obj, 'key': 'a-wide:7',
+                 'value': 2 ** 31 - 1}]}]
+        # a WIDE-format actor population: thousands of distinct ids
+        # (multi-byte table indices on the wire)
+        changes += [
+            {'actor': f'actor-{i:05d}', 'seq': 1,
+             'deps': {'a-wide': 0x7FFFFFFF} if i % 7 == 0 else {},
+             'ops': [{'action': 'set', 'obj': ROOT_ID,
+                      'key': f'k{i % 17}', 'value': i}]}
+            for i in range(3000)]
+        block = _encode_block([changes])
+        self._assert_roundtrip(block)
+
+    def test_null_and_missing_values(self):
+        """A set without "value" and a set of literal null both ride
+        (and come back as None, like the dict edge's op.get)."""
+        block = _encode_block([[
+            {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'x',
+                 'value': None},
+                {'action': 'set', 'obj': ROOT_ID, 'key': 'y',
+                 'value': 0},
+                {'action': 'del', 'obj': ROOT_ID, 'key': 'y'}]}]])
+        self._assert_roundtrip(block)
+
+    def test_parse_is_json_free(self, monkeypatch):
+        """ZERO json.loads anywhere in a v2 parse (composite values
+        decode lazily at materialize time, never during the parse)."""
+        block = _encode_block([rich_doc(d) for d in range(3)])
+        data = _container_of(block)
+
+        def boom(*a, **k):
+            raise AssertionError('json.loads on the v2 parse path')
+
+        for forced in (None, False):
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', forced)
+            monkeypatch.setattr(json, 'loads', boom)
+            monkeypatch.setattr(wire.json, 'loads', boom)
+            try:
+                parsed = wire.parse_columnar_block(data)
+            finally:
+                monkeypatch.undo()
+            assert parsed.to_changes() == block.to_changes()
+
+
+class TestFuzz:
+    """Randomized schedules: emit parity native-vs-Python, bit-exact
+    round trips on both parse paths. Every trial is seeded — a failure
+    names its seed."""
+
+    def _random_schedule(self, rng, n_docs):
+        per = []
+        for d in range(n_docs):
+            changes = []
+            made = []
+            n_changes = rng.randrange(1, 4)
+            for s in range(1, n_changes + 1):
+                actor = f'a{rng.randrange(6)}'
+                ops = []
+                for _ in range(rng.randrange(1, 8)):
+                    roll = rng.random()
+                    if roll < 0.25 or not made:
+                        obj = (f'00000000-0000-4000-8000-'
+                               f'{rng.randrange(1 << 31):012x}')
+                        ops.append({'action': rng.choice(
+                            ['makeList', 'makeText', 'makeMap']),
+                            'obj': obj})
+                        made.append((obj, ops[-1]['action']))
+                    elif roll < 0.5:
+                        obj, kind = rng.choice(made)
+                        if kind == 'makeMap':
+                            ops.append({'action': 'set', 'obj': obj,
+                                        'key': f'k{rng.randrange(9)}',
+                                        'value': self._value(rng)})
+                        else:
+                            ops.append({'action': 'ins', 'obj': obj,
+                                        'key': '_head',
+                                        'elem': rng.randrange(
+                                            1, 1 << 30)})
+                    elif roll < 0.75:
+                        ops.append({'action': 'set', 'obj': ROOT_ID,
+                                    'key': f'k{rng.randrange(9)}',
+                                    'value': self._value(rng)})
+                    else:
+                        ops.append({'action': rng.choice(
+                            ['del', 'link']), 'obj': ROOT_ID,
+                            'key': f'k{rng.randrange(9)}'})
+                        if ops[-1]['action'] == 'link' and made:
+                            ops[-1]['value'] = made[0][0]
+                deps = {f'a{rng.randrange(6)}': rng.randrange(1, 4)} \
+                    if rng.random() < 0.4 else {}
+                changes.append({'actor': actor, 'seq': s,
+                                'deps': deps, 'ops': ops})
+            per.append(changes)
+        return per
+
+    def _value(self, rng):
+        return rng.choice([
+            rng.randrange(-(1 << 40), 1 << 40), rng.random() * 1e6,
+            f's{rng.randrange(1000)}', True, False, None,
+            {'k': rng.randrange(100)}, [1, None, 'x'],
+            'uniçøde☃'])
+
+    @pytest.mark.parametrize('seed', range(12))
+    def test_roundtrip_and_parity(self, seed):
+        rng = random.Random(seed)
+        block = _encode_block(
+            self._random_schedule(rng, rng.randrange(1, 5)))
+        rows = list(range(block.n_changes))
+        nat = wire.encode_change_rows_columnar(block, rows)
+        old = wire._NATIVE_COLUMNAR
+        wire._NATIVE_COLUMNAR = False
+        try:
+            py = wire.encode_change_rows_columnar(block, rows)
+        finally:
+            wire._NATIVE_COLUMNAR = old
+        if native.columnar_available():
+            assert nat == py, f'emit parity broke at seed {seed}'
+        data = _container_of(block)
+        want = block.to_changes()
+        assert wire.parse_columnar_block(data).to_changes() == want, \
+            f'native parse broke at seed {seed}'
+        wire._NATIVE_COLUMNAR = False
+        try:
+            assert wire.parse_columnar_block(data).to_changes() == \
+                want, f'python parse broke at seed {seed}'
+        finally:
+            wire._NATIVE_COLUMNAR = old
+
+
+class TestCorruption:
+    """Torn and bit-flipped containers must raise ValueError from BOTH
+    parse paths — never crash, never silently mis-parse into an
+    exception the quarantine path would misattribute. (In production
+    the envelope CRC rejects these before the codec ever runs; this is
+    the defense-in-depth layer.)"""
+
+    def _data(self):
+        return _container_of(_encode_block([rich_doc(d)
+                                            for d in range(2)]))
+
+    def _attempt(self, data):
+        for forced in (None, False):
+            old = wire._NATIVE_COLUMNAR
+            wire._NATIVE_COLUMNAR = forced
+            try:
+                try:
+                    wire.parse_columnar_block(data)
+                except ValueError:
+                    pass                   # loud and typed: good
+            finally:
+                wire._NATIVE_COLUMNAR = old
+
+    def test_truncations(self):
+        data = self._data()
+        for cut in [0, 1, 3, 4, 5, len(data) // 2, len(data) - 1]:
+            self._attempt(data[:cut])
+
+    def test_bad_magic(self):
+        data = self._data()
+        with pytest.raises(ValueError, match='magic'):
+            wire.parse_columnar_block(b'XXXX' + data[4:])
+
+    def test_trailing_garbage(self):
+        with pytest.raises(ValueError, match='trailing'):
+            wire.parse_columnar_block(self._data() + b'\x00')
+
+    @pytest.mark.parametrize('seed', range(8))
+    def test_random_bit_flips(self, seed):
+        rng = random.Random(seed)
+        data = bytearray(self._data())
+        for _ in range(rng.randrange(1, 4)):
+            i = rng.randrange(4, len(data))
+            data[i] ^= 1 << rng.randrange(8)
+        self._attempt(bytes(data))
+
+
+class TestDurability:
+    def test_v2_container_journals_and_replays(self, tmp_path):
+        """A columnar container WALs (base64-armored — the journal
+        framing is JSON) and crash-recovery replays it through the
+        fused path, byte-identical."""
+        from automerge_tpu.durability import DurableDocSet
+        sched = [rich_doc(d) for d in range(3)]
+        block = _encode_block(sched)
+        data = _container_of(block)
+        doc_ids = [f'doc{d}' for d in range(3)]
+
+        ds = DurableDocSet(GeneralDocSet(8), str(tmp_path))
+        ds.apply_wire(data, doc_ids=doc_ids)
+        want = {d: ds.doc_set.materialize(d) for d in doc_ids}
+        ds.close()
+
+        rec = DurableDocSet.recover(str(tmp_path),
+                                    lambda: GeneralDocSet(8))
+        got = {d: rec.doc_set.materialize(d) for d in doc_ids}
+        assert got == want
+        rec.close()

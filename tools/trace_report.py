@@ -44,6 +44,29 @@ def load_events(paths):
     return events, skipped
 
 
+def wire_throughput(events):
+    """Per-direction wire codec throughput from span events:
+    ``wire.parse`` / ``wire.serve`` spans carry their byte volume
+    (``n_bytes`` / ``bytes``), so a trace shows the per-tick wire MB/s
+    the sync path actually sustained. Returns
+    ``{span_name: (n_spans, total_bytes, total_ms)}``."""
+    out = {}
+    for e in events:
+        if e.get('event') != 'span':
+            continue
+        name = e.get('name')
+        if name not in ('wire.parse', 'wire.serve'):
+            continue
+        n_bytes = e.get('n_bytes', e.get('bytes'))
+        dur = e.get('dur_ms')
+        if not isinstance(n_bytes, (int, float)) or \
+                not isinstance(dur, (int, float)):
+            continue
+        n, total, ms = out.get(name, (0, 0, 0.0))
+        out[name] = (n + 1, total + n_bytes, ms + dur)
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description='Convert incident/event JSONL dumps to a '
@@ -68,6 +91,10 @@ def main(argv=None):
           f'from {len(events)} events'
           + (f' ({skipped} unparseable lines skipped)' if skipped
              else ''))
+    for name, (n, total, ms) in sorted(wire_throughput(events).items()):
+        rate = total / (ms / 1e3) / 1e6 if ms else 0.0
+        print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
+              f'{ms:.1f} ms -> {rate:.0f} MB/s')
     return 0
 
 
