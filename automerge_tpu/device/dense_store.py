@@ -189,6 +189,18 @@ def _extract_kernel(eseq, eval_, m, rank_plane, key_capacity,
     return fidx, winner_slot, winner_value, alive, values
 
 
+def _note_dense_dispatch(store, args, statics):
+    """Shape-signature registry hook for the fused dense apply+extract
+    dispatch (device/profiler.py): plane capacity + padded change/op/
+    coo widths + the static args ARE the compile signature."""
+    from . import profiler as _profiler
+    _profiler.note_dispatch(
+        'dense.apply_extract',
+        (store.eseq.shape, args[0].shape, args[7].shape,
+         args[4].shape, tuple(sorted(statics.items()))),
+        rows=args[7].shape[0])
+
+
 class DensePatch:
     """Patches from one dense apply, as device arrays; host
     materialization (`to_patch_block` / `diffs`) is lazy."""
@@ -721,6 +733,7 @@ class DenseMapStore:
         self.drain()
         finish_pack, (t0, t1, t2) = self._stage_block(block)
         args, statics = finish_pack()
+        _note_dense_dispatch(self, args, statics)
         out = _apply_extract_kernel(self.eseq, self.eval_, self.m,
                                     *args, **statics)
         self.eseq, self.eval_, self.m = out[:3]
@@ -764,6 +777,7 @@ class DenseMapStore:
                         'skipped: a previous async apply failed') \
                         from self._async_error
                 args, statics = finish_pack()
+                _note_dense_dispatch(self, args, statics)
                 out = _apply_extract_kernel(self.eseq, self.eval_,
                                             self.m, *args, **statics)
                 self.eseq, self.eval_, self.m = out[:3]

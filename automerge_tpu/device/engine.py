@@ -167,6 +167,12 @@ def batch_merge_docs(docs_changes, return_timing=False, kernel=None,
     t1 = time.perf_counter()
 
     resolve = pick_resolve_kernel(opts.kernel)
+    from . import profiler as _profiler
+    _profiler.note_dispatch(
+        'engine.resolve',
+        (getattr(resolve, '__name__', 'resolve'), seg_id.shape,
+         clock.shape, str(seg_id.dtype), str(clock.dtype), n_segs),
+        rows=seg_id.shape[0])
     out = resolve(
         jnp.asarray(seg_id), jnp.asarray(actor), jnp.asarray(seq),
         jnp.asarray(clock), jnp.asarray(is_del), jnp.asarray(valid),

@@ -63,6 +63,7 @@ from functools import partial
 from ..common import ROOT_ID
 from ..utils.metrics import metrics
 from . import engine as _engine
+from . import profiler as _profiler
 from . import blocks as _blocks
 from .blocks import (
     ChangeBlock, BlockStore, ValueTable, _intern, _span_indices,
@@ -1700,6 +1701,42 @@ def _mirror_convert(mir, to_fmt, store, opts):
             **base}
 
 
+# Estimated device bytes per resident mirror row, by format: packed =
+# two int32 words, wide = three, cols = parent/elemc/actor/vis_index
+# int32 + visible bool. Host arithmetic only — memory accounting must
+# never force a device sync.
+_MIRROR_ROW_BYTES = {'packed': 8, 'wide': 12, 'cols': 17}
+
+
+def mirror_bytes(mir):
+    """Estimated device-plane bytes of a resident mirror dict (0 when
+    no mirror has materialized) — the per-store read behind the
+    ``fleet_status()['memory']`` block and the process-wide
+    ``mem_device_plane_bytes`` gauge."""
+    if not mir:
+        return 0
+    return _MIRROR_ROW_BYTES.get(mir.get('fmt'), 17) * \
+        int(mir.get('cap', 0))
+
+
+def _update_mirror_gauges(fmt, cap):
+    """Refresh the device-plane memory gauges after an apply installed
+    a mirror of ``fmt`` at capacity ``cap`` (last-applied store wins —
+    the gauges are process-level; per-store truth lives in
+    ``fleet_status()['memory']``). The non-active formats read 0 so a
+    dashboard sees format transitions, and the peak watermark only
+    ratchets up."""
+    total = _MIRROR_ROW_BYTES[fmt] * cap
+    metrics.set_gauge('mem_device_plane_bytes', total)
+    metrics.set_gauge('mem_device_packed_bytes',
+                      total if fmt == 'packed' else 0)
+    metrics.set_gauge('mem_device_wide_bytes',
+                      total if fmt == 'wide' else 0)
+    metrics.set_gauge('mem_device_cols_bytes',
+                      total if fmt == 'cols' else 0)
+    metrics.ratchet('mem_device_plane_peak_bytes', total)
+
+
 # -- apply -------------------------------------------------------------------
 
 class GeneralPatch:
@@ -1868,6 +1905,10 @@ class GeneralPatch:
         # span_event parents it under whatever span that thread holds)
         dt_ms = (time.perf_counter() - _t0) * 1e3
         metrics.observe('general_patch_read_ms', dt_ms)
+        # the device-phase series fleet_status()['latency'] reports
+        # alongside admit/pack/dispatch/run — same value, the phase
+        # taxonomy name (general_patch_read_ms stays for back-compat)
+        metrics.observe('device_patch_read_ms', dt_ms)
         if metrics.active:
             metrics.span_event('device.patch_read', dt_ms,
                                fields=F)
@@ -2664,6 +2705,14 @@ def _apply_general(store, block, options, return_timing):
                 o += len(arr)
             assert o == len(wire)
 
+        # shape-signature registry: every distinct signature here is
+        # one XLA compile of the packed program (retraces counted,
+        # flight-recorded — device/profiler.py)
+        _profiler.note_dispatch(
+            'general.fused_packed',
+            (cap, sizes, S, A, m_pad, has_remap,
+             int(remap_dev.shape[0]), n_old > 0),
+            rows=n_pad)
         outs = _fused_general_packed(
             w1m, w2m, jnp.asarray(wire), np.int32(n_old),
             jnp.asarray(np.int32(n_rows)), remap_dev,
@@ -2731,6 +2780,11 @@ def _apply_general(store, block, options, return_timing):
                 o += len(arr)
             assert o == len(wire)
 
+        _profiler.note_dispatch(
+            'general.fused_wide',
+            (cap, sizes, S, A, m_pad, int(rank_table_dev.shape[0]),
+             n_old > 0),
+            rows=n_pad)
         outs = _fused_general_wide(
             w1m, w2m, w3m, jnp.asarray(wire), np.int32(n_old),
             jnp.asarray(np.int32(n_rows)), rank_table_dev,
@@ -2771,6 +2825,12 @@ def _apply_general(store, block, options, return_timing):
         else:
             rank_table_dev = mir['rank_table']
 
+        _profiler.note_dispatch(
+            'general.fused_cols',
+            (cap, d_pad, n_pad, K, nnz_pad, S, A, m_pad,
+             int(rank_table_dev.shape[0]), seq_arr.dtype.str,
+             actor_arr.dtype.str, coo_val.dtype.str),
+            rows=n_pad)
         outs = _fused_general_resident(
             *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
             jnp.asarray(d_actor), jnp.asarray(d_pos), np.int32(n_old),
@@ -2792,6 +2852,7 @@ def _apply_general(store, block, options, return_timing):
         vis_planes = outs[7:11] if len(dirty) else None
         vis_fmt = 'cols'
     pool._epoch += 1
+    _update_mirror_gauges(fmt, cap)
     if _STAGE_CAPTURE is not None:
         if native_wire and use_packed:
             # the staged planes live in the wire buffer — expose them
@@ -2829,6 +2890,19 @@ def _apply_general(store, block, options, return_timing):
             'winner': winner_dev, 'vis_fmt': vis_fmt,
             'vis_planes': vis_planes, 'variant': fmt})
     t3 = time.perf_counter()
+
+    # sampled per-phase device-time attribution: every Nth apply
+    # fences on the fused program and splits its wall time into the
+    # admit/pack/dispatch/device histogram series — one pipeline
+    # bubble per sample, amortized by the cadence; off-sample applies
+    # paid exactly the integer check above the fence
+    if _profiler.should_sample():
+        jax.block_until_ready(winner_dev)
+        t_dev = (time.perf_counter() - t3) * 1e3
+        _profiler.record_phases(
+            (t1 - t0) * 1e3, (t2 - t1 - (tc1 - tc0)) * 1e3,
+            (t3 - t2) * 1e3, t_dev,
+            (time.perf_counter() - t0) * 1e3)
 
     # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
     # `cat` holds the UNPERMUTED row columns plus `order` (the

@@ -226,11 +226,22 @@ class ChangeJournal:
         self.path = path
         self.fsync = fsync
         self._f = open(path, 'ab')
+        # memory accounting: journal file bytes (gauge + watermark) —
+        # an append-only WAL that never checkpoints is a disk leak a
+        # dashboard should see long before the filesystem does
+        self.bytes = self._f.tell()
+        self._publish_bytes()
+
+    def _publish_bytes(self):
+        metrics.set_gauge('mem_journal_bytes', self.bytes)
+        metrics.ratchet('mem_journal_peak_bytes', self.bytes)
 
     def append(self, record):
         payload = json.dumps(record, separators=(',', ':')).encode()
         self._f.write(_REC_HEADER.pack(len(payload),
                                        zlib.crc32(payload)) + payload)
+        self.bytes += _REC_HEADER.size + len(payload)
+        self._publish_bytes()
         self._f.flush()
         if self.fsync:
             # journal fsync is the durable write path's latency floor:
@@ -249,6 +260,8 @@ class ChangeJournal:
         journaled record."""
         self._f.truncate(0)
         self._f.seek(0)
+        self.bytes = 0
+        self._publish_bytes()
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())

@@ -53,6 +53,18 @@ DEFAULT_HEALTH_THRESHOLDS = {
     'admission_debt': (64, 65_536),
     'backpressure_depth': (16, 4_096),
     'parked': (1, 64),
+    # jit retraces since the last evaluation (device/profiler.py
+    # shape-signature registry, process-wide): a workload that keeps
+    # crossing shape buckets recompiles instead of serving — the
+    # classic silent perf killer in a jit-heavy stack. A handful per
+    # quantum is a warm-up; a steady stream is a storm.
+    'recompile_storm': (8, 512),
+    # serving layer only (health_extra): resident bytes / memory
+    # budget. >1 = the budget is breached RIGHT NOW — eviction cannot
+    # keep up (e.g. blocked on a truncated log) or the working set is
+    # pinned hot; well past it, the process is headed for the OOM
+    # killer.
+    'memory_pressure': (1.0, 2.0),
 }
 _HEALTH_RANK = {'green': 0, 'degraded': 1, 'critical': 2}
 
@@ -189,6 +201,12 @@ class GeneralDocSet:
         # baseline for the retry_exhausted delta signal: the sum over
         # THIS doc set's registered links' scoped slices (none yet)
         self._health_last_exhausted = 0
+        # baseline for the recompile_storm delta signal — None until
+        # the FIRST evaluation records it: the retrace counter is
+        # process-wide, so a doc set created late in a process must
+        # not inherit every compile that ever happened as its first
+        # "storm"
+        self._health_last_retraces = None
 
     # -- DocSet surface ------------------------------------------------------
 
@@ -632,10 +650,14 @@ class GeneralDocSet:
                # scan per link
                'connections': connections,
                # tick-path latencies from the SAME histogram series
-               # the bench's *_p50/*_p99 JSON keys read
+               # the bench's *_p50/*_p99 JSON keys read — now
+               # including the sampled device-phase attribution
                'latency': _latency_quantiles(
                    ('sync_apply_ms', 'sync_flush_ms',
-                    'sync_convergence_ms')),
+                    'sync_convergence_ms', 'device_admit_ms',
+                    'device_pack_ms', 'device_dispatch_ms',
+                    'device_run_ms', 'device_patch_read_ms')),
+               'memory': self._memory_summary(),
                'convergence': self._convergence_summary(),
                'health': self.evaluate_health()}
         if docs:
@@ -665,6 +687,28 @@ class GeneralDocSet:
             lagging = max(lagging, counters.get(
                 prefix + 'sync_lagging_docs', 0))
         return lag, lagging
+
+    def _memory_summary(self):
+        """The memory-accounting block of :meth:`fleet_status`:
+        THIS store's device-plane estimate (host arithmetic off the
+        resident mirror — never a device sync) + encode-cache bytes,
+        alongside the process-level journal/park gauges and the
+        device-plane peak watermark. The serving layer overlays its
+        residency totals (resident bytes, budget, pressure) on top."""
+        from ..device.general import mirror_bytes
+        store = self.store
+        mir = getattr(getattr(store, 'pool', None), 'mirror', None)
+        counters = _metrics.counters
+        return {
+            'device_plane_bytes': mirror_bytes(mir),
+            'device_plane_fmt': mir.get('fmt') if mir else None,
+            'device_plane_peak_bytes':
+                counters.get('mem_device_plane_peak_bytes', 0),
+            'wire_cache_bytes': getattr(store, '_wire_cache_bytes',
+                                        0),
+            'journal_bytes': counters.get('mem_journal_bytes', 0),
+            'park_shard_bytes': counters.get('mem_park_shard_bytes',
+                                             0)}
 
     def _convergence_summary(self):
         """The replication-convergence block of :meth:`fleet_status`:
@@ -710,6 +754,13 @@ class GeneralDocSet:
         lag, lagging = self._link_lag()
         delta = exhausted - self._health_last_exhausted
         self._health_last_exhausted = exhausted
+        # recompile-storm: jit retraces since the last evaluation
+        # (the shape-signature registry is process-wide; the first
+        # evaluation records the baseline and reports 0)
+        retraces = counters.get('device_retraces_total', 0)
+        last = self._health_last_retraces
+        self._health_last_retraces = retraces
+        storm = retraces - last if last is not None else 0
         signals = {'replication_lag_ops': lag,
                    'lagging_docs': lagging,
                    'convergence_ms_p99':
@@ -719,6 +770,7 @@ class GeneralDocSet:
                    'retry_exhausted': max(0, delta),
                    'admission_debt': debt,
                    'backpressure_depth': backpressure,
+                   'recompile_storm': max(0, storm),
                    'parked': 0}
         if self.health_extra is not None:
             signals.update(self.health_extra())
@@ -987,6 +1039,14 @@ class GeneralDocSet:
             field = e_field[rows]
             rank = ranks[store.e_actor[rows]]
         from ..device.general_backend import winner_select
+        from ..device import profiler as _profiler
+        # size-class registry for the vectorized winner select —
+        # host-side (jit=False): a new entry-count bucket is tracked
+        # per fn but never counted as an XLA compile or retrace
+        _profiler.note_dispatch(
+            'view.winner_select',
+            (_profiler.shape_bucket(len(field)),), rows=len(field),
+            jit=False)
         fields, wpos = winner_select(field, rank)
         w_rows = wpos if rows is None else rows[wpos]
         w_value = store.e_value[w_rows]
@@ -1188,6 +1248,11 @@ class GeneralDocSet:
                 .astype(np.int64)
         else:
             seq_objs = objs_sel
+        from ..device import profiler as _profiler
+        _profiler.note_dispatch(
+            'view.visible_walk',
+            (_profiler.shape_bucket(len(seq_objs)),),
+            rows=len(seq_objs), jit=False)
         seg, local, counts = visible_walk(store.pool, seq_objs)
         starts = np.zeros(len(seq_objs) + 1, np.int64)
         if len(seq_objs):

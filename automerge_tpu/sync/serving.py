@@ -142,6 +142,7 @@ class ServingDocSet:
         self._last_touch = {}          # doc_id -> last-touch tick
         self._evicted = {}             # doc_id -> {'clock', 'error'}
         self._park_files = {}          # doc_id -> newest shard path
+        self._park_bytes = {}          # shard path -> on-disk bytes
         self._park_seq = 0
         self._quarantine_since = {}    # doc_id -> tick first seen held
         self._handles = {}
@@ -175,11 +176,13 @@ class ServingDocSet:
         names = sorted(n for n in os.listdir(self.park_dir)
                        if n.startswith('park-'))
         if not names:
+            self._refresh_park_gauge()
             return
         inner = self.inner
         merge_now = []
         for name in names:
             path = os.path.join(self.park_dir, name)
+            self._park_bytes[path] = os.path.getsize(path)
             try:
                 self._park_seq = max(self._park_seq,
                                      int(name[5:13]))
@@ -202,6 +205,14 @@ class ServingDocSet:
                     merge_now.append(doc_id)
         if merge_now:
             self._fault_in(merge_now)
+        self._refresh_park_gauge()
+
+    def _refresh_park_gauge(self):
+        """Publish the live parked-shard disk footprint (the cold half
+        of the memory accounting: evicted docs are not free, they
+        moved to disk)."""
+        metrics.set_gauge('mem_park_shard_bytes',
+                          sum(self._park_bytes.values()))
 
     @classmethod
     def recover(cls, dir_path, capacity=1024, options=None,
@@ -410,6 +421,7 @@ class ServingDocSet:
                                 f'park-{self._park_seq:08d}.amtpu')
             write_park_shard(path,
                              {d: payloads[d] for d in group})
+            self._park_bytes[path] = os.path.getsize(path)
             for doc_id in group:
                 self._park_files[doc_id] = path
         inner.drop_doc_state(doc_ids)
@@ -421,6 +433,7 @@ class ServingDocSet:
                 'error': q['error'] if q else None}
         self._n_evictions += len(doc_ids)
         metrics.bump('serving_evictions', len(doc_ids))
+        self._refresh_park_gauge()
         if parked:
             self._n_parked += len(doc_ids)
             metrics.bump('serving_docs_parked', len(doc_ids))
@@ -437,6 +450,7 @@ class ServingDocSet:
         total = int(est[:n].sum())
         self.resident_bytes = total
         metrics.set_gauge('serving_resident_bytes', total)
+        metrics.ratchet('mem_resident_peak_bytes', total)
         if total <= self.memory_budget_bytes:
             return
         if inner.store.log_truncated:
@@ -681,9 +695,18 @@ class ServingDocSet:
 
     def _serving_health_signals(self):
         """The serving layer's contribution to the health rollup:
-        parked (stuck-quarantine) docs. O(evicted), never O(fleet)."""
+        parked (stuck-quarantine) docs, and the eviction-pressure
+        ratio (resident bytes over the memory budget, from the byte
+        estimate the LAST enforcement pass recorded — >1 means the
+        budget is breached right now and eviction is not keeping up).
+        O(evicted), never O(fleet)."""
+        pressure = 0.0
+        if self.memory_budget_bytes:
+            pressure = round(
+                self.resident_bytes / self.memory_budget_bytes, 4)
         return {'parked': sum(1 for rec in self._evicted.values()
-                              if rec.get('error'))}
+                              if rec.get('error')),
+                'memory_pressure': pressure}
 
     def _health_incident(self, previous, state, signals, reasons):
         """First entry to critical dumps the flight recorder — the
@@ -716,6 +739,8 @@ class ServingDocSet:
             path = os.path.join(self.park_dir, name)
             if path not in live:
                 os.unlink(path)
+                self._park_bytes.pop(path, None)
+        self._refresh_park_gauge()
 
     # -- operator surface ----------------------------------------------------
 
@@ -765,6 +790,15 @@ class ServingDocSet:
         status['latency'].update(_latency_quantiles(
             ('serving_faultin_ms', 'sync_busy_wait_ms',
              'journal_fsync_ms')))
+        # residency overlay on the memory block: the inner set
+        # reported the device/host plane estimates; this layer owns
+        # the resident/evicted split, the budget and the park shards
+        status['memory'].update({
+            'resident_bytes': status['totals']['resident_bytes'],
+            'resident_peak_bytes':
+                counters.get('mem_resident_peak_bytes', 0),
+            'memory_budget_bytes': self.memory_budget_bytes,
+            'park_shard_bytes': sum(self._park_bytes.values())})
         return status
 
     fleetStatus = fleet_status

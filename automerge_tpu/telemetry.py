@@ -30,7 +30,8 @@ import json
 import re
 
 from .utils.metrics import (ALL_COUNTER_REGISTRIES, HIST_BUCKETS,
-                            HIST_LO, HIST_RATIO, metrics as _metrics)
+                            HIST_LO, HIST_RATIO, HIST_SUFFIXES,
+                            metrics as _metrics)
 
 _BAD_CHARS = re.compile(r'[^a-zA-Z0-9_:]')
 
@@ -104,7 +105,7 @@ def render_prometheus(m=None, registered=ALL_COUNTER_REGISTRIES):
                  for name, buckets in m._hists.items()}
     if registered:
         for name in registered:
-            if name.endswith('_ms'):
+            if name.endswith(HIST_SUFFIXES):
                 hists.setdefault(name, [0] * HIST_BUCKETS)
             else:
                 counters.setdefault(name, 0)
@@ -152,15 +153,24 @@ def dump_chrome_trace(events, path=None):
     :class:`FlightRecorder`, or anything with ``.events()``) into a
     Chrome-trace/Perfetto JSON object. Completed ``span`` events
     become complete ("X") slices — one thread lane per trace id, so a
-    cross-peer tick reads as one aligned group — and every other
-    event becomes an instant ("i") on the shared events lane. With
-    ``path``, the JSON is written atomically (snapshot-grade: never
-    torn) and the object is still returned."""
+    cross-peer tick reads as one aligned group, EXCEPT the device
+    phases (``device.*`` span names), which get one dedicated lane
+    per phase so a 10k-doc bench trace shows the admit/pack/dispatch/
+    device/patch-read split as aligned per-phase rows. ``counter``
+    events (the sampled device profiler's utilization/memory/retrace
+    samples) become Perfetto counter ("C") tracks — one per numeric
+    field — and every other event becomes an instant ("i") on the
+    shared events lane. With ``path``, the JSON is written atomically
+    (snapshot-grade: never torn) and the object is still returned."""
     if hasattr(events, 'events'):
         events = events.events()
     PID = 1
     lane_of = {}                   # trace id -> tid (lane)
+    device_lane_of = {}            # device phase name -> tid
     trace_events = []
+    # device lanes and trace lanes share the tid space; device phases
+    # allocate from the top so trace-lane ids stay dense from 1
+    _DEVICE_BASE = 1 << 20
     for event in events:
         if not isinstance(event, dict):
             continue
@@ -172,16 +182,31 @@ def dump_chrome_trace(events, path=None):
             dur_ms = event.get('dur_ms')
             if not isinstance(dur_ms, (int, float)) or dur_ms < 0:
                 continue
-            trace = event.get('trace')
-            tid = lane_of.setdefault(trace, len(lane_of) + 1)
+            name = str(event.get('name', 'span'))
+            if name.startswith('device.'):
+                tid = device_lane_of.setdefault(
+                    name, _DEVICE_BASE + len(device_lane_of))
+            else:
+                trace = event.get('trace')
+                tid = lane_of.setdefault(trace, len(lane_of) + 1)
             args = {k: v for k, v in event.items()
                     if k not in ('event', 'ts', 'mono', 'name',
                                  'dur_ms')}
             trace_events.append({
-                'name': str(event.get('name', 'span')),
+                'name': name,
                 'cat': 'span', 'ph': 'X', 'pid': PID, 'tid': tid,
                 'ts': ts * 1e6 - dur_ms * 1e3,
                 'dur': dur_ms * 1e3, 'args': args})
+        elif kind == 'counter':
+            for key, value in event.items():
+                if key in ('event', 'ts', 'mono') or \
+                        not isinstance(value, (int, float)) or \
+                        isinstance(value, bool):
+                    continue
+                trace_events.append({
+                    'name': key, 'cat': 'counter', 'ph': 'C',
+                    'pid': PID, 'tid': 0, 'ts': ts * 1e6,
+                    'args': {'value': value}})
         else:
             args = {k: v for k, v in event.items()
                     if k not in ('event', 'ts', 'mono')}
@@ -197,6 +222,11 @@ def dump_chrome_trace(events, path=None):
         meta.append({'ph': 'M', 'pid': PID, 'tid': tid,
                      'name': 'thread_name',
                      'args': {'name': f'trace {trace}'}})
+    for phase, tid in sorted(device_lane_of.items(),
+                             key=lambda kv: kv[1]):
+        meta.append({'ph': 'M', 'pid': PID, 'tid': tid,
+                     'name': 'thread_name',
+                     'args': {'name': phase}})
     out = {'traceEvents': meta + trace_events,
            'displayTimeUnit': 'ms'}
     if path is not None:

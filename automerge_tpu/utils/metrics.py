@@ -154,12 +154,72 @@ CONVERGENCE_COUNTERS = (
     'sync_convergence_ms', 'sync_divergence_detected',
     'fleet_health_state', 'fleet_health_transitions')
 
+# Device-path performance counters (the performance-observability
+# contract — device/profiler.py, device/general.py, device/engine.py;
+# the registry-drift guard covers the device_*/mem_* families in both
+# directions exactly like sync_/serving_/fleet_):
+#   device_batches / device_ops / device_batch_occupancy
+#                              the dense merge path's batch stats
+#   device_backend_*           the auto-routed facade's fused-apply
+#                              stats
+#   device_dispatches_total    tracked entry-point dispatches (jit
+#                              programs AND the size-bucketed host
+#                              view gathers)
+#   device_compiles_total      distinct (fn, shape signature) pairs
+#                              over the JIT entries only — each one
+#                              is an XLA compile (host view gathers
+#                              grow per-fn signature gauges but never
+#                              this total)
+#   device_retraces_total      compiles BEYOND the first per function
+#                              (the recompile-storm signal's source)
+#   device_dispatch_rows       observe series: padded rows per
+#                              dispatch — the shape-bucket
+#                              distribution
+#   device_admit_ms/_pack_ms/_dispatch_ms/_run_ms
+#                              observe series: the sampled per-phase
+#                              device-time attribution (every Nth
+#                              apply fences and splits its wall time)
+#   device_patch_read_ms       observe series: device fetch + patch
+#                              column build (the read side)
+#   device_utilization         gauge: device ms / wall ms of the last
+#                              sampled apply
+#   mem_device_plane_bytes     gauge: resident device mirror bytes
+#   mem_device_packed_bytes/_wide_bytes/_cols_bytes
+#                              the same, split by mirror format (the
+#                              non-active formats read 0)
+#   mem_device_plane_peak_bytes   high-water mark of the plane gauge
+#   mem_journal_bytes          gauge: change-journal file bytes
+#   mem_journal_peak_bytes     high-water mark of the journal gauge
+#   mem_park_shard_bytes       gauge: on-disk bytes of live park
+#                              shards (serving-layer eviction store)
+#   mem_resident_peak_bytes    high-water mark of the serving layer's
+#                              resident-byte estimate
+DEVICE_COUNTERS = (
+    'device_batches', 'device_ops', 'device_batch_occupancy',
+    'device_backend_fused_calls', 'device_backend_batches',
+    'device_backend_ops', 'device_backend_seq_objects',
+    'device_dispatches_total', 'device_compiles_total',
+    'device_retraces_total', 'device_dispatch_rows',
+    'device_admit_ms', 'device_pack_ms', 'device_dispatch_ms',
+    'device_run_ms', 'device_patch_read_ms', 'device_utilization',
+    'mem_device_plane_bytes', 'mem_device_packed_bytes',
+    'mem_device_wide_bytes', 'mem_device_cols_bytes',
+    'mem_device_plane_peak_bytes', 'mem_journal_bytes',
+    'mem_journal_peak_bytes', 'mem_park_shard_bytes',
+    'mem_resident_peak_bytes')
+
 # Every registered counter/gauge/series name, in one tuple — the
 # telemetry exporter (automerge_tpu/telemetry.py) renders ALL of these
 # even when never bumped, and tests/test_metrics.py asserts none is
 # silently unexported.
 ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
-                          SYNC_COUNTERS + CONVERGENCE_COUNTERS)
+                          SYNC_COUNTERS + CONVERGENCE_COUNTERS +
+                          DEVICE_COUNTERS)
+
+# Observe-series name suffixes: a registered name ending in one of
+# these is a histogram series (count/sum/max + buckets), not a scalar
+# — the exporter zero-fills it as an empty histogram.
+HIST_SUFFIXES = ('_ms', '_rows')
 
 
 # -- histogram geometry --------------------------------------------------------
@@ -298,6 +358,15 @@ class Metrics:
         with self._lock:
             self.counters[name] = value
 
+    def ratchet(self, name, value):
+        """Raise gauge ``name`` to ``value`` if higher — the peak-
+        watermark write (device plane / journal / resident bytes),
+        atomic under the registry lock so concurrent writers can
+        never record a lower peak than observed."""
+        with self._lock:
+            if value > self.counters[name]:
+                self.counters[name] = value
+
     def observe(self, name, value):
         """Record one sample of a duration/size series: keeps count,
         sum and max under ``<name>.count`` / ``.sum`` / ``.max`` (the
@@ -327,16 +396,20 @@ class Metrics:
 
     def quantile(self, name, q):
         """Quantile ``q`` (0..1) of an :meth:`observe` series from its
-        log-spaced buckets (+-12% bucket resolution; 0.0 when the
-        series is empty). ``quantile('sync_apply_ms', 0.99)`` is the
-        live p99 the bench and ``fleet_status()`` both report."""
+        log-spaced buckets (+-12% bucket resolution). An empty or
+        never-observed series returns ``None`` — never raises, and
+        never a fake 0.0 a dashboard would read as "zero latency"
+        (callers that need a number spell the default:
+        ``quantile(...) or 0``). ``quantile('sync_apply_ms', 0.99)``
+        is the live p99 the bench and ``fleet_status()`` both
+        report."""
         with self._lock:
             hist = self._hists.get(name)
             if hist is None:
-                return 0.0
+                return None
             total = sum(hist)
             if not total:
-                return 0.0
+                return None
             target = max(1, math.ceil(q * total))
             acc = 0
             for b, n in enumerate(hist):
@@ -670,6 +743,7 @@ emit = metrics.emit
 bump = metrics.bump
 set_gauge = metrics.set_gauge
 observe = metrics.observe
+ratchet = metrics.ratchet
 mean = metrics.mean
 quantile = metrics.quantile
 trace_span = metrics.trace_span

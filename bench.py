@@ -560,10 +560,15 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
     one_round(False)                       # warm the fleet shapes
     # scope the latency histograms to the MEASURED rounds: the p50/p99
     # JSON keys below read the very same observe series fleet_status()
-    # serves (no private timers — ISSUE 7 contract)
+    # serves (no private timers — ISSUE 7 contract). The sampled
+    # device-phase series reset here too — earlier bench sections and
+    # the warm-up round must not leak into this section's keys
     from automerge_tpu.utils.metrics import metrics as _m
     _m.reset_series('sync_apply_ms')
     _m.reset_series('sync_flush_ms')
+    for _series in ('device_admit_ms', 'device_pack_ms',
+                    'device_dispatch_ms', 'device_run_ms'):
+        _m.reset_series(_series)
     t0 = time.perf_counter()
     n_msgs, dst = one_round(False)
     t_dict = time.perf_counter() - t0
@@ -612,13 +617,22 @@ def bench_general_sync_10k(n_docs=10240, list_ops=22):
             'wire_v1_bytes': v1_bytes, 'wire_v2_bytes': v2_bytes,
             'wire_v2_ratio': v1_bytes / max(v2_bytes, 1),
             'wire_v2_parse_ms_p50':
-                _m.quantile('sync_wire_parse_ms', 0.5),
+                _m.quantile('sync_wire_parse_ms', 0.5) or 0,
             'wire_v2_parse_ms_p99':
-                _m.quantile('sync_wire_parse_ms', 0.99),
-            'apply_ms_p50': _m.quantile('sync_apply_ms', 0.5),
-            'apply_ms_p99': _m.quantile('sync_apply_ms', 0.99),
-            'flush_ms_p50': _m.quantile('sync_flush_ms', 0.5),
-            'flush_ms_p99': _m.quantile('sync_flush_ms', 0.99)}
+                _m.quantile('sync_wire_parse_ms', 0.99) or 0,
+            'apply_ms_p50': _m.quantile('sync_apply_ms', 0.5) or 0,
+            'apply_ms_p99': _m.quantile('sync_apply_ms', 0.99) or 0,
+            'flush_ms_p50': _m.quantile('sync_flush_ms', 0.5) or 0,
+            'flush_ms_p99': _m.quantile('sync_flush_ms', 0.99) or 0,
+            # the sampled device-phase attribution over the whole
+            # section (1/16 applies fenced — device/profiler.py): the
+            # p50s of the same histogram series fleet_status() reports
+            'device_run_ms_p50':
+                _m.quantile('device_run_ms', 0.5) or 0,
+            'device_pack_ms_p50':
+                _m.quantile('device_pack_ms', 0.5) or 0,
+            'device_utilization':
+                _m.counters.get('device_utilization', 0)}
 
 
 def bench_degraded_link(n_docs=10240, list_ops=22,
@@ -788,9 +802,10 @@ def bench_serving(n_docs=10240, list_ops=22, hot_docs=64, rounds=24,
             'hot_unbounded_s': t_hot_unbounded,
             'hot_degraded_s': t_hot_degraded,
             'degraded_ratio': t_hot_degraded / t_hot_unbounded,
-            'faultin_ms_p50': _sm.quantile('serving_faultin_ms', 0.5),
-            'faultin_ms_p99': _sm.quantile('serving_faultin_ms',
-                                           0.99),
+            'faultin_ms_p50':
+                _sm.quantile('serving_faultin_ms', 0.5) or 0,
+            'faultin_ms_p99':
+                _sm.quantile('serving_faultin_ms', 0.99) or 0,
             'faultins': ds._n_faultins,
             'evictions': evictions,
             'evicted_frac': evicted_frac}
@@ -808,12 +823,17 @@ IDLE_OBSERVER_NS_PER_SITE = 3000
 
 def bench_observer_overhead(n=200000):
     """The no-subscriber fast path of the observability layer: times
-    the three instrumented site shapes (``trace_span`` null span,
-    ``active``-gated ``emit``, bare ``bump``) with nothing subscribed
-    and asserts each stays under ``IDLE_OBSERVER_NS_PER_SITE`` — the
-    executable form of "an idle-observer ``bench_general_sync_10k``
-    runs within noise of the pre-instrumentation constant"."""
+    the four instrumented site shapes (``trace_span`` null span,
+    ``active``-gated ``emit``, bare ``bump``, and the device
+    profiler's off-sample ``should_sample`` check) with nothing
+    subscribed and asserts each stays under
+    ``IDLE_OBSERVER_NS_PER_SITE`` — the executable form of "an
+    idle-observer ``bench_general_sync_10k`` runs within noise of the
+    pre-instrumentation constant". The sampler check is the ALWAYS-ON
+    cost of the sampled per-phase device profiler: off-sample applies
+    must pay an integer test, never a fence."""
     from automerge_tpu.utils.metrics import Metrics
+    from automerge_tpu.device import profiler
     m = Metrics()
     assert not m.active
 
@@ -831,13 +851,22 @@ def bench_observer_overhead(n=200000):
     for _ in range(n):
         m.bump('guard_counter')
     t_bump = (time.perf_counter() - t0) / n * 1e9
-    worst = max(t_span, t_emit, t_bump)
+    # the off-sample profiling path: n is a multiple of the default
+    # cadence, so the loop pays the true mixed cost (15/16 off-sample
+    # int checks, the occasional True return — the caller only fences
+    # on True, and no caller is attached here)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        profiler.should_sample()
+    t_sample = (time.perf_counter() - t0) / n * 1e9
+    worst = max(t_span, t_emit, t_bump, t_sample)
     assert worst < IDLE_OBSERVER_NS_PER_SITE, (
         f'idle-observer site cost {worst:.0f} ns/site exceeds the '
         f'{IDLE_OBSERVER_NS_PER_SITE} ns budget (span {t_span:.0f}, '
-        f'emit {t_emit:.0f}, bump {t_bump:.0f}) — the no-subscriber '
-        f'fast path regressed')
-    return {'span_ns': t_span, 'emit_ns': t_emit, 'bump_ns': t_bump}
+        f'emit {t_emit:.0f}, bump {t_bump:.0f}, sample '
+        f'{t_sample:.0f}) — the no-subscriber fast path regressed')
+    return {'span_ns': t_span, 'emit_ns': t_emit, 'bump_ns': t_bump,
+            'sample_ns': t_sample}
 
 
 def smoke():
@@ -847,7 +876,8 @@ def smoke():
     guard = bench_observer_overhead()
     log(f'observer-overhead[no subscriber]: '
         f'trace_span {guard["span_ns"]:.0f} ns, emit '
-        f'{guard["emit_ns"]:.0f} ns, bump {guard["bump_ns"]:.0f} ns '
+        f'{guard["emit_ns"]:.0f} ns, bump {guard["bump_ns"]:.0f} ns, '
+        f'off-sample profiler check {guard["sample_ns"]:.0f} ns '
         f'per site (budget {IDLE_OBSERVER_NS_PER_SITE} ns) — idle '
         f'observers ride the null-span fast path')
     print(json.dumps({
@@ -855,6 +885,7 @@ def smoke():
         'observer_span_ns': round(guard['span_ns'], 1),
         'observer_emit_ns': round(guard['emit_ns'], 1),
         'observer_bump_ns': round(guard['bump_ns'], 1),
+        'observer_sample_ns': round(guard['sample_ns'], 1),
         'observer_budget_ns': IDLE_OBSERVER_NS_PER_SITE,
     }), flush=True)
 
@@ -1436,7 +1467,29 @@ def main():
         f'({n_gd / t_geager:.0f} docs/s) -> '
         f'{t_geager / t_gbatch:.1f}x, one fused apply per tick')
 
+    # --trace-out PATH: record the 10240-doc sync bench through a
+    # flight recorder and dump it as a Perfetto trace — per-phase
+    # device lanes (device.fused_apply/admit/stage/dispatch/
+    # patch_read) + counter tracks (utilization, device memory,
+    # retraces) in one file, loadable at ui.perfetto.dev
+    trace_out = None
+    argv = sys.argv[1:]
+    if '--trace-out' in argv:
+        trace_out = argv[argv.index('--trace-out') + 1]
+    if trace_out:
+        from automerge_tpu.utils.metrics import (FlightRecorder,
+                                                 metrics as _tm)
+        _trace_rec = FlightRecorder(1 << 16)
+        _tm.subscribe(_trace_rec)
     s10k = bench_general_sync_10k()
+    if trace_out:
+        _tm.unsubscribe(_trace_rec)
+        from automerge_tpu import telemetry as _telemetry
+        _telemetry.dump_chrome_trace(_trace_rec, path=trace_out)
+        log(f'perfetto-trace[general 10k sync]: {trace_out} — '
+            f'device-phase lanes + memory/utilization/retrace '
+            f'counter tracks ({len(_trace_rec.events())} events '
+            f'retained)')
     n_10k, n_10k_ops, t_10k = s10k['n_docs'], s10k['n_ops'], \
         s10k['t_dict']
     t_10k_wire = s10k['t_wire']
@@ -1469,6 +1522,18 @@ def main():
         f'{s10k["flush_ms_p50"]:.1f} / p99 {s10k["flush_ms_p99"]:.1f} '
         f'ms — quantile() over the same sync_apply_ms/sync_flush_ms '
         f'series fleet_status() reports')
+    from automerge_tpu.device import profiler as _prof
+    from automerge_tpu.utils.metrics import metrics as _dm
+    log(f'device-observatory[general 10k]: sampled device-run p50 '
+        f'{s10k["device_run_ms_p50"]:.1f} ms, pack p50 '
+        f'{s10k["device_pack_ms_p50"]:.1f} ms, utilization '
+        f'{s10k["device_utilization"]:.2f} (1/16 applies fenced); '
+        f'{_dm.counters.get("device_compiles_total", 0)} compiles / '
+        f'{_dm.counters.get("device_retraces_total", 0)} retraces '
+        f'across {len(_prof.signature_counts())} jit entry points, '
+        f'device plane peak '
+        f'{_dm.counters.get("mem_device_plane_peak_bytes", 0) >> 10} '
+        f'KiB')
 
     (n_deg, deg_clean_ticks, t_deg_clean, deg_clean_stats, deg,
      t_deg_wire_clean, deg_wire) = bench_degraded_link()
@@ -1677,6 +1742,20 @@ def main():
         'general_sync10k_apply_ms_p99': round(s10k['apply_ms_p99'], 2),
         'general_sync10k_flush_ms_p50': round(s10k['flush_ms_p50'], 2),
         'general_sync10k_flush_ms_p99': round(s10k['flush_ms_p99'], 2),
+        # the device-path observatory: sampled per-phase attribution
+        # over the 10k sync section, and the process-wide shape-
+        # signature registry totals at exit (compiles vs retraces —
+        # a retrace-heavy run is compiling, not serving)
+        'general_sync10k_device_run_ms_p50':
+            round(s10k['device_run_ms_p50'], 2),
+        'general_sync10k_device_utilization':
+            round(s10k['device_utilization'], 3),
+        'device_compiles_total':
+            _metrics.counters.get('device_compiles_total', 0),
+        'device_retraces_total':
+            _metrics.counters.get('device_retraces_total', 0),
+        'mem_device_plane_peak_bytes':
+            _metrics.counters.get('mem_device_plane_peak_bytes', 0),
         'general_sync10k_wire_emit_native':
             bool(_amnat.emit_available()),
         'general_sync10k_degraded_ticks_5': deg[0.05][0],

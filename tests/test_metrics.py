@@ -29,6 +29,16 @@ class TestRegistry:
         m.reset()
         assert m.snapshot() == {}
 
+    def test_ratchet_only_raises(self):
+        """The peak-watermark write: atomic max-update, never lowers,
+        materializes the key at 0-or-higher like any gauge."""
+        m = M.Metrics()
+        m.ratchet('peak', 10)
+        m.ratchet('peak', 4)
+        assert m.counters['peak'] == 10
+        m.ratchet('peak', 12)
+        assert m.counters['peak'] == 12
+
     def test_events_only_materialize_with_subscribers(self):
         m = M.Metrics()
         assert not m.active
@@ -57,11 +67,22 @@ class TestHistograms:
         assert m.mean('lat') == pytest.approx(500.5)
         assert m.counters['lat.max'] == 1000.0
 
-    def test_empty_series_is_zero(self):
+    def test_empty_series_is_none_never_raises(self):
+        """Satellite regression (ISSUE 10): an empty or never-observed
+        series quantile is None — not a fake 0.0 a dashboard would
+        read as zero latency, and NEVER an exception (a stray .count
+        counter without a histogram must not break fleet_status)."""
         m = M.Metrics()
-        assert m.quantile('nope', 0.5) == 0.0
+        assert m.quantile('nope', 0.5) is None
         m.bump('lat.count')            # count with no histogram
-        assert m.quantile('lat', 0.5) == 0.0
+        assert m.quantile('lat', 0.5) is None
+        # a scoped view proxies the same contract
+        assert m.scoped(peer='p').quantile('nope', 0.99) is None
+        # observing then resetting the series goes back to None
+        m.observe('lat2', 1.0)
+        assert m.quantile('lat2', 0.5) is not None
+        m.reset_series('lat2')
+        assert m.quantile('lat2', 0.5) is None
 
     def test_extreme_values_clamp_to_edge_buckets(self):
         m = M.Metrics()
@@ -75,7 +96,7 @@ class TestHistograms:
         m.observe('a', 1.0)
         m.observe('b', 2.0)
         m.reset_series('a')
-        assert m.quantile('a', 0.5) == 0.0
+        assert m.quantile('a', 0.5) is None
         assert 'a.count' not in m.counters
         assert m.quantile('b', 0.5) > 0
         assert m.counters['b.count'] == 1
@@ -317,14 +338,16 @@ class TestFlightRecorder:
 
 
 class TestRegistryDriftGuard:
-    """Satellite: every literal sync_/serving_/fleet_ counter name
-    bumped anywhere in automerge_tpu/ must appear in one of the four
-    registries — a silently added name fails here, not in a dashboard
-    six weeks later."""
+    """Satellite: every literal sync_/serving_/fleet_/device_/mem_
+    counter name bumped anywhere in automerge_tpu/ must appear in one
+    of the five registries — a silently added name fails here, not in
+    a dashboard six weeks later. (Dynamic scoped names — peer/<id>/,
+    jit/<fn>/ — are labels, not registry entries, and stay outside
+    the guard by construction.)"""
 
     NAME_RE = re.compile(
-        r"(?:bump|set_gauge|observe)\(\s*'((?:sync|serving|fleet)_"
-        r"[a-z0-9_]+)'")
+        r"(?:bump|set_gauge|observe|ratchet)\(\s*'"
+        r"((?:sync|serving|fleet|device|mem)_[a-z0-9_]+)'")
 
     def _package_names(self):
         pkg = os.path.dirname(M.__file__)         # automerge_tpu/utils
@@ -344,17 +367,20 @@ class TestRegistryDriftGuard:
         registered = set(M.ALL_COUNTER_REGISTRIES)
         missing = bumped - registered
         assert not missing, (
-            f'sync_/serving_/fleet_ counters bumped in automerge_tpu/ '
-            f'but absent from FAULT_COUNTERS/SERVING_COUNTERS/'
-            f'SYNC_COUNTERS/CONVERGENCE_COUNTERS: {sorted(missing)}')
+            f'sync_/serving_/fleet_/device_/mem_ counters bumped in '
+            f'automerge_tpu/ but absent from FAULT_COUNTERS/'
+            f'SERVING_COUNTERS/SYNC_COUNTERS/CONVERGENCE_COUNTERS/'
+            f'DEVICE_COUNTERS: {sorted(missing)}')
 
     def test_no_registered_name_is_dead(self):
-        """The reverse direction: a registered sync_/serving_/fleet_
-        name no call site bumps is a stale registry entry."""
+        """The reverse direction: a registered sync_/serving_/fleet_/
+        device_/mem_ name no call site bumps is a stale registry
+        entry."""
         bumped = self._package_names()
         registered = set(M.ALL_COUNTER_REGISTRIES)
         dead = {n for n in registered
-                if n.startswith(('sync_', 'serving_', 'fleet_'))} \
+                if n.startswith(('sync_', 'serving_', 'fleet_',
+                                 'device_', 'mem_'))} \
             - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
 
@@ -363,7 +389,8 @@ class TestRegistryDriftGuard:
         exporter's zero-fill pass."""
         seen = set()
         for reg in (M.FAULT_COUNTERS, M.SERVING_COUNTERS,
-                    M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS):
+                    M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS,
+                    M.DEVICE_COUNTERS):
             dup = seen & set(reg)
             assert not dup, f'registered twice: {sorted(dup)}'
             seen |= set(reg)
@@ -377,7 +404,7 @@ class TestRegistryDriftGuard:
         text = telemetry.render_prometheus(M.Metrics())
         for name in M.ALL_COUNTER_REGISTRIES:
             metric = name
-            if name.endswith('_ms'):
+            if name.endswith(M.HIST_SUFFIXES):
                 assert f'{metric}_count' in text, name
                 assert f'{metric}_bucket' in text, name
             else:
@@ -495,6 +522,18 @@ class TestFaultCounters:
             'sync_replication_lag_ops', 'sync_lagging_docs',
             'sync_convergence_ms', 'sync_divergence_detected',
             'fleet_health_state', 'fleet_health_transitions'}
+
+    def test_device_registry_names_are_pinned(self):
+        """ISSUE 10 satellite: the device-path performance counter
+        family has its own registry, guard-covered like the rest."""
+        assert set(M.DEVICE_COUNTERS) >= {
+            'device_compiles_total', 'device_retraces_total',
+            'device_dispatches_total', 'device_dispatch_rows',
+            'device_admit_ms', 'device_pack_ms',
+            'device_dispatch_ms', 'device_run_ms',
+            'device_patch_read_ms', 'device_utilization',
+            'mem_device_plane_bytes', 'mem_device_plane_peak_bytes',
+            'mem_journal_bytes', 'mem_park_shard_bytes'}
 
     def test_rejected_message_counts(self):
         from automerge_tpu.sync.connection import MessageRejected

@@ -1,0 +1,126 @@
+"""CI perf-budget gate (ISSUE 10): ``tools/perf_gate.py`` must PASS on
+the checked-in BENCH_r05 artifact with the checked-in budgets, FAIL on
+an artificially regressed copy, and handle the ``--smoke`` JSON shape
+— the acceptance gate for "bench numbers are a floor, not a memory".
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+try:
+    import perf_gate
+finally:
+    sys.path.pop(0)
+
+BENCH_R05 = os.path.join(REPO, 'BENCH_r05.json')
+BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
+
+
+def _budgets():
+    with open(BUDGETS) as f:
+        return json.load(f)['budgets']
+
+
+class TestGateOnCheckedInArtifacts:
+    def test_bench_r05_passes(self, capsys):
+        assert perf_gate.main([BENCH_R05]) == 0
+        out = capsys.readouterr().out
+        # the driver record's nested 'parsed' keys were hoisted and
+        # matched — several budgets really ran
+        assert 'kernel_ops_per_sec' in out
+        assert out.count('PASS') >= 5
+
+    def test_budget_schema_is_well_formed(self):
+        """Every budget entry has exactly one bound and numeric
+        values — a malformed entry would silently never fail."""
+        for path, bound in _budgets().items():
+            bounds = [k for k in ('min', 'max') if k in bound]
+            assert len(bounds) == 1, path
+            assert isinstance(bound[bounds[0]], (int, float)), path
+
+    def test_regressed_bench_fails(self, tmp_path, capsys):
+        with open(BENCH_R05) as f:
+            artifact = json.load(f)
+        artifact['parsed']['kernel_ops_per_sec'] /= 2   # 13.3M < floor
+        bad = tmp_path / 'regressed.json'
+        bad.write_text(json.dumps(artifact))
+        assert perf_gate.main([str(bad)]) == 1
+        err = capsys.readouterr().err
+        assert 'kernel_ops_per_sec' in err and 'FAIL' in err
+
+    def test_regressed_latency_fails(self, tmp_path):
+        with open(BENCH_R05) as f:
+            artifact = json.load(f)
+        artifact['parsed']['link_floor_ms'] = 400.0     # > 150 ceiling
+        bad = tmp_path / 'slow.json'
+        bad.write_text(json.dumps(artifact))
+        assert perf_gate.main([str(bad)]) == 1
+
+
+class TestGateOnSmokeShape:
+    """The CI lane: ``python bench.py --smoke | tee smoke.json`` then
+    the gate — observer/off-sample ns budgets, other keys skipped."""
+
+    SMOKE = {'smoke': 'observer_overhead', 'observer_span_ns': 650.0,
+             'observer_emit_ns': 40.0, 'observer_bump_ns': 180.0,
+             'observer_sample_ns': 90.0, 'observer_budget_ns': 3000}
+
+    def test_good_smoke_passes(self, tmp_path):
+        p = tmp_path / 'smoke.json'
+        p.write_text(json.dumps(self.SMOKE))
+        assert perf_gate.main([str(p)]) == 0
+
+    def test_smoke_with_log_noise_parses_last_json_line(self,
+                                                        tmp_path):
+        p = tmp_path / 'stream.txt'
+        p.write_text('warming up...\nnot json\n'
+                     + json.dumps(self.SMOKE) + '\n')
+        assert perf_gate.main([str(p)]) == 0
+
+    def test_blown_off_sample_budget_fails(self, tmp_path, capsys):
+        smoke = dict(self.SMOKE, observer_sample_ns=99999.0)
+        p = tmp_path / 'smoke.json'
+        p.write_text(json.dumps(smoke))
+        assert perf_gate.main([str(p)]) == 1
+        assert 'observer_sample_ns' in capsys.readouterr().err
+
+
+class TestGateEdgeCases:
+    def test_artifact_matching_no_budget_fails(self, tmp_path):
+        """A renamed bench key must not turn the gate green."""
+        p = tmp_path / 'renamed.json'
+        p.write_text(json.dumps({'totally_new_key': 1}))
+        assert perf_gate.main([str(p)]) == 1
+
+    def test_non_numeric_budgeted_value_fails(self, tmp_path):
+        p = tmp_path / 'bad.json'
+        p.write_text(json.dumps({'observer_span_ns': 'fast'}))
+        assert perf_gate.main([str(p)]) == 1
+
+    def test_no_json_object_raises(self, tmp_path):
+        p = tmp_path / 'empty.txt'
+        p.write_text('no json here\n')
+        with pytest.raises(ValueError):
+            perf_gate.main([str(p)])
+
+    def test_dotted_paths_descend(self, tmp_path):
+        """Nested keys (e.g. dense_breakdown_ms.device) are budgetable
+        via dotted paths."""
+        budgets = tmp_path / 'b.json'
+        budgets.write_text(json.dumps(
+            {'budgets': {'dense_breakdown_ms.device': {'max': 50}}}))
+        art = tmp_path / 'a.json'
+        art.write_text(json.dumps(
+            {'dense_breakdown_ms': {'device': 20.0}}))
+        assert perf_gate.main([str(art), '--budgets',
+                               str(budgets)]) == 0
+        art.write_text(json.dumps(
+            {'dense_breakdown_ms': {'device': 80.0}}))
+        assert perf_gate.main([str(art), '--budgets',
+                               str(budgets)]) == 1

@@ -49,17 +49,18 @@ def validate_exposition(text):
 
 def validate_chrome_trace(obj):
     """The Chrome-trace/Perfetto shape gate: traceEvents is a list,
-    every event's phase is known, every X/i event references a
+    every event's phase is known, every X/i/C event references a
     (pid, tid) lane that a metadata record declared, X durations are
-    non-negative. Returns (n_spans, n_instants)."""
+    non-negative, C samples carry a numeric value. Returns
+    (n_spans, n_instants, n_counters)."""
     assert isinstance(obj, dict) and 'traceEvents' in obj
     declared = set()
     for e in obj['traceEvents']:
         if e['ph'] == 'M':
             declared.add((e['pid'], e['tid']))
-    n_spans = n_instants = 0
+    n_spans = n_instants = n_counters = 0
     for e in obj['traceEvents']:
-        assert e['ph'] in ('M', 'X', 'i'), e
+        assert e['ph'] in ('M', 'X', 'i', 'C'), e
         if e['ph'] == 'M':
             continue
         assert (e['pid'], e['tid']) in declared, \
@@ -68,9 +69,12 @@ def validate_chrome_trace(obj):
         if e['ph'] == 'X':
             assert e['dur'] >= 0
             n_spans += 1
+        elif e['ph'] == 'C':
+            assert isinstance(e['args']['value'], (int, float))
+            n_counters += 1
         else:
             n_instants += 1
-    return n_spans, n_instants
+    return n_spans, n_instants, n_counters
 
 
 def _run_fleet(recorder=None):
@@ -125,7 +129,8 @@ class TestPrometheusExposition:
         names = validate_exposition(
             telemetry.render_prometheus(M.Metrics()))
         for name in M.ALL_COUNTER_REGISTRIES:
-            want = name + '_count' if name.endswith('_ms') else name
+            want = name + '_count' \
+                if name.endswith(M.HIST_SUFFIXES) else name
             assert want in names, f'{name} silently unexported'
 
     def test_scope_prefixes_become_labels(self):
@@ -152,7 +157,7 @@ class TestChromeTrace:
         rec = FlightRecorder(4096)
         _run_fleet(recorder=rec)
         obj = telemetry.dump_chrome_trace(rec)
-        n_spans, n_instants = validate_chrome_trace(obj)
+        n_spans, n_instants, _ = validate_chrome_trace(obj)
         assert n_spans > 0, 'fleet run produced no spans'
         # every span lane is a declared trace lane
         json.dumps(obj)                        # fully serializable
@@ -175,7 +180,7 @@ class TestChromeTrace:
             {'event': 'doc_quarantined', 'ts': 3.0, 'doc_id': 'd'},
         ]
         obj = telemetry.dump_chrome_trace(events)
-        n_spans, n_instants = validate_chrome_trace(obj)
+        n_spans, n_instants, _ = validate_chrome_trace(obj)
         assert (n_spans, n_instants) == (1, 1)
 
     def test_incident_file_to_trace_report(self, tmp_path):
@@ -196,6 +201,244 @@ class TestChromeTrace:
         out = tmp_path / 'out.json'
         assert trace_report.main([inc, '-o', str(out)]) == 0
         with open(out, 'r', encoding='utf-8') as f:
-            n_spans, n_instants = validate_chrome_trace(json.load(f))
+            n_spans, n_instants, _ = validate_chrome_trace(
+                json.load(f))
         assert n_spans > 0
         assert n_instants > 0                  # the trigger record
+
+
+def _apply_round(ds, seq, n_ops=1, doc='doc0'):
+    """One causally-chained apply of ``n_ops`` root set ops — growing
+    ``n_ops`` across a padding bucket forces a NEW shape signature
+    (the injected retrace)."""
+    ds.apply_changes_batch({doc: [{
+        'actor': 'a', 'seq': seq,
+        'deps': {'a': seq - 1} if seq > 1 else {},
+        'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': f'k{i}',
+                 'value': seq * 1000 + i} for i in range(n_ops)]}]})
+
+
+class TestDeviceProfileExport:
+    """ISSUE 10: a Perfetto trace from a profiled fleet run must show
+    per-phase device lanes (device.* spans in dedicated rows) and
+    memory/utilization/retrace counter tracks — validated machine-side
+    here, same as the other exporter gates (runs in both CI lanes)."""
+
+    def test_profiled_run_has_device_lanes_and_counter_tracks(self):
+        from automerge_tpu.device import profiler
+        from automerge_tpu.sync import GeneralDocSet
+        prev = profiler.set_sample_every(1)   # fence every apply
+        rec = FlightRecorder(8192)
+        metrics.subscribe(rec)
+        try:
+            ds = GeneralDocSet(8)
+            for seq in range(1, 4):
+                _apply_round(ds, seq, n_ops=2)
+            ds.materialize('doc0')
+            # the read side: a patch whose diffs are materialized
+            # closes the tick path with a device.patch_read span
+            from automerge_tpu.device.general import \
+                apply_general_block
+            block = ds.store.encode_changes(
+                [[{'actor': 'a', 'seq': 4, 'deps': {'a': 3},
+                   'ops': [{'action': 'set', 'obj': ROOT_ID,
+                            'key': 'k0', 'value': 9}]}]],
+                n_docs=ds.capacity)
+            patch = apply_general_block(ds.store, block)
+            patch.diffs(0)
+        finally:
+            metrics.unsubscribe(rec)
+            profiler.set_sample_every(prev)
+        obj = telemetry.dump_chrome_trace(rec)
+        n_spans, _, n_counters = validate_chrome_trace(obj)
+        assert n_spans > 0
+        assert n_counters > 0, 'sampled profiler emitted no counters'
+        # per-phase device lanes: dedicated thread_name metas
+        lanes = {e['args']['name'] for e in obj['traceEvents']
+                 if e['ph'] == 'M' and e['name'] == 'thread_name'}
+        assert 'device.fused_apply' in lanes
+        assert {'device.admit', 'device.stage',
+                'device.dispatch'} <= lanes
+        assert 'device.patch_read' in lanes
+        # counter tracks: utilization + device memory + retraces
+        tracks = {e['name'] for e in obj['traceEvents']
+                  if e['ph'] == 'C'}
+        assert 'device_utilization' in tracks
+        assert 'mem_device_plane_bytes' in tracks
+        assert 'device_retraces_total' in tracks
+        # device spans really landed in the device lanes
+        device_tids = {e['tid'] for e in obj['traceEvents']
+                       if e['ph'] == 'M' and
+                       e['args']['name'].startswith('device.')}
+        device_spans = [e for e in obj['traceEvents']
+                        if e['ph'] == 'X' and
+                        e['name'].startswith('device.')]
+        assert device_spans
+        assert {e['tid'] for e in device_spans} <= device_tids
+        json.dumps(obj)                       # fully serializable
+
+    def test_phase_series_feed_fleet_status_latency(self):
+        """The sampled phases land in the SAME histogram series
+        fleet_status()['latency'] reports."""
+        from automerge_tpu.device import profiler
+        from automerge_tpu.sync import GeneralDocSet
+        prev = profiler.set_sample_every(1)
+        try:
+            ds = GeneralDocSet(4)
+            _apply_round(ds, 1, n_ops=2)
+        finally:
+            profiler.set_sample_every(prev)
+        lat = ds.fleet_status(docs=False)['latency']
+        for series in ('device_run_ms', 'device_pack_ms',
+                       'device_dispatch_ms', 'device_admit_ms'):
+            assert series in lat, series
+            assert lat[series]['p99'] >= 0
+            assert lat[series]['p50'] == \
+                metrics.quantile(series, 0.5)
+
+
+class TestRetraceStorm:
+    """ISSUE 10 acceptance: an injected retrace storm (a shape change
+    mid-run) is detected within ONE serving quantum — the counter
+    moves, the health rollup flags ``recompile_storm``, and the
+    flight recorder retains the ``recompile`` event. Parametrized over
+    the native stager exactly like the serving squeeze suite, so both
+    CI lanes exercise both staging paths."""
+
+    @pytest.mark.parametrize('force', [False, True])
+    def test_storm_counter_health_and_recorder(self, tmp_path,
+                                               force):
+        from automerge_tpu import native as amnative
+        from automerge_tpu.device import general, profiler
+        from automerge_tpu.sync import GeneralDocSet
+        from automerge_tpu.sync.serving import ServingDocSet
+        if force and not amnative.stage_available():
+            pytest.skip('native stager unavailable')
+        prev_force = general._NATIVE_STAGING
+        general._NATIVE_STAGING = force
+        rec = FlightRecorder(4096)
+        try:
+            ds = ServingDocSet(GeneralDocSet(4), str(tmp_path))
+            # a storm of ONE retrace must trip the (tightened) SLO —
+            # the threshold is configurable by design
+            ds.inner.health_thresholds['recompile_storm'] = (1, None)
+            _apply_round(ds, 1, n_ops=1)
+            ds.tick()                  # quantum 0: baseline recorded
+            assert ds.inner._health_state == 'green'
+            profiler.reset()           # deterministic signature count
+            metrics.subscribe(rec)
+            before = metrics.counters.get('device_retraces_total', 0)
+            _apply_round(ds, 2, n_ops=1)    # compile #1 post-reset
+            _apply_round(ds, 3, n_ops=200)  # new op bucket: RETRACE
+            after = metrics.counters.get('device_retraces_total', 0)
+            assert after > before, 'shape change did not retrace'
+            ds.tick()                  # quantum 1: detection
+            assert ds.inner._health_state != 'green'
+            health = ds.inner.evaluate_health.__self__ \
+                .fleet_status(docs=False)['health']
+            # the signal re-evaluated just now reads 0 (delta since
+            # the tick above) — the STATE carries the detection; the
+            # reason that tripped it is in the recorder's transition
+            events = rec.events()
+            recompiles = [e for e in events
+                          if e['event'] == 'recompile']
+            assert recompiles, 'no recompile flight-recorder event'
+            assert any(e.get('fn', '').startswith('general.')
+                       for e in recompiles)
+            transitions = [e for e in events
+                           if e['event'] == 'health_transition']
+            assert any(
+                any('recompile_storm' in r
+                    for r in e.get('reasons', []))
+                for e in transitions), \
+                'health transition did not cite recompile_storm'
+            assert health['thresholds']['recompile_storm'] == (1,
+                                                               None)
+        finally:
+            metrics.unsubscribe(rec)
+            general._NATIVE_STAGING = prev_force
+
+    def test_first_evaluation_never_inherits_old_retraces(self):
+        """A doc set created late in a process (after thousands of
+        legitimate warm-up compiles) must not read degraded on its
+        first evaluation — the baseline is lazy."""
+        from automerge_tpu.sync import GeneralDocSet
+        metrics.bump('device_retraces_total', 5000)
+        try:
+            ds = GeneralDocSet(4)
+            ds.health_thresholds['recompile_storm'] = (1, None)
+            health = ds.evaluate_health()
+            assert health['signals']['recompile_storm'] == 0
+            assert health['state'] == 'green'
+        finally:
+            metrics.bump('device_retraces_total', -5000)
+
+
+class TestMemoryAccounting:
+    """ISSUE 10: live memory gauges (device plane per format, journal,
+    park shards) + peak watermarks, rolled into
+    fleet_status()['memory'] and the serving eviction-pressure
+    signal."""
+
+    def test_general_fleet_memory_block(self):
+        from automerge_tpu.sync import GeneralDocSet
+        ds = GeneralDocSet(4)
+        _apply_round(ds, 1, n_ops=3)
+        mem = ds.fleet_status(docs=False)['memory']
+        assert mem['device_plane_bytes'] > 0
+        assert mem['device_plane_fmt'] in ('packed', 'wide', 'cols')
+        assert mem['device_plane_peak_bytes'] >= \
+            mem['device_plane_bytes']
+        # the process gauges agree with the per-store read (this
+        # store applied last)
+        assert metrics.counters.get('mem_device_plane_bytes') == \
+            mem['device_plane_bytes']
+        fmt_gauge = f'mem_device_{mem["device_plane_fmt"]}_bytes'
+        assert metrics.counters.get(fmt_gauge) == \
+            mem['device_plane_bytes']
+
+    def test_journal_bytes_gauge_tracks_appends_and_reset(self,
+                                                          tmp_path):
+        from automerge_tpu.durability import ChangeJournal
+        j = ChangeJournal(str(tmp_path / 'j.amtpu'), fsync=False)
+        assert metrics.counters.get('mem_journal_bytes') == 0
+        j.append({'changes': {'d': []}})
+        size = metrics.counters.get('mem_journal_bytes')
+        assert size > 0
+        assert metrics.counters.get('mem_journal_peak_bytes') >= size
+        j.append({'changes': {'d': []}})
+        assert metrics.counters.get('mem_journal_bytes') > size
+        j.reset()
+        assert metrics.counters.get('mem_journal_bytes') == 0
+        assert metrics.counters.get('mem_journal_peak_bytes') >= size
+        j.close()
+
+    def test_serving_park_bytes_and_pressure_signal(self, tmp_path):
+        from automerge_tpu.sync import GeneralDocSet
+        from automerge_tpu.sync.serving import ServingDocSet
+        ds = ServingDocSet(GeneralDocSet(8), str(tmp_path))
+        for d in range(4):
+            _apply_round(ds, 1, n_ops=2, doc=f'doc{d}')
+        # squeeze everything cold out
+        ds.memory_budget_bytes = 1
+        ds.tick()
+        assert ds._n_evictions > 0
+        st = ds.fleet_status(docs=False)
+        assert st['memory']['park_shard_bytes'] > 0
+        assert metrics.counters.get('mem_park_shard_bytes') == \
+            st['memory']['park_shard_bytes']
+        assert st['memory']['memory_budget_bytes'] == 1
+        assert st['memory']['resident_peak_bytes'] > 0
+        assert 'memory_pressure' in st['health']['signals']
+        # eviction pressure: block eviction (truncated-log rule) and
+        # the budget breach surfaces through the health rollup
+        ds.retry_quarantined()         # fault everything back in
+        ds.materialize_many(list(ds.inner.ids))
+        ds.store.log_truncated = True
+        ds.tick()
+        sig = ds.inner._health_signals()
+        assert sig['memory_pressure'] > 1.0
+        health = ds.inner.evaluate_health()
+        assert health['state'] != 'green'
+        assert any('memory_pressure' in r for r in health['reasons'])
+        ds.store.log_truncated = False

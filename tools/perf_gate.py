@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""CI perf-budget regression gate.
+
+Compares a bench JSON artifact (``python bench.py`` stdout, ``python
+bench.py --smoke`` output, or a stored ``BENCH_r*.json``) against the
+checked-in budget file and FAILS (exit 1) on any regression — the
+executable form of "the numbers in BENCH_r05 are a floor, not a
+memory".
+
+Usage::
+
+    python tools/perf_gate.py BENCH.json [--budgets PERF_BUDGETS.json]
+
+Input tolerance: the artifact may be a bare JSON object, a driver
+record with the numbers nested (``{"parsed": {...}}``), or a mixed
+stdout stream whose LAST line is the JSON object (the bench prints
+exactly one JSON line on stdout; ``--smoke`` does the same).
+
+Budget schema (``PERF_BUDGETS.json``)::
+
+    {"budgets": {
+        "<dotted.path>": {"min": <number>}  # throughput floor
+                       | {"max": <number>}, # latency/overhead ceiling
+        ...}}
+
+Dotted paths descend into nested objects (``parsed.kernel_ops_per_sec``
+first tries the literal key, then splits on dots). A budget whose path
+is absent from the artifact is SKIPPED and reported — one budget file
+covers the full bench, the smoke lane and historical artifacts — but
+an artifact matching zero budgeted paths fails loudly (a renamed key
+must not turn the gate green). Entries may carry a "note" (ignored by
+the gate, read by humans). Tolerance bands live in the budget values
+themselves: they are seeded from BENCH_r05 with ~30% headroom, so CI
+noise passes and a real regression does not.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_BUDGETS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    'PERF_BUDGETS.json')
+
+
+def load_artifact(path):
+    """The bench JSON object: the whole file if it parses, else the
+    last line that parses as a JSON object (bench stdout streams)."""
+    with open(path, 'r', encoding='utf-8') as f:
+        text = f.read()
+    obj = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict):
+            obj = parsed
+    except ValueError:
+        pass
+    if obj is None:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                obj = parsed
+                break
+    if obj is None:
+        raise ValueError(f'{path}: no JSON object found')
+    # driver records (BENCH_r*.json) nest the bench keys under
+    # 'parsed' — hoist them so one budget file matches both the
+    # stored artifacts and live bench stdout
+    if isinstance(obj.get('parsed'), dict):
+        obj = {**obj['parsed'], **obj}
+    return obj
+
+
+def resolve(obj, path):
+    """Value at ``path`` ('a.b.c' descends; a literal key wins), or
+    a sentinel when absent."""
+    if isinstance(obj, dict) and path in obj:
+        return obj[path]
+    cur = obj
+    for part in path.split('.'):
+        if not isinstance(cur, dict) or part not in cur:
+            return _MISSING
+        cur = cur[part]
+    return cur
+
+
+_MISSING = object()
+
+
+def check(artifact, budgets):
+    """Returns (violations, checked, skipped) — each violation is a
+    human-readable line."""
+    violations, checked, skipped = [], [], []
+    for path, bound in budgets.items():
+        value = resolve(artifact, path)
+        if value is _MISSING:
+            skipped.append(path)
+            continue
+        if not isinstance(value, (int, float)) or \
+                isinstance(value, bool):
+            violations.append(
+                f'{path}: budgeted but not numeric in the artifact '
+                f'({value!r})')
+            continue
+        lo = bound.get('min')
+        hi = bound.get('max')
+        if lo is not None and value < lo:
+            violations.append(
+                f'{path}: {value:g} < budget min {lo:g}'
+                + (f'  ({bound["note"]})' if bound.get('note')
+                   else ''))
+        elif hi is not None and value > hi:
+            violations.append(
+                f'{path}: {value:g} > budget max {hi:g}'
+                + (f'  ({bound["note"]})' if bound.get('note')
+                   else ''))
+        else:
+            checked.append(f'{path}: {value:g} ok'
+                           + (f' (min {lo:g})' if lo is not None
+                              else f' (max {hi:g})'))
+    return violations, checked, skipped
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Fail CI when a bench JSON regresses past the '
+                    'checked-in perf budgets.')
+    ap.add_argument('artifact', help='bench JSON (file or captured '
+                                     'stdout; last JSON line wins)')
+    ap.add_argument('--budgets', default=DEFAULT_BUDGETS,
+                    help='budget file (default: repo '
+                         'PERF_BUDGETS.json)')
+    args = ap.parse_args(argv)
+
+    artifact = load_artifact(args.artifact)
+    with open(args.budgets, 'r', encoding='utf-8') as f:
+        budgets = json.load(f)['budgets']
+
+    violations, checked, skipped = check(artifact, budgets)
+    for line in checked:
+        print(f'  PASS {line}')
+    if skipped:
+        print(f'  skipped (not in artifact): {", ".join(skipped)}')
+    if violations:
+        print('PERF GATE FAILED:', file=sys.stderr)
+        for line in violations:
+            print(f'  FAIL {line}', file=sys.stderr)
+        return 1
+    if not checked:
+        print('PERF GATE FAILED: artifact matched no budgeted key '
+              '(renamed bench keys must update PERF_BUDGETS.json)',
+              file=sys.stderr)
+        return 1
+    print(f'perf gate: {len(checked)} budget(s) ok, '
+          f'{len(skipped)} skipped')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
