@@ -75,6 +75,23 @@ def _intern(table, index, item):
 _MISSING = object()
 
 
+class HorizonTruncated(ValueError):
+    """A peer's clock predates this store's compaction horizon for a
+    document: the change bodies it needs were folded into the per-doc
+    state snapshot and no longer exist as history. The sync layer
+    answers with a ``'state'`` message (the snapshot + the retained
+    tail) instead of a change replay; callers that cannot ship state
+    surface this as the clear serve error it is."""
+
+    def __init__(self, doc, message=None):
+        super().__init__(
+            message or
+            f'history of doc {doc} at or behind the compaction '
+            f'horizon was folded into its state snapshot; serve the '
+            f'peer a state bootstrap')
+        self.doc = doc
+
+
 def _wire_entry_bytes(entry):
     """Resident byte size of one encode-cache entry: v1 entries are
     plain JSON bytes, v2 entries are ``(body, lits)`` columnar
@@ -685,6 +702,14 @@ class BlockStore:
         # not advertise digests (a zero digest vs a real one would be a
         # false divergence alarm)
         self._digest_valid = True
+        # compaction horizon (tiered doc storage): doc index ->
+        # {'clock': {actor: seq}, 'digest': int|None, 'state': bytes}.
+        # History at or behind the horizon clock has been folded into
+        # the doc's encoded state snapshot ('state' — the payload the
+        # sync layer ships to peers whose clock predates the horizon);
+        # the retained log holds only the TAIL (changes admitted after
+        # the fold). Maintained by automerge_tpu.compaction.
+        self.horizon = {}
 
     # -- interning / lookup helpers -----------------------------------------
 
@@ -843,6 +868,14 @@ class BlockStore:
         clock = self.clock_of(d)
         if all(have_deps.get(a, 0) >= s for a, s in clock.items()):
             return []
+        rec = self.horizon.get(d)
+        if rec is not None and \
+                not all(have_deps.get(a, 0) >= s
+                        for a, s in rec['clock'].items()):
+            # the peer predates the fold point: the bodies it needs
+            # were folded into the state snapshot — the sync layer
+            # ships that (plus the tail below) instead of history
+            raise HorizonTruncated(d)
         if not self.retain_log and not self.log_truncated:
             raise ValueError(
                 'change-log retention is disabled on this store '
@@ -924,7 +957,8 @@ class BlockStore:
         # gather per retained block in one vectorized pass instead of
         # a clock_of + searchsorted per document. Truncated/unretained
         # logs keep the per-doc path (its errors are per doc).
-        fresh = [d for d, have_deps in wants if not have_deps] \
+        fresh = [d for d, have_deps in wants
+                 if not have_deps and d not in self.horizon] \
             if len(wants) > 16 and self.retain_log \
             and not self.log_truncated else []
         if fresh:
@@ -1063,12 +1097,31 @@ class BlockStore:
     def digest_recompute(self, d):
         """O(doc) from-scratch digest over the retained log — the
         parity oracle for the incremental fold (raises the usual
-        retention errors when the log cannot serve the full
-        history)."""
-        out = 0
-        for change in self.get_missing_changes(d, {}):
+        retention errors when the log cannot serve the full history).
+        On a compacted doc the fold starts from the digest recorded at
+        the horizon and covers only the retained tail — the state
+        snapshot carries the pre-horizon XOR exactly so this oracle
+        keeps working after the bodies are gone."""
+        rec = self.horizon.get(d)
+        if rec is not None:
+            if rec.get('digest') is None:
+                raise ValueError(
+                    f'doc {d} was compacted without a valid digest; '
+                    f'its history digest cannot be recomputed')
+            out = rec['digest']
+            have = rec['clock']
+        else:
+            out = 0
+            have = {}
+        for change in self.get_missing_changes(d, have):
             out ^= change_hash(change)
         return out
+
+    def state_snapshot_bytes(self):
+        """Total resident bytes of the per-doc horizon state snapshots
+        (the ``mem_state_snapshot_bytes`` gauge reads this)."""
+        return sum(len(rec['state']) for rec in self.horizon.values()
+                   if rec.get('state') is not None)
 
 
 def init_store(n_docs):

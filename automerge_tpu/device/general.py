@@ -507,9 +507,39 @@ class GeneralStore(BlockStore):
                 'obj_type': self.obj_type,
                 'obj_inbound': {str(k): v for k, v in
                                 self.obj_inbound.items()}}
+        extra = {}
+        if self.horizon:
+            # tiered container (v2): the compaction horizon records
+            # (per-doc state snapshots + clocks + digests) and the
+            # retained TAIL bodies ride along, so a resumed store is
+            # `state + tail` — fully servable and evictable, never
+            # blunt-truncated. The format string stays @1 (older
+            # readers load the state columns and simply remain
+            # truncated — the v-stamp is meta['tiers']).
+            meta['tiers'] = 2
+            meta['horizon'] = {
+                str(d): {'clock': rec['clock'],
+                         'digest': rec['digest']}
+                for d, rec in self.horizon.items()}
+            hdocs = sorted(self.horizon)
+            blobs = [self.horizon[d].get('state') or b''
+                     for d in hdocs]
+            offsets = np.zeros(len(blobs) + 1, np.int64)
+            if blobs:
+                np.cumsum([len(b) for b in blobs], out=offsets[1:])
+            extra['hz_doc'] = np.asarray(hdocs, np.int64)
+            extra['hz_off'] = offsets
+            extra['hz_blob'] = np.frombuffer(b''.join(blobs),
+                                             dtype=np.uint8)
+            tail = {}
+            for block, rows, docs in self.retained:
+                for c, d in zip(rows.tolist(), docs.tolist()):
+                    tail.setdefault(str(d), []).append(
+                        block.change_dict(int(c)))
+            meta['tail'] = tail
         buf = io.BytesIO()
         np.savez_compressed(
-            buf,
+            buf, **extra,
             e_doc=self.e_doc, e_obj=self.e_obj, e_key=self.e_key,
             e_actor=self.e_actor, e_seq=self.e_seq,
             e_value=self.e_value, e_link=self.e_link,
@@ -587,8 +617,33 @@ class GeneralStore(BlockStore):
             pool.max_elem = int(pool.elemc.max()) \
                 if len(pool.elemc) else 0
             # change bodies are not serialized: peers sync forward
-            # from here, not across the snapshot boundary
+            # from here, not across the snapshot boundary — UNLESS the
+            # store was compacted (meta['tiers'] >= 2): then the
+            # horizon records + tail bodies restore below and the
+            # store stays fully servable (state for peers behind the
+            # horizon, tail replay for everyone else)
             store.log_truncated = True
+            if meta.get('tiers', 1) >= 2 and 'horizon' in meta:
+                hz_meta = meta['horizon']
+                hz_doc = z['hz_doc']
+                hz_off = z['hz_off']
+                hz_blob = z['hz_blob'].tobytes()
+                for i, d in enumerate(hz_doc.tolist()):
+                    rec = hz_meta[str(d)]
+                    blob = hz_blob[int(hz_off[i]):int(hz_off[i + 1])]
+                    store.horizon[int(d)] = {
+                        'clock': dict(rec['clock']),
+                        'digest': rec['digest'],
+                        'state': blob or None}
+                from .. import compaction as _compaction
+                store.retained = _compaction._encode_retained(
+                    store, {int(d): ch
+                            for d, ch in meta.get('tail',
+                                                  {}).items()})
+                store.log_truncated = False
+                from ..utils.metrics import metrics as _metrics2
+                _metrics2.set_gauge('mem_state_snapshot_bytes',
+                                    store.state_snapshot_bytes())
             # state digests ride the snapshot (they cannot be refolded
             # once the bodies are gone); a pre-digest snapshot resumes
             # with digests INVALID — it must not advertise zeros

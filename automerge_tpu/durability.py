@@ -119,23 +119,35 @@ def write_snapshot_file(path, payload):
 # -- parked-doc shards (cold-doc eviction / quarantine parking) ---------------
 
 PARK_FORMAT = 'automerge-tpu-parked-docs@1'
+# tiered container (ISSUE 12): a v2 shard's payloads may carry a
+# base64-armored per-doc STATE snapshot ('state') in place of — or, for
+# a compacted doc, instead of — the full change history ('changes').
+# v1 shards (full-log payloads only) keep writing byte-identically, and
+# the reader accepts both versions.
+PARK_FORMAT_V2 = 'automerge-tpu-parked-docs@2'
 
 
 def write_park_shard(path, docs):
     """Persist one eviction batch's parked docs as a checksummed shard:
     ``docs`` is ``{doc_id: payload}`` where each payload carries the
-    doc's full change history (``changes``), buffered ``queued``
-    changes, ``clock`` and an optional ``quarantine`` record. Written
-    atomically — a parked doc's shard is the doc's ONLY durable copy
-    once a checkpoint snapshots the fleet without it."""
+    doc's ``clock``, buffered ``queued`` changes, an optional
+    ``quarantine`` record and either its full change history
+    (``changes``) or a base64-armored state snapshot (``state`` — the
+    tiered form for compacted docs). Written atomically — a parked
+    doc's shard is the doc's ONLY durable copy once a checkpoint
+    snapshots the fleet without it. Full-log-only shards keep the v1
+    format stamp (byte-compatible with pre-tier readers)."""
+    tiered = any(isinstance(p, dict) and p.get('state') is not None
+                 for p in docs.values())
     atomic_write_bytes(path, pack_snapshot(json.dumps(
-        {'format': PARK_FORMAT, 'docs': docs},
+        {'format': PARK_FORMAT_V2 if tiered else PARK_FORMAT,
+         'docs': docs},
         separators=(',', ':'))))
 
 
 def read_park_shard(path):
-    """Load a :func:`write_park_shard` artifact; returns the
-    ``{doc_id: payload}`` map. Raises
+    """Load a :func:`write_park_shard` artifact (either container
+    version); returns the ``{doc_id: payload}`` map. Raises
     :class:`~automerge_tpu.snapshot.SnapshotCorruptError` naming the
     failure on truncation/bit rot/format mismatch."""
     with open(path, 'rb') as f:
@@ -145,7 +157,8 @@ def read_park_shard(path):
     except ValueError as err:
         raise SnapshotCorruptError(
             f'park shard is not valid JSON ({err})') from None
-    if not isinstance(obj, dict) or obj.get('format') != PARK_FORMAT:
+    if not isinstance(obj, dict) or \
+            obj.get('format') not in (PARK_FORMAT, PARK_FORMAT_V2):
         raise SnapshotCorruptError('not a parked-docs shard')
     docs = obj.get('docs')
     if not isinstance(docs, dict):
@@ -364,6 +377,26 @@ class DurableDocSet:
 
     applyWire = apply_wire
 
+    def apply_states(self, payload_by_doc):
+        """WAL the state-bootstrap path (tiered doc storage): an
+        absorbed state snapshot must survive a crash exactly like an
+        acknowledged change — the binary payloads journal
+        base64-armored and replay through ``apply_states`` on
+        recover."""
+        import base64
+        self.journal.append(
+            {'states': {doc_id: base64.b64encode(
+                bytes(payload)).decode('ascii')
+                for doc_id, payload in payload_by_doc.items()}})
+        return self.doc_set.apply_states(payload_by_doc)
+
+    applyStates = apply_states
+
+    def apply_state(self, doc_id, payload):
+        return self.apply_states({doc_id: payload}).get(doc_id)
+
+    applyState = apply_state
+
     def checkpoint(self):
         """Atomic fleet checkpoint: packed snapshot to a tmp file,
         fsync, rename, THEN journal truncate — a crash between the two
@@ -407,7 +440,15 @@ class DurableDocSet:
         n_replayed = 0
         for record, end in ChangeJournal._scan(journal_path):
             n_replayed += 1
-            if 'wire' in record or 'wireb64' in record:
+            if 'states' in record:
+                # state-bootstrap records (tiered doc storage) replay
+                # through the same absorb path; apply_states isolates
+                # per doc internally
+                import base64
+                doc_set.apply_states(
+                    {doc_id: base64.b64decode(b64)
+                     for doc_id, b64 in record['states'].items()})
+            elif 'wire' in record or 'wireb64' in record:
                 # wire-path record: replay the raw payload through the
                 # fused path; a poisoned doc falls back to the dict
                 # batch under per-doc isolation (the fused apply rolls

@@ -64,7 +64,10 @@ def assert_digest_parity(doc_set):
     recompute over the retained log, for every doc of a general-store
     doc set — the maintenance-correctness oracle the chaos schedules
     run after converging (no-op for doc sets without digests, or for
-    snapshot-truncated stores whose history cannot be recomputed)."""
+    snapshot-truncated stores whose history cannot be recomputed).
+    COMPACTED stores stay checkable: the recompute starts from the
+    digest recorded at each doc's horizon and folds only the retained
+    tail, so the oracle survives the bodies being folded away."""
     store = getattr(doc_set, 'store', None)
     if store is None or not hasattr(store, 'digests_all'):
         return

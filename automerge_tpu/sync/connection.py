@@ -92,6 +92,60 @@ def validate_msg(msg):
     if snapshot is not None and not isinstance(snapshot, (str, bytes)):
         _reject(f'snapshot payload is not str/bytes: '
                 f'{type(snapshot).__name__}')
+    state = msg.get('state')
+    if state is not None and \
+            not isinstance(state, (bytes, bytearray)):
+        _reject(f'state payload is not bytes: '
+                f'{type(state).__name__}')
+    return msg
+
+
+def validate_state_msg(msg):
+    """Validate the multi-doc STATE bootstrap message (tiered doc
+    storage): ``state`` the format version (1); ``docs`` a non-empty
+    list of doc-id strings; ``clocks`` the aligned HORIZON clocks;
+    ``lens`` the aligned per-doc payload byte lengths (one state
+    snapshot per doc); ``blob`` their concatenation. Payload CONTENT
+    is covered by the envelope CRC and its own checksummed container;
+    a corrupt payload quarantines only its doc at absorb time."""
+    if not isinstance(msg, dict):
+        _reject(f'state message is {type(msg).__name__}, not a dict')
+    if msg.get('state') != 1 or isinstance(msg.get('state'), bool):
+        _reject(f"state version is not 1: {msg.get('state')!r}")
+    docs = msg.get('docs')
+    if not isinstance(docs, (list, tuple)) or not docs:
+        _reject(f'state docs is not a non-empty list: {docs!r}')
+    for doc_id in docs:
+        if not isinstance(doc_id, str):
+            _reject(f'state doc id is not a string: {doc_id!r}')
+    clocks = msg.get('clocks')
+    if not isinstance(clocks, (list, tuple)) or \
+            len(clocks) != len(docs):
+        _reject('state clocks is not a list aligned with docs')
+    for clock in clocks:
+        if not isinstance(clock, dict):
+            _reject(f'state clock is not a dict: '
+                    f'{type(clock).__name__}')
+        for actor, seq in clock.items():
+            if not isinstance(actor, str) or not isinstance(seq, int) \
+                    or isinstance(seq, bool) or seq < 0:
+                _reject(f'state clock entry {actor!r}: {seq!r} is '
+                        f'not str -> non-negative int')
+    lens = msg.get('lens')
+    if not isinstance(lens, (list, tuple)) or len(lens) != len(docs):
+        _reject('state lens is not a list aligned with docs')
+    total = 0
+    for ln in lens:
+        if not isinstance(ln, int) or isinstance(ln, bool) or ln <= 0:
+            _reject(f'state payload length is not a positive int: '
+                    f'{ln!r}')
+        total += ln
+    blob = msg.get('blob')
+    if not isinstance(blob, (bytes, bytearray)):
+        _reject(f'state blob is not bytes: {type(blob).__name__}')
+    if len(blob) != total:
+        _reject(f'state blob carries {len(blob)} bytes, lens claim '
+                f'{total}')
     return msg
 
 
@@ -179,6 +233,12 @@ def validate_wire_msg(msg):
     return msg
 
 
+# highest state-bootstrap message version this build speaks (tiered
+# doc storage): a peer advertises its own via `maxs` on every wire/
+# state message, and 'state' payloads only ship to peers that did —
+# un-advertised (old) peers fall back to the legacy snapshot path
+STATE_VERSION = 1
+
 # highest wire-blob format this build speaks: 2 = columnar binary
 # spans + shared literal tables (JSON-free receive path); 1 = the
 # PR 5 JSON-blob spans, kept for mixed-fleet interop and pinnable via
@@ -259,7 +319,7 @@ class Connection:
                 changes = _backend_of(doc).get_missing_changes(
                     state, self._their_clock[doc_id])
             except ValueError as err:
-                self._send_snapshot(doc_id, doc, clock, err)
+                self._send_snapshot(doc_id, clock, err)
                 return
             if changes:
                 self._their_clock = clock_union(self._their_clock, doc_id, clock)
@@ -269,11 +329,37 @@ class Connection:
         if clock != self._our_clock.get(doc_id, {}):
             self.send_msg(doc_id, clock)
 
-    def _send_snapshot(self, doc_id, doc, clock, original_err):
-        """Serve a too-far-behind peer the packed state itself. Only
-        device-backend documents carry a servable packed snapshot; for
-        other backends the original (clear) error propagates."""
+    def _send_snapshot(self, doc_id, clock, original_err):
+        """Serve a too-far-behind peer the packed state itself: a
+        compacted doc's per-doc STATE snapshot when the doc set holds
+        one (tiered doc storage — the peer absorbs it and the normal
+        protocol serves the tail), else the per-document packed
+        snapshot of device-backend documents; for everything else the
+        original (clear) error propagates."""
         from .. import snapshot as _snapshot
+        serve = getattr(self._doc_set, 'serve_state_payload', None)
+        if serve is not None:
+            got = serve(doc_id)
+            if got is not None:
+                payload, h_clock = got
+                # assume delivery up to the horizon (the resilient
+                # shell rolls this back if the envelope dies); the
+                # receiver's next advert pulls the tail
+                clock_union(self._their_clock, doc_id, h_clock)
+                clock_union(self._our_clock, doc_id, clock)
+                self.metrics.bump('sync_msgs_sent')
+                self.metrics.bump('sync_state_msgs_sent')
+                if self.metrics.active:
+                    self.metrics.emit('sync_send', doc_id=doc_id,
+                                      changes=0, state=True)
+                with self.metrics.trace_span('sync.send',
+                                             doc_id=doc_id,
+                                             state=True):
+                    self._send_msg({'docId': doc_id,
+                                    'clock': dict(clock),
+                                    'state': payload})
+                return
+        doc = self._doc_set.get_doc(doc_id)
         try:
             payload = _snapshot.save_snapshot(doc)
         except TypeError:
@@ -315,6 +401,8 @@ class Connection:
             self._their_clock = clock_union(self._their_clock, msg['docId'], msg['clock'])
         if 'snapshot' in msg:
             return self._receive_snapshot(msg)
+        if 'state' in msg and msg['state'] is not None:
+            return self._receive_state(msg)
         if 'changes' in msg and msg['changes'] is not None:
             return self._doc_set.apply_changes(msg['docId'], msg['changes'])
 
@@ -326,6 +414,20 @@ class Connection:
             self.send_msg(msg['docId'], {})
 
         return self._doc_set.get_doc(msg['docId'])
+
+    def _receive_state(self, msg):
+        """Absorb a served per-doc state snapshot (tiered doc
+        storage), then advertise the doc's new clock so the sender
+        ships the retained tail through the normal protocol."""
+        doc_id = msg['docId']
+        apply_state = getattr(self._doc_set, 'apply_state', None)
+        if apply_state is None:
+            _reject(f'state payload for {doc_id!r} but this doc set '
+                    f'cannot absorb state snapshots')
+        self.metrics.bump('sync_state_msgs_received')
+        out = apply_state(doc_id, msg['state'])
+        self.maybe_send_changes(doc_id)
+        return out
 
     def _receive_snapshot(self, msg):
         """Resume from a served snapshot, then replay any LOCAL changes
@@ -554,8 +656,14 @@ class WireConnection(BatchingConnection):
         # ships, costing zero v1 round-trips.
         self.wire_version = wire_version
         self._peer_wire_version = 1
+        # state-bootstrap capability (tiered doc storage): `maxs`
+        # rides every outgoing wire/state message exactly like `maxv`;
+        # a peer that never advertises it (an old build) gets the
+        # legacy snapshot fallback instead of 'state' messages
+        self._peer_state_version = 0
         self._pending_send = {}       # doc_id -> None (insertion order)
         self._incoming_wire = []
+        self._incoming_state = []
 
     def open(self):
         """Advertise every doc WITHOUT materializing handles: the wire
@@ -586,18 +694,30 @@ class WireConnection(BatchingConnection):
     docChanged = doc_changed
 
     def receive_msg(self, msg):
+        if isinstance(msg, dict) and 'state' in msg \
+                and 'docs' in msg:
+            # multi-doc state bootstrap: clock bookkeeping now (the
+            # horizon clocks are the sender's proven floor), payloads
+            # buffered and absorbed at flush BEFORE any buffered data
+            # — the tail in the same tick lands on absorbed state
+            validate_state_msg(msg)
+            self._note_peer_caps(msg)
+            self.metrics.bump('sync_msgs_received')
+            self.metrics.bump('sync_state_msgs_received')
+            for doc_id, clock in zip(msg['docs'], msg['clocks']):
+                self._their_clock = clock_union(self._their_clock,
+                                                doc_id, clock)
+            self._incoming_state.append(msg)
+            return None
         if isinstance(msg, dict) and 'wire' in msg:
             validate_wire_msg(msg)
+            self._note_peer_caps(msg)
             if msg['wire'] > self.wire_version:
                 # a peer shipped a format newer than this side speaks —
                 # reject loudly (a conforming sender never does this:
                 # it pins to the receiver's advertised maxv)
                 _reject(f"wire version {msg['wire']} not spoken here "
                         f"(max {self.wire_version})")
-            maxv = msg.get('maxv')
-            if isinstance(maxv, int) and not isinstance(maxv, bool) \
-                    and maxv > self._peer_wire_version:
-                self._peer_wire_version = min(maxv, self.wire_version)
             self.metrics.bump('sync_msgs_received')
             self.metrics.bump('sync_wire_msgs_received')
             if msg['wire'] >= 2:
@@ -625,21 +745,57 @@ class WireConnection(BatchingConnection):
 
     receiveMsg = receive_msg
 
+    def _note_peer_caps(self, msg):
+        """Fold the negotiation stamps a peer's message carries:
+        ``maxv`` (highest wire-blob format it speaks) and ``maxs``
+        (highest state-bootstrap version) — the in-band capability
+        advertisement every wire/state message repeats."""
+        maxv = msg.get('maxv')
+        if isinstance(maxv, int) and not isinstance(maxv, bool) \
+                and maxv > self._peer_wire_version:
+            self._peer_wire_version = min(maxv, self.wire_version)
+        maxs = msg.get('maxs')
+        if isinstance(maxs, int) and not isinstance(maxs, bool) \
+                and maxs > self._peer_state_version:
+            self._peer_state_version = min(maxs, STATE_VERSION)
+
     def _flush_pending(self):
         return bool(self._incoming or self._incoming_wire
-                    or self._pending_send)
+                    or self._incoming_state or self._pending_send)
 
     def _flush_work(self):
-        """Apply the tick's buffered data (dict messages through the
-        batched dict path, wire blobs through ONE fused apply_wire),
-        then assemble and ship the single outgoing multi-doc wire
-        message the tick's ``doc_changed`` follow-ups asked for.
-        Returns {doc_id: doc} for the docs that changed — the body
+        """Apply the tick's buffered data: state bootstraps absorb
+        FIRST (the tail buffered in the same tick lands on absorbed
+        state), then dict messages through the batched dict path and
+        wire blobs through ONE fused apply_wire; finally assemble and
+        ship the single outgoing multi-doc wire message the tick's
+        ``doc_changed`` follow-ups asked for. Returns {doc_id: doc}
+        for the docs that changed — the body
         :meth:`BatchingConnection.flush` times and traces."""
-        out = self._flush_data()
+        out = self._flush_state()
+        out.update(self._flush_data())
         out.update(self._flush_wire())
         self._flush_outgoing()
         return out
+
+    def _flush_state(self):
+        """Absorb the tick's buffered state-bootstrap payloads in one
+        batched ``apply_states`` (per-doc fault isolation inside)."""
+        if not self._incoming_state:
+            return {}
+        payloads = {}                  # doc_id -> latest payload
+        for msg in self._incoming_state:
+            blob, lens = msg['blob'], msg['lens']
+            pos = 0
+            for doc_id, ln in zip(msg['docs'], msg['lens']):
+                payloads[doc_id] = bytes(blob[pos:pos + ln])
+                pos += ln
+        self._incoming_state = []
+        apply_states = getattr(self._doc_set, 'apply_states', None)
+        if apply_states is None:
+            self.metrics.bump('sync_msgs_rejected')
+            return {}
+        return apply_states(payloads)
 
     def _flush_wire(self):
         """Merge the buffered wire blobs per document and apply in one
@@ -737,6 +893,65 @@ class WireConnection(BatchingConnection):
                 changes_by_doc, isolate=True)
         return dict(zip(doc_ids, handles))
 
+    def _serve_state_bootstraps(self, served, errors, version):
+        """The horizon answer of the serve path: docs whose requester
+        clock predates the compaction horizon
+        (:class:`~automerge_tpu.device.blocks.HorizonTruncated` in
+        ``errors``) ship their recorded per-doc state snapshot in ONE
+        ``'state'`` message, and their retained TAIL is re-served
+        from the horizon clock into the tick's normal data message —
+        cold-peer bootstrap lands in a single tick, O(state +
+        divergence). Peers that never advertised ``maxs`` keep the
+        legacy snapshot fallback (their error stays put)."""
+        from ..device.blocks import HorizonTruncated
+        if self._peer_state_version < 1:
+            return
+        store = self._doc_set.store
+        ids = self._doc_set.ids
+        horizon = getattr(store, 'horizon', None) or {}
+        boot = {}
+        for idx, err in list(errors.items()):
+            rec = horizon.get(idx)
+            if isinstance(err, HorizonTruncated) and rec is not None \
+                    and rec.get('state') is not None:
+                boot[idx] = rec
+                del errors[idx]
+        if not boot:
+            return
+        tail_served, tail_errors = store.get_missing_changes_wire_batch(
+            [(idx, rec['clock']) for idx, rec in boot.items()],
+            version=version)
+        served.update(tail_served)
+        errors.update(tail_errors)
+        docs, clocks, lens, chunks = [], [], [], []
+        for idx, rec in boot.items():
+            if idx in tail_errors:
+                continue
+            doc_id = ids[idx]
+            docs.append(doc_id)
+            clocks.append(dict(rec['clock']))
+            lens.append(len(rec['state']))
+            chunks.append(rec['state'])
+            # assume delivery up to the horizon (the resilient shell
+            # rolls this back when the envelope dies), so the next
+            # tick never re-ships the same snapshot
+            clock_union(self._their_clock, doc_id, rec['clock'])
+            clock_union(self._our_clock, doc_id, rec['clock'])
+        if not docs:
+            return
+        blob = b''.join(chunks)
+        msg = {'state': 1, 'docs': docs, 'clocks': clocks,
+               'lens': lens, 'blob': blob, 'maxs': STATE_VERSION}
+        if self.wire_version >= 2:
+            msg['maxv'] = self.wire_version
+        self.metrics.bump('sync_msgs_sent')
+        self.metrics.bump('sync_state_msgs_sent')
+        self.metrics.bump('sync_wire_bytes_sent', len(blob))
+        if self.metrics.active:
+            self.metrics.emit('sync_state_send', docs=len(docs),
+                              blob_bytes=len(blob))
+        self._send_msg(msg)
+
     def _flush_outgoing(self):
         """Assemble and ship the tick's single multi-doc wire message:
         cached change encodings for peers behind on data, zero-change
@@ -797,6 +1012,8 @@ class WireConnection(BatchingConnection):
                         for e in blobs))
         else:
             served, errors = {}, {}
+        if errors:
+            self._serve_state_bootstraps(served, errors, version)
         docs, clocks, counts, chunks = [], [], [], []
         blob_bytes = 0
         data_docs = 0
@@ -819,9 +1036,7 @@ class WireConnection(BatchingConnection):
             if clock is None:
                 clock = clock_of(idx)
             if idx in errors:
-                self._send_snapshot(
-                    doc_id, self._doc_set.get_doc(doc_id), clock,
-                    errors[idx])
+                self._send_snapshot(doc_id, clock, errors[idx])
                 continue
             blobs = served.get(idx)
             if blobs:
@@ -884,6 +1099,7 @@ class WireConnection(BatchingConnection):
             payload_bytes = len(blob)
         if self.wire_version >= 2:
             msg['maxv'] = self.wire_version
+        msg['maxs'] = STATE_VERSION
         self.metrics.bump('sync_msgs_sent')
         self.metrics.bump('sync_wire_msgs_sent')
         self.metrics.bump('sync_changes_sent', len(lens))

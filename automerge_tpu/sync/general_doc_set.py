@@ -379,6 +379,21 @@ class GeneralDocSet:
             else [d for d in doc_ids if d in self.quarantined]
         out = {}
         for doc_id in targets:
+            held_state = self.quarantined[doc_id].get('state')
+            if held_state is not None:
+                # a state-bootstrap hold: re-attempt the absorb (a
+                # truly corrupt payload fails again and stays held
+                # with the fresh error — never a trivial clear over a
+                # still-empty doc)
+                self.quarantined.pop(doc_id, None)
+                got = self.apply_states({doc_id: held_state})
+                if doc_id in got:
+                    out[doc_id] = got[doc_id]
+                    if _metrics.active:
+                        _metrics.emit('doc_quarantine_cleared',
+                                      doc_id=doc_id,
+                                      superseded=False)
+                continue
             idx = self.id_of.get(doc_id)
             clock = self.store.clock_of(idx) if idx is not None else {}
             pending = [c for c in self.quarantined[doc_id]['changes']
@@ -534,16 +549,132 @@ class GeneralDocSet:
 
     clearDivergence = clear_divergence
 
+    # -- tiered doc storage: state-snapshot bootstrap ------------------------
+
+    def serve_state_payload(self, doc_id):
+        """``(state_payload_bytes, horizon_clock)`` for a compacted
+        doc — what the sync layer ships to a peer whose clock predates
+        the horizon — or None when the doc has no horizon record (its
+        full history is servable). The payload is the snapshot
+        recorded at the fold point, served to any number of cold peers
+        with zero re-extraction (the state twin of the per-change
+        encode cache)."""
+        idx = self.id_of.get(doc_id)
+        if idx is None:
+            return None
+        rec = self.store.horizon.get(idx)
+        if rec is None or rec.get('state') is None:
+            return None
+        return rec['state'], dict(rec['clock'])
+
+    serveStatePayload = serve_state_payload
+
+    def apply_state(self, doc_id, payload):
+        """Absorb one doc's state-snapshot payload (see
+        :meth:`apply_states`)."""
+        return self.apply_states({doc_id: payload}).get(doc_id)
+
+    applyState = apply_state
+
+    def apply_states(self, payload_by_doc):
+        """Bootstrap documents from encoded state snapshots (the
+        receive side of the ``'state'`` sync message, and the park-
+        shard/journal restore path): each payload absorbs into the
+        store in one bulk pass — columnar planes, insertion trees,
+        clock, causal-closure rows and the recorded digest — and the
+        doc's horizon record is installed so this replica can serve
+        further cold peers from the same snapshot.
+
+        An empty local doc absorbs directly. A doc whose clock the
+        snapshot already covers keeps its (superset) state — the stale
+        ship drops. A doc holding changes CONCURRENT with the snapshot
+        replays like the dict path's snapshot resume: local-only
+        changes are collected, the doc's state drops, the snapshot
+        absorbs, and the local changes re-apply on top. Faults
+        isolate per document (a corrupt payload quarantines the doc,
+        never the tick); returns ``{doc_id: handle}`` for the docs
+        touched."""
+        from .. import compaction as _compaction
+        store = self.store
+        absorb = []                    # (idx, payload, decoded)
+        replace = []                   # (idx, payload, decoded, local)
+        out = {}
+        for doc_id, payload in payload_by_doc.items():
+            idx = self._index(doc_id, create=True)
+            try:
+                decoded = _compaction.decode_state_snapshot(payload)
+                have = store.clock_of(idx)
+                sclock = decoded['clock']
+                if have and _covers(have, sclock):
+                    out[doc_id] = self.get_doc(doc_id)
+                    continue           # stale ship: local is a superset
+                if not have:
+                    absorb.append((idx, payload, decoded))
+                else:
+                    # concurrent local state: keep what the snapshot
+                    # does not cover, replace the rest (raises the
+                    # clear both-truncated error when local history
+                    # below the snapshot clock is itself gone)
+                    local_only = store.get_missing_changes(idx, sclock)
+                    replace.append((idx, payload, decoded, local_only))
+            except Exception as err:
+                # keep the PAYLOAD in the hold (the change path keeps
+                # its changes): retry_quarantined re-attempts the
+                # absorb for real instead of trivially clearing an
+                # empty change list over a still-empty doc
+                self.quarantined[doc_id] = {'error': repr(err),
+                                            'changes': [],
+                                            'state': bytes(payload)}
+                _metrics.bump('sync_docs_quarantined')
+                if _metrics.active:
+                    _metrics.emit('doc_quarantined', doc_id=doc_id,
+                                  error=repr(err))
+        if replace:
+            self.drop_doc_state([self.ids[i]
+                                 for i, _, _, _ in replace])
+        items = absorb + [(i, p, dec) for i, p, dec, _ in replace]
+        if items:
+            # drop_doc_state REBUILDS the store object: absorb into
+            # the current one, not the pre-drop reference
+            _compaction.absorb_doc_states(self.store, items)
+            _metrics.bump('sync_state_bootstraps', len(items))
+        local_re = {self.ids[i]: ch
+                    for i, _, _, ch in replace if ch}
+        if local_re:
+            self.apply_changes_batch(local_re, isolate=True)
+        if items and self.store.queue:
+            # causally-buffered tail changes that raced ahead of the
+            # state ship merge now instead of waiting for unrelated
+            # traffic
+            queued_docs = {d for d, _ in self.store.queue}
+            kick = {self.ids[i]: [] for i, _, _ in items
+                    if i in queued_docs}
+            if kick:
+                self.apply_changes_batch(kick)
+        for idx, _, _ in items:
+            doc_id = self.ids[idx]
+            doc = out[doc_id] = self.get_doc(doc_id)
+            for handler in list(self.handlers):
+                handler(doc_id, doc)
+        return out
+
+    applyStates = apply_states
+
     # -- cold-doc eviction mechanism (policy lives in ServingDocSet) --------
 
     def extract_doc_state(self, doc_ids):
-        """The parkable state of each doc in ``doc_ids``: its FULL
-        retained change history (admission order — re-applying it
-        deterministically reproduces the doc, byte-identical), any
-        causally-buffered queued changes, and its clock. Raises the
-        store's retention/truncation ValueError when the history is not
-        fully servable (a snapshot-resumed store cannot park such a
-        doc — its pre-resume change bodies are gone)."""
+        """The parkable state of each doc in ``doc_ids``: any
+        causally-buffered queued changes, the clock/digest, and either
+        its FULL retained change history (admission order —
+        re-applying it deterministically reproduces the doc,
+        byte-identical) or, when the history is not fully servable (a
+        compacted doc, or a snapshot-resumed truncated log with
+        compaction available), a freshly-extracted STATE snapshot
+        (``'state'``, base64-armored for the JSON shard container) —
+        the ``state + tail`` park tier. Raises the store's
+        retention ValueError only when neither tier can represent the
+        doc."""
+        import base64
         store = self.store
         store._commit_pending()
         store.pool.sync()
@@ -554,17 +685,29 @@ class GeneralDocSet:
                 queued.setdefault(d, []).append(ch)
         digests_ok = getattr(store, '_digest_valid', False)
         out = {}
+        state_docs = []
         for doc_id in doc_ids:
             idx = self.id_of[doc_id]
-            out[doc_id] = {
+            rec = {
                 'doc_id': doc_id,
                 'clock': store.clock_of(idx),
-                'changes': store.get_missing_changes(idx, {}),
                 'queued': queued.get(idx, []),
                 # the recorded digest keeps the divergence audit (and
                 # its heartbeat advertisement) truthful while the doc
                 # is parked; fault-in refolds it from the replay
                 'digest': store.digest_of(idx) if digests_ok else None}
+            if idx in store.horizon or store.log_truncated:
+                state_docs.append((doc_id, idx))
+            else:
+                rec['changes'] = store.get_missing_changes(idx, {})
+            out[doc_id] = rec
+        if state_docs:
+            from .. import compaction as _compaction
+            states = _compaction.extract_doc_states(
+                store, [idx for _, idx in state_docs])
+            for doc_id, idx in state_docs:
+                out[doc_id]['state'] = base64.b64encode(
+                    states[idx]['state']).decode('ascii')
         return out
 
     def drop_doc_state(self, doc_ids, chunk_docs=512):
@@ -584,12 +727,24 @@ class GeneralDocSet:
         old.pool.sync()
         new_store = _general.init_store(self.capacity)
         resident = [i for i in range(len(self.ids)) if i not in drop]
+        # compacted survivors restore tiered: their state-at-horizon
+        # absorbs wholesale (no pre-horizon bodies exist to replay),
+        # then the retained TAIL re-applies on top like any other log
+        compacted = [i for i in resident if i in old.horizon]
+        if compacted:
+            from .. import compaction as _compaction
+            _compaction.absorb_doc_states(
+                new_store,
+                [(i, old.horizon[i]['state'], None)
+                 for i in compacted])
+        horizon_clock = {i: old.horizon[i]['clock'] for i in compacted}
         for start in range(0, len(resident), chunk_docs):
             batch = resident[start:start + chunk_docs]
             per_doc = [[] for _ in range(max(batch) + 1)]
             any_changes = False
             for i in batch:
-                changes = old.get_missing_changes(i, {})
+                changes = old.get_missing_changes(
+                    i, horizon_clock.get(i, {}))
                 if changes:
                     per_doc[i] = changes
                     any_changes = True
@@ -610,8 +765,10 @@ class GeneralDocSet:
         new_store._apply_seq = max(old._apply_seq,
                                    new_store._apply_seq)
         # the rebuild refolded surviving docs' digests from their
-        # replayed logs; an invalid source history stays invalid
-        new_store._digest_valid = old._digest_valid
+        # replayed logs (and absorbed horizon digests); an invalid
+        # source history stays invalid either way
+        new_store._digest_valid = (old._digest_valid and
+                                   new_store._digest_valid)
         new_store.adopt_wire_cache(old, drop_docs=drop)
         self.store = new_store
         for i in drop:
@@ -706,6 +863,12 @@ class GeneralDocSet:
                 counters.get('mem_device_plane_peak_bytes', 0),
             'wire_cache_bytes': getattr(store, '_wire_cache_bytes',
                                         0),
+            # tiered doc storage: resident bytes of the per-doc
+            # horizon state snapshots (the fold target history
+            # compaction shrinks everything else into)
+            'state_snapshot_bytes': store.state_snapshot_bytes()
+            if hasattr(store, 'state_snapshot_bytes') else 0,
+            'compacted_docs': len(getattr(store, 'horizon', ())),
             'journal_bytes': counters.get('mem_journal_bytes', 0),
             'park_shard_bytes': counters.get('mem_park_shard_bytes',
                                              0)}

@@ -159,7 +159,8 @@ def _payload_cost(payload):
     known without an encode — the change meter is the binding one
     there). Advertisements/requests cost nothing: the repair loop must
     never be throttled."""
-    if 'wire' in payload:
+    if 'wire' in payload or ('state' in payload
+                             and 'docs' in payload):
         n_bytes = 0
         for field in ('blob', 'tab'):
             part = payload.get(field)
@@ -167,8 +168,10 @@ def _payload_cost(payload):
                 n_bytes += len(part)
         return (sum(payload.get('counts') or ()), n_bytes)
     changes = payload.get('changes')
+    state = payload.get('state')
     return (len(changes) if isinstance(changes, (list, tuple)) else 0,
-            0)
+            len(state) if isinstance(state, (bytes, bytearray))
+            else 0)
 
 
 def payload_checksum(payload):
@@ -177,15 +180,17 @@ def payload_checksum(payload):
     regardless of dict ordering.
 
     A WIRE data message carries its change payload as a binary
-    ``blob`` (and, v2, a binary literal-table ``tab``): those bytes
+    ``blob`` (and, v2, a binary literal-table ``tab``); state
+    bootstraps carry their per-doc snapshot payloads as a binary
+    ``blob`` (multi-doc) or ``state`` (dict-path) field: those bytes
     are checksummed DIRECTLY (CRC32 over the raw bytes, folded into
-    the header checksum as ``blob_crc32``/``tab_crc32``) instead of
-    riding through ``json.dumps`` — integrity for megabytes of change
-    data at memcpy speed, and the reason corrupt-blob envelopes are
-    caught before the codec ever parses them. A v1 message (no tab)
+    the header checksum as ``<field>_crc32``) instead of riding
+    through ``json.dumps`` — integrity for megabytes of change data
+    at memcpy speed, and the reason corrupt-blob envelopes are caught
+    before the codec ever parses them. A v1 message (no tab)
     checksums byte-identically to the pre-v2 protocol."""
     if isinstance(payload, dict):
-        binary = {f: payload[f] for f in ('blob', 'tab')
+        binary = {f: payload[f] for f in ('blob', 'tab', 'state')
                   if isinstance(payload.get(f), (bytes, bytearray))}
         if binary:
             head = {k: v for k, v in payload.items()
@@ -492,14 +497,19 @@ class ResilientConnection:
         if not isinstance(payload, dict):
             return
         their = self._conn._their_clock
-        if 'wire' in payload:
+        if 'state' in payload and 'docs' in payload:
+            # every span of a state-bootstrap message is data
+            for doc_id in payload.get('docs') or ():
+                their.pop(doc_id, None)
+        elif 'wire' in payload:
             for doc_id, count in zip(payload.get('docs') or (),
                                      payload.get('counts') or ()):
                 if count:
                     their.pop(doc_id, None)
         elif 'docId' in payload and (
                 payload.get('changes') is not None or
-                payload.get('snapshot') is not None):
+                payload.get('snapshot') is not None or
+                payload.get('state') is not None):
             their.pop(payload['docId'], None)
 
     # -- replication lag / convergence ---------------------------------------
@@ -517,7 +527,8 @@ class ResilientConnection:
         if not isinstance(payload, dict):
             return
         docs = []
-        if 'wire' in payload:
+        if 'wire' in payload or ('state' in payload
+                                 and 'docs' in payload):
             for doc_id, clock in zip(payload.get('docs') or (),
                                      payload.get('clocks') or ()):
                 if isinstance(doc_id, str) and isinstance(clock, dict):
@@ -725,7 +736,8 @@ class ResilientConnection:
         next flush (0 for the eager flavor, which buffers nothing)."""
         conn = self._conn
         return (len(getattr(conn, '_incoming', ())) +
-                len(getattr(conn, '_incoming_wire', ())))
+                len(getattr(conn, '_incoming_wire', ())) +
+                len(getattr(conn, '_incoming_state', ())))
 
     def _receive_busy(self, env):
         """The peer's admission valve deferred our data envelope:

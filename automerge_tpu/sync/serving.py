@@ -120,7 +120,8 @@ class ServingDocSet:
     def __init__(self, doc_set, dir_path, memory_budget_bytes=None,
                  low_watermark=0.75, check_every=32, shard_docs=64,
                  park_quarantined_after=None,
-                 park_quarantined_bytes=None, flight_recorder=None):
+                 park_quarantined_bytes=None, flight_recorder=None,
+                 auto_compact=True):
         inner = getattr(doc_set, 'doc_set', doc_set)
         if not isinstance(inner, GeneralDocSet):
             raise TypeError(
@@ -138,6 +139,13 @@ class ServingDocSet:
         self.shard_docs = shard_docs
         self.park_quarantined_after = park_quarantined_after
         self.park_quarantined_bytes = park_quarantined_bytes
+        # tiered doc storage: with auto_compact, a snapshot-resumed
+        # (truncated-log) store compacts on the first eviction need —
+        # per-doc state snapshots + horizon replace the full log, so
+        # eviction parks `state + tail` shards instead of refusing.
+        # auto_compact=False keeps the PR 6 loud refusal
+        # (serving_evictions_blocked_truncated).
+        self.auto_compact = auto_compact
         self._tick = 0
         self._last_touch = {}          # doc_id -> last-touch tick
         self._evicted = {}             # doc_id -> {'clock', 'error'}
@@ -355,6 +363,7 @@ class ServingDocSet:
                          docs=list(doc_ids[:64]))
 
     def _fault_in_traced(self, doc_ids):
+        import base64
         inner = self.inner
         store = inner.store
         by_shard = {}
@@ -370,13 +379,31 @@ class ServingDocSet:
                    range(max(inner.id_of[d] for d in doc_ids) + 1)]
         queued = []
         quarantines = {}
+        absorb = []                    # tiered (state-form) payloads
+        merge_states = {}              # state payloads over partial docs
         for doc_id, payload in payloads.items():
             idx = inner.id_of[doc_id]
-            per_doc[idx] = list(payload.get('changes') or ())
+            state_b64 = payload.get('state')
+            if state_b64 is not None:
+                raw = base64.b64decode(state_b64)
+                if store.clock_of(idx):
+                    # journal replay landed partial post-eviction
+                    # state before this fault-in: the absorb-or-
+                    # replace logic of apply_states reconciles
+                    merge_states[doc_id] = raw
+                else:
+                    absorb.append((idx, raw, None))
+            else:
+                per_doc[idx] = list(payload.get('changes') or ())
             queued.extend((idx, ch)
                           for ch in payload.get('queued') or ())
             if payload.get('quarantine'):
                 quarantines[doc_id] = payload['quarantine']
+        if absorb:
+            from ..compaction import absorb_doc_states
+            absorb_doc_states(store, absorb)
+        if merge_states:
+            inner.apply_states(merge_states)
         if any(per_doc):
             block = store.encode_changes(per_doc,
                                          n_docs=inner.capacity)
@@ -454,10 +481,19 @@ class ServingDocSet:
         if total <= self.memory_budget_bytes:
             return
         if inner.store.log_truncated:
-            # a snapshot-resumed store cannot rebuild a parked doc's
-            # history — eviction is off until the log is whole again
-            metrics.bump('serving_evictions_blocked_truncated')
-            return
+            if self.auto_compact:
+                # fold the truncated history into per-doc state
+                # snapshots: the horizon + (empty) tail make every doc
+                # parkable as `state + tail`, and the store comes out
+                # fully servable — eviction proceeds below
+                from ..compaction import compact_docset
+                compact_docset(self)
+            else:
+                # a snapshot-resumed store cannot rebuild a parked
+                # doc's history — eviction is off until the log is
+                # whole again
+                metrics.bump('serving_evictions_blocked_truncated')
+                return
         target = int(self.memory_budget_bytes * self.low_watermark)
         quarantined = set(inner.quarantined)
         cands = []
@@ -582,6 +618,25 @@ class ServingDocSet:
         return out
 
     applyWire = apply_wire
+
+    def apply_states(self, payload_by_doc):
+        """State-bootstrap absorb is a touch: evicted targets fault in
+        first (the absorb-or-replace logic needs the doc's real local
+        state to reconcile against), then the write path runs under
+        the usual budget bookkeeping."""
+        doc_ids = list(payload_by_doc)
+        self.ensure_resident(doc_ids)
+        self._touch(doc_ids)
+        out = self.doc_set.apply_states(payload_by_doc)
+        self._after_write()
+        return out
+
+    applyStates = apply_states
+
+    def apply_state(self, doc_id, payload):
+        return self.apply_states({doc_id: payload}).get(doc_id)
+
+    applyState = apply_state
 
     def retry_quarantined(self, doc_ids=None):
         parked = [d for d in (doc_ids if doc_ids is not None

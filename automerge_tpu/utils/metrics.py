@@ -208,13 +208,32 @@ DEVICE_COUNTERS = (
     'mem_journal_peak_bytes', 'mem_park_shard_bytes',
     'mem_resident_peak_bytes')
 
+# Tiered-doc-storage counters (the compaction observability contract
+# — automerge_tpu/compaction.py and the 'state' sync message kind):
+#   compaction_runs            horizon advances (compact_docset calls)
+#   compaction_ops_folded      retained-log ops folded into per-doc
+#                              state snapshots (the bodies released)
+#   compaction_ms              observe series: wall time per fold
+#   mem_state_snapshot_bytes   gauge: resident bytes of the per-doc
+#                              horizon state snapshots
+#   sync_state_msgs_sent/_received  'state' bootstrap messages (the
+#                              O(state) answer to a peer whose clock
+#                              predates the horizon)
+#   sync_state_bootstraps      docs absorbed from a state snapshot
+#                              (cold-peer bootstraps, park fault-ins,
+#                              journal-replayed absorbs)
+COMPACTION_COUNTERS = (
+    'compaction_runs', 'compaction_ops_folded', 'compaction_ms',
+    'mem_state_snapshot_bytes', 'sync_state_msgs_sent',
+    'sync_state_msgs_received', 'sync_state_bootstraps')
+
 # Every registered counter/gauge/series name, in one tuple — the
 # telemetry exporter (automerge_tpu/telemetry.py) renders ALL of these
 # even when never bumped, and tests/test_metrics.py asserts none is
 # silently unexported.
 ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
                           SYNC_COUNTERS + CONVERGENCE_COUNTERS +
-                          DEVICE_COUNTERS)
+                          DEVICE_COUNTERS + COMPACTION_COUNTERS)
 
 # Observe-series name suffixes: a registered name ending in one of
 # these is a histogram series (count/sum/max + buckets), not a scalar

@@ -811,6 +811,159 @@ def bench_serving(n_docs=10240, list_ops=22, hot_docs=64, rounds=24,
             'evicted_frac': evicted_frac}
 
 
+def bench_cold_bootstrap(n_docs=10240, updates=48):
+    """BENCH_r06 lane — tiered doc storage: 10k-doc first contact,
+    full-history replay vs compacted state bootstrap. The fleet is
+    update-heavy (each doc: one small list, then ``updates``
+    overwrites of 6 root keys with ~40-char values) — history grows
+    per edit while state stays bounded, the shape compaction targets.
+    Both contacts run the SAME WireConnection v2 protocol; the second
+    runs after ``compact_docset`` folds the fleet, so data ships as
+    one 'state' message + tails instead of every change ever made.
+    Byte counts read ``sync_wire_bytes_sent`` (state blobs included),
+    and the bootstrapped replica is digest-verified against the
+    source doc for doc."""
+    import numpy as _np
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.compaction import compact_docset
+    from automerge_tpu.sync.connection import WireConnection
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+    from automerge_tpu.utils.metrics import metrics as _m
+
+    def mk(i):
+        obj = f'00000000-0000-4000-8000-{i:012x}'
+        ch = [{'actor': f'a{i}', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': f'a{i}:1',
+             'value': i}]}]
+        ch += [{'actor': f'a{i}', 'seq': s, 'deps': {},
+                'ops': [{'action': 'set', 'obj': ROOT_ID,
+                         'key': f'k{s % 6}',
+                         'value': f'{"pay" * 12}-{i}-{s}'}]}
+               for s in range(2, 2 + updates)]
+        return ch
+
+    src = GeneralDocSet(n_docs)
+    src.apply_changes_batch(
+        {f'doc{i}': mk(i) for i in range(n_docs)})
+    n_changes = n_docs * (updates + 1)
+
+    def contact():
+        dst = GeneralDocSet(1024)
+        msgs_a, msgs_b = [], []
+        ca = WireConnection(src, msgs_a.append)
+        cb = WireConnection(dst, msgs_b.append)
+        sent0 = _m.counters.get('sync_wire_bytes_sent', 0)
+        t0 = time.perf_counter()
+        ca.open()
+        cb.open()
+        for _ in range(64):
+            ca.flush()
+            if not (msgs_a or msgs_b):
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        dt = time.perf_counter() - t0
+        ca.close()
+        cb.close()
+        sent = _m.counters.get('sync_wire_bytes_sent', 0) - sent0
+        got = dst.get_doc(f'doc{n_docs - 1}').materialize()
+        last = updates + 1          # highest seq in the update chain
+        key = f'k{last % 6}'
+        assert len(got['items']) == 1 and \
+            got[key] == f'{"pay" * 12}-{n_docs - 1}-{last}'
+        return sent, dt, dst
+
+    src.store.clear_wire_cache()
+    full_bytes, t_full, _ = contact()
+
+    stats = compact_docset(src)
+    state_bytes, t_state, dst = contact()
+    # digest parity on every doc of the bootstrapped replica — the
+    # acceptance bar's "converges byte-identically, digests equal on
+    # both ends", vectorized over the fleet
+    src_dig = src.store.digests_all()
+    dst_dig = dst.store.digests_all()
+    order = _np.asarray([dst.id_of[d] for d in src.ids])
+    assert (dst_dig[order] == src_dig[:len(src.ids)]).all()
+    return {'n_docs': n_docs, 'n_changes': n_changes,
+            'full_bytes': full_bytes, 'state_bytes': state_bytes,
+            'bytes_ratio': full_bytes / max(state_bytes, 1),
+            'full_s': t_full, 'state_s': t_state,
+            'compaction_ms': stats['ms'],
+            'ops_folded': stats['ops_folded'],
+            'state_snapshot_bytes':
+                _m.counters.get('mem_state_snapshot_bytes', 0)}
+
+
+def bench_compacted_recover(n_docs=2048, updates=24, chunk=64):
+    """BENCH_r06 lane — crash recovery, journal replay vs tiered
+    snapshot: the same durable fleet recovered (a) from a checkpoint-
+    free journal (replaying every batch) and (b) from a
+    ``compact_and_checkpoint`` tiered snapshot (state columns load,
+    nothing replays). fsync off — this lane measures recovery, not
+    the disk."""
+    import shutil
+    import tempfile
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.compaction import compact_and_checkpoint
+    from automerge_tpu.durability import DurableDocSet
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+    def mk(i):
+        return [{'actor': f'a{i}', 'seq': s, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': f'k{s % 5}',
+                          'value': f'{"pay" * 8}-{i}-{s}'}]}
+                for s in range(1, 1 + updates)]
+
+    tmp = tempfile.mkdtemp(prefix='amtpu-bench-recover-')
+    try:
+        durable = DurableDocSet(GeneralDocSet(n_docs), tmp,
+                                fsync=False)
+        for start in range(0, n_docs, chunk):
+            durable.apply_changes_batch(
+                {f'doc{i}': mk(i)
+                 for i in range(start, min(start + chunk, n_docs))})
+        journal_bytes = durable.journal.bytes
+        durable.close()
+        t0 = time.perf_counter()
+        rec = DurableDocSet.recover(
+            tmp, lambda: GeneralDocSet(n_docs),
+            load_snapshot=GeneralDocSet.load_snapshot, fsync=False)
+        t_journal = time.perf_counter() - t0
+        compact_and_checkpoint(rec)
+        import os as _os
+        snap_bytes = _os.path.getsize(
+            _os.path.join(tmp, DurableDocSet.SNAPSHOT_FILE))
+        rec.close()
+        t0 = time.perf_counter()
+        rec2 = DurableDocSet.recover(
+            tmp, lambda: GeneralDocSet(n_docs),
+            load_snapshot=GeneralDocSet.load_snapshot, fsync=False)
+        t_compacted = time.perf_counter() - t0
+        assert not rec2.doc_set.store.log_truncated
+        assert rec2.doc_set.materialize(
+            f'doc{n_docs - 1}')['k1'].startswith('pay')
+        rec2.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {'n_docs': n_docs,
+            'journal_bytes': journal_bytes,
+            'snapshot_bytes': snap_bytes,
+            'journal_recover_s': t_journal,
+            'compacted_recover_s': t_compacted,
+            'recover_speedup_x': t_journal / max(t_compacted, 1e-9)}
+
+
 # The idle-observer budget: with NO subscriber every instrumented
 # call site in the tick path costs one truthiness check plus a shared
 # null context manager (metrics._NULL_SPAN) — nanoseconds, not
@@ -1571,6 +1724,27 @@ def main():
         f'{serving["evictions"]} evictions — cold docs are a cache, '
         f'not a capacity bound)')
 
+    boot = bench_cold_bootstrap()
+    log(f'cold-bootstrap[tiered, {boot["n_docs"]} docs / '
+        f'{boot["n_changes"]} changes]: full-history first contact '
+        f'{boot["full_bytes"] >> 10} KiB / {boot["full_s"]:.2f}s; '
+        f'after compaction ({boot["ops_folded"]} ops folded in '
+        f'{boot["compaction_ms"] / 1e3:.2f}s, '
+        f'{boot["state_snapshot_bytes"] >> 10} KiB of state '
+        f'snapshots) the same contact ships '
+        f'{boot["state_bytes"] >> 10} KiB / {boot["state_s"]:.2f}s '
+        f'-> {boot["bytes_ratio"]:.1f}x fewer bytes, '
+        f'{boot["full_s"] / max(boot["state_s"], 1e-9):.1f}x faster, '
+        f'digests verified equal on both ends for every doc')
+
+    recov = bench_compacted_recover()
+    log(f'recover[tiered, {recov["n_docs"]} docs]: journal replay '
+        f'{recov["journal_recover_s"]:.2f}s '
+        f'({recov["journal_bytes"] >> 10} KiB WAL) vs compacted '
+        f'checkpoint {recov["compacted_recover_s"]:.2f}s '
+        f'({recov["snapshot_bytes"] >> 10} KiB tiered snapshot) -> '
+        f'{recov["recover_speedup_x"]:.1f}x faster crash recovery')
+
     guard = bench_observer_overhead()
     log(f'observer-overhead[no subscriber]: trace_span '
         f'{guard["span_ns"]:.0f} ns, emit {guard["emit_ns"]:.0f} ns, '
@@ -1789,6 +1963,21 @@ def main():
         'serving_faultins': serving['faultins'],
         'serving_degraded_ratio': round(serving['degraded_ratio'], 3),
         'serving_evicted_frac': round(serving['evicted_frac'], 3),
+        # tiered doc storage (BENCH_r06): cold-peer bootstrap of the
+        # compacted 10k-doc fleet vs full-history replay, and crash
+        # recovery from a tiered checkpoint vs journal replay
+        'cold_bootstrap_full_bytes': boot['full_bytes'],
+        'cold_bootstrap_state_bytes': boot['state_bytes'],
+        'cold_bootstrap_bytes_ratio': round(boot['bytes_ratio'], 2),
+        'cold_bootstrap_full_s': round(boot['full_s'], 3),
+        'cold_bootstrap_state_s': round(boot['state_s'], 3),
+        'cold_bootstrap_speedup_x':
+            round(boot['full_s'] / max(boot['state_s'], 1e-9), 2),
+        'compaction_10k_ms': round(boot['compaction_ms'], 1),
+        'mem_state_snapshot_bytes': boot['state_snapshot_bytes'],
+        'recover_journal_s': round(recov['journal_recover_s'], 3),
+        'recover_compacted_s': round(recov['compacted_recover_s'], 3),
+        'recover_speedup_x': round(recov['recover_speedup_x'], 2),
         'general_materialize_docs_per_sec': round(n_mat / t_mat_cold,
                                                   1),
         'general_rematerialize_dirty_ms': round(t_mat_dirty * 1e3, 2),

@@ -1,0 +1,562 @@
+"""Tiered doc storage suite (ISSUE 12): per-doc state snapshots,
+history compaction behind an explicit horizon, the `state + tail`
+restore paths (wire bootstrap, park-shard fault-in, tiered snapshot
+resume, journal recovery) and their correctness bar — a doc restored
+from `state + tail` is digest- and materialize-identical to one
+rebuilt from the full log, including under chaos and with a mixed
+fleet where only one peer compacts."""
+
+import json
+
+import pytest
+
+from automerge_tpu import compaction as C
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device.blocks import HorizonTruncated
+from automerge_tpu.durability import DurableDocSet, read_park_shard
+from automerge_tpu.snapshot import SnapshotCorruptError
+from automerge_tpu.sync import (GeneralDocSet, ServingDocSet,
+                                WireConnection)
+from automerge_tpu.sync.chaos import (ChaosFleet, assert_digest_parity,
+                                      canonical)
+from automerge_tpu.sync.connection import BatchingConnection, Connection
+from automerge_tpu.utils.metrics import metrics
+
+
+def _rich(i, updates=6):
+    """One doc's history: a list with inserts + a delete, a text
+    object, links, a concurrent-writer conflict, then an update chain
+    overwriting a few root keys (the shape compaction folds well)."""
+    obj = f'00000000-0000-4000-8000-{i:012x}'
+    txt = f'00000000-0000-4000-8000-{i:012x}99'
+    ch = [
+        {'actor': f'a{i}', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeList', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': f'a{i}:1',
+             'value': i},
+            {'action': 'ins', 'obj': obj, 'key': f'a{i}:1',
+             'elem': 2},
+            {'action': 'set', 'obj': obj, 'key': f'a{i}:2',
+             'value': i * 10},
+            {'action': 'makeText', 'obj': txt},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 'title',
+             'value': txt},
+            {'action': 'ins', 'obj': txt, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': txt, 'key': f'a{i}:1',
+             'value': 'h'}]},
+        {'actor': f'b{i}', 'seq': 1, 'deps': {f'a{i}': 1}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+             'value': i},
+            {'action': 'del', 'obj': obj, 'key': f'a{i}:2'}]},
+        {'actor': f'c{i}', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'set', 'obj': ROOT_ID, 'key': 'meta',
+             'value': -i}]},
+    ]
+    ch += [{'actor': f'b{i}', 'seq': s, 'deps': {},
+            'ops': [{'action': 'set', 'obj': ROOT_ID,
+                     'key': f'k{s % 3}',
+                     'value': f'{"v" * 24}{s}'}]}
+           for s in range(2, 2 + updates)]
+    return ch
+
+
+def _seed(n_docs=6, capacity=32, updates=6):
+    ds = GeneralDocSet(capacity)
+    ds.apply_changes_batch(
+        {f'doc{i}': _rich(i, updates) for i in range(n_docs)})
+    return ds
+
+
+def _views(ds):
+    return {d: canonical(ds.materialize(d)) for d in ds.doc_ids}
+
+
+def _digests(ds):
+    return {d: ds.digest_of_id(d) for d in ds.doc_ids}
+
+
+def _tail(i):
+    return [{'actor': f'b{i}', 'seq': 8, 'deps': {f'b{i}': 7},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'tail',
+                      'value': f't{i}'}]}]
+
+
+class TestStateSnapshot:
+    def test_roundtrip_materialize_and_digest(self):
+        src = _seed()
+        want, digs = _views(src), _digests(src)
+        recs = C.extract_doc_states(src.store,
+                                    list(range(len(src.ids))))
+        dst = GeneralDocSet(32)
+        out = dst.apply_states(
+            {f'doc{i}': recs[i]['state'] for i in range(len(recs))})
+        assert set(out) == set(src.doc_ids)
+        assert _views(dst) == want
+        assert _digests(dst) == digs
+        # forward convergence: the same tail applies identically
+        src.apply_changes('doc0', _tail(0))
+        dst.apply_changes('doc0', _tail(0))
+        assert canonical(dst.materialize('doc0')) == \
+            canonical(src.materialize('doc0'))
+        assert dst.digest_of_id('doc0') == src.digest_of_id('doc0')
+
+    def test_corrupt_payload_raises_checksum(self):
+        src = _seed(2)
+        rec = C.extract_doc_states(src.store, [0])[0]
+        blob = bytearray(rec['state'])
+        blob[len(blob) // 2] ^= 0x40
+        with pytest.raises(SnapshotCorruptError):
+            C.decode_state_snapshot(bytes(blob))
+        with pytest.raises(SnapshotCorruptError):
+            C.decode_state_snapshot(rec['state'][:-7])
+
+    def test_corrupt_state_quarantines_not_crashes(self):
+        src = _seed(2)
+        rec = C.extract_doc_states(src.store, [0])[0]
+        blob = bytearray(rec['state'])
+        blob[len(blob) - 3] ^= 0x01
+        dst = _seed(2)
+        before = _views(dst)
+        out = dst.apply_states({'doc9': bytes(blob)})
+        assert out == {}
+        assert 'doc9' in dst.quarantined
+        assert all(_views(dst)[d] == before[d] for d in before)
+
+    def test_inconsistent_payload_isolates_within_batch(self):
+        """Review regression: a CRC-valid but internally inconsistent
+        payload (out-of-bounds cross-reference) fails DECODE-side
+        bounds validation and quarantines only its doc — the other
+        docs of the same batch absorb normally and the store never
+        mutates for the bad one."""
+        src = _seed(3)
+        recs = C.extract_doc_states(src.store, [0, 1])
+        st = dict(C.decode_state_snapshot(recs[1]['state']))
+        bad_e_obj = st['e_obj'].copy()
+        bad_e_obj[0] = 99                    # no such object
+        st['e_obj'] = bad_e_obj
+        evil = C.encode_state_snapshot(st)
+        with pytest.raises(SnapshotCorruptError):
+            C.decode_state_snapshot(evil)
+        dst = GeneralDocSet(8)
+        out = dst.apply_states({'good': recs[0]['state'],
+                                'bad': evil})
+        assert set(out) == {'good'}
+        assert 'bad' in dst.quarantined
+        assert canonical(dst.materialize('good')) == \
+            canonical(src.materialize('doc0'))
+        assert not dst.store.clock_of(dst.id_of['bad'])
+
+    def test_quarantined_state_retry_reabsorbs(self):
+        """Review regression: retry_quarantined on a state-bootstrap
+        hold re-attempts the ABSORB from the stored payload — a truly
+        corrupt payload stays quarantined (never a trivial clear over
+        a still-empty doc), and a transiently-failed one heals."""
+        src = _seed(2)
+        rec = C.extract_doc_states(src.store, [0])[0]
+        blob = bytearray(rec['state'])
+        blob[len(blob) - 3] ^= 0x01
+        dst = GeneralDocSet(8)
+        dst.apply_states({'doc0': bytes(blob)})
+        assert 'doc0' in dst.quarantined
+        assert dst.retry_quarantined(['doc0']) == {}
+        assert 'doc0' in dst.quarantined     # still corrupt: held
+        # swap in the good payload (a corrected redelivery) and retry
+        dst.quarantined['doc0']['state'] = rec['state']
+        out = dst.retry_quarantined(['doc0'])
+        assert 'doc0' in out and 'doc0' not in dst.quarantined
+        assert canonical(dst.materialize('doc0')) == \
+            canonical(src.materialize('doc0'))
+
+    def test_stale_state_ship_drops(self):
+        src = _seed(2)
+        rec = C.extract_doc_states(src.store, [0])[0]
+        # local applied MORE on top of the same history
+        dst = _seed(2)
+        dst.apply_changes('doc0', _tail(0))
+        want = canonical(dst.materialize('doc0'))
+        dst.apply_state('doc0', rec['state'])
+        assert canonical(dst.materialize('doc0')) == want
+
+    def test_concurrent_local_changes_replay_on_absorb(self):
+        src = _seed(2)
+        rec = C.extract_doc_states(src.store, [0])[0]
+        conc = [{'actor': 'zz', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'mine', 'value': 'local'}]}]
+        # replica that holds ONLY the concurrent change absorbs the
+        # state and must equal a full-log replica with both histories
+        dst = GeneralDocSet(8)
+        dst.apply_changes('doc0', conc)
+        dst.apply_state('doc0', rec['state'])
+        full = _seed(2)
+        full.apply_changes('doc0', conc)
+        assert canonical(dst.materialize('doc0')) == \
+            canonical(full.materialize('doc0'))
+        assert dst.digest_of_id('doc0') == full.digest_of_id('doc0')
+
+
+class TestCompaction:
+    def test_fold_shrinks_log_and_serves_tiered(self):
+        src = _seed()
+        digs = _digests(src)
+        before = metrics.snapshot()
+        stats = C.compact_docset(src)
+        after = metrics.snapshot()
+        assert stats['docs'] == len(src.ids)
+        assert stats['ops_folded'] > 0
+        assert after['compaction_runs'] == \
+            before.get('compaction_runs', 0) + 1
+        assert after['mem_state_snapshot_bytes'] > 0
+        assert not src.store.retained            # all history folded
+        assert not src.store.log_truncated
+        # behind-horizon peers raise the state-bootstrap error
+        with pytest.raises(HorizonTruncated):
+            src.store.get_missing_changes(0, {})
+        # at/after the horizon the tail serves normally
+        src.apply_changes('doc0', _tail(0))
+        hz = src.store.horizon[0]['clock']
+        served = src.store.get_missing_changes(0, hz)
+        assert [c['seq'] for c in served] == [8]
+        # digest oracle survives the fold (horizon digest + tail);
+        # docs without tail still hold their pre-fold digests
+        for i in range(len(src.ids)):
+            assert src.store.digest_of(i) == \
+                src.store.digest_recompute(i)
+        assert all(_digests(src)[d] == digs[d]
+                   for d in src.doc_ids if d != 'doc0')
+        # the memory surface reports the new tier
+        mem = src.fleet_status(docs=False)['memory']
+        assert mem['state_snapshot_bytes'] > 0
+        assert mem['compacted_docs'] == len(src.ids)
+
+    @pytest.mark.parametrize('fmt', ['packed', 'wide', 'cols'])
+    def test_state_tail_parity_across_mirror_formats(self, fmt):
+        """Correctness bar: `state + tail` restore equals a full-log
+        rebuild (materialized tree AND digest) whatever packed/WIDE/
+        cols mirror the doc shape lands on."""
+        def build():
+            ds = GeneralDocSet(8)
+            changes = {'doc0': _rich(0), 'doc1': _rich(1)}
+            if fmt == 'wide':
+                obj = '00000000-0000-4000-8000-00000000beef'
+                changes['doc2'] = [
+                    {'actor': 'w', 'seq': 1, 'deps': {}, 'ops': [
+                        {'action': 'makeList', 'obj': obj},
+                        {'action': 'link', 'obj': ROOT_ID,
+                         'key': 'long', 'value': obj},
+                        {'action': 'ins', 'obj': obj,
+                         'key': '_head', 'elem': 40000},
+                        {'action': 'set', 'obj': obj,
+                         'key': 'w:40000', 'value': 'far'}]}]
+            elif fmt == 'cols':
+                changes['doc2'] = [
+                    {'actor': f'actor{j:04d}', 'seq': 1, 'deps': {},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': f'f{j % 7}', 'value': j}]}
+                    for j in range(300)]
+            ds.apply_changes_batch(changes)
+            return ds
+        src = build()
+        assert src.store.pool.mirror['fmt'] == \
+            ('packed' if fmt == 'packed' else fmt)
+        C.compact_docset(src)
+        tail = _tail(0)
+        src.apply_changes('doc0', tail)
+        # restore from state + tail
+        dst = GeneralDocSet(8)
+        dst.apply_states({d: src.store.horizon[
+            src.id_of[d]]['state'] for d in src.doc_ids})
+        dst.apply_changes('doc0', tail)
+        # full-log rebuild
+        full = build()
+        full.apply_changes('doc0', tail)
+        assert _views(dst) == _views(full) == _views(src)
+        assert _digests(dst) == _digests(full) == _digests(src)
+        assert_digest_parity(dst)
+        assert_digest_parity(src)
+
+    def test_partial_fold_keeps_truncation_loud(self):
+        """Review regression: compacting a SUBSET of a snapshot-
+        resumed store's docs must not lift the truncated-log error
+        for the docs it did not cover — they would otherwise silently
+        serve an empty history to cold peers."""
+        src = _seed(3)
+        res = GeneralDocSet.load_snapshot(src.save_snapshot())
+        assert res.store.log_truncated
+        C.compact_docset(res, doc_ids=['doc0'])
+        assert res.store.log_truncated       # doc1/doc2 uncovered
+        with pytest.raises(HorizonTruncated):
+            res.store.get_missing_changes(0, {})
+        with pytest.raises(ValueError):
+            res.store.get_missing_changes(1, {})
+        C.compact_docset(res)                # full fold lifts it
+        assert not res.store.log_truncated
+
+    def test_drop_doc_state_on_compacted_store(self):
+        src = _seed()
+        C.compact_docset(src)
+        src.apply_changes('doc0', _tail(0))
+        want = _views(src)
+        digs = _digests(src)
+        src.drop_doc_state(['doc3'])
+        survivors = [d for d in src.doc_ids if d != 'doc3']
+        assert {d: canonical(src.materialize(d))
+                for d in survivors} == \
+            {d: want[d] for d in survivors}
+        assert {d: src.digest_of_id(d) for d in survivors} == \
+            {d: digs[d] for d in survivors}
+
+
+class TestTieredContainers:
+    def test_tiered_snapshot_resume_fully_servable(self):
+        src = _seed()
+        C.compact_docset(src)
+        src.apply_changes('doc0', _tail(0))   # retained tail
+        want, digs = _views(src), _digests(src)
+        data = src.save_snapshot()
+        res = GeneralDocSet.load_snapshot(data)
+        assert not res.store.log_truncated
+        assert set(res.store.horizon) == set(range(len(src.ids)))
+        assert _views(res) == want and _digests(res) == digs
+        # the resumed store serves a cold peer via state + tail
+        with pytest.raises(HorizonTruncated):
+            res.store.get_missing_changes(0, {})
+        hz = res.store.horizon[0]['clock']
+        assert [c['seq'] for c in
+                res.store.get_missing_changes(0, hz)] == [8]
+        assert_digest_parity(res)
+
+    def test_uncompacted_snapshot_keeps_old_contract(self):
+        """Old-container compatibility: a snapshot of an uncompacted
+        store is the pre-tier artifact — resume stays log-truncated
+        and serves forward only, exactly as before."""
+        src = _seed(3)
+        res = GeneralDocSet.load_snapshot(src.save_snapshot())
+        assert res.store.log_truncated
+        assert not res.store.horizon
+        with pytest.raises(ValueError) as err:
+            res.store.get_missing_changes(0, {})
+        assert not isinstance(err.value, HorizonTruncated)
+        assert _views(res) == _views(src)
+
+    def test_park_shard_versions(self, tmp_path):
+        src = _seed(3)
+        serving = ServingDocSet(src, str(tmp_path))
+        want = _views(src)
+        # uncompacted park: v1 full-log shard, byte-compatible
+        serving.memory_budget_bytes = 1
+        serving.tick()
+        names = sorted(p for p in
+                       (tmp_path / 'parked').iterdir())
+        shard = read_park_shard(str(names[0]))
+        assert all('changes' in p for p in shard.values())
+        raw = names[0].read_bytes()
+        assert b'automerge-tpu-parked-docs@1' in raw
+        serving.memory_budget_bytes = None
+        assert {d: canonical(serving.materialize(d))
+                for d in serving.doc_ids} == want
+
+    def test_park_state_shard_roundtrip(self, tmp_path):
+        src = _seed()
+        C.compact_docset(src)
+        src.apply_changes('doc0', _tail(0))
+        want, digs = _views(src), _digests(src)
+        serving = ServingDocSet(src, str(tmp_path))
+        serving.memory_budget_bytes = 1
+        serving.tick()
+        assert serving._evicted
+        names = sorted(p for p in (tmp_path / 'parked').iterdir())
+        shard = read_park_shard(str(names[0]))
+        assert all('state' in p and 'changes' not in p
+                   for p in shard.values())
+        assert b'automerge-tpu-parked-docs@2' in names[0].read_bytes()
+        serving.memory_budget_bytes = None
+        assert {d: canonical(serving.materialize(d))
+                for d in serving.doc_ids} == want
+        assert {d: serving.digest_of_id(d)
+                for d in serving.doc_ids} == digs
+
+
+class TestWireStateBootstrap:
+    def _pump(self, ca, cb, msgs_a, msgs_b, rounds=24):
+        for _ in range(rounds):
+            ca.flush()
+            cb.flush()
+            if not (msgs_a or msgs_b):
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+
+    def test_cold_peer_bootstrap_ships_state(self):
+        src = _seed(12, updates=40)
+        full_bytes = self._contact_bytes(src)
+        C.compact_docset(src)
+        src.apply_changes('doc0', _tail(0))
+        before = metrics.snapshot()
+        state_bytes = self._contact_bytes(src)
+        after = metrics.snapshot()
+        assert after['sync_state_bootstraps'] >= \
+            before.get('sync_state_bootstraps', 0) + 12
+        assert state_bytes < full_bytes
+        assert self.dst_views == _views(src)
+        assert {d: self.dst.digest_of_id(d)
+                for d in self.dst.doc_ids} == _digests(src)
+        assert not self.dst.quarantined
+
+    def _contact_bytes(self, src):
+        dst = GeneralDocSet(8)
+        msgs_a, msgs_b = [], []
+        ca = WireConnection(src, msgs_a.append)
+        cb = WireConnection(dst, msgs_b.append)
+        sent0 = metrics.counters.get('sync_wire_bytes_sent', 0)
+        ca.open()
+        cb.open()
+        self._pump(ca, cb, msgs_a, msgs_b)
+        ca.close()
+        cb.close()
+        self.dst = dst
+        self.dst_views = _views(dst)
+        return metrics.counters.get('sync_wire_bytes_sent',
+                                    0) - sent0
+
+    def test_dict_path_state_fallback(self):
+        """The non-wire protocol serves the same tier: a compacted
+        store answers a behind-horizon advert with a dict 'state'
+        message and the tail follows through the normal protocol."""
+        src = _seed(4)
+        C.compact_docset(src)
+        src.apply_changes('doc0', _tail(0))
+        dst = GeneralDocSet(8)
+        msgs_a, msgs_b = [], []
+        ca = Connection(src, msgs_a.append)
+        cb = BatchingConnection(dst, msgs_b.append)
+        ca.open()
+        cb.open()
+        for _ in range(24):
+            if not (msgs_a or msgs_b):
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        assert _views(dst) == _views(src)
+        assert _digests(dst) == _digests(src)
+
+    def test_chaos_mixed_fleet_only_one_peer_compacts(self):
+        """A 3-node wire fleet under drop+dup+corrupt chaos where ONE
+        node compacts mid-run: every node converges byte-identically
+        to the clean run, with zero quarantines and digest parity
+        everywhere — compaction is invisible to correctness."""
+        def seeded():
+            return _seed(5, updates=4)
+
+        def edits(fleet):
+            fleet.doc_sets[1].apply_changes('doc1', [
+                {'actor': 'n1', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'late', 'value': 'n1'}]}])
+            fleet.doc_sets[2].apply_changes('docX', [
+                {'actor': 'n2', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'born', 'value': 2}]}])
+
+        clean = ChaosFleet([seeded(), GeneralDocSet(8),
+                            GeneralDocSet(8)], seed=5, wire=True)
+        clean.run(min_ticks=2)
+        edits(clean)
+        C.compact_docset(clean.doc_sets[0])
+        clean.run()
+        want = clean.views()[0]
+
+        fleet = ChaosFleet([seeded(), GeneralDocSet(8),
+                            GeneralDocSet(8)], seed=11, wire=True,
+                           drop=0.15, dup=0.05, corrupt=0.1, delay=2)
+        fleet.run(min_ticks=2)
+        edits(fleet)
+        C.compact_docset(fleet.doc_sets[0])
+        fleet.run()
+        for view in fleet.views():
+            assert canonical(view) == canonical(want)
+        for ds in fleet.doc_sets:
+            assert not ds.quarantined
+            assert_digest_parity(ds)
+        fleet.close()
+        clean.close()
+
+
+class TestDurability:
+    def _durable(self, tmp_path, n_docs=4):
+        inner = _seed(n_docs)
+        return ServingDocSet(DurableDocSet(inner, str(tmp_path)),
+                             str(tmp_path))
+
+    def test_crash_mid_compaction_leaves_old_tiers(self, tmp_path):
+        """A torn compaction must leave the pre-compaction tiers
+        intact: the fold is in-memory until the atomic checkpoint, so
+        a crash between them recovers the OLD snapshot + journal —
+        byte-identical to never having compacted."""
+        ds = self._durable(tmp_path)
+        ds.checkpoint()
+        ds.apply_changes('doc0', _tail(0))   # journaled post-snapshot
+        want = _views(ds.inner)
+        digs = {d: ds.digest_of_id(d) for d in ds.doc_ids}
+        C.compact_docset(ds)                 # in-memory fold only...
+        ds.close()                           # ...crash before checkpoint
+        rec = ServingDocSet.recover(str(tmp_path), capacity=32)
+        assert not rec.store.horizon         # pre-compaction tiers
+        assert {d: canonical(rec.materialize(d))
+                for d in rec.doc_ids} == want
+        assert {d: rec.digest_of_id(d) for d in rec.doc_ids} == digs
+        # now compact durably and crash again: the new tiers load
+        C.compact_and_checkpoint(rec)
+        rec.apply_changes('doc1', _tail(1))
+        want2 = _views(rec.inner)
+        rec.close()
+        rec2 = ServingDocSet.recover(str(tmp_path), capacity=32)
+        assert rec2.store.horizon
+        assert not rec2.store.log_truncated
+        assert {d: canonical(rec2.materialize(d))
+                for d in rec2.doc_ids} == want2
+        assert_digest_parity(rec2.inner)
+        rec2.close()
+
+    def test_journal_replays_state_bootstraps(self, tmp_path):
+        src = _seed(3)
+        C.compact_docset(src)
+        dst = DurableDocSet(GeneralDocSet(8), str(tmp_path))
+        dst.apply_states(
+            {d: src.store.horizon[src.id_of[d]]['state']
+             for d in src.doc_ids})
+        assert _views(dst.doc_set) == _views(src)
+        dst.close()                          # crash: no checkpoint
+        rec = DurableDocSet.recover(
+            str(tmp_path), lambda: GeneralDocSet(8),
+            load_snapshot=GeneralDocSet.load_snapshot)
+        assert _views(rec.doc_set) == _views(src)
+        assert {d: rec.doc_set.digest_of_id(d)
+                for d in rec.doc_set.doc_ids} == _digests(src)
+        rec.close()
+
+    def test_evicted_compacted_fleet_survives_crash(self, tmp_path):
+        ds = self._durable(tmp_path)
+        C.compact_and_checkpoint(ds)
+        want = _views(ds.inner)
+        ds.memory_budget_bytes = 1
+        ds.tick()                            # state+tail park shards
+        assert ds._evicted
+        ds.close()
+        rec = ServingDocSet.recover(str(tmp_path), capacity=32)
+        assert {d: canonical(rec.materialize(d))
+                for d in rec.doc_ids} == want
+        rec.close()

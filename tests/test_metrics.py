@@ -347,7 +347,8 @@ class TestRegistryDriftGuard:
 
     NAME_RE = re.compile(
         r"(?:bump|set_gauge|observe|ratchet)\(\s*'"
-        r"((?:sync|serving|fleet|device|mem)_[a-z0-9_]+)'")
+        r"((?:sync|serving|fleet|device|mem|compaction)_"
+        r"[a-z0-9_]+)'")
 
     def _package_names(self):
         pkg = os.path.dirname(M.__file__)         # automerge_tpu/utils
@@ -367,10 +368,11 @@ class TestRegistryDriftGuard:
         registered = set(M.ALL_COUNTER_REGISTRIES)
         missing = bumped - registered
         assert not missing, (
-            f'sync_/serving_/fleet_/device_/mem_ counters bumped in '
-            f'automerge_tpu/ but absent from FAULT_COUNTERS/'
-            f'SERVING_COUNTERS/SYNC_COUNTERS/CONVERGENCE_COUNTERS/'
-            f'DEVICE_COUNTERS: {sorted(missing)}')
+            f'sync_/serving_/fleet_/device_/mem_/compaction_ '
+            f'counters bumped in automerge_tpu/ but absent from '
+            f'FAULT_COUNTERS/SERVING_COUNTERS/SYNC_COUNTERS/'
+            f'CONVERGENCE_COUNTERS/DEVICE_COUNTERS/'
+            f'COMPACTION_COUNTERS: {sorted(missing)}')
 
     def test_no_registered_name_is_dead(self):
         """The reverse direction: a registered sync_/serving_/fleet_/
@@ -380,7 +382,7 @@ class TestRegistryDriftGuard:
         registered = set(M.ALL_COUNTER_REGISTRIES)
         dead = {n for n in registered
                 if n.startswith(('sync_', 'serving_', 'fleet_',
-                                 'device_', 'mem_'))} \
+                                 'device_', 'mem_', 'compaction_'))} \
             - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
 
@@ -390,7 +392,7 @@ class TestRegistryDriftGuard:
         seen = set()
         for reg in (M.FAULT_COUNTERS, M.SERVING_COUNTERS,
                     M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS,
-                    M.DEVICE_COUNTERS):
+                    M.DEVICE_COUNTERS, M.COMPACTION_COUNTERS):
             dup = seen & set(reg)
             assert not dup, f'registered twice: {sorted(dup)}'
             seen |= set(reg)
@@ -534,6 +536,14 @@ class TestFaultCounters:
             'device_patch_read_ms', 'device_utilization',
             'mem_device_plane_bytes', 'mem_device_plane_peak_bytes',
             'mem_journal_bytes', 'mem_park_shard_bytes'}
+
+    def test_compaction_registry_names_are_pinned(self):
+        """ISSUE 12 satellite: the tiered-doc-storage counter family
+        has its own registry, guard-covered like the rest."""
+        assert set(M.COMPACTION_COUNTERS) >= {
+            'compaction_runs', 'compaction_ops_folded',
+            'compaction_ms', 'mem_state_snapshot_bytes',
+            'sync_state_bootstraps'}
 
     def test_rejected_message_counts(self):
         from automerge_tpu.sync.connection import MessageRejected

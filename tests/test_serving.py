@@ -821,14 +821,39 @@ class TestServingDurability:
             canonical(_seed_general().materialize('doc1'))
         assert 'doc1' in rec.quarantined
 
-    def test_eviction_blocked_on_truncated_log(self, tmp_path):
-        """A snapshot-resumed store cannot rebuild parked history:
-        eviction is refused loudly (counter), never silently lossy."""
+    def test_eviction_on_truncated_log_parks_state_tail(self,
+                                                        tmp_path):
+        """ISSUE 12 flip of the PR 6 refusal: eviction on a
+        snapshot-resumed (truncated-log) store now auto-compacts and
+        parks `state + tail` shards instead of refusing — and the
+        round trip is byte-identical. The refusal counter stays 0 in
+        this lane."""
+        want = _oracle_views()
         ds = _seed_serving(tmp_path, durable=True)
         ds.checkpoint()
         ds.close()
         rec = ServingDocSet.recover(str(tmp_path), capacity=32,
                                     memory_budget_bytes=1)
+        before = metrics.snapshot().get(
+            'serving_evictions_blocked_truncated', 0)
+        rec.tick()
+        assert metrics.snapshot().get(
+            'serving_evictions_blocked_truncated', 0) == before
+        assert rec._evicted                      # state+tail parked
+        got = {d: canonical(rec.materialize(d)) for d in rec.doc_ids}
+        assert got == want
+
+    def test_eviction_blocked_on_truncated_log_opt_out(self,
+                                                       tmp_path):
+        """auto_compact=False keeps the PR 6 behavior: a snapshot-
+        resumed store refuses eviction loudly (counter), never
+        silently lossy."""
+        ds = _seed_serving(tmp_path, durable=True)
+        ds.checkpoint()
+        ds.close()
+        rec = ServingDocSet.recover(str(tmp_path), capacity=32,
+                                    memory_budget_bytes=1,
+                                    auto_compact=False)
         before = metrics.snapshot().get(
             'serving_evictions_blocked_truncated', 0)
         rec.tick()

@@ -416,7 +416,11 @@ class TestMemoryAccounting:
     def test_serving_park_bytes_and_pressure_signal(self, tmp_path):
         from automerge_tpu.sync import GeneralDocSet
         from automerge_tpu.sync.serving import ServingDocSet
-        ds = ServingDocSet(GeneralDocSet(8), str(tmp_path))
+        # auto_compact off: the blocked-eviction half below relies on
+        # the truncated-log refusal (with compaction the block never
+        # happens — tiered storage evicts state+tail instead)
+        ds = ServingDocSet(GeneralDocSet(8), str(tmp_path),
+                           auto_compact=False)
         for d in range(4):
             _apply_round(ds, 1, n_ops=2, doc=f'doc{d}')
         # squeeze everything cold out
