@@ -18,9 +18,10 @@ from .connection import (Connection, BatchingConnection, WireConnection,
                          validate_wire_msg)
 from .resilient import (ResilientConnection, AdmissionControl,
                         TokenBucket)
+from .control import FleetController
 
 __all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
            'ServingDocSet', 'WatchableDoc', 'Connection',
            'BatchingConnection', 'WireConnection', 'MessageRejected',
            'validate_msg', 'validate_wire_msg', 'ResilientConnection',
-           'AdmissionControl', 'TokenBucket']
+           'AdmissionControl', 'TokenBucket', 'FleetController']

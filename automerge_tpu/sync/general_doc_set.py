@@ -438,11 +438,15 @@ class GeneralDocSet:
         for doc_id in doc_ids:
             births[doc_id] = t
 
-    def note_peer_ack(self, doc_ids):
+    def note_peer_ack(self, doc_ids, clock_of=None):
         """A registered link folded new acked clocks for ``doc_ids``:
         close out any birth the whole fleet now covers. O(notified
         docs x peers); called by :class:`~.resilient.
-        ResilientConnection` on acks, data clocks and heartbeats."""
+        ResilientConnection` on acks, data clocks and heartbeats.
+        ``clock_of`` overrides the per-doc clock source (the serving
+        wrapper passes its eviction-aware reader, so a PARKED doc's
+        birth still closes against its recorded park clock instead of
+        the store's empty rows)."""
         births = self._births
         if not births or not self.connections:
             return
@@ -453,10 +457,13 @@ class GeneralDocSet:
             t0 = births.get(doc_id)
             if t0 is None:
                 continue
-            idx = self.id_of.get(doc_id)
-            if idx is None:
-                continue
-            clock = store.clock_of(idx)
+            if clock_of is not None:
+                clock = clock_of(doc_id)
+            else:
+                idx = self.id_of.get(doc_id)
+                if idx is None:
+                    continue
+                clock = store.clock_of(idx)
             if not clock:
                 continue
             if all(_covers(c.acked_clock(doc_id), clock)

@@ -163,6 +163,11 @@ class ServingDocSet:
         if flight_recorder is not None:
             metrics.subscribe(flight_recorder)   # idempotent
         self._incident_seen = set()    # docs whose quarantine dumped
+        # closed-loop adaptive control (sync/control.py): when a
+        # FleetController attaches itself here, every serving quantum
+        # hands it the health evaluation maintenance() already
+        # performs — the controller adds no second status poll
+        self.controller = None
         # health rollup wiring: the inner doc set owns the state
         # machine; this layer contributes the serving signals (parked
         # docs) and captures incidents on first entry to critical
@@ -501,8 +506,15 @@ class ServingDocSet:
             if doc_id in self._evicted or doc_id in quarantined:
                 continue               # quarantined docs are PINNED
             lt = self._last_touch.get(doc_id, -1)
-            if lt >= self._tick:
-                continue               # pinned: touched this tick
+            if lt >= self._tick - 1:
+                # pinned: touched this quantum — including the one
+                # the end-of-quantum tick() just closed (tick()
+                # advances _tick BEFORE maintenance, so a doc written
+                # every quantum would otherwise evict at each quantum
+                # boundary and fault straight back in on its next
+                # write: pure park/fault-in thrash, surfaced by the
+                # fleet simulator's flash-crowd scenario)
+                continue
             cands.append((lt, idx, doc_id))
         cands.sort()
         victims = []
@@ -556,7 +568,11 @@ class ServingDocSet:
         self._enforce_budget()
         # health transitions are recorded per quantum, not only when
         # an operator happens to poll fleet_status() — O(connections)
-        self.inner.evaluate_health()
+        health = self.inner.evaluate_health()
+        # the adaptive-control policy tick rides the SAME evaluation
+        # (one health computation per quantum, consumed twice)
+        if self.controller is not None:
+            self.controller.on_quantum(health)
 
     # -- DocSet surface (every public entry is a touch) ----------------------
 
@@ -666,15 +682,17 @@ class ServingDocSet:
 
     # -- sync support --------------------------------------------------------
 
-    def clock_of_id(self, doc_id):
-        """The doc's clock WITHOUT faulting it in: recorded park clock
-        for evicted docs, store clock otherwise."""
-        rec = self._evicted.get(doc_id)
-        if rec is not None:
-            return dict(rec['clock'])
-        idx = self.inner.id_of.get(doc_id)
-        return self.inner.store.clock_of(idx) \
-            if idx is not None else {}
+    def note_peer_ack(self, doc_ids):
+        """Convergence closure with eviction-aware clocks: the inner
+        logic against the store clock would leave a PARKED doc's
+        pending birth open forever (empty rows never compare covered)
+        and ``pending_births`` would report the fleet unconverged.
+        :meth:`clock_of_id` serves the recorded park clock for
+        evicted docs and the store clock otherwise — an evicted doc
+        every live peer has acked IS converged."""
+        self.inner.note_peer_ack(doc_ids, clock_of=self.clock_of_id)
+
+    notePeerAck = note_peer_ack
 
     def heartbeat_clocks(self):
         """Every doc's truthful clock for the anti-entropy beat, one
@@ -854,6 +872,11 @@ class ServingDocSet:
                 counters.get('mem_resident_peak_bytes', 0),
             'memory_budget_bytes': self.memory_budget_bytes,
             'park_shard_bytes': sum(self._park_bytes.values())})
+        if self.controller is not None:
+            # the adaptive-control knob positions + per-action totals
+            # join the operator surface next to the signals that drive
+            # them
+            status['control'] = self.controller.status()
         return status
 
     fleetStatus = fleet_status

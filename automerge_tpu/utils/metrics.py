@@ -227,13 +227,49 @@ COMPACTION_COUNTERS = (
     'mem_state_snapshot_bytes', 'sync_state_msgs_sent',
     'sync_state_msgs_received', 'sync_state_bootstraps')
 
+# Closed-loop control counters (the adaptive-control observability
+# contract — sync/control.py: every knob the controller turns is
+# counted, so a fleet being actively steered is never mistaken for
+# one that tuned itself; a green fleet bumps NONE of these, which is
+# the do-nothing guarantee tests/test_control.py asserts):
+#   control_actions            total actions fired (sum of the rest)
+#   control_tokens_widened     admission token rates widened under
+#                              sustained busy + low debt utilization
+#   control_tokens_narrowed    rates stepped back toward base after a
+#                              quiet spell
+#   control_watermark_lowered  eviction low_watermark stepped down
+#                              under sustained memory_pressure
+#   control_watermark_raised   watermark stepped back toward its base
+#   control_compactions        compact_docset folds the controller
+#                              scheduled under memory pressure
+#   control_load_sheds         critical health: rates cut to the shed
+#                              fraction (+ a load_shed incident dump)
+#   control_shed_restores      sustained green: pre-shed rates restored
+CONTROL_COUNTERS = (
+    'control_actions', 'control_tokens_widened',
+    'control_tokens_narrowed', 'control_watermark_lowered',
+    'control_watermark_raised', 'control_compactions',
+    'control_load_sheds', 'control_shed_restores')
+
+# Fleet-simulator counters (automerge_tpu/fleetsim.py — the workload
+# generator's own telemetry, so a scenario run is auditable from the
+# same registry everything else exports through):
+#   sim_scenario_runs          scenarios executed
+#   sim_ticks                  scheduling quanta driven
+#   sim_ops_injected           ops generated into the fleet
+#   sim_actors_spawned         distinct simulated actors minted
+SIM_COUNTERS = (
+    'sim_scenario_runs', 'sim_ticks', 'sim_ops_injected',
+    'sim_actors_spawned')
+
 # Every registered counter/gauge/series name, in one tuple — the
 # telemetry exporter (automerge_tpu/telemetry.py) renders ALL of these
 # even when never bumped, and tests/test_metrics.py asserts none is
 # silently unexported.
 ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
                           SYNC_COUNTERS + CONVERGENCE_COUNTERS +
-                          DEVICE_COUNTERS + COMPACTION_COUNTERS)
+                          DEVICE_COUNTERS + COMPACTION_COUNTERS +
+                          CONTROL_COUNTERS + SIM_COUNTERS)
 
 # Observe-series name suffixes: a registered name ending in one of
 # these is a histogram series (count/sum/max + buckets), not a scalar

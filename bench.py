@@ -1022,6 +1022,134 @@ def bench_observer_overhead(n=200000):
             'sample_ns': t_sample}
 
 
+def bench_fleet_sim(smoke=False, trace_out=None):
+    """Fleet workload simulator lanes (ISSUE 13): run every catalog
+    scenario with the closed-loop controller enabled, then the
+    adaptive scenarios again with it disabled — the acceptance matrix
+    ``fleet_sim_adaptive_wins`` counts scenarios that flip red→green
+    when the controller is on. ``smoke=True`` runs the scaled-down CI
+    fleets; the full scale is the bench lane (actor churn crosses
+    100k simulated actors there). With ``trace_out`` the whole matrix
+    records through a flight recorder and dumps ONE Perfetto file:
+    the per-tick load curve as a counter track, health transitions as
+    instants, and every ``control.*`` action span — the scenario
+    timeline on one track set (``tools/trace_report.py --scenario``
+    prints the same artifact as a table)."""
+    from automerge_tpu import fleetsim
+    from automerge_tpu.utils.metrics import (FlightRecorder,
+                                             metrics as _m)
+    scale = 'smoke' if smoke else 'full'
+    recorder = None
+    if trace_out:
+        recorder = FlightRecorder(1 << 17)
+        _m.subscribe(recorder)
+    results = {}
+    for name in sorted(fleetsim.SCENARIOS):
+        results[name] = fleetsim.run_scenario(name, scale=scale)
+        log(f'fleet-sim[{name}] done: {results[name]["verdict"]} '
+            f'in {results[name]["wall_s"]:.0f}s')
+    wins = 0
+    uncontrolled = {}
+    for name in fleetsim.ADAPTIVE_SCENARIOS:
+        off = fleetsim.run_scenario(name, scale=scale,
+                                    controller=False)
+        log(f'fleet-sim[{name}, controller off] done: '
+            f'{off["verdict"]} in {off["wall_s"]:.0f}s')
+        uncontrolled[name] = off
+        if off['verdict'] == 'red' and \
+                results[name]['verdict'] == 'green':
+            wins += 1
+    n_events = 0
+    if recorder is not None:
+        _m.unsubscribe(recorder)
+        n_events = len(recorder.events())
+        from automerge_tpu import telemetry as _telemetry
+        _telemetry.dump_chrome_trace(recorder, path=trace_out)
+    return {'scale': scale, 'results': results,
+            'uncontrolled': uncontrolled, 'adaptive_wins': wins,
+            'trace_events': n_events}
+
+
+def fleet_sim_json(sim):
+    """The perf-gate JSON keys of a :func:`bench_fleet_sim` run. The
+    hardware-independent keys (per-scenario SLO verdicts, the
+    adaptive-wins count, the uncontrolled-run verdicts) are the CI
+    bands in PERF_BUDGETS.json; throughput/latency/memory keys ride
+    along for trend tracking. The 100k-actor churn count only appears
+    at full scale, so its band never fails the smoke artifact."""
+    out = {'fleet_sim_adaptive_wins': sim['adaptive_wins']}
+    for name, r in sim['results'].items():
+        p = f'fleet_sim_{name}_'
+        out[p + 'slo_green'] = 1 if r['verdict'] == 'green' else 0
+        out[p + 'ops_per_sec'] = r['ops_per_sec']
+        out[p + 'convergence_ms_p99'] = \
+            round(r['convergence_ms_p99'] or 0, 2)
+        out[p + 'peak_resident_bytes'] = r['peak_resident_bytes']
+        out[p + 'control_actions'] = r['control_action_total']
+    for name, r in sim['uncontrolled'].items():
+        out[f'fleet_sim_{name}_uncontrolled_slo_green'] = \
+            1 if r['verdict'] == 'green' else 0
+    if sim['scale'] == 'full':
+        out['fleet_sim_actor_churn_actors'] = \
+            sim['results']['actor_churn']['n_actors']
+    return out
+
+
+def log_fleet_sim(sim):
+    for name, r in sorted(sim['results'].items()):
+        off = sim['uncontrolled'].get(name)
+        log(f'fleet-sim[{name}]: {r["verdict"].upper()} — '
+            f'{r["ops_per_sec"]:.0f} ops/s, convergence p99 '
+            f'{r["convergence_ms_p99"] or 0:.0f} ms, peak resident '
+            f'{r["peak_resident_bytes"] >> 10} KiB, '
+            f'{r["control_action_total"]} controller actions '
+            f'{dict(r["control_actions"])}'
+            + (f'; uncontrolled run: {off["verdict"].upper()} '
+               f'(failed: '
+               f'{[n for n, c in off["checks"].items() if not c["ok"]]})'
+               if off else ''))
+    log(f'fleet-sim[adaptive]: {sim["adaptive_wins"]} scenario(s) '
+        f'flip red -> green with the controller enabled '
+        f'(acceptance floor: 2)')
+
+
+def _force_native_fleet_sim():
+    """CI forced-native lane for the fleet-sim smoke subset: the
+    native stager/emit/columnar paths RAISE instead of silently
+    falling back to numpy/Python (same force switches the pytest
+    lanes flip)."""
+    from automerge_tpu import wire
+    from automerge_tpu.device import general
+    general._NATIVE_STAGING = True
+    wire._NATIVE_EMIT = True
+    wire._NATIVE_COLUMNAR = True
+
+
+def fleet_sim_cli(argv):
+    """``python bench.py --fleet-sim [--smoke] [--forced-native]
+    [--trace-out PATH]`` — the scenario matrix alone, one JSON line
+    on stdout for tools/perf_gate.py."""
+    smoke_lane = '--smoke' in argv
+    trace_out = None
+    if '--trace-out' in argv:
+        i = argv.index('--trace-out') + 1
+        if i >= len(argv) or argv[i].startswith('--'):
+            raise SystemExit('--trace-out needs a file path operand')
+        trace_out = argv[i]
+    if '--forced-native' in argv:
+        _force_native_fleet_sim()
+    sim = bench_fleet_sim(smoke=smoke_lane, trace_out=trace_out)
+    log_fleet_sim(sim)
+    if trace_out:
+        log(f'fleet-sim[trace]: {trace_out} — load-curve counter '
+            f'track + health transitions + control.* action spans '
+            f'({sim["trace_events"]} events retained)')
+    print(json.dumps({
+        'bench': 'fleet_sim',
+        'fleet_sim_smoke': 1 if smoke_lane else 0,
+        **fleet_sim_json(sim)}), flush=True)
+
+
 def smoke():
     """CI smoke invocation (``python bench.py --smoke``): the
     idle-observer overhead guard alone — no jax import, no device
@@ -1745,6 +1873,13 @@ def main():
         f'({recov["snapshot_bytes"] >> 10} KiB tiered snapshot) -> '
         f'{recov["recover_speedup_x"]:.1f}x faster crash recovery')
 
+    # fleet workload simulator + closed-loop control (ISSUE 13): the
+    # full-scale scenario matrix, every verdict computed from the
+    # exported telemetry surface; the adaptive scenarios run twice
+    # (controller off/on) for the red->green acceptance count
+    fsim = bench_fleet_sim(smoke=False)
+    log_fleet_sim(fsim)
+
     guard = bench_observer_overhead()
     log(f'observer-overhead[no subscriber]: trace_span '
         f'{guard["span_ns"]:.0f} ns, emit {guard["emit_ns"]:.0f} ns, '
@@ -1988,11 +2123,16 @@ def main():
         'observer_overhead_span_ns': round(guard['span_ns'], 1),
         'resolve_hbm_frac': round(res_hbm, 4),
         'rga_hbm_frac': round(rga_hbm, 4),
+        # fleet-sim scenario matrix: per-scenario SLO verdicts +
+        # adaptive-control acceptance (PERF_BUDGETS bands)
+        **fleet_sim_json(fsim),
     }), flush=True)
 
 
 if __name__ == '__main__':
-    if '--smoke' in sys.argv[1:]:
+    if '--fleet-sim' in sys.argv[1:]:
+        fleet_sim_cli(sys.argv[1:])
+    elif '--smoke' in sys.argv[1:]:
         smoke()
     else:
         main()

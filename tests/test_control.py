@@ -1,0 +1,302 @@
+"""Unit tests for the closed-loop adaptive controller (ISSUE 13).
+
+Covers each signal→action mapping in isolation (synthetic health
+evaluations over fake serving/connection stand-ins plus real
+AdmissionControl buckets and a real serving stack for the compaction
+arm), the hysteresis bounds (a signal glued to a threshold can never
+flap a knob), and the do-nothing guarantee: a green fleet's policy
+tick fires zero actions, bumps zero ``control_*`` counters and emits
+zero events.
+"""
+
+import types
+
+import pytest
+
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.sync.control import FleetController
+from automerge_tpu.sync.general_doc_set import GeneralDocSet
+from automerge_tpu.sync.resilient import AdmissionControl
+from automerge_tpu.sync.serving import ServingDocSet
+from automerge_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _fake_conn(admission=None, shared=None, prefix=''):
+    return types.SimpleNamespace(
+        admission=admission, shared_admission=shared,
+        metrics=types.SimpleNamespace(prefix=prefix))
+
+
+def _fake_serving(budget=None, watermark=0.75, conns=()):
+    inner = types.SimpleNamespace(
+        connections={i: c for i, c in enumerate(conns)}, store=None)
+    return types.SimpleNamespace(
+        low_watermark=watermark, memory_budget_bytes=budget,
+        inner=inner, dir_path=None, flight_recorder=None)
+
+
+def _health(state='green', **signals):
+    return {'state': state, 'signals': signals, 'reasons': []}
+
+
+def _seed_serving(tmp_path, n_updates=24):
+    """A real mini serving stack whose one doc carries a foldable
+    retained history — the compaction arm's target."""
+    ds = ServingDocSet(GeneralDocSet(4), str(tmp_path))
+    ds.apply_changes_batch({'d0': [
+        {'actor': 'a1', 'seq': s,
+         'deps': {'a1': s - 1} if s > 1 else {},
+         'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                  'value': s}]}
+        for s in range(1, 1 + n_updates)]})
+    return ds
+
+
+class TestMemoryRule:
+    def test_pressure_lowers_watermark_and_compacts(self, tmp_path):
+        ds = _seed_serving(tmp_path)
+        ds.memory_budget_bytes = 1
+        events = []
+        metrics.subscribe(events.append)
+        try:
+            ctl = FleetController(ds, hold=2, cooldown=2,
+                                  compact_cooldown=4)
+            assert ds.controller is ctl     # serving-tick attach
+            for _ in range(2):
+                ctl.on_quantum(_health('degraded',
+                                       memory_pressure=1.4))
+        finally:
+            metrics.unsubscribe(events.append)
+        assert ds.low_watermark == pytest.approx(0.65)
+        assert ctl.actions == {'watermark_lower': 1, 'compact': 1}
+        snap = metrics.snapshot()
+        assert snap['control_watermark_lowered'] == 1
+        assert snap['control_compactions'] == 1
+        assert snap['control_actions'] == 2
+        # compaction really ran: the doc now has a horizon record
+        assert ds.inner.store.horizon
+        # every action is a traced control.* span AND an event
+        spans = {e['name'] for e in events if e['event'] == 'span'}
+        assert {'control.watermark_lower',
+                'control.compact'} <= spans
+        acts = [e['action'] for e in events
+                if e['event'] == 'control_action']
+        assert acts == ['watermark_lower', 'compact']
+
+    def test_low_pressure_raises_watermark_back_to_base_only(self):
+        serving = _fake_serving(budget=1000, watermark=0.75)
+        ctl = FleetController(serving, hold=2, cooldown=1, attach=False)
+        serving.low_watermark = 0.55      # as if previously lowered
+        for _ in range(12):
+            ctl.on_quantum(_health(memory_pressure=0.2))
+        # raised step by step, clamped at the configured base
+        assert serving.low_watermark == pytest.approx(0.75)
+        for _ in range(8):
+            ctl.on_quantum(_health(memory_pressure=0.2))
+        assert serving.low_watermark == pytest.approx(0.75)
+        assert metrics.snapshot()['control_watermark_raised'] == 2
+
+    def test_no_budget_means_no_memory_actions(self):
+        serving = _fake_serving(budget=None)
+        ctl = FleetController(serving, hold=1, attach=False)
+        for _ in range(6):
+            ctl.on_quantum(_health(memory_pressure=5.0))
+        assert serving.low_watermark == 0.75
+        assert 'control_actions' not in metrics.snapshot()
+
+
+class TestAdmissionRule:
+    def _busy_setup(self, rate=4):
+        ctrl = AdmissionControl(changes_per_tick=rate, burst_ticks=2)
+        conn = _fake_conn(shared=ctrl, prefix='testscope/x1/')
+        serving = _fake_serving(conns=[conn])
+        return ctrl, serving
+
+    def test_sustained_busy_low_debt_widens(self):
+        ctrl, serving = self._busy_setup()
+        fc = FleetController(serving, hold=2, cooldown=1,
+                             attach=False)
+        base_rate = ctrl.change_bucket.rate
+        for _ in range(3):
+            metrics.bump('testscope/x1/sync_busy_sent')
+            fc.on_quantum(_health())
+        assert ctrl.change_bucket.rate == int(base_rate * 1.5)
+        assert metrics.snapshot()['control_tokens_widened'] == 1
+        # widening scales the burst with the rate
+        assert ctrl.change_bucket.burst >= ctrl.change_bucket.rate
+
+    def test_deep_debt_never_widens(self):
+        ctrl, serving = self._busy_setup()
+        fc = FleetController(serving, hold=2, cooldown=1,
+                             attach=False)
+        ctrl.change_bucket.tokens = -10 * ctrl.change_bucket.burst
+        base_rate = ctrl.change_bucket.rate
+        for _ in range(6):
+            metrics.bump('testscope/x1/sync_busy_sent')
+            fc.on_quantum(_health())
+        assert ctrl.change_bucket.rate == base_rate
+        assert 'control_tokens_widened' not in metrics.snapshot()
+
+    def test_quiet_spell_narrows_back_to_base(self):
+        ctrl, serving = self._busy_setup()
+        fc = FleetController(serving, hold=2, cooldown=1,
+                             narrow_after=4, attach=False)
+        base_rate = ctrl.change_bucket.rate
+        for _ in range(3):
+            metrics.bump('testscope/x1/sync_busy_sent')
+            fc.on_quantum(_health())
+        assert fc._rate_factor > 1.0
+        for _ in range(20):               # no fresh busy at all
+            fc.on_quantum(_health())
+        assert fc._rate_factor == 1.0
+        assert ctrl.change_bucket.rate == base_rate
+        snap = metrics.snapshot()
+        assert snap['control_tokens_narrowed'] >= 1
+        # once back at base, quiet quanta stop producing actions
+        total = snap['control_actions']
+        for _ in range(10):
+            fc.on_quantum(_health())
+        assert metrics.snapshot()['control_actions'] == total
+
+
+class TestShedRule:
+    def test_critical_sheds_then_green_restores(self):
+        ctrl, serving = (AdmissionControl(changes_per_tick=8),
+                         None)
+        conn = _fake_conn(admission=ctrl)
+        serving = _fake_serving(conns=[conn])
+        fc = FleetController(serving, hold=2, cooldown=1,
+                             shed_factor=0.25, attach=False)
+        base_rate = ctrl.change_bucket.rate
+        fc.on_quantum(_health('critical'))
+        assert fc._shed
+        assert ctrl.change_bucket.rate == max(1, base_rate // 4)
+        assert metrics.snapshot()['control_load_sheds'] == 1
+        # still critical: no re-shed, no restore
+        fc.on_quantum(_health('critical'))
+        assert metrics.snapshot()['control_load_sheds'] == 1
+        for _ in range(3):
+            fc.on_quantum(_health('green'))
+        assert not fc._shed
+        assert ctrl.change_bucket.rate == base_rate
+        assert metrics.snapshot()['control_shed_restores'] == 1
+
+    def test_shed_dumps_incident(self, tmp_path):
+        import os
+        from automerge_tpu.utils.metrics import FlightRecorder
+        rec = FlightRecorder(64)
+        ds = ServingDocSet(GeneralDocSet(4), str(tmp_path),
+                           flight_recorder=rec)
+        conn = _fake_conn(admission=AdmissionControl(
+            changes_per_tick=8))
+        ds.inner.connections[0] = conn
+        fc = FleetController(ds, attach=False)
+        fc.on_quantum(_health('critical'))
+        names = os.listdir(os.path.join(str(tmp_path), 'incidents'))
+        assert any('load_shed' in n for n in names)
+
+
+class TestHysteresis:
+    def test_signal_at_threshold_never_flaps(self):
+        """A pressure signal glued exactly to the high threshold:
+        lowers are spaced (fresh hold + cooldown per action), clamp at
+        the floor, and NEVER interleave with raises."""
+        serving = _fake_serving(budget=1000, watermark=0.85)
+        fc = FleetController(serving, hold=3, cooldown=5,
+                             attach=False)
+        marks = []
+        for _ in range(40):
+            fc.on_quantum(_health(memory_pressure=fc.mem_high))
+            marks.append(serving.low_watermark)
+        snap = metrics.snapshot()
+        assert snap.get('control_watermark_raised', 0) == 0
+        # monotonically non-increasing, clamped at the floor
+        assert all(b <= a + 1e-9 for a, b in zip(marks, marks[1:]))
+        assert marks[-1] >= fc.watermark_min - 1e-9
+        # each action needed >= max(hold, cooldown) quanta
+        assert snap['control_watermark_lowered'] <= 40 // 5 + 1
+
+    def test_signal_at_low_threshold_never_flaps(self):
+        serving = _fake_serving(budget=1000, watermark=0.75)
+        serving.low_watermark = 0.55
+        fc = FleetController(serving, hold=3, cooldown=5,
+                             attach=False)
+        fc._watermark_base = 0.75
+        for _ in range(40):
+            fc.on_quantum(_health(memory_pressure=fc.mem_low))
+        snap = metrics.snapshot()
+        assert snap.get('control_watermark_lowered', 0) == 0
+        assert serving.low_watermark == pytest.approx(0.75)
+
+    def test_dead_band_oscillation_is_ignored(self):
+        """A signal oscillating INSIDE the dead band produces zero
+        actions no matter how long it runs."""
+        serving = _fake_serving(budget=1000)
+        fc = FleetController(serving, hold=2, cooldown=1,
+                             attach=False)
+        for i in range(60):
+            p = 0.6 if i % 2 else 0.85   # strictly inside (low, high)
+            fc.on_quantum(_health(memory_pressure=p))
+        assert 'control_actions' not in metrics.snapshot()
+
+    def test_breach_shorter_than_hold_is_ignored(self):
+        serving = _fake_serving(budget=1000)
+        fc = FleetController(serving, hold=3, cooldown=1,
+                             attach=False)
+        for _ in range(10):               # breach, recover, breach...
+            fc.on_quantum(_health(memory_pressure=1.5))
+            fc.on_quantum(_health(memory_pressure=0.7))
+        assert 'control_actions' not in metrics.snapshot()
+
+
+class TestDoNothingGuarantee:
+    def test_green_fleet_zero_actions_zero_events(self, tmp_path):
+        """The do-nothing guarantee, over the REAL serving tick: a
+        green fleet's controller fires nothing — no counters, no
+        events, no knob movement — across many quanta."""
+        ds = _seed_serving(tmp_path, n_updates=4)
+        fc = FleetController(ds)          # attaches to the tick
+        watermark = ds.low_watermark
+        events = []
+        metrics.subscribe(events.append)
+        try:
+            for _ in range(20):
+                ds.tick()                 # maintenance -> on_quantum
+        finally:
+            metrics.unsubscribe(events.append)
+        assert fc._quantum == 20          # the hook really ran
+        snap = metrics.snapshot()
+        assert not any(k.startswith('control_') for k in snap), \
+            {k: v for k, v in snap.items()
+             if k.startswith('control_')}
+        assert ds.low_watermark == watermark
+        assert fc.actions == {}
+        assert not [e for e in events
+                    if e['event'] == 'control_action' or
+                    (e['event'] == 'span' and
+                     str(e.get('name', '')).startswith('control.'))]
+
+    def test_status_surface(self, tmp_path):
+        ds = _seed_serving(tmp_path, n_updates=4)
+        FleetController(ds)
+        st = ds.fleet_status(docs=False)
+        assert st['control'] == {
+            'rate_factor': 1.0, 'low_watermark': 0.75,
+            'watermark_base': 0.75, 'shed': False, 'actions': {}}
+
+
+class TestRegistry:
+    def test_control_registry_names_are_pinned(self):
+        from automerge_tpu.utils import metrics as M
+        assert set(M.CONTROL_COUNTERS) >= {
+            'control_actions', 'control_tokens_widened',
+            'control_tokens_narrowed', 'control_watermark_lowered',
+            'control_watermark_raised', 'control_compactions',
+            'control_load_sheds', 'control_shed_restores'}

@@ -1,0 +1,208 @@
+"""Fleet workload simulator acceptance suite (ISSUE 13).
+
+- Deterministic replay: one seed ⇒ byte-identical event schedule and
+  identical final per-doc state digests across two independent runs,
+  and across the forced-native vs numpy staging lanes.
+- Scenario regression vs the clean dict-path oracle: the flash-crowd
+  and reconnect-storm schedules converge byte-identically on the full
+  serving/wire stack — controller enabled AND disabled — with zero
+  quarantines and zero divergence detections.
+- SLO scorecard plumbing: verdicts computed solely from the exported
+  telemetry surface, sim counters registered and bumped.
+- (slow) The adaptive-control acceptance matrix at smoke scale: the
+  flash-crowd and diurnal scenarios end RED with the controller
+  disabled and GREEN with it enabled.
+"""
+
+import pytest
+
+from automerge_tpu import fleetsim, native
+from automerge_tpu.device import general
+from automerge_tpu.utils.metrics import metrics
+
+# Tiny scales: the tier-1 versions of the scenario specs — same
+# shapes, fleet sizes that keep a full run in seconds.
+TINY = {
+    'zipf': dict(n_nodes=2, n_docs=12, ticks=10, drain=40,
+                 ops_per_tick=6, alpha=1.1),
+    'flash_crowd': dict(
+        n_nodes=2, n_docs=12, ticks=20, drain=24, base_ops=3,
+        resident_docs=4, crowd_ops=8, crowd_start=4, crowd_end=18,
+        hot_actors=4, budget_factor=1.8,
+        slo={'peak_memory_pressure': 1.2, 'non_green_polls_max': 4},
+        controller_kwargs=dict(hold=2, cooldown=4, mem_high=0.75,
+                               compact_cooldown=6)),
+    'reconnect_storm': dict(n_nodes=3, n_docs=12, ticks=20, drain=80,
+                            ops_per_tick=5, alpha=1.1,
+                            partition_at=5, heal_at=14),
+}
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_byte_identical_schedule(self):
+        a = fleetsim.build_schedule('zipf', seed=7,
+                                    scale=TINY['zipf'])
+        b = fleetsim.build_schedule('zipf', seed=7,
+                                    scale=TINY['zipf'])
+        assert a == b
+        assert a['digest'] == b['digest']
+
+    def test_different_seed_different_schedule(self):
+        a = fleetsim.build_schedule('zipf', seed=7,
+                                    scale=TINY['zipf'])
+        b = fleetsim.build_schedule('zipf', seed=8,
+                                    scale=TINY['zipf'])
+        assert a['digest'] != b['digest']
+        assert a['ticks'] != b['ticks']
+
+    def test_every_catalog_scenario_builds_both_scales(self):
+        for name in fleetsim.SCENARIOS:
+            for scale in ('smoke', 'full'):
+                sched = fleetsim.build_schedule(name, scale=scale)
+                assert sched['n_ops'] > 0
+                assert sched['digest']
+        with pytest.raises(ValueError):
+            fleetsim.build_schedule('nope')
+
+    def test_actor_churn_full_scale_crosses_100k(self):
+        sched = fleetsim.build_schedule('actor_churn', scale='full')
+        assert sched['n_actors'] >= 100_000
+
+
+class TestReplayDeterminism:
+    def test_same_seed_identical_run(self):
+        """Two independent runs from one seed: identical schedule,
+        identical final per-doc state digests, identical materialized
+        views."""
+        runs = [fleetsim.run_scenario('zipf', seed=5,
+                                      scale=TINY['zipf'],
+                                      collect_views=True)
+                for _ in range(2)]
+        a, b = runs
+        assert a['schedule_digest'] == b['schedule_digest']
+        assert a['state_digests'] == b['state_digests']
+        assert a['state_digests']          # non-trivial comparand
+        assert a['views'] == b['views']
+        assert a['verdict'] == b['verdict'] == 'green'
+
+    @pytest.mark.skipif(not native.stage_available(),
+                        reason='native stager unavailable')
+    def test_forced_native_matches_numpy_lane(self):
+        """The same seed lands identical state digests whether the
+        fused applies stage through the C++ pipeline or the numpy
+        fallback."""
+        prev = general._NATIVE_STAGING
+        results = {}
+        try:
+            for lane, force in (('numpy', False), ('native', True)):
+                general._NATIVE_STAGING = force
+                results[lane] = fleetsim.run_scenario(
+                    'zipf', seed=5, scale=TINY['zipf'],
+                    collect_views=True)
+        finally:
+            general._NATIVE_STAGING = prev
+        assert results['numpy']['state_digests'] == \
+            results['native']['state_digests']
+        assert results['numpy']['views'] == \
+            results['native']['views']
+
+
+class TestScenarioOracleRegression:
+    """Flash-crowd and reconnect-storm runs converge byte-identically
+    with the clean dict-path oracle — controller enabled and disabled
+    — with zero quarantines and zero divergence detections."""
+
+    @pytest.mark.parametrize('scenario',
+                             ['flash_crowd', 'reconnect_storm'])
+    def test_byte_identical_to_oracle(self, scenario):
+        sched = fleetsim.build_schedule(scenario,
+                                        scale=TINY[scenario])
+        oracle = fleetsim.run_oracle(sched)
+        assert len(set(oracle)) == 1       # the oracle itself converged
+        for controller in (False, True):
+            r = fleetsim.FleetSim(schedule=sched,
+                                  controller=controller,
+                                  collect_views=True).run()
+            assert r['checks']['quarantined']['value'] == 0
+            assert r['checks']['diverged']['value'] == 0
+            assert metrics.counters.get('sync_divergence_detected',
+                                        0) == 0
+            # every serving/wire node == every clean dict-path node
+            assert set(r['views']) == set(oracle[:1]), (
+                scenario, controller)
+
+    def test_flash_crowd_controller_really_acts(self):
+        """The tiny flash crowd still drives the control loop: the
+        controller compacts under memory pressure and the fold is
+        visible in the store and the counters."""
+        r = fleetsim.run_scenario('flash_crowd',
+                                  scale=TINY['flash_crowd'],
+                                  controller=True)
+        assert r['control_actions'].get('compact', 0) >= 1
+        assert metrics.counters.get('control_compactions', 0) >= 1
+        assert metrics.counters.get('compaction_runs', 0) >= 1
+
+
+class TestScorecard:
+    def test_green_scorecard_fields_and_counters(self):
+        r = fleetsim.run_scenario('zipf', scale=TINY['zipf'])
+        assert r['verdict'] == 'green'
+        for key in ('scenario', 'checks', 'ops_per_sec',
+                    'convergence_ms_p99', 'peak_resident_bytes',
+                    'final_health', 'control_actions',
+                    'schedule_digest', 'state_digests'):
+            assert key in r, key
+        for name in ('quarantined', 'diverged',
+                     'replicas_digest_equal', 'replication_lag_ops',
+                     'pending_births', 'backpressure_depth',
+                     'final_health', 'critical_polls'):
+            assert r['checks'][name]['ok'], r['checks'][name]
+        snap = metrics.snapshot()
+        assert snap['sim_scenario_runs'] == 1
+        assert snap['sim_ticks'] > 0
+        assert snap['sim_ops_injected'] >= r['n_ops']
+        assert snap['sim_actors_spawned'] == r['n_actors']
+
+    def test_sim_registry_names_are_pinned(self):
+        from automerge_tpu.utils import metrics as M
+        assert set(M.SIM_COUNTERS) >= {
+            'sim_scenario_runs', 'sim_ticks', 'sim_ops_injected',
+            'sim_actors_spawned'}
+
+    def test_scenario_events_for_trace_report(self):
+        """The sim emits the scenario-start/summary events the
+        --scenario report mode of tools/trace_report.py parses."""
+        events = []
+        metrics.subscribe(events.append)
+        try:
+            fleetsim.run_scenario('zipf', scale=TINY['zipf'])
+        finally:
+            metrics.unsubscribe(events.append)
+        kinds = [e['event'] for e in events]
+        assert 'sim_scenario_start' in kinds
+        assert 'counter' in kinds          # the load-curve track
+        summary = [e for e in events if e['event'] == 'sim_scenario']
+        assert summary and summary[-1]['verdict'] == 'green'
+
+
+@pytest.mark.slow
+class TestAdaptiveAcceptance:
+    """The acceptance matrix at smoke scale: both adaptive scenarios
+    demonstrably end red with the controller disabled and green with
+    it enabled — the same runs bench_fleet_sim gates as
+    fleet_sim_adaptive_wins."""
+
+    @pytest.mark.parametrize('scenario', fleetsim.ADAPTIVE_SCENARIOS)
+    def test_red_without_controller_green_with(self, scenario):
+        off = fleetsim.run_scenario(scenario, controller=False)
+        on = fleetsim.run_scenario(scenario, controller=True)
+        assert off['verdict'] == 'red', off['checks']
+        assert on['verdict'] == 'green', on['checks']
+        assert on['control_action_total'] > 0

@@ -345,9 +345,13 @@ class TestRegistryDriftGuard:
     jit/<fn>/ — are labels, not registry entries, and stay outside
     the guard by construction.)"""
 
+    # bump/set_gauge/observe/ratchet('<name>' ...) — plus the
+    # controller's _act('<action>', '<counter>', ...) sites, whose
+    # CONTROL_COUNTERS literal is the second argument
     NAME_RE = re.compile(
-        r"(?:bump|set_gauge|observe|ratchet)\(\s*'"
-        r"((?:sync|serving|fleet|device|mem|compaction)_"
+        r"(?:bump|set_gauge|observe|ratchet|_act)\(\s*"
+        r"(?:'[a-z0-9_]+',\s*)?'"
+        r"((?:sync|serving|fleet|device|mem|compaction|control|sim)_"
         r"[a-z0-9_]+)'")
 
     def _package_names(self):
@@ -368,11 +372,12 @@ class TestRegistryDriftGuard:
         registered = set(M.ALL_COUNTER_REGISTRIES)
         missing = bumped - registered
         assert not missing, (
-            f'sync_/serving_/fleet_/device_/mem_/compaction_ '
-            f'counters bumped in automerge_tpu/ but absent from '
-            f'FAULT_COUNTERS/SERVING_COUNTERS/SYNC_COUNTERS/'
-            f'CONVERGENCE_COUNTERS/DEVICE_COUNTERS/'
-            f'COMPACTION_COUNTERS: {sorted(missing)}')
+            f'sync_/serving_/fleet_/device_/mem_/compaction_/'
+            f'control_/sim_ counters bumped in automerge_tpu/ but '
+            f'absent from FAULT_COUNTERS/SERVING_COUNTERS/'
+            f'SYNC_COUNTERS/CONVERGENCE_COUNTERS/DEVICE_COUNTERS/'
+            f'COMPACTION_COUNTERS/CONTROL_COUNTERS/SIM_COUNTERS: '
+            f'{sorted(missing)}')
 
     def test_no_registered_name_is_dead(self):
         """The reverse direction: a registered sync_/serving_/fleet_/
@@ -382,7 +387,8 @@ class TestRegistryDriftGuard:
         registered = set(M.ALL_COUNTER_REGISTRIES)
         dead = {n for n in registered
                 if n.startswith(('sync_', 'serving_', 'fleet_',
-                                 'device_', 'mem_', 'compaction_'))} \
+                                 'device_', 'mem_', 'compaction_',
+                                 'control_', 'sim_'))} \
             - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
 
@@ -392,7 +398,8 @@ class TestRegistryDriftGuard:
         seen = set()
         for reg in (M.FAULT_COUNTERS, M.SERVING_COUNTERS,
                     M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS,
-                    M.DEVICE_COUNTERS, M.COMPACTION_COUNTERS):
+                    M.DEVICE_COUNTERS, M.COMPACTION_COUNTERS,
+                    M.CONTROL_COUNTERS, M.SIM_COUNTERS):
             dup = seen & set(reg)
             assert not dup, f'registered twice: {sorted(dup)}'
             seen |= set(reg)

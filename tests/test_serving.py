@@ -59,9 +59,12 @@ def _oracle_views(n_docs=8):
 
 
 def _evict_all_cold(ds):
-    """Force one eviction pass that takes every unpinned doc."""
+    """Force eviction of every doc: two passes, because docs touched
+    in the quantum that just ended keep a one-quantum pin (the
+    anti-thrash grace from the fleet-sim flash-crowd scenario)."""
     prev = ds.memory_budget_bytes
     ds.memory_budget_bytes = 1
+    ds.tick()
     ds.tick()
     ds.memory_budget_bytes = prev
     return ds

@@ -423,8 +423,11 @@ class TestMemoryAccounting:
                            auto_compact=False)
         for d in range(4):
             _apply_round(ds, 1, n_ops=2, doc=f'doc{d}')
-        # squeeze everything cold out
+        # squeeze everything cold out (two ticks: docs touched in
+        # the quantum that just ended keep a one-quantum pin — the
+        # anti-thrash grace from the fleet-sim flash-crowd scenario)
         ds.memory_budget_bytes = 1
+        ds.tick()
         ds.tick()
         assert ds._n_evictions > 0
         st = ds.fleet_status(docs=False)

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""trace_report: incident JSONL / span dumps -> one Chrome-trace file.
+"""trace_report: incident JSONL / span dumps -> one Chrome-trace file,
+plus a per-scenario fleet-sim summary mode.
 
 The flight recorder dumps incidents as JSON-lines
 (``<dir>/incidents/incident-<seq>-<kind>.jsonl``) and any subscriber
@@ -14,6 +15,16 @@ https://ui.perfetto.dev — spans group into one lane per trace id
 (cross-peer ticks line up), every other event shows as an instant.
 Lines that are not valid JSON (a hand-edited file, a torn copy) are
 counted and skipped, never fatal.
+
+``--scenario`` switches to the fleet-simulator summary mode: the
+inputs (event JSONL dumps, incident files, or a Perfetto trace the
+simulator already produced via ``bench.py --fleet-sim --trace-out``)
+are scanned for the sim's scenario markers and a per-scenario table
+prints — SLO verdict with failed checks, the health-transition
+timeline, and every controller action, each stamped with its offset
+from scenario start:
+
+    python tools/trace_report.py --scenario fleetsim_trace.json
 """
 
 import argparse
@@ -21,26 +32,61 @@ import json
 import sys
 
 
+def _events_from_perfetto(trace):
+    """Reconstruct observability events from a Perfetto trace object
+    (the inverse of dump_chrome_trace, lossy but sufficient for the
+    scenario report: instants carry their fields in args, counter
+    samples carry one numeric field each)."""
+    events = []
+    for e in trace.get('traceEvents', ()):
+        ph = e.get('ph')
+        ts = e.get('ts')
+        if not isinstance(ts, (int, float)):
+            continue
+        if ph == 'i':
+            events.append({'event': e.get('name'), 'ts': ts / 1e6,
+                           **(e.get('args') or {})})
+        elif ph == 'C':
+            events.append({'event': 'counter', 'ts': ts / 1e6,
+                           e.get('name'): (e.get('args') or {})
+                           .get('value')})
+        elif ph == 'X':
+            events.append({'event': 'span', 'ts': ts / 1e6 +
+                           (e.get('dur') or 0) / 1e6,
+                           'name': e.get('name'),
+                           'dur_ms': (e.get('dur') or 0) / 1e3,
+                           **(e.get('args') or {})})
+    return events
+
+
 def load_events(paths):
-    """Events from JSONL files, in file order; returns
-    (events, skipped_line_count)."""
+    """Events from JSONL files (or whole-file Perfetto traces), in
+    file order; returns (events, skipped_line_count)."""
     events = []
     skipped = 0
     for path in paths:
         with open(path, 'r', encoding='utf-8') as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    event = json.loads(line)
-                except ValueError:
-                    skipped += 1
-                    continue
-                if isinstance(event, dict):
-                    events.append(event)
-                else:
-                    skipped += 1
+            text = f.read()
+        try:
+            whole = json.loads(text)
+        except ValueError:
+            whole = None
+        if isinstance(whole, dict) and 'traceEvents' in whole:
+            events.extend(_events_from_perfetto(whole))
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(event, dict):
+                events.append(event)
+            else:
+                skipped += 1
     return events, skipped
 
 
@@ -67,35 +113,148 @@ def wire_throughput(events):
     return out
 
 
+def split_scenarios(events):
+    """Segment an event stream on the simulator's markers: returns a
+    list of ``{'start': event, 'summary': event-or-None, 'events':
+    [events in between]}`` — one entry per ``sim_scenario_start``."""
+    segments = []
+    current = None
+    for e in events:
+        kind = e.get('event')
+        if kind == 'sim_scenario_start':
+            current = {'start': e, 'summary': None, 'events': []}
+            segments.append(current)
+        elif current is not None:
+            if kind == 'sim_scenario':
+                current['summary'] = e
+                current = None
+            else:
+                current['events'].append(e)
+    return segments
+
+
+def _offset(e, t0):
+    ts = e.get('ts')
+    if isinstance(ts, (int, float)) and isinstance(t0, (int, float)):
+        return f'+{ts - t0:7.2f}s'
+    return '        ?'
+
+
+def scenario_report(events, out=sys.stdout):
+    """The ``--scenario`` summary: per scenario, the SLO verdict (and
+    which checks failed), the health-transition timeline and every
+    controller action, offsets relative to scenario start."""
+    segments = split_scenarios(events)
+    if not segments:
+        print('no sim_scenario_start markers found — is this a '
+              'fleet-sim artifact (bench.py --fleet-sim '
+              '--trace-out / a flight-recorder dump of a sim run)?',
+              file=out)
+        return 1
+    header = (f'{"scenario":<18} {"ctl":<4} {"verdict":<8} '
+              f'{"ops/s":>10} {"conv p99 ms":>12} '
+              f'{"peak resident":>14} {"actions":>8}')
+    print(header, file=out)
+    print('-' * len(header), file=out)
+    for seg in segments:
+        start = seg['start']
+        s = seg['summary'] or {}
+        verdict = s.get('verdict', '(no summary)')
+        print(f'{start.get("scenario", "?"):<18} '
+              f'{"on" if start.get("controller") else "off":<4} '
+              f'{verdict:<8} '
+              f'{s.get("ops_per_sec") or 0:>10.0f} '
+              f'{s.get("convergence_ms_p99") or 0:>12.1f} '
+              f'{(s.get("peak_resident_bytes") or 0) >> 10:>10} KiB '
+              f'{s.get("control_action_total") or 0:>8}', file=out)
+        failed = s.get('failed') or []
+        if failed:
+            print(f'    failed checks: {", ".join(map(str, failed))}',
+                  file=out)
+    for seg in segments:
+        start = seg['start']
+        t0 = start.get('ts')
+        health = [e for e in seg['events']
+                  if e.get('event') == 'health_transition']
+        actions = [e for e in seg['events']
+                   if e.get('event') == 'control_action']
+        loads = [e.get('sim_load_ops') for e in seg['events']
+                 if e.get('event') == 'counter' and
+                 isinstance(e.get('sim_load_ops'), (int, float))]
+        if not (health or actions):
+            continue
+        label = (f'{start.get("scenario", "?")} '
+                 f'[controller '
+                 f'{"on" if start.get("controller") else "off"}]')
+        print(f'\n{label} timeline'
+              + (f' (load peak {max(loads):.0f} ops/tick, mean '
+                 f'{sum(loads) / len(loads):.0f})' if loads else ''),
+              file=out)
+        timeline = sorted(health + actions,
+                          key=lambda e: e.get('ts') or 0)
+        for e in timeline:
+            if e.get('event') == 'health_transition':
+                print(f'  {_offset(e, t0)}  health '
+                      f'{e.get("previous")} -> {e.get("state")}'
+                      f'  ({"; ".join(e.get("reasons") or ())})',
+                      file=out)
+            else:
+                detail = {k: v for k, v in e.items()
+                          if k not in ('event', 'ts', 'mono',
+                                       'action')}
+                print(f'  {_offset(e, t0)}  control '
+                      f'{e.get("action")} {detail}', file=out)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description='Convert incident/event JSONL dumps to a '
-                    'Chrome-trace JSON file.')
+                    'Chrome-trace JSON file, or summarize a '
+                    'fleet-sim run per scenario (--scenario).')
     parser.add_argument('inputs', nargs='+',
                         help='incident .jsonl files (flight-recorder '
-                             'dumps or raw event logs)')
-    parser.add_argument('-o', '--output', required=True,
-                        help='Chrome-trace JSON output path')
+                             'dumps or raw event logs) or a Perfetto '
+                             'trace produced by bench.py --fleet-sim '
+                             '--trace-out')
+    parser.add_argument('-o', '--output',
+                        help='Chrome-trace JSON output path '
+                             '(required unless --scenario)')
+    parser.add_argument('--scenario', action='store_true',
+                        help='print the per-scenario fleet-sim '
+                             'summary (SLO verdicts, health '
+                             'transitions, controller actions) '
+                             'instead of converting')
     args = parser.parse_args(argv)
+    if not args.scenario and not args.output:
+        parser.error('-o/--output is required unless --scenario')
 
     sys.path.insert(0, __file__.rsplit('/', 2)[0])
-    from automerge_tpu.telemetry import dump_chrome_trace
 
     events, skipped = load_events(args.inputs)
-    trace = dump_chrome_trace(events, path=args.output)
-    n_spans = sum(1 for e in trace['traceEvents']
-                  if e.get('ph') == 'X')
-    n_instants = sum(1 for e in trace['traceEvents']
-                     if e.get('ph') == 'i')
-    print(f'{args.output}: {n_spans} spans, {n_instants} instants '
-          f'from {len(events)} events'
-          + (f' ({skipped} unparseable lines skipped)' if skipped
-             else ''))
-    for name, (n, total, ms) in sorted(wire_throughput(events).items()):
-        rate = total / (ms / 1e3) / 1e6 if ms else 0.0
-        print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
-              f'{ms:.1f} ms -> {rate:.0f} MB/s')
-    return 0
+    rc = 0
+    if args.scenario:
+        rc = scenario_report(events)
+        if skipped and not args.output:
+            # the conversion summary below reports the count itself
+            print(f'({skipped} unparseable lines skipped)')
+    if args.output:
+        from automerge_tpu.telemetry import dump_chrome_trace
+        trace = dump_chrome_trace(events, path=args.output)
+        n_spans = sum(1 for e in trace['traceEvents']
+                      if e.get('ph') == 'X')
+        n_instants = sum(1 for e in trace['traceEvents']
+                         if e.get('ph') == 'i')
+        print(f'{args.output}: {n_spans} spans, {n_instants} '
+              f'instants from {len(events)} events'
+              + (f' ({skipped} unparseable lines skipped)' if skipped
+                 else ''))
+        for name, (n, total, ms) in sorted(
+                wire_throughput(events).items()):
+            rate = total / (ms / 1e3) / 1e6 if ms else 0.0
+            print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
+                  f'{ms:.1f} ms -> {rate:.0f} MB/s')
+    return rc
 
 
 if __name__ == '__main__':
