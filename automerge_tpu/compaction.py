@@ -81,6 +81,15 @@ _ARRAYS = (
     ('nd_actor', '<i4'), ('nd_elemc', '<i4'), ('nd_vis', 'u1'),
     ('nd_visidx', '<i4'))
 
+# v2 column extension: the device-resident sequence index (tree_pos
+# per node) rides the state snapshot, so a restore rebuilds the
+# mirror WITH a valid 'tp' plane and skips the whole-object
+# _rga_order rebuild on first touch. The header's 'idx' flag says
+# whether the column is a live index (every seq object of the doc had
+# idx_ok at extraction) or mere padding. Old payloads (len(lens) ==
+# len(_ARRAYS)) decode exactly as before, with no index claim.
+_ARRAYS_V2 = _ARRAYS + (('nd_tpos', '<i4'),)
+
 
 def encode_state_snapshot(st):
     """Serialize one extracted doc state (the dict
@@ -90,16 +99,21 @@ def encode_state_snapshot(st):
     length + CRC32 — truncation and bit rot surface as a clean
     :class:`~automerge_tpu.snapshot.SnapshotCorruptError`)."""
     from .durability import pack_snapshot
+    if 'nd_tpos' not in st:
+        st = dict(st)
+        st['nd_tpos'] = np.zeros(len(st['nd_obj']), np.int32)
+        st.setdefault('idx', False)
     header = {'format': STATE_FORMAT, 'clock': st['clock'],
               'digest': st['digest'], 'actors': st['actors'],
               'keys': st['keys'], 'values': st['values'],
               'objs': st['objs'], 'inbound': st['inbound'],
-              'lens': [int(len(st[name])) for name, _ in _ARRAYS]}
+              'idx': bool(st.get('idx', False)),
+              'lens': [int(len(st[name])) for name, _ in _ARRAYS_V2]}
     head = json.dumps(header, separators=(',', ':')).encode()
     body = b''.join([_LEN.pack(len(head)), head] +
                     [np.ascontiguousarray(
                         st[name].astype(dtype)).tobytes()
-                     for name, dtype in _ARRAYS])
+                     for name, dtype in _ARRAYS_V2])
     return pack_snapshot(_STATE_MAGIC + zlib.compress(body, 6))
 
 
@@ -126,18 +140,22 @@ def decode_state_snapshot(data):
             header.get('format') != STATE_FORMAT:
         raise SnapshotCorruptError('not a doc-state snapshot')
     lens = header.get('lens')
-    if not isinstance(lens, list) or len(lens) != len(_ARRAYS):
+    if not isinstance(lens, list) or \
+            len(lens) not in (len(_ARRAYS), len(_ARRAYS_V2)):
         raise SnapshotCorruptError(
             "doc-state snapshot: missing field 'lens'")
+    manifest = _ARRAYS_V2 if len(lens) == len(_ARRAYS_V2) else _ARRAYS
     out = {'clock': header.get('clock') or {},
            'digest': header.get('digest'),
            'actors': header.get('actors') or [],
            'keys': header.get('keys') or [],
            'values': header.get('values') or [],
            'objs': header.get('objs') or [],
-           'inbound': header.get('inbound') or {}}
+           'inbound': header.get('inbound') or {},
+           'idx': bool(header.get('idx', False))
+           and len(lens) == len(_ARRAYS_V2)}
     pos = 4 + hlen
-    for (name, dtype), n in zip(_ARRAYS, lens):
+    for (name, dtype), n in zip(manifest, lens):
         try:
             arr = np.frombuffer(body, dtype=dtype, count=n,
                                 offset=pos)
@@ -205,9 +223,13 @@ def _validate_decoded(st):
                  'nd_vis', 'nd_visidx'):
         if len(st[name]) != n_nodes:
             bad('node column lengths disagree')
+    if 'nd_tpos' in st and len(st['nd_tpos']) != n_nodes:
+        bad('node column lengths disagree')
     check(st['nd_obj'], 0, max(n_objs, 1), 'node object ref')
     check(st['nd_actor'], -1, max(n_actors, 1), 'node actor ref')
     check(st['nd_local'], 0, 1 << 22, 'node local index')
+    if 'nd_tpos' in st:
+        check(st['nd_tpos'], 0, 1 << 22, 'node tree position')
     for obj in st['objs']:
         if not (isinstance(obj, list) and len(obj) == 2 and
                 isinstance(obj[0], str)):
@@ -242,6 +264,8 @@ def extract_doc_states(store, idxs):
     """
     store._commit_pending()
     store.pool.sync()
+    store.pool.sync_index()      # the order index rides the state
+    #                              snapshot (docs with idx_ok claims)
     store._fold_digests()
     pool = store.pool
     digests_ok = getattr(store, '_digest_valid', False)
@@ -317,11 +341,18 @@ def _extract_one(store, pool, d, e_order, e_sorted, o_order, o_sorted,
         nd_elemc = pool.elemc[rows]
         nd_vis = pool.visible[rows].astype(np.uint8)
         nd_visidx = pool.vis_index[rows]
+        nd_tpos = pool.tpos[rows]
+        # a live index claim only when EVERY seq object of the doc is
+        # index-valid (absorb sets idx_ok per object anyway; the
+        # all-or-nothing flag keeps the header one bit)
+        idx_ok = bool(pool.idx_ok[seq_objs].all()) \
+            if len(pool.idx_ok) > int(seq_objs.max()) else False
     else:
         z = np.zeros(0, np.int32)
         nd_obj = nd_local = nd_parent = nd_actor = nd_elemc = \
-            nd_visidx = z
+            nd_visidx = nd_tpos = z
         nd_vis = np.zeros(0, np.uint8)
+        idx_ok = True            # vacuously: nothing to rebuild
 
     # causal-closure log rows (append order within the doc)
     llo, lhi = np.searchsorted(l_sorted, [d, d + 1])
@@ -388,7 +419,8 @@ def _extract_one(store, pool, d, e_order, e_sorted, o_order, o_sorted,
           'nd_obj': nd_obj, 'nd_local': nd_local,
           'nd_parent': nd_parent, 'nd_actor': nd_actor,
           'nd_elemc': nd_elemc, 'nd_vis': nd_vis,
-          'nd_visidx': nd_visidx}
+          'nd_visidx': nd_visidx, 'nd_tpos': nd_tpos,
+          'idx': idx_ok}
     return {'clock': st['clock'], 'digest': st['digest'],
             'state': encode_state_snapshot(st)}
 
@@ -414,6 +446,9 @@ def absorb_doc_states(store, items):
              for idx, payload, decoded in items]
     store._commit_pending()
     store.pool.sync()
+    store.pool.sync_index()      # existing docs' order index must be
+    #                              host-current BEFORE the mirror
+    #                              rebuilds from the host columns
     store._fold_digests()
     pool = store.pool
 
@@ -428,6 +463,7 @@ def absorb_doc_states(store, items):
     ent_doc = []
     pool_obj, pool_local, pool_parent, pool_actor = [], [], [], []
     pool_elemc, pool_vis, pool_visidx = [], [], []
+    pool_tpos, idx_claims = [], []
     l_keys, l_dep_counts, l_dep_actor, l_dep_seq = [], [], [], []
     ck_doc, ck_actor, ck_seq = [], [], []
     l_base = len(store.l_key)
@@ -466,6 +502,16 @@ def absorb_doc_states(store, items):
             pool_vis.append(np.asarray(st['nd_vis'], np.uint8)
                             .astype(bool))
             pool_visidx.append(np.asarray(st['nd_visidx'], np.int32))
+            if 'nd_tpos' in st:
+                pool_tpos.append(np.asarray(st['nd_tpos'], np.int32))
+            else:
+                pool_tpos.append(np.zeros(len(st['nd_obj']),
+                                          np.int32))
+            if st.get('idx'):
+                # the snapshot shipped a live order index for this
+                # doc's seq objects: claim it after grow_objects
+                idx_claims.append(
+                    obj_map[np.unique(st['nd_obj'])].astype(np.int64))
         # log rows
         n_log = len(st['lg_seq'])
         if n_log:
@@ -543,6 +589,7 @@ def absorb_doc_states(store, items):
         pool.visible = np.concatenate([pool.visible] + pool_vis)
         pool.vis_index = np.concatenate(
             [pool.vis_index] + pool_visidx)
+        pool.tpos = np.concatenate([pool.tpos] + pool_tpos)
         # new object rows are strictly above every existing one, so
         # the position keys append at the tail of the sorted index
         keys = (obj_cat.astype(np.int64) << 32) | local_cat
@@ -565,6 +612,8 @@ def absorb_doc_states(store, items):
     # per-object counters must cover node-less objects (maps) too —
     # rows_of_objs and friends index n_of by object row
     pool.grow_objects(len(store.obj_uuid))
+    for rows_c in idx_claims:
+        pool.idx_ok[rows_c] = True
     new_l = np.concatenate(l_keys)
     if len(new_l):
         dep_counts = np.concatenate(l_dep_counts)

@@ -110,9 +110,10 @@ class _SeqPool:
     """
 
     __slots__ = ('obj', 'local', 'parent', 'actor', 'elemc', 'visible',
-                 'vis_index', 'pos_sorted', 'pos_row', 'n_of',
-                 'max_elem_of', 'max_tree', 'max_elem', 'mirror',
-                 '_epoch', '_host_epoch', '_lock')
+                 'vis_index', 'tpos', 'idx_ok', 'pos_sorted', 'pos_row',
+                 'n_of', 'max_elem_of', 'max_tree', 'max_elem',
+                 'mirror', '_epoch', '_host_epoch', '_tpos_epoch',
+                 '_lock')
 
     def __init__(self):
         # host lock shared with the owning store: serializes the apply
@@ -127,6 +128,14 @@ class _SeqPool:
         self.elemc = z32
         self.visible = np.zeros(0, bool)
         self.vis_index = z32
+        # host materialization of the device-resident ORDER index
+        # (tree_pos per node; fetched on demand by sync_index — the
+        # snapshot/compaction path, never the per-tick read path)
+        self.tpos = z32
+        # per-OBJECT: the mirror's 'tp' plane holds this object's true
+        # tree positions (the incremental-update eligibility bit; False
+        # forces a whole-object _rga_order rebuild on next touch)
+        self.idx_ok = np.zeros(0, bool)
         self.pos_sorted = np.zeros(0, np.int64)
         self.pos_row = np.zeros(0, np.int64)
         self.n_of = np.zeros(0, np.int64)        # per OBJECT row
@@ -135,9 +144,13 @@ class _SeqPool:
         self.max_elem = 0        # pool-wide max elemc (packed-fmt guard)
         # device mirror: {'cap', 'n', 'parent', 'elemc', 'actor',
         # 'visible', 'vis_index' (device arrays, POS order), 'rank_n'}
+        # (+ 'tp': int32 tree_pos per node on packed/wide — the
+        # persistent order-statistic index the incremental update
+        # maintains across ticks)
         self.mirror = None
         self._epoch = 0          # bumped per apply that dirtied trees
         self._host_epoch = 0     # host visible/vis_index currency
+        self._tpos_epoch = 0     # host tpos currency (sync_index)
 
     @property
     def n_nodes(self):
@@ -150,6 +163,9 @@ class _SeqPool:
                 [self.n_of, np.zeros(pad, np.int64)])
             self.max_elem_of = np.concatenate(
                 [self.max_elem_of, np.zeros(pad, np.int64)])
+            # a fresh object has no device-resident index yet
+            self.idx_ok = np.concatenate(
+                [self.idx_ok, np.zeros(pad, bool)])
 
     def _append(self, obj, local, parent, actor, elemc):
         base = len(self.obj)
@@ -162,6 +178,7 @@ class _SeqPool:
         self.visible = np.concatenate([self.visible, np.zeros(n, bool)])
         self.vis_index = np.concatenate(
             [self.vis_index, np.full(n, -1, np.int32)])
+        self.tpos = np.concatenate([self.tpos, np.zeros(n, np.int32)])
         keys = (obj.astype(np.int64) << 32) | local
         pos = np.searchsorted(self.pos_sorted, keys)
         self.pos_sorted = np.insert(self.pos_sorted, pos, keys)
@@ -249,6 +266,25 @@ class _SeqPool:
             self.visible[rows] = np.asarray(vis)
             self.vis_index[rows] = np.asarray(idx)
 
+    def sync_index(self):
+        """Materialize the device-resident ORDER index (the mirror's
+        'tp' tree_pos plane) into the host ``tpos`` column — the
+        snapshot/compaction counterpart of :meth:`sync`, fetched on
+        demand so the per-tick read path never pays the extra D2H.
+        Host ``tpos`` values are meaningful exactly for objects whose
+        ``idx_ok`` bit is set (the same validity contract as the
+        device plane)."""
+        with self._lock:
+            if self.mirror is None or 'tp' not in self.mirror:
+                return
+            if self._tpos_epoch == self._epoch:
+                return
+            self._tpos_epoch = self._epoch
+            n = self.mirror['n']
+            tp = np.asarray(jax.device_get(self.mirror['tp'][:n]))
+            rows = self.mirror['pos_row'][:n]
+            self.tpos[rows] = tp
+
 
 def _exact_lookup(t_obj, t_key, t_val, q_obj, q_key, n_objs):
     """Exact-match (obj, key) -> val lookup, whole batch: `t_*` is an
@@ -335,9 +371,10 @@ class _Txn:
                         store.e_link, store.e_change)
         self.pool_cols = (pool.obj, pool.local, pool.parent, pool.actor,
                           pool.elemc, pool.visible, pool.vis_index,
-                          pool.pos_sorted, pool.pos_row)
+                          pool.tpos, pool.pos_sorted, pool.pos_row)
         self.pool_n = (pool.n_of.copy(), pool.max_elem_of.copy(),
-                       pool.max_tree, pool.max_elem)
+                       pool.max_tree, pool.max_elem,
+                       pool.idx_ok.copy(), pool._tpos_epoch)
         # digest fold is copy-on-fold and reads never interleave an
         # apply, so the array REFERENCE plus the pending length is a
         # complete rollback record — no per-apply copy
@@ -386,10 +423,10 @@ class _Txn:
          store.e_seq, store.e_value, store.e_link,
          store.e_change) = self.entries
         (pool.obj, pool.local, pool.parent, pool.actor, pool.elemc,
-         pool.visible, pool.vis_index, pool.pos_sorted,
+         pool.visible, pool.vis_index, pool.tpos, pool.pos_sorted,
          pool.pos_row) = self.pool_cols
         (pool.n_of, pool.max_elem_of, pool.max_tree,
-         pool.max_elem) = self.pool_n
+         pool.max_elem, pool.idx_ok, pool._tpos_epoch) = self.pool_n
         store._digest = self.digest
         del store._digest_pending[self.n_digest_pending:]
 
@@ -494,6 +531,9 @@ class GeneralStore(BlockStore):
         import json as _json2
         self._commit_pending()
         self.pool.sync()
+        self.pool.sync_index()       # order index rides the snapshot:
+        #                              resume skips the per-object
+        #                              _rga_order rebuild
         self.log_sorted_keys()       # fold pending appends into l_order
         self._fold_digests()         # change bodies are dropped below —
         #                              the digest must be folded NOW
@@ -552,6 +592,7 @@ class GeneralStore(BlockStore):
             p_obj=pool.obj, p_local=pool.local, p_parent=pool.parent,
             p_actor=pool.actor, p_elemc=pool.elemc,
             p_visible=pool.visible, p_vis_index=pool.vis_index,
+            p_tpos=pool.tpos, p_idx_ok=pool.idx_ok,
             p_pos_sorted=pool.pos_sorted, p_pos_row=pool.pos_row,
             p_n_of=pool.n_of, p_max_elem_of=pool.max_elem_of,
             digest=self._digest,
@@ -608,6 +649,15 @@ class GeneralStore(BlockStore):
             pool.elemc = z['p_elemc']
             pool.visible = z['p_visible']
             pool.vis_index = z['p_vis_index']
+            # order-index planes: present since the incremental-index
+            # format; a pre-index snapshot resumes with idx_ok all
+            # False (first touch of each object rebuilds its order)
+            if 'p_tpos' in z:
+                pool.tpos = z['p_tpos']
+                pool.idx_ok = z['p_idx_ok'].astype(bool)
+            else:
+                pool.tpos = np.zeros(len(pool.obj), np.int32)
+                pool.idx_ok = np.zeros(len(z['p_n_of']), bool)
             pool.pos_sorted = z['p_pos_sorted']
             pool.pos_row = z['p_pos_row']
             pool.n_of = z['p_n_of']
@@ -682,6 +732,14 @@ class GeneralStore(BlockStore):
         else:
             a_width = 1
         a_pad = opts.pad_actors(max(a_width, 1))
+        # the persistent order index rides along for every object whose
+        # idx_ok bit survived (snapshot resume / state absorb): those
+        # objects skip the whole-object _rga_order rebuild and go
+        # straight to incremental updates. tpos is a host column, so
+        # this needs no device fetch; objects with idx_ok False carry
+        # garbage slots that are never read.
+        tp = np.zeros(cap, np.int32)
+        tp[:n] = pool.tpos[rows]
         if _packed_mirror_guard(pool, n_act, a_pad):
             ranks = np.asarray(self.actor_str_ranks())
             actor = pool.actor[rows]
@@ -698,6 +756,7 @@ class GeneralStore(BlockStore):
             self.pool.mirror = {
                 'fmt': 'packed', 'cap': cap, 'n': n,
                 'w1': jnp.asarray(w1), 'w2': jnp.asarray(w2),
+                'tp': jnp.asarray(tp),
                 'ranks': ranks.copy(), 'pos_row': pool.pos_row}
         elif _wide_mirror_guard(pool, n_act, a_pad):
             # a resumed long-text store builds the wide mirror
@@ -716,7 +775,7 @@ class GeneralStore(BlockStore):
             self.pool.mirror = {
                 'fmt': 'wide', 'cap': cap, 'n': n,
                 'w1': jnp.asarray(w1), 'w2': jnp.asarray(w2),
-                'w3': jnp.asarray(w3),
+                'w3': jnp.asarray(w3), 'tp': jnp.asarray(tp),
                 'rank_n': n_act, 'rank_table': _rank_table(self, opts),
                 'pos_row': pool.pos_row}
         else:
@@ -725,6 +784,10 @@ class GeneralStore(BlockStore):
                 out[:n] = src[rows]
                 return jnp.asarray(out)
 
+            # the cols fallback never runs the incremental update — it
+            # carries no 'tp' plane, and the idx_ok claims must drop
+            # with it
+            pool.idx_ok[:] = False
             self.pool.mirror = {
                 'fmt': 'cols', 'cap': cap, 'n': n,
                 'parent': col(pool.parent, 0, np.int32),
@@ -1367,6 +1430,30 @@ _STAGE_CAPTURE = None
 # falling back)
 _NATIVE_STAGING = None
 
+# incremental-index switch: None = auto (take the incremental path
+# whenever the eligibility gate holds), 'rebuild' = always run the
+# whole-object rebuild variant (the A/B arm of bench_incremental_order
+# and the parity oracle in tests/test_sequence_index.py), 'require' =
+# raise when an apply with dirty sequences cannot go incremental
+# (tests: an invalidation path that silently falls back is a bug)
+_INDEX_MODE = None
+
+# edit-stream read switch (GeneralPatch._ensure): one fused device
+# dispatch compacts the tick's edits into pre-ordered delta-sized
+# buffers (pallas_view.edit_stream) and the read fetches THOSE
+# instead of the full O(doc) vis planes. None = auto (on for real
+# accelerator backends, where the link fetch is the binding cost; the
+# CPU backend keeps the host path — there is no link to save, and
+# XLA-CPU scatters lose to a memcpy-sized fetch), True = force on,
+# False = host path always.
+_EDIT_STREAM = None
+
+
+def _edit_stream_on():
+    if _EDIT_STREAM is None:
+        return jax.default_backend() != 'cpu'
+    return bool(_EDIT_STREAM)
+
 
 def _packed_mirror_guard(pool, n_act, a_pad=None):
     """The packed 2-word mirror format's bit-field bounds — ONE
@@ -1429,12 +1516,16 @@ def _wire_sizes_wide(d_pad, n_pad, K, nnz_pad):
 
 @partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
                                    'm_pad', 'has_remap', 'has_old'))
-def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
-                          sizes, num_segments, a_pad, m_pad, has_remap,
-                          has_old):
-    """One apply against the PACKED device-resident mirror. Outputs:
-    (w1', w2', surv_u8, winner[S], vis_packed[K, m_pad]) where
-    vis_packed = prior_vis<<31 | visible<<30 | (prior_idx+1)<<15
+def _fused_general_packed(w1m, w2m, tpm, wire, n_old, n_rows,
+                          rank_remap, *, sizes, num_segments, a_pad,
+                          m_pad, has_remap, has_old):
+    """One apply against the PACKED device-resident mirror — the
+    whole-object REBUILD variant: every dirty sequence re-orders from
+    scratch via `_rga_order_batched`, and the fresh tree positions
+    (re)initialize the persistent 'tp' index plane that the
+    incremental variant (`_fused_general_incr`) maintains afterwards.
+    Outputs: (w1', w2', tp', surv_u8, winner[S], vis_packed[K, m_pad])
+    where vis_packed = prior_vis<<31 | visible<<30 | (prior_idx+1)<<15
     | (new_idx+1) — the host unpacks via a uint32 view."""
     from .merge import _resolve_sorted
     from .sequence import _rga_order_batched
@@ -1494,6 +1585,7 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
 
     w1f = fold(w1m, w1d)
     w2f = fold(w2m, w2e)             # new nodes: hidden, vis word = elemc
+    tpf = fold(tpm, jnp.zeros(d_pad, jnp.int32))
 
     # ---- job planes ----
     l = jnp.arange(m_pad, dtype=jnp.int32)
@@ -1526,11 +1618,13 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
                                  valid_plane)
     new_idx = ordered['vis_index']
 
-    # ---- scatter the updated vis word back (one scatter) ----
+    # ---- scatter the updated vis word + tree positions back ----
     w2n = (visible.astype(jnp.int32) << _W2_VIS_SHIFT) | \
         ((new_idx + 1) << _W2_IDX_SHIFT) | s_elem
     scatter_pos = jnp.where(valid_plane, pos_mat, cap).reshape(-1)
     w2f = w2f.at[scatter_pos].set(w2n.reshape(-1), mode='drop')
+    tpf = tpf.at[scatter_pos].set(
+        ordered['tree_pos'].reshape(-1), mode='drop')
 
     surv_u8 = jnp.sum(
         out['surviving'].reshape(-1, 8).astype(jnp.uint8)
@@ -1539,20 +1633,23 @@ def _fused_general_packed(w1m, w2m, wire, n_old, n_rows, rank_remap, *,
     vis_packed = (prior_vis.astype(jnp.int32) << 31) | \
         (visible.astype(jnp.int32) << 30) | \
         ((prior_idx + 1) << _W2_IDX_SHIFT) | (new_idx + 1)
-    return w1f, w2f, surv_u8, out['winner'], vis_packed
+    return w1f, w2f, tpf, surv_u8, out['winner'], vis_packed
 
 
 @partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
                                    'm_pad', 'has_old'))
-def _fused_general_wide(w1m, w2m, w3m, wire, n_old, n_rows, rank_table,
-                        *, sizes, num_segments, a_pad, m_pad, has_old):
+def _fused_general_wide(w1m, w2m, w3m, tpm, wire, n_old, n_rows,
+                        rank_table, *, sizes, num_segments, a_pad,
+                        m_pad, has_old):
     """One apply against the WIDE 3-word packed mirror (trees to
     2^22 - 1 nodes; elemc/seq bounded only by int32). Same program
     shape as `_fused_general_packed` with the wide bit layout, int32
     seq/coo wire sections and actor ids (stable) in the words instead
     of ranks — the RGA rank rides the small `rank_table` gather, so a
-    growing actor table never remaps the mirror. Outputs: (w1', w2',
-    w3', surv_u8, winner[S], vis_prior[K, m_pad], vis_new[K, m_pad]);
+    growing actor table never remaps the mirror. The whole-object
+    REBUILD variant: fresh tree positions (re)initialize the
+    persistent 'tp' index plane. Outputs: (w1', w2', w3', tp',
+    surv_u8, winner[S], vis_prior[K, m_pad], vis_new[K, m_pad]);
     each vis plane word is ``visible << 22 | (idx + 1)``
     (`unpack_wide_word`)."""
     from .merge import _resolve_sorted
@@ -1606,6 +1703,7 @@ def _fused_general_wide(w1m, w2m, w3m, wire, n_old, n_rows, rank_table,
     # new nodes: hidden, vis_index+1 = 0, actor-hi bits ride along
     w2f = fold(w2m, d_ahi << _WIDE_AHI_SHIFT)
     w3f = fold(w3m, w3d)
+    tpf = fold(tpm, jnp.zeros(d_pad, jnp.int32))
 
     # ---- job planes ----
     l = jnp.arange(m_pad, dtype=jnp.int32)
@@ -1639,11 +1737,13 @@ def _fused_general_wide(w1m, w2m, w3m, wire, n_old, n_rows, rank_table,
                                  valid_plane)
     new_idx = ordered['vis_index']
 
-    # ---- scatter the updated vis word back (actor-hi bits preserved) ----
+    # ---- scatter the updated vis word + tree positions back ----
     w2n = (w2p & _WIDE_AHI_BITS) | \
         (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | (new_idx + 1)
     scatter_pos = jnp.where(valid_plane, pos_mat, cap).reshape(-1)
     w2f = w2f.at[scatter_pos].set(w2n.reshape(-1), mode='drop')
+    tpf = tpf.at[scatter_pos].set(
+        ordered['tree_pos'].reshape(-1), mode='drop')
 
     surv_u8 = jnp.sum(
         out['surviving'].reshape(-1, 8).astype(jnp.uint8)
@@ -1653,7 +1753,392 @@ def _fused_general_wide(w1m, w2m, w3m, wire, n_old, n_rows, rank_table,
         (prior_idx + 1)
     vis_new = (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
         (new_idx + 1)
-    return w1f, w2f, w3f, surv_u8, out['winner'], vis_prior, vis_new
+    return w1f, w2f, w3f, tpf, surv_u8, out['winner'], vis_prior, \
+        vis_new
+
+
+@partial(jax.jit, static_argnames=('fmt', 'sizes', 'num_segments',
+                                   'a_pad', 'm_pad', 'dm_pad',
+                                   'has_remap'))
+def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
+                        n_rows, aux, *, fmt, sizes, num_segments,
+                        a_pad, m_pad, dm_pad, has_remap):
+    """One apply as an INCREMENTAL index update (Jiffy-style batch
+    insert) against the packed/WIDE resident mirror: instead of
+    re-deriving every dirty sequence's order from scratch
+    (`_rga_order_batched` — one lexsort plus ~2·log2(m) dependent
+    gather rounds over the whole tree), this merges the tick's delta
+    into the PERSISTENT tree-position plane ('tp'):
+
+    1. the delta nodes order among THEMSELVES with
+       `_rga_delta_order_batched` over [K, dm_pad+1] planes — O(delta
+       log delta), independent of tree size;
+    2. ONE prefix-sum pass over the [K, m_pad] planes splices them in:
+       old node at position p shifts by #{delta anchors < p}, delta
+       node with group anchor a and delta rank r lands at a + r + 1;
+    3. the visibility index rebuilds with the same scatter + cumsum +
+       gather the rebuild path uses (deletes/sets are pure visibility
+       flips — zero sort work).
+
+    Valid only under the host-checked FRONT-INSERT precondition (every
+    delta root's elem exceeds its object's pre-tick max elem) and only
+    for objects whose 'tp' plane is current (`pool.idx_ok`); the host
+    falls back to the rebuild variant otherwise. ``aux`` is the packed
+    format's rank_remap (`has_remap`) or the wide format's rank_table.
+    Same wire layout, resolution pipeline and output contract as the
+    matching rebuild variant — the parity suite
+    (tests/test_sequence_index.py) pins incremental == rebuild ==
+    host oracle. Returns the uniform 8-tuple (w1', w2', w3', tp',
+    surv_u8, winner, visA, visB): packed sets w3' = w3m (dummy) and
+    visA = visB = vis_packed; wide returns vis_prior/vis_new."""
+    from .merge import _resolve_sorted
+    from .sequence import _rga_delta_order_batched
+    d_pad, n_pad, K, nnz_pad = sizes
+    cap = w1m.shape[0]
+    nb = n_pad >> 3
+
+    def cut(vec, state, cnt):
+        o = state[0]
+        state[0] = o + cnt
+        return vec[o:o + cnt]
+
+    # ---- wire parse: byte-identical section layouts to the rebuild
+    # variants (the host builds ONE wire buffer either way) ----
+    if fmt == 'packed':
+        i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
+        i16_n = d_pad + n_pad + nnz_pad
+        i32v = jax.lax.bitcast_convert_type(
+            wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+        i16v = jax.lax.bitcast_convert_type(
+            wire[4 * i32_n:4 * i32_n + 2 * i16_n].reshape(i16_n, 2),
+            jnp.int16)
+        u8v = wire[4 * i32_n + 2 * i16_n:]
+        s32, s16, s8 = [0], [0], [0]
+        w1d = cut(i32v, s32, d_pad)
+        d_pos = cut(i32v, s32, d_pad)
+        row_slot = cut(i32v, s32, n_pad)
+        coo_row = cut(i32v, s32, nnz_pad)
+        job_start = cut(i32v, s32, K)
+        job_n = cut(i32v, s32, K)
+        w2e = cut(i16v, s16, d_pad).astype(jnp.int32)
+        seq = cut(i16v, s16, n_pad).astype(jnp.int32)
+        coo_val = cut(i16v, s16, nnz_pad).astype(jnp.int32)
+        actor = cut(u8v, s8, n_pad).astype(jnp.int32)
+        flags_u8 = cut(u8v, s8, 2 * nb)
+        coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+        if has_remap:
+            w1m = (w1m & ~0xFFFF) | jnp.take(aux, w1m & 0xFFFF) \
+                .astype(jnp.int32)
+    else:
+        i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
+        i32v = jax.lax.bitcast_convert_type(
+            wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+        u8v = wire[4 * i32_n:]
+        s32, s8 = [0], [0]
+        w1d = cut(i32v, s32, d_pad)
+        w3d = cut(i32v, s32, d_pad)
+        d_pos = cut(i32v, s32, d_pad)
+        row_slot = cut(i32v, s32, n_pad)
+        seq = cut(i32v, s32, n_pad)
+        coo_row = cut(i32v, s32, nnz_pad)
+        coo_val = cut(i32v, s32, nnz_pad)
+        job_start = cut(i32v, s32, K)
+        job_n = cut(i32v, s32, K)
+        d_ahi = cut(u8v, s8, d_pad).astype(jnp.int32)
+        actor = cut(u8v, s8, n_pad).astype(jnp.int32)
+        flags_u8 = cut(u8v, s8, 2 * nb)
+        coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+
+    # ---- fold the new nodes in (an existing mirror is a
+    # precondition of the incremental path, so always has_old).
+    # Inverse-gather formulation: a cap-sized SCATTER costs ~40x a
+    # gather on the XLA backends (it materializes a fresh array per
+    # update set), so instead of scattering every old slot to its
+    # shifted position, each output slot GATHERS its source — the
+    # shift is one shared prefix sum over the delta-slot marks, and
+    # only the d_pad delta values scatter (O(delta) updates). ----
+    i = jnp.arange(cap, dtype=jnp.int32)
+    tgt_new = d_pos + jnp.arange(d_pad, dtype=jnp.int32)
+    in_new = jnp.zeros((cap + 1,), bool).at[tgt_new].set(
+        True, mode='drop')[:cap]
+    d_before = jnp.cumsum(in_new.astype(jnp.int32))
+    src = jnp.minimum(jnp.maximum(i - d_before, 0), cap - 1)
+
+    def fold(col, dcol):
+        base = jnp.where(in_new, 0, jnp.take(col, src))
+        return base.at[tgt_new].set(dcol, mode='drop')
+
+    w1f = fold(w1m, w1d)
+    if fmt == 'packed':
+        w2f = fold(w2m, w2e)
+        w3f = w3m
+    else:
+        w2f = fold(w2m, d_ahi << _WIDE_AHI_SHIFT)
+        w3f = fold(w3m, w3d)
+    tpf = fold(tpm, jnp.zeros(d_pad, jnp.int32))
+
+    # ---- job planes ----
+    l = jnp.arange(m_pad, dtype=jnp.int32)
+    rowi = jnp.arange(K, dtype=jnp.int32)[:, None]
+    pos_mat = job_start[:, None] + l[None, :]
+    valid_plane = l[None, :] < job_n[:, None]
+    pos_c = jnp.minimum(jnp.where(valid_plane, pos_mat, 0), cap - 1)
+    w1p = jnp.take(w1f, pos_c)
+    w2p = jnp.take(w2f, pos_c)
+    tpp = jnp.take(tpf, pos_c)
+    if fmt == 'packed':
+        s_parent = w1p >> 16
+        s_rank = w1p & 0xFFFF
+        s_elem = w2p & _W2_ELEM
+        prior_vis = ((w2p >> _W2_VIS_SHIFT) & 1).astype(bool) \
+            & valid_plane
+        prior_idx = jnp.where(
+            valid_plane, ((w2p >> _W2_IDX_SHIFT) & _W2_ELEM) - 1, -1)
+    else:
+        s_elem = jnp.take(w3f, pos_c)
+        s_parent = (w1p >> _WIDE_PARENT_SHIFT) & _WIDE_IDX_MASK
+        actor1 = (w1p & _WIDE_ALO_MASK) | \
+            (((w2p >> _WIDE_AHI_SHIFT) & 0x3F) << 10)
+        s_rank = jnp.take(aux, actor1)
+        prior_vis = ((w2p >> _WIDE_VIS_SHIFT) & 1).astype(bool) \
+            & valid_plane
+        prior_idx = jnp.where(valid_plane,
+                              (w2p & _WIDE_IDX_MASK) - 1, -1)
+
+    # ---- field resolution (identical to the rebuild variants) ----
+    boundary = _unpack_bits(flags_u8[:nb], n_pad)
+    is_del = _unpack_bits(flags_u8[nb:], n_pad)
+    valid = jnp.arange(n_pad) < n_rows
+    clock = _build_clock(actor, seq, a_pad, coo_row, coo_col, coo_val)
+    out = _resolve_sorted(boundary, actor, seq, clock, is_del, valid,
+                          num_segments)
+
+    # ---- element visibility ----
+    touched, vis_hit = _vis_grid(row_slot, valid, out['surviving'],
+                                 K, m_pad)
+    visible = jnp.where(touched, vis_hit, prior_vis) & valid_plane
+
+    # ---- incremental order update: delta ordering + ONE prefix-sum
+    # merge against the persistent 'tp' plane ----
+    is_old_node = (l[None, :] < jd_base[:, None]) & valid_plane
+    dj = jnp.arange(dm_pad, dtype=jnp.int32)
+    dcols = jd_base[:, None] + dj[None, :]
+    dvalid = dj[None, :] < (job_n - jd_base)[:, None]
+    dcols_c = jnp.minimum(jnp.where(dvalid, dcols, 0), m_pad - 1)
+    dparent = jnp.take_along_axis(s_parent, dcols_c, axis=1)
+    delem = jnp.take_along_axis(s_elem, dcols_c, axis=1)
+    drank = jnp.take_along_axis(s_rank, dcols_c, axis=1)
+    # a delta node whose parent pre-existed is a delta ROOT; its
+    # anchor is the parent's OLD tree position (front-insert: the
+    # whole group splices immediately after the anchor)
+    p_old = dvalid & (dparent < jd_base[:, None])
+    anchor = jnp.take_along_axis(
+        tpp, jnp.minimum(jnp.maximum(dparent, 0), m_pad - 1), axis=1)
+
+    def pad1(x, fill):
+        return jnp.concatenate(
+            [jnp.full((K, 1), fill, x.dtype), x], axis=1)
+
+    dpos = _rga_delta_order_batched(
+        pad1(jnp.where(p_old, 0, dparent - jd_base[:, None] + 1), 0),
+        pad1(jnp.where(p_old, anchor, 0), 0),
+        pad1(delem, 0), pad1(drank, 0), pad1(dvalid, True))
+    dm1 = dm_pad + 1
+    is_root1 = pad1(p_old, False)
+    dvalid1 = pad1(dvalid, False)
+    anch1 = pad1(jnp.where(p_old, anchor, 0), 0)
+    dpos_c = jnp.minimum(jnp.maximum(dpos, 0), dm1 - 1)
+    # group anchor per delta DFS position: roots scatter theirs, the
+    # running max propagates it over each root's (contiguous) subtree
+    # — anchors ascend across groups by construction of the sort
+    anch_at = jnp.zeros((K, dm1), jnp.int32).at[
+        rowi, jnp.where(is_root1, dpos_c, 0)].max(
+        jnp.where(is_root1, anch1, 0), mode='drop')
+    a_pos = jax.lax.cummax(anch_at, axis=1)
+    a_of = jnp.take_along_axis(a_pos, dpos_c, axis=1)
+    d_tp = a_of + dpos                 # final position: a + r + 1
+    # old-node shift = #{delta anchors < old position}: scatter-add
+    # the anchors, one cumsum — THE merge prefix-sum
+    cnt_a = jnp.zeros((K, m_pad), jnp.int32).at[
+        rowi, jnp.where(dvalid1, jnp.minimum(a_of, m_pad - 1), 0)].add(
+        dvalid1.astype(jnp.int32), mode='drop')
+    cum_a = jnp.cumsum(cnt_a, axis=1)
+    tpp_c = jnp.minimum(jnp.maximum(tpp, 0), m_pad - 1)
+    shift = jnp.take_along_axis(cum_a, tpp_c, axis=1) - \
+        jnp.take_along_axis(cnt_a, tpp_c, axis=1)
+    tp_new = jnp.where(is_old_node, tpp + shift, 0)
+    dslot = jnp.where(dvalid1, pad1(dcols, 0), m_pad)
+    tp_new = tp_new.at[rowi, dslot].set(d_tp, mode='drop')
+
+    # ---- visibility index over the updated order (one flat
+    # permutation scatter + cumsum + gather, as the rebuild's step 4;
+    # tp_new is injective per job over the chain, so a plain set
+    # suffices) ----
+    on_chain = valid_plane & (tp_new > 0)
+    tp_sc = jnp.where(on_chain, tp_new, 0)
+    flat_tp = jnp.where(on_chain, rowi * m_pad + tp_sc, K * m_pad) \
+        .reshape(-1)
+    vis_ord = jnp.zeros((K * m_pad + 1,), bool).at[flat_tp].set(
+        (visible & on_chain).reshape(-1),
+        mode='drop')[:K * m_pad].reshape(K, m_pad)
+    vis_rank = (jnp.cumsum(vis_ord, axis=1) - vis_ord) \
+        .astype(jnp.int32)
+    new_idx = jnp.take_along_axis(
+        vis_rank, jnp.minimum(tp_sc, m_pad - 1), axis=1)
+    new_idx = jnp.where(visible & on_chain, new_idx, -1)
+
+    # ---- write the updated vis word + tree positions back. Same
+    # inverse-gather idiom as the fold: every job's nodes are ONE
+    # contiguous pos window, so window membership and the owning job
+    # come from K-sized mark scatters + one prefix max, and each
+    # mirror slot gathers its updated value — no plane-sized scatter.
+    if fmt == 'packed':
+        w2n = (visible.astype(jnp.int32) << _W2_VIS_SHIFT) | \
+            ((new_idx + 1) << _W2_IDX_SHIFT) | s_elem
+    else:
+        w2n = (w2p & _WIDE_AHI_BITS) | \
+            (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
+            (new_idx + 1)
+    real_job = job_n > 0
+    marks = jnp.zeros((cap + 1,), jnp.int32).at[
+        jnp.where(real_job, job_start, cap)].add(
+        real_job.astype(jnp.int32), mode='drop')
+    marks = marks.at[jnp.where(real_job, job_start + job_n, cap)].add(
+        -real_job.astype(jnp.int32), mode='drop')
+    in_win = jnp.cumsum(marks[:cap]) > 0
+    job_mark = jnp.zeros((cap + 1,), jnp.int32).at[
+        jnp.where(real_job, job_start, cap)].max(
+        jnp.arange(K, dtype=jnp.int32) + 1, mode='drop')
+    job_at = jax.lax.cummax(job_mark[:cap]) - 1
+    job_c = jnp.maximum(job_at, 0)
+    l_at = jnp.minimum(
+        jnp.maximum(i - jnp.take(job_start, job_c), 0), m_pad - 1)
+    flat_at = job_c * m_pad + l_at
+
+    def write_back(col, plane):
+        return jnp.where(in_win, jnp.take(plane.reshape(-1), flat_at),
+                         col)
+
+    w2f = write_back(w2f, w2n)
+    tpf = write_back(tpf, tp_new)
+
+    surv_u8 = jnp.sum(
+        out['surviving'].reshape(-1, 8).astype(jnp.uint8)
+        * (jnp.uint8(1) << (7 - jnp.arange(8, dtype=jnp.uint8))),
+        axis=1, dtype=jnp.uint8)
+    if fmt == 'packed':
+        vis_packed = (prior_vis.astype(jnp.int32) << 31) | \
+            (visible.astype(jnp.int32) << 30) | \
+            ((prior_idx + 1) << _W2_IDX_SHIFT) | (new_idx + 1)
+        vis_a = vis_b = vis_packed
+    else:
+        vis_a = (prior_vis.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
+            (prior_idx + 1)
+        vis_b = (visible.astype(jnp.int32) << _WIDE_VIS_SHIFT) | \
+            (new_idx + 1)
+    return w1f, w2f, w3f, tpf, surv_u8, out['winner'], vis_a, vis_b
+
+
+# dummy W3 operand for the packed incremental dispatch (the program's
+# static fmt branch never reads it; one shared constant keeps the jit
+# signature stable)
+_NO_W3 = np.zeros(1, np.int32)
+
+
+def _mirror_tp_in(mir, cap, n_total):
+    """The persistent 'tp' plane as this apply's input: grown with the
+    mirror capacity; zeros when absent (first mirror, pre-index
+    resume) — the rebuild variant then (re)writes the dirty objects'
+    slots and validates them."""
+    if mir is None or 'tp' not in mir:
+        return jnp.zeros(cap, jnp.int32)
+    if mir['cap'] < n_total:
+        return jnp.concatenate(
+            [mir['tp'], jnp.zeros(cap - mir['cap'], jnp.int32)])
+    return mir['tp']
+
+
+def _pick_incremental(pool, mir, dirty, n_j, nof_pre, mel_pre, n_old,
+                      n_total, m_pad, opts, parent_d, elemc_d):
+    """Mode switch + eligibility + counters for one packed/wide apply.
+    Returns the eligibility tuple or None (rebuild)."""
+    incr = None
+    if (_INDEX_MODE != 'rebuild' and mir is not None
+            and 'tp' in mir and n_old > 0 and len(dirty)):
+        incr = _incr_eligibility(pool, dirty, n_j, nof_pre, mel_pre,
+                                 n_old, n_total, m_pad, parent_d,
+                                 elemc_d, opts)
+    if incr is not None:
+        metrics.bump('device_idx_incremental_applies')
+        metrics.bump('device_idx_delta_nodes', int(n_total - n_old))
+    else:
+        if len(dirty):
+            metrics.bump('device_idx_rebuild_applies')
+        if _INDEX_MODE == 'require' and len(dirty):
+            # loud, with store rollback via the apply txn: an
+            # invalidation path that silently falls back is a bug the
+            # tests must see
+            raise RuntimeError(
+                "incremental index path required (_INDEX_MODE="
+                "'require') but this apply is ineligible")
+    return incr
+
+
+def _incr_eligibility(pool, dirty, n_j, nof_pre, mel_pre, n_old,
+                      n_total, m_pad, parent_d, elemc_d, opts):
+    """Host gate of the incremental-index path: O(delta) checks that
+    every dirty object's persistent 'tp' plane is current
+    (``pool.idx_ok``) and that every delta node with a PRE-EXISTING
+    parent is a front insert (elem strictly above the object's
+    pre-tick max elem, hence above every existing sibling — the
+    sequential-typing and concurrent-append shape). A late/concurrent
+    interleaving insert, a first-sight object or an oversized delta
+    returns None: the apply takes the whole-object rebuild variant,
+    which re-validates the index for its dirty set. Returns
+    ``(dm_pad, jd_base)`` on success."""
+    K_jobs = len(dirty)
+    if K_jobs == 0:
+        return None
+    hi_obj = int(dirty.max())
+    if hi_obj >= len(pool.idx_ok) or hi_obj >= len(nof_pre):
+        return None
+    if not pool.idx_ok[dirty].all():
+        metrics.bump('device_idx_invalidations')
+        return None
+    old_nof = nof_pre[dirty]
+    if (old_nof < 1).any():
+        return None
+    jd_n = n_j - old_nof
+    if (jd_n < 0).any():
+        return None
+    dm = int(jd_n.max()) if K_jobs else 0
+    if dm and 2 * dm > int(n_j.max()):
+        # the delta approaches the tree size (bulk load, first fill):
+        # the rebuild is no more work and re-validates the index
+        return None
+    dm_pad = opts.pad_nodes(max(dm, 8))
+    d_n = n_total - n_old
+    if d_n:
+        # delta obj column in pos order == the sorted append-order
+        # column (pos order sorts by (obj, local); within one object
+        # the values are identical, so alignment with the d planes
+        # holds rowwise)
+        obj_d = np.sort(pool.obj[n_old:n_total]).astype(np.int64)
+        pos = np.searchsorted(dirty, obj_d)
+        safe = np.minimum(pos, K_jobs - 1)
+        in_dirty = (pos < K_jobs) & (dirty[safe] == obj_d)
+        if in_dirty.any():
+            par = np.asarray(parent_d[:d_n])[in_dirty]
+            base = old_nof[safe[in_dirty]]
+            rooted = par < base
+            if rooted.any():
+                mel = mel_pre[obj_d[in_dirty][rooted]]
+                el = np.asarray(elemc_d[:d_n])[in_dirty][rooted] \
+                    .astype(np.int64)
+                if (el <= mel).any():
+                    metrics.bump('device_idx_invalidations')
+                    return None
+    return dm_pad, old_nof.astype(np.int32)
 
 
 @jax.jit
@@ -1737,6 +2222,12 @@ def _mirror_convert(mir, to_fmt, store, opts):
             mir['parent'], mir['elemc'], mir['actor'], mir['visible'],
             mir['vis_index'])
     base = {'cap': mir['cap'], 'n': mir['n'], 'pos_row': mir['pos_row']}
+    # the order index is format-independent (tree_pos per node): it
+    # carries through packed<->wide conversions untouched, so idx_ok
+    # claims survive a format crossing; the cols fallback drops it
+    # (no incremental program there — the caller resets idx_ok)
+    if to_fmt in ('packed', 'wide') and 'tp' in mir:
+        base['tp'] = mir['tp']
     if to_fmt == 'packed':
         ranks = np.asarray(store.actor_str_ranks())
         w1, w2 = _mirror_pack(parent, elemc, actor, visible, visidx,
@@ -1757,10 +2248,11 @@ def _mirror_convert(mir, to_fmt, store, opts):
 
 
 # Estimated device bytes per resident mirror row, by format: packed =
-# two int32 words, wide = three, cols = parent/elemc/actor/vis_index
-# int32 + visible bool. Host arithmetic only — memory accounting must
-# never force a device sync.
-_MIRROR_ROW_BYTES = {'packed': 8, 'wide': 12, 'cols': 17}
+# two int32 words + the int32 tree_pos index plane, wide = three + the
+# index plane, cols = parent/elemc/actor/vis_index int32 + visible
+# bool (no index plane — the cols fallback always rebuilds). Host
+# arithmetic only — memory accounting must never force a device sync.
+_MIRROR_ROW_BYTES = {'packed': 12, 'wide': 16, 'cols': 17}
 
 
 def mirror_bytes(mir):
@@ -1849,8 +2341,45 @@ class GeneralPatch:
             pc = store._pending_commit
             own_pc = pc is not None and pc.get('patch') is self
             surv_dev = pc['surv_u8_dev'] if own_pc else None
+        # edit-stream read: ONE extra device dispatch compacts the
+        # tick's sequence edits into pre-ordered [K, e_pad] buffers
+        # (e_pad bounded by the tick's row count, never the tree
+        # size) — the fetch below then moves O(delta) bytes instead
+        # of the full O(doc) vis planes, and the per-object host
+        # argsorts disappear
+        # element-field index (field rows keyed by a sequence node),
+        # shared by the edit-stream dispatch and both read branches
+        elem_fi = np.flatnonzero(self.f_kind)
+        ef_obj = self.f_obj[elem_fi] if len(elem_fi) else \
+            np.zeros(0, np.int32)
+        ef_node = (self.f_key[elem_fi] & 0x7FFFFFFF) \
+            .astype(np.int64) if len(elem_fi) else \
+            np.zeros(0, np.int64)
+        es_dev = None
+        if raw['vis_planes'] is not None and _edit_stream_on() \
+                and raw.get('e_pad'):
+            from . import pallas_view as _pview
+            dirty_a = raw['dirty']
+            m_pad = raw['m_pad']
+            if raw['vis_fmt'] == 'packed':
+                k_pl = int(raw['vis_planes'].shape[0])
+            elif raw['vis_fmt'] == 'wide':
+                k_pl = int(raw['vis_planes'][0].shape[0])
+            else:
+                k_pl = int(raw['vis_planes'][0].shape[0])
+            tb = np.zeros((k_pl, m_pad), bool)
+            if len(elem_fi) and len(dirty_a):
+                ji_t = np.searchsorted(dirty_a, ef_obj)
+                ji_c = np.minimum(ji_t, len(dirty_a) - 1)
+                ok_t = dirty_a[ji_c] == ef_obj
+                tb[ji_c[ok_t], ef_node[ok_t]] = True
+            es_dev = _pview.dispatch_edit_stream(
+                raw['vis_fmt'], raw['vis_planes'],
+                np.packbits(tb, axis=1), raw['e_pad'])
         fetch = [raw['winner_dev']]
-        if raw['vis_planes'] is not None:
+        if es_dev is not None:
+            fetch.append(es_dev)
+        elif raw['vis_planes'] is not None:
             fetch.append(raw['vis_planes'])
         if own_pc:
             fetch.append(surv_dev)
@@ -1898,11 +2427,66 @@ class GeneralPatch:
         self.s_value = r_value[loser_rows]
         self.s_link = r_link[loser_rows]
 
-        # sequence edit columns per dirty object: the prior AND new
-        # visibility/order planes come back from the fused program as
-        # device-resident outputs — ONE fetch here, no host mirror sync
+        # sequence edit columns per dirty object. Preferred path: the
+        # edit-stream kernel already compacted each class in document
+        # order on device — the loop below just slices delta-sized
+        # buffers (no per-object argsorts, no O(doc) node-row gather).
+        # Legacy path (cols-scale stores with _EDIT_STREAM off, A/B
+        # tests): unpack the full vis planes and re-derive on host.
+        def fis_of(nodes, lo, span):
+            # node ids -> field-row ids within one object's ef span
+            # (-1 = node has no field row)
+            if not len(nodes):
+                return np.zeros(0, np.int64)
+            if not len(span):
+                return np.full(len(nodes), -1, np.int64)
+            p = np.minimum(np.searchsorted(span, nodes),
+                           len(span) - 1)
+            return np.where(span[p] == nodes,
+                            elem_fi[lo + p], -1)
+
         planes = fetched_planes
-        if planes is not None:
+        if planes is not None and es_dev is not None:
+            pool = store.pool
+            with store._host_lock:
+                pool_actor, pool_elemc = pool.actor, pool.elemc
+            (rm_b, insn_b, insi_b, setn_b, seti_b,
+             cnts_b) = [np.asarray(x) for x in planes]
+            dirty = raw['dirty']
+            gained = raw['gained_max_elem']
+            ps_sorted, ps_row = raw['pos_snap']
+            e_cap = rm_b.shape[1]
+            for ji, obj_row in enumerate(dirty.tolist()):
+                nrm, nins, nset = cnts_b[ji].tolist()
+                if max(nrm, nins, nset) > e_cap:
+                    raise RuntimeError(
+                        'edit-stream buffer overflow (e_pad '
+                        f'{e_cap} < {max(nrm, nins, nset)} edits)')
+                ins_nodes = insn_b[ji, :nins].astype(np.int64)
+                set_nodes = setn_b[ji, :nset].astype(np.int64)
+                lo, hi = np.searchsorted(ef_obj,
+                                         [obj_row, obj_row + 1])
+                span = ef_node[lo:hi]
+                rowsq = ps_row[np.searchsorted(
+                    ps_sorted, (np.int64(obj_row) << 32) | ins_nodes)]
+                self.seq_edits[obj_row] = {
+                    'max_elem': gained.get(obj_row),
+                    # device order is prior-idx ASC; the emit wants
+                    # descending — one reversed view, no sort
+                    'removes': rm_b[ji, :nrm][::-1].astype(np.int64),
+                    'ins_idx': insi_b[ji, :nins].astype(np.int32),
+                    'ins_fis': fis_of(ins_nodes, lo, span),
+                    'ins_actor': pool_actor[rowsq],
+                    'ins_elemc': pool_elemc[rowsq],
+                    'set_idx': seti_b[ji, :nset].astype(np.int32),
+                    'set_fis': fis_of(set_nodes, lo, span),
+                }
+        elif planes is not None:
+            # host read path (CPU backend, forced-off edit stream):
+            # ONE plane fetch, then O(m) vectorized masks + O(delta)
+            # sorts/lookups per dirty object — no more O(doc)
+            # node-row gathers or full field_at tables (the pre-index
+            # read rebuilt both per tick)
             pool = store.pool
             with store._host_lock:
                 pool_actor, pool_elemc = pool.actor, pool.elemc
@@ -1915,44 +2499,39 @@ class GeneralPatch:
             else:
                 pv, nv, pi, ni = [np.asarray(x) for x in planes]
             dirty, n_j = raw['dirty'], raw['dirty_n']
-            rows_flat = raw['rows_flat']()
-            row_start = np.zeros(len(dirty) + 1, np.int64)
-            np.cumsum(n_j, out=row_start[1:])
             gained = raw['gained_max_elem']
-            elem_fi = np.flatnonzero(self.f_kind)
-            ef_obj = self.f_obj[elem_fi] if len(elem_fi) else \
-                np.zeros(0, np.int32)
-            ef_node = (self.f_key[elem_fi] & 0x7FFFFFFF).astype(np.int64) \
-                if len(elem_fi) else np.zeros(0, np.int64)
+            ps_sorted, ps_row = raw['pos_snap']
             for ji, obj_row in enumerate(dirty.tolist()):
                 n = int(n_j[ji])
                 new_vis = nv[ji, :n]
                 new_idx = ni[ji, :n].astype(np.int32)
                 prev_idx = pi[ji, :n].astype(np.int32)
                 was_vis = pv[ji, :n]
-                rows = rows_flat[row_start[ji]:row_start[ji] + n]
                 lo, hi = np.searchsorted(ef_obj, [obj_row, obj_row + 1])
-                my_nodes = ef_node[lo:hi]
-                field_at = np.full(n, -1, np.int64)
-                field_at[my_nodes] = elem_fi[lo:hi]
+                span = ef_node[lo:hi]
                 removes = np.flatnonzero(was_vis & ~new_vis)
                 rm_old = -np.sort(-prev_idx[removes])
                 ins_nodes = np.flatnonzero(new_vis & ~was_vis)
                 ins_nodes = ins_nodes[np.argsort(new_idx[ins_nodes],
                                                  kind='stable')]
-                touched_nodes = field_at >= 0
-                set_nodes = np.flatnonzero(new_vis & was_vis
-                                           & touched_nodes)
-                set_nodes = set_nodes[np.argsort(new_idx[set_nodes],
-                                                 kind='stable')]
+                # sets only exist among TOUCHED nodes: intersect the
+                # delta-sized touched span instead of a full mask
+                tn = span[(new_vis[span] & was_vis[span])] \
+                    if len(span) else span
+                set_nodes = tn[np.argsort(new_idx[tn],
+                                          kind='stable')]
+                rowsq = ps_row[np.searchsorted(
+                    ps_sorted,
+                    (np.int64(obj_row) << 32) | ins_nodes)]
                 self.seq_edits[obj_row] = {
                     'max_elem': gained.get(obj_row),
-                    'removes': rm_old,
-                    'ins_nodes': ins_nodes, 'ins_idx': new_idx[ins_nodes],
-                    'set_nodes': set_nodes, 'set_idx': new_idx[set_nodes],
-                    'field_at': field_at,
-                    'node_actor': pool_actor[rows],
-                    'node_elemc': pool_elemc[rows],
+                    'removes': rm_old.astype(np.int64),
+                    'ins_idx': new_idx[ins_nodes],
+                    'ins_fis': fis_of(ins_nodes, lo, span),
+                    'ins_actor': pool_actor[rowsq],
+                    'ins_elemc': pool_elemc[rowsq],
+                    'set_idx': new_idx[set_nodes],
+                    'set_fis': fis_of(set_nodes, lo, span),
                 }
         # patch-read closes the tick path: one device fetch + the
         # winner-dependent column build, measured as a completed span
@@ -2076,19 +2655,17 @@ class GeneralPatch:
             diffs.append({'action': 'remove', 'type': tname,
                           'obj': obj_uuid, 'index': int(idx),
                           'path': path})
-        field_at = ed['field_at']
-        node_actor, node_elemc = ed['node_actor'], ed['node_elemc']
         actors = store.actors
 
-        def emit(nodes, idxs, action, with_elem_id):
-            """Edits for one node batch: winner values fetched with ONE
-            vectorized ValueTable pass; the rare link/conflict rows
-            fall back to the per-field payload."""
-            fis = field_at[nodes]
+        def emit(fis, idxs, action, e_actor=None, e_elemc=None):
+            """Edits for one pre-ordered batch: winner values fetched
+            with ONE vectorized ValueTable pass; the rare
+            link/conflict rows fall back to the per-field payload.
+            ``e_actor``/``e_elemc`` (ins only) carry the elemId
+            source columns, aligned with the batch."""
             vals = self.values.take(self.f_value[fis])
             plain = self._plain_mask(fis)
-            for k, (node, idx) in enumerate(zip(nodes.tolist(),
-                                                idxs.tolist())):
+            for k, idx in enumerate(idxs.tolist()):
                 if plain[k]:
                     value, link, conflicts = vals[k], False, None
                 else:
@@ -2097,17 +2674,18 @@ class GeneralPatch:
                 edit = {'action': action, 'type': tname,
                         'obj': obj_uuid, 'index': int(idx),
                         'value': value, 'path': path}
-                if with_elem_id:
-                    edit['elemId'] = (f'{actors[node_actor[node]]}:'
-                                      f'{int(node_elemc[node])}')
+                if e_actor is not None:
+                    edit['elemId'] = (f'{actors[e_actor[k]]}:'
+                                      f'{int(e_elemc[k])}')
                 if link:
                     edit['link'] = True
                 if conflicts:
                     edit['conflicts'] = conflicts
                 diffs.append(edit)
 
-        emit(ed['ins_nodes'], ed['ins_idx'], 'insert', True)
-        emit(ed['set_nodes'], ed['set_idx'], 'set', False)
+        emit(ed['ins_fis'], ed['ins_idx'], 'insert',
+             ed['ins_actor'], ed['ins_elemc'])
+        emit(ed['set_fis'], ed['set_idx'], 'set')
         return diffs
 
     def clock_of(self, d):
@@ -2143,7 +2721,7 @@ def apply_general_block(store, block, options=None, return_timing=False):
             # from the timing points _apply_general already records
             with metrics.trace_span('device.fused_apply'):
                 return _apply_general(store, block, options,
-                                      return_timing)
+                                      return_timing, txn=txn)
         except BaseException:
             # validation errors (ValueError/TypeError) AND unexpected
             # failures (a MemoryError in the native stager, the forced
@@ -2265,7 +2843,7 @@ def close_general(store):
     applier.join()
 
 
-def _apply_general(store, block, options, return_timing):
+def _apply_general(store, block, options, return_timing, txn=None):
     import time
     opts = _engine.as_options(options)
     if not block.is_general():
@@ -2298,6 +2876,18 @@ def _apply_general(store, block, options, return_timing):
         o_key_raw = block.key[keep]
         o_key_elem = block.key_elem[keep]
         o_elem = block.elem[keep]
+
+    # pre-apply per-object tree geometry: the incremental-index
+    # eligibility gate compares this apply's delta against what
+    # existed BEFORE any node minting (create_heads/append_batch
+    # mutate n_of/max_elem_of in place). The enclosing _Txn already
+    # took these exact copies for rollback — alias them (read-only
+    # here) instead of copying O(n_objects) again per apply
+    if txn is not None:
+        nof_pre, mel_pre = txn.pool_n[0], txn.pool_n[1]
+    else:
+        nof_pre = pool.n_of.copy()
+        mel_pre = pool.max_elem_of.copy()
 
     # ---- object creation, whole batch (make ops + missing roots) ----
     make_rows = np.flatnonzero(o_act >= _MAKE_MAP)
@@ -2560,7 +3150,12 @@ def _apply_general(store, block, options, return_timing):
         [coo_row, np.full(nnz_pad - len(coo_row), n_pad, np.int32)])
 
     # ---- device-resident trees: ship only this apply's NEW nodes ----
-    K = max(len(dirty), 1)
+    # the job axis is BUCKETED like every other padded axis: a serving
+    # fleet's dirty-set size drifts tick to tick, and an unpadded K
+    # minted a fresh jit signature (a retrace) at every new count —
+    # the job table pads with job_n = 0 rows, which every plane op
+    # masks out
+    K = opts._pad(None, max(len(dirty), 1), 'job_pad')
     if ns is not None:
         n_j = ns.n_j
     else:
@@ -2594,7 +3189,12 @@ def _apply_general(store, block, options, return_timing):
         fmt = 'cols'
     if mir is not None and cur_fmt != fmt:
         mir = pool.mirror = _mirror_convert(mir, fmt, store, opts)
+        if fmt == 'cols' and pool.idx_ok.any():
+            # converting down to cols drops the 'tp' plane
+            pool.idx_ok[:] = False
+            metrics.bump('device_idx_invalidations')
     use_packed = fmt == 'packed'
+    incr = None                  # set by the packed/wide dispatches
 
     if mir is None:
         # first resident apply: EVERY node is this apply's delta — the
@@ -2651,19 +3251,20 @@ def _apply_general(store, block, options, return_timing):
             d_pos[:d_n] = final_pos - np.arange(d_n)
 
             # job table: each dirty object's contiguous pos slice
+            # (bucket-padded rows keep job_n = 0 and mask out)
             job_start = np.zeros(K, np.int32)
             n_j_arr = np.zeros(K, np.int32)
             if len(dirty):
-                job_start[:] = np.searchsorted(pool.pos_sorted,
-                                               dirty << np.int64(32))
-                n_j_arr[:] = n_j
+                job_start[:len(dirty)] = np.searchsorted(
+                    pool.pos_sorted, dirty << np.int64(32))
+                n_j_arr[:len(dirty)] = n_j
 
         # per-row (job, node) slots, in the field-sorted coordinates
         row_slot = np.full(n_pad, -1, np.int32)
         if len(dirty):
             slot_cat = np.full(n_rows, -1, np.int64)
             dirty_lookup = np.full(len(store.obj_uuid), -1, np.int64)
-            dirty_lookup[dirty] = np.arange(K)
+            dirty_lookup[dirty] = np.arange(len(dirty))
             if n_new:
                 loc = dirty_lookup[a_objr]
                 nd = a_node
@@ -2760,26 +3361,57 @@ def _apply_general(store, block, options, return_timing):
                 o += len(arr)
             assert o == len(wire)
 
-        # shape-signature registry: every distinct signature here is
-        # one XLA compile of the packed program (retraces counted,
-        # flight-recorded — device/profiler.py)
-        _profiler.note_dispatch(
-            'general.fused_packed',
-            (cap, sizes, S, A, m_pad, has_remap,
-             int(remap_dev.shape[0]), n_old > 0),
-            rows=n_pad)
-        outs = _fused_general_packed(
-            w1m, w2m, jnp.asarray(wire), np.int32(n_old),
-            jnp.asarray(np.int32(n_rows)), remap_dev,
-            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
-            has_remap=has_remap, has_old=n_old > 0)
+        tpm = _mirror_tp_in(mir, cap, n_total)
+        incr = _pick_incremental(
+            pool, mir, dirty, n_j, nof_pre, mel_pre, n_old, n_total,
+            m_pad, opts,
+            parent_d=(wire[:4 * d_pad].view(np.int32) >> 16)
+            if native_wire else d_parent,
+            elemc_d=wire[4 * i32_n:4 * i32_n + 2 * d_pad]
+            .view(np.int16) if native_wire else d_elemc)
+        if incr is not None:
+            dm_pad, jd_base = incr
+            jd = np.zeros(K, np.int32)
+            jd[:len(dirty)] = jd_base
+            _profiler.note_dispatch(
+                'general.fused_incr',
+                ('packed', cap, sizes, S, A, m_pad, dm_pad, has_remap,
+                 int(remap_dev.shape[0])),
+                rows=n_pad)
+            outs = _fused_general_incr(
+                w1m, w2m, jnp.asarray(_NO_W3), tpm, jnp.asarray(wire),
+                jnp.asarray(jd), np.int32(n_old),
+                jnp.asarray(np.int32(n_rows)), remap_dev,
+                fmt='packed', sizes=sizes, num_segments=S, a_pad=A,
+                m_pad=m_pad, dm_pad=dm_pad, has_remap=has_remap)
+            w1o, w2o, tpo = outs[0], outs[1], outs[3]
+            surv_u8_dev, winner_dev = outs[4], outs[5]
+            vis_planes = outs[6] if len(dirty) else None
+        else:
+            # shape-signature registry: every distinct signature here
+            # is one XLA compile of the packed program (retraces
+            # counted, flight-recorded — device/profiler.py)
+            _profiler.note_dispatch(
+                'general.fused_packed',
+                (cap, sizes, S, A, m_pad, has_remap,
+                 int(remap_dev.shape[0]), n_old > 0),
+                rows=n_pad)
+            outs = _fused_general_packed(
+                w1m, w2m, tpm, jnp.asarray(wire), np.int32(n_old),
+                jnp.asarray(np.int32(n_rows)), remap_dev,
+                sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
+                has_remap=has_remap, has_old=n_old > 0)
+            w1o, w2o, tpo = outs[0], outs[1], outs[2]
+            surv_u8_dev, winner_dev = outs[3], outs[4]
+            vis_planes = outs[5] if len(dirty) else None
+            if len(dirty):
+                # the rebuild just (re)wrote these objects' index
+                pool.idx_ok[dirty] = True
         pool.mirror = {
             'fmt': 'packed', 'cap': cap, 'n': n_total,
-            'w1': outs[0], 'w2': outs[1], 'ranks': ranks.copy(),
+            'w1': w1o, 'w2': w2o, 'tp': tpo, 'ranks': ranks.copy(),
             'pos_row': pool.pos_row,  # replaced-on-append: stable ref
         }
-        surv_u8_dev, winner_dev = outs[2], outs[3]
-        vis_planes = outs[4] if len(dirty) else None
         vis_fmt = 'packed'
     elif fmt == 'wide':
         if mir is None:
@@ -2835,24 +3467,55 @@ def _apply_general(store, block, options, return_timing):
                 o += len(arr)
             assert o == len(wire)
 
-        _profiler.note_dispatch(
-            'general.fused_wide',
-            (cap, sizes, S, A, m_pad, int(rank_table_dev.shape[0]),
-             n_old > 0),
-            rows=n_pad)
-        outs = _fused_general_wide(
-            w1m, w2m, w3m, jnp.asarray(wire), np.int32(n_old),
-            jnp.asarray(np.int32(n_rows)), rank_table_dev,
-            sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
-            has_old=n_old > 0)
+        tpm = _mirror_tp_in(mir, cap, n_total)
+        incr = _pick_incremental(
+            pool, mir, dirty, n_j, nof_pre, mel_pre, n_old, n_total,
+            m_pad, opts,
+            parent_d=((wire[:4 * d_pad].view(np.int32)
+                       >> _WIDE_PARENT_SHIFT) & _WIDE_IDX_MASK)
+            if native_wire else d_parent,
+            elemc_d=wire[4 * d_pad:8 * d_pad].view(np.int32)
+            if native_wire else d_elemc)
+        if incr is not None:
+            dm_pad, jd_base = incr
+            jd = np.zeros(K, np.int32)
+            jd[:len(dirty)] = jd_base
+            _profiler.note_dispatch(
+                'general.fused_incr',
+                ('wide', cap, sizes, S, A, m_pad, dm_pad,
+                 int(rank_table_dev.shape[0])),
+                rows=n_pad)
+            outs = _fused_general_incr(
+                w1m, w2m, w3m, tpm, jnp.asarray(wire),
+                jnp.asarray(jd), np.int32(n_old),
+                jnp.asarray(np.int32(n_rows)), rank_table_dev,
+                fmt='wide', sizes=sizes, num_segments=S, a_pad=A,
+                m_pad=m_pad, dm_pad=dm_pad, has_remap=False)
+            w1o, w2o, w3o, tpo = outs[0], outs[1], outs[2], outs[3]
+            surv_u8_dev, winner_dev = outs[4], outs[5]
+            vis_planes = (outs[6], outs[7]) if len(dirty) else None
+        else:
+            _profiler.note_dispatch(
+                'general.fused_wide',
+                (cap, sizes, S, A, m_pad, int(rank_table_dev.shape[0]),
+                 n_old > 0),
+                rows=n_pad)
+            outs = _fused_general_wide(
+                w1m, w2m, w3m, tpm, jnp.asarray(wire), np.int32(n_old),
+                jnp.asarray(np.int32(n_rows)), rank_table_dev,
+                sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
+                has_old=n_old > 0)
+            w1o, w2o, w3o, tpo = outs[0], outs[1], outs[2], outs[3]
+            surv_u8_dev, winner_dev = outs[4], outs[5]
+            vis_planes = (outs[6], outs[7]) if len(dirty) else None
+            if len(dirty):
+                pool.idx_ok[dirty] = True
         pool.mirror = {
             'fmt': 'wide', 'cap': cap, 'n': n_total,
-            'w1': outs[0], 'w2': outs[1], 'w3': outs[2],
+            'w1': w1o, 'w2': w2o, 'w3': w3o, 'tp': tpo,
             'rank_n': n_act, 'rank_table': rank_table_dev,
             'pos_row': pool.pos_row,  # replaced-on-append: stable ref
         }
-        surv_u8_dev, winner_dev = outs[3], outs[4]
-        vis_planes = (outs[5], outs[6]) if len(dirty) else None
         vis_fmt = 'wide'
     else:
         if mir is None:
@@ -2903,6 +3566,13 @@ def _apply_general(store, block, options, return_timing):
             'rank_n': n_act, 'rank_table': rank_table_dev,
             'pos_row': pool.pos_row,  # replaced-on-append: stable ref
         }
+        # the cols fallback maintains no 'tp' plane; any index claims
+        # drop with it (a cols-scale store always rebuilds)
+        if pool.idx_ok.any():
+            pool.idx_ok[:] = False
+            metrics.bump('device_idx_invalidations')
+        if len(dirty):
+            metrics.bump('device_idx_rebuild_applies')
         surv_u8_dev, winner_dev = outs[5], outs[6]
         vis_planes = outs[7:11] if len(dirty) else None
         vis_fmt = 'cols'
@@ -2957,7 +3627,13 @@ def _apply_general(store, block, options, return_timing):
         _profiler.record_phases(
             (t1 - t0) * 1e3, (t2 - t1 - (tc1 - tc0)) * 1e3,
             (t3 - t2) * 1e3, t_dev,
-            (time.perf_counter() - t0) * 1e3)
+            (time.perf_counter() - t0) * 1e3,
+            # the index update is FUSED into the apply program, so its
+            # attribution is the fenced run time of the incremental
+            # variant (its own series + Perfetto lane; rebuild-path
+            # run time stays out, which is what makes the before/after
+            # comparable)
+            idx_ms=t_dev if incr is not None else None)
 
     # ---- unpack: lazy patch wiring + DEFERRED entry commit ----
     # `cat` holds the UNPERMUTED row columns plus `order` (the
@@ -3020,6 +3696,12 @@ def _apply_general(store, block, options, return_timing):
         'cat': cat, 'order': order, 'vis_fmt': vis_fmt,
         'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
         'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat_thunk,
+        # edit-stream read geometry: the fused patch-read kernel
+        # compacts this tick's edits into [K, e_pad] buffers (edits
+        # are bounded by the resolved row count, never the tree size)
+        'm_pad': m_pad, 'e_pad': opts._pad(
+            None, max(min(m_pad, n_rows), 1), 'edit_pad'),
+        'pos_snap': pos_snap,
         # per-object maxElem SNAPSHOT at apply time: a pipelined reader
         # may materialize this patch after apply N+1 has grown the pool,
         # and the reference reports the per-apply maxElem
@@ -3059,6 +3741,14 @@ def _apply_general(store, block, options, return_timing):
                            (t2 - t1 - (tc1 - tc0)) * 1e3,
                            native=ns is not None)
         metrics.span_event('device.dispatch', (t3 - t2) * 1e3)
+        if incr is not None:
+            # the incremental index update gets its own Perfetto lane
+            # (device.* names each map to a dedicated track) — the
+            # dispatch wall of the merge-pass program, with the delta
+            # size attached
+            metrics.span_event('device.idx_update', (t3 - t2) * 1e3,
+                               delta=int(n_total - n_old),
+                               jobs=len(dirty))
     if return_timing:
         return patch, {'admit': t1 - t0, 'pack': t2 - t1,
                        'commit_wait': tc1 - tc0,

@@ -141,15 +141,21 @@ def signature_counts():
         return {fn: len(sigs) for fn, sigs in _signatures.items()}
 
 
-def record_phases(admit_ms, pack_ms, dispatch_ms, run_ms, wall_ms):
+def record_phases(admit_ms, pack_ms, dispatch_ms, run_ms, wall_ms,
+                  idx_ms=None):
     """Fold one SAMPLED apply's per-phase attribution into the shared
     histogram series and the utilization gauge; with a subscriber
     attached, also emit a ``counter`` event for the Perfetto counter
-    tracks (utilization, device-plane bytes, retraces)."""
+    tracks (utilization, device-plane bytes, retraces). ``idx_ms``
+    (when the sampled apply took the incremental index-update path)
+    additionally feeds ``device_idx_update_ms`` — the fused merge
+    pass's fenced run time, separable from rebuild-path samples."""
     metrics.observe('device_admit_ms', admit_ms)
     metrics.observe('device_pack_ms', pack_ms)
     metrics.observe('device_dispatch_ms', dispatch_ms)
     metrics.observe('device_run_ms', run_ms)
+    if idx_ms is not None:
+        metrics.observe('device_idx_update_ms', idx_ms)
     util = run_ms / wall_ms if wall_ms > 0 else 0.0
     metrics.set_gauge('device_utilization', util)
     if metrics.active:

@@ -235,6 +235,81 @@ def _rga_order_mxu(parent, elem, actor, visible, valid):
                               axis=1).astype(jnp.int32)}
 
 
+def _rga_delta_order(parent, anchor, elem, actor, valid):
+    """DFS order of ONE tick's delta forest — the small companion of
+    :func:`_rga_order` behind the incremental index update (Jiffy-style
+    batch insert: the whole tick's new nodes order among THEMSELVES
+    here, then splice into the persistent index with one prefix-sum
+    merge pass — see ``general._fused_general_incr``).
+
+    Slot 0 is a virtual head standing in for the ENTIRE existing tree;
+    a delta node whose parent already existed before this tick (a
+    "delta root") is a child of that head, carrying the OLD tree
+    position of its anchor (its real parent) as ``anchor``. Head
+    children therefore sort by (anchor asc, elem desc, actor desc) —
+    groups land in anchor order, each group in RGA priority order —
+    while children of real delta parents sort by the ordinary RGA
+    (elem desc, actor desc) key (their ``anchor`` must be 0).
+
+    Only valid under the FRONT-INSERT precondition the caller checks on
+    host: every delta root's elem exceeds every pre-existing elem of
+    its object, so the root precedes all existing children of its
+    parent and the group splices immediately after the anchor.
+
+    Returns ``tree_pos`` int32[n]: 0 for the virtual head, 1..count for
+    delta nodes in final relative order (padding rows carry garbage).
+    """
+    n = parent.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rounds = _ceil_log2(n) + 1
+
+    parent_adj = jnp.where(valid & (idx != 0), parent, n)
+    anchor_k = jnp.where(parent_adj == 0, anchor, 0)
+    order = jnp.lexsort((-actor, -elem, anchor_k, parent_adj))
+    p_sorted = parent_adj[order]
+
+    # tree threading + list ranking: identical to _rga_order steps 2-3
+    is_seg_start = jnp.concatenate([
+        jnp.array([True]), p_sorted[1:] != p_sorted[:-1]])
+    first_child = jnp.full((n + 1,), -1, dtype=jnp.int32)
+    first_child = first_child.at[jnp.where(is_seg_start, p_sorted, n)].set(
+        jnp.where(is_seg_start, order, -1), mode='drop')
+    first_child = first_child[:n]
+    same_parent_next = jnp.concatenate([
+        p_sorted[1:] == p_sorted[:-1], jnp.array([False])])
+    nxt_in_sort = jnp.concatenate([order[1:], jnp.array([-1], dtype=jnp.int32)])
+    next_sibling = jnp.full((n,), -1, dtype=jnp.int32)
+    next_sibling = next_sibling.at[order].set(
+        jnp.where(same_parent_next, nxt_in_sort, -1))
+    next_sibling = next_sibling.at[0].set(-1)
+
+    has_sib = next_sibling >= 0
+    is_head = idx == 0
+    climb = jnp.where(has_sib | is_head, idx, parent)
+    for _ in range(rounds):
+        climb = climb[climb]
+    up = jnp.where(has_sib[climb], next_sibling[climb], -1)
+    succ = jnp.where(first_child[idx] >= 0, first_child[idx], up)
+    succ = jnp.where(valid, succ, -1)
+
+    nxt = jnp.where(succ >= 0, succ, n)
+    nxt = jnp.concatenate([nxt, jnp.array([n], dtype=jnp.int32)])
+    dist = jnp.where(jnp.arange(n + 1) == n, 0, 1)
+    for _ in range(rounds):
+        dist = dist + dist[nxt]
+        nxt = nxt[nxt]
+    dist = dist[:n]
+    return (dist[0] - dist).astype(jnp.int32)
+
+
+def _rga_delta_order_batched(parent, anchor, elem, actor, valid):
+    """Batched [K, dm] delta ordering (vmapped gather variant — delta
+    planes are block-delta sized, so the doubling rounds are cheap by
+    construction; no MXU pick needed)."""
+    return jax.vmap(_rga_delta_order)(parent, anchor, elem, actor,
+                                      valid)
+
+
 def _rga_order_batched(parent, elem, actor, visible, valid):
     """Batched RGA over [K, m] job planes: MXU one-hot doubling when the
     one-hot plane is small enough to be cheap traffic, vmapped gather

@@ -287,8 +287,10 @@ def sharded_step_from_capture(mesh, store, patch, captured):
         'surviving': np.unpackbits(np.asarray(
             jax.device_get(captured['surv_u8']))).astype(bool)[:n_pad],
         'winner': np.asarray(jax.device_get(captured['winner'])),
-        'visible': vis_ref,
-        'vis_index': np.asarray(idx_ref, np.int64),
+        # the fused planes carry the BUCKETED job axis (padding jobs
+        # are all-masked rows); the equality gate compares real jobs
+        'visible': np.asarray(vis_ref)[:Kj],
+        'vis_index': np.asarray(idx_ref, np.int64)[:Kj],
     }
     sharded['vis_index'] = np.asarray(sharded['vis_index'], np.int64)
     return sharded, fused

@@ -820,7 +820,8 @@ class GeneralDocSet:
                    ('sync_apply_ms', 'sync_flush_ms',
                     'sync_convergence_ms', 'device_admit_ms',
                     'device_pack_ms', 'device_dispatch_ms',
-                    'device_run_ms', 'device_patch_read_ms')),
+                    'device_run_ms', 'device_idx_update_ms',
+                    'device_patch_read_ms')),
                'memory': self._memory_summary(),
                'convergence': self._convergence_summary(),
                'health': self.evaluate_health()}

@@ -181,6 +181,20 @@ CONVERGENCE_COUNTERS = (
 #                              apply fences and splits its wall time)
 #   device_patch_read_ms       observe series: device fetch + patch
 #                              column build (the read side)
+#   device_idx_incremental_applies / device_idx_rebuild_applies
+#                              applies that merged the tick's delta
+#                              into the persistent sequence index vs
+#                              ones that re-derived dirty objects'
+#                              order from scratch (first sight,
+#                              invalidation, ineligible delta)
+#   device_idx_invalidations   index-validity drops / eligibility
+#                              rejections (stale tp plane, non-front
+#                              insert, cols downgrade)
+#   device_idx_delta_nodes     total delta nodes merged by the
+#                              incremental path
+#   device_idx_update_ms       observe series: fenced run time of
+#                              SAMPLED incremental-index applies (the
+#                              merge pass's own phase attribution)
 #   device_utilization         gauge: device ms / wall ms of the last
 #                              sampled apply
 #   mem_device_plane_bytes     gauge: resident device mirror bytes
@@ -201,7 +215,10 @@ DEVICE_COUNTERS = (
     'device_dispatches_total', 'device_compiles_total',
     'device_retraces_total', 'device_dispatch_rows',
     'device_admit_ms', 'device_pack_ms', 'device_dispatch_ms',
-    'device_run_ms', 'device_patch_read_ms', 'device_utilization',
+    'device_run_ms', 'device_patch_read_ms',
+    'device_idx_incremental_applies', 'device_idx_rebuild_applies',
+    'device_idx_invalidations', 'device_idx_delta_nodes',
+    'device_idx_update_ms', 'device_utilization',
     'mem_device_plane_bytes', 'mem_device_packed_bytes',
     'mem_device_wide_bytes', 'mem_device_cols_bytes',
     'mem_device_plane_peak_bytes', 'mem_journal_bytes',

@@ -968,6 +968,181 @@ def bench_compacted_recover(n_docs=2048, updates=24, chunk=64):
 # call site in the tick path costs one truthiness check plus a shared
 # null context manager (metrics._NULL_SPAN) — nanoseconds, not
 # microseconds. This constant is the pre-instrumentation tolerance the
+def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
+    """Device-resident incremental sequence index (ISSUE 15): the
+    long-doc append/edit workload. ONE text document of ``n_chars``
+    elements takes per-tick edits (``batch`` appended chars, a delete
+    every 5th tick); arm A pins the PRE-INDEX behavior — every tick
+    re-derives the whole document order (``_INDEX_MODE='rebuild'``)
+    and the patch read fetches the full vis planes + argsorts on host
+    (``_EDIT_STREAM=False``); arm B is the shipped path — the batched
+    index-update kernel merges the tick's delta into the persistent
+    'tp' plane and the read fetches the delta-sized edit-stream
+    buffers. Per-tick wall covers apply -> fence -> diff read; the
+    ``device_{run,patch_read,idx_update}_ms`` series are cited per
+    arm (profiler cadence forced to 1 so every tick attributes)."""
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.device import general as G
+    from automerge_tpu.device import profiler as _prof
+    from automerge_tpu.utils.metrics import metrics as _m
+
+    def build():
+        store = G.init_store(1)
+        ops = [{'action': 'makeText', 'obj': 'T'},
+               {'action': 'link', 'obj': ROOT_ID, 'key': 'text',
+                'value': 'T'}]
+        prev = '_head'
+        for i in range(n_chars):
+            ops.append({'action': 'ins', 'obj': 'T', 'key': prev,
+                        'elem': i + 1})
+            ops.append({'action': 'set', 'obj': 'T',
+                        'key': f'w:{i + 1}', 'value': 'x'})
+            prev = f'w:{i + 1}'
+        block = store.encode_changes(
+            [[{'actor': 'w', 'seq': 1, 'deps': {}, 'ops': ops}]])
+        p = G.apply_general_block(store, block)
+        p.block_until_ready()
+        p.diffs(0)
+        return store, prev
+
+    def run_arm(mode, edit_stream):
+        prev_mode, prev_es = G._INDEX_MODE, G._EDIT_STREAM
+        prev_cad = _prof.set_sample_every(1)
+        G._INDEX_MODE, G._EDIT_STREAM = mode, edit_stream
+        try:
+            store, prev_key = build()
+            elem = n_chars
+            seq = 2
+            times = []
+            for t in range(ticks):
+                ops = []
+                if t % 5 == 4:
+                    ops.append({'action': 'del', 'obj': 'T',
+                                'key': f'w:{elem - batch}'})
+                for _ in range(batch):
+                    elem += 1
+                    ops.append({'action': 'ins', 'obj': 'T',
+                                'key': prev_key, 'elem': elem})
+                    ops.append({'action': 'set', 'obj': 'T',
+                                'key': f'w:{elem}', 'value': 'y'})
+                    prev_key = f'w:{elem}'
+                ch = [{'actor': 'w', 'seq': seq, 'deps': {},
+                       'ops': ops}]
+                seq += 1
+                block = store.encode_changes([ch])
+                if t == warm:
+                    for s in ('device_run_ms', 'device_patch_read_ms',
+                              'device_idx_update_ms'):
+                        _m.reset_series(s)
+                t0 = time.perf_counter()
+                p = G.apply_general_block(store, block)
+                p.block_until_ready()
+                p.diffs(0)
+                dt = time.perf_counter() - t0
+                if t >= warm:
+                    times.append(dt)
+            times.sort()
+            return {
+                'tick_ms_p50': times[len(times) // 2] * 1e3,
+                'run_ms_p50': _m.quantile('device_run_ms', 0.5) or 0,
+                'patch_read_ms_p50':
+                    _m.quantile('device_patch_read_ms', 0.5) or 0,
+                'idx_update_ms_p50':
+                    _m.quantile('device_idx_update_ms', 0.5) or 0,
+            }
+        finally:
+            G._INDEX_MODE, G._EDIT_STREAM = prev_mode, prev_es
+            _prof.set_sample_every(prev_cad)
+
+    before = dict(_m.counters)
+    rebuild = run_arm('rebuild', False)
+    incr = run_arm(None, None)      # shipped defaults: incremental +
+    #                                 auto edit-stream (device-link
+    #                                 backends fetch delta buffers;
+    #                                 CPU keeps the host read)
+    incr_applies = _m.counters.get('device_idx_incremental_applies',
+                                   0) - before.get(
+        'device_idx_incremental_applies', 0)
+    out = {
+        'doc_nodes': n_chars,
+        'rebuild_tick_ms_p50': rebuild['tick_ms_p50'],
+        'warm_tick_ms_p50': incr['tick_ms_p50'],
+        'speedup_x': rebuild['tick_ms_p50']
+        / max(incr['tick_ms_p50'], 1e-9),
+        'rebuild_run_ms_p50': rebuild['run_ms_p50'],
+        'warm_run_ms_p50': incr['run_ms_p50'],
+        'idx_update_ms_p50': incr['idx_update_ms_p50'],
+        'rebuild_patch_read_ms_p50': rebuild['patch_read_ms_p50'],
+        'warm_patch_read_ms_p50': incr['patch_read_ms_p50'],
+        'patch_read_improvement_x': rebuild['patch_read_ms_p50']
+        / max(incr['patch_read_ms_p50'], 1e-9),
+        'incremental_applies': incr_applies,
+    }
+    log(f'incremental-order[{n_chars}-char doc, {batch}-char ticks]: '
+        f'cold-rebuild {out["rebuild_tick_ms_p50"]:.2f} ms/tick '
+        f'(device {out["rebuild_run_ms_p50"]:.2f} ms, patch read '
+        f'{out["rebuild_patch_read_ms_p50"]:.2f} ms) -> '
+        f'warm-incremental {out["warm_tick_ms_p50"]:.2f} ms/tick '
+        f'(device {out["warm_run_ms_p50"]:.2f} ms, patch read '
+        f'{out["warm_patch_read_ms_p50"]:.2f} ms) = '
+        f'{out["speedup_x"]:.1f}x; patch read '
+        f'{out["patch_read_improvement_x"]:.1f}x; '
+        f'{out["incremental_applies"]} incremental applies')
+    return out
+
+
+def incremental_order_json(res):
+    """The bench_incremental_order JSON keys (shared by the full
+    bench and the --incremental-order CI lane; PERF_BUDGETS bands
+    gate speedup_x >= 3 and the patch-read drop)."""
+    return {
+        'incremental_order_doc_nodes': res['doc_nodes'],
+        'incremental_order_rebuild_ms_p50':
+            round(res['rebuild_tick_ms_p50'], 3),
+        'incremental_order_warm_ms_p50':
+            round(res['warm_tick_ms_p50'], 3),
+        'incremental_order_speedup_x': round(res['speedup_x'], 2),
+        'incremental_order_rebuild_run_ms_p50':
+            round(res['rebuild_run_ms_p50'], 3),
+        'incremental_order_warm_run_ms_p50':
+            round(res['warm_run_ms_p50'], 3),
+        'device_idx_update_ms_p50':
+            round(res['idx_update_ms_p50'], 3),
+        'incremental_order_patch_read_rebuild_ms_p50':
+            round(res['rebuild_patch_read_ms_p50'], 3),
+        'incremental_order_patch_read_ms_p50':
+            round(res['warm_patch_read_ms_p50'], 3),
+        'incremental_order_patch_read_improvement_x':
+            round(res['patch_read_improvement_x'], 2),
+        'incremental_order_applies': res['incremental_applies'],
+    }
+
+
+def incremental_order_cli(argv):
+    """``python bench.py --incremental-order [--smoke]``: the
+    CI-gated lane for the incremental sequence index (one JSON line;
+    hardware-independent ratio bands in PERF_BUDGETS.json). The smoke
+    lane runs a scaled-down doc whose per-tick host floor caps the
+    ratio, so its keys are prefixed ``incremental_order_smoke_`` and
+    carry their own (looser) bands; the full-scale keys gate
+    BENCH_r08-style artifacts."""
+    smoke_lane = '--smoke' in argv
+    res = bench_incremental_order(
+        n_chars=8192 if smoke_lane else 32768,
+        ticks=24 if smoke_lane else 48,
+        warm=6 if smoke_lane else 8)
+    keys = incremental_order_json(res)
+    if smoke_lane:
+        keys = {k.replace('incremental_order_',
+                          'incremental_order_smoke_'): v
+                for k, v in keys.items()}
+    print(json.dumps({
+        'bench': 'incremental_order',
+        'incremental_order_smoke': 1 if smoke_lane else 0,
+        **keys,
+    }), flush=True)
+
+
 # CI smoke asserts against: if a refactor makes the no-subscriber path
 # allocate or lock, the per-site cost blows through it and the guard
 # fails before a BENCH run ever shows the regression.
@@ -2132,6 +2307,8 @@ def main():
 if __name__ == '__main__':
     if '--fleet-sim' in sys.argv[1:]:
         fleet_sim_cli(sys.argv[1:])
+    elif '--incremental-order' in sys.argv[1:]:
+        incremental_order_cli(sys.argv[1:])
     elif '--smoke' in sys.argv[1:]:
         smoke()
     else:

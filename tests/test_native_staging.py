@@ -36,22 +36,28 @@ class _ForcedStaging:
         self._capture = general._STAGE_CAPTURE
         self._packed = general._fused_general_packed
         self._wide = general._fused_general_wide
+        self._incr = general._fused_general_incr
         general._NATIVE_STAGING = self.force
         general._STAGE_CAPTURE = lambda c: self.captures.append(
             {k: (np.asarray(c[k]).copy()
                  if k in PLANE_KEYS else c[k])
              for k in PLANE_KEYS + SCALAR_KEYS})
 
-        def spy(w1m, w2m, wire, *a, **k):
+        def spy(w1m, w2m, tpm, wire, *a, **k):
             self.wires.append(np.asarray(wire).copy())
-            return self._packed(w1m, w2m, wire, *a, **k)
+            return self._packed(w1m, w2m, tpm, wire, *a, **k)
 
-        def spy_wide(w1m, w2m, w3m, wire, *a, **k):
+        def spy_wide(w1m, w2m, w3m, tpm, wire, *a, **k):
             self.wires.append(np.asarray(wire).copy())
-            return self._wide(w1m, w2m, w3m, wire, *a, **k)
+            return self._wide(w1m, w2m, w3m, tpm, wire, *a, **k)
+
+        def spy_incr(w1m, w2m, w3m, tpm, wire, *a, **k):
+            self.wires.append(np.asarray(wire).copy())
+            return self._incr(w1m, w2m, w3m, tpm, wire, *a, **k)
 
         general._fused_general_packed = spy
         general._fused_general_wide = spy_wide
+        general._fused_general_incr = spy_incr
         return self
 
     def __exit__(self, *exc):
@@ -59,6 +65,7 @@ class _ForcedStaging:
         general._STAGE_CAPTURE = self._capture
         general._fused_general_packed = self._packed
         general._fused_general_wide = self._wide
+        general._fused_general_incr = self._incr
 
 
 def _corpus_blocks():

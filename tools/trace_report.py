@@ -90,6 +90,22 @@ def load_events(paths):
     return events, skipped
 
 
+def device_phase_summary(events):
+    """{device phase span name: (count, total ms)} — one row per
+    ``device.*`` lane of the Perfetto dump (admit/stage/dispatch/
+    idx_update/patch_read...), so a conversion immediately shows where
+    the device time of the captured window went."""
+    out = {}
+    for e in events:
+        name = e.get('name')
+        if not isinstance(name, str) or not name.startswith('device.'):
+            continue
+        ms = e.get('dur_ms') or 0
+        n, total = out.get(name, (0, 0.0))
+        out[name] = (n + 1, total + float(ms))
+    return out
+
+
 def wire_throughput(events):
     """Per-direction wire codec throughput from span events:
     ``wire.parse`` / ``wire.serve`` spans carry their byte volume
@@ -254,6 +270,9 @@ def main(argv=None):
             rate = total / (ms / 1e3) / 1e6 if ms else 0.0
             print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
                   f'{ms:.1f} ms -> {rate:.0f} MB/s')
+        for name, (n, total) in sorted(
+                device_phase_summary(events).items()):
+            print(f'  {name}: {n} spans, {total:.2f} ms total')
     return rc
 
 
