@@ -609,6 +609,11 @@ def absorb_doc_states(store, items):
         pool.max_tree = max(pool.max_tree,
                             int(local_cat[ends].max()) + 1)
         pool.max_elem = max(pool.max_elem, int(seg_max.max()))
+        # chain-shape bit for the absorbed objects: grow_objects pads
+        # it False, but a restored chain doc must stay window-eligible
+        par_cat = pool.parent[base:]
+        ok_chain = (local_cat == 0) | (par_cat == local_cat - 1)
+        pool.idx_linear[uo] = np.logical_and.reduceat(ok_chain, starts)
     # per-object counters must cover node-less objects (maps) too —
     # rows_of_objs and friends index n_of by object row
     pool.grow_objects(len(store.obj_uuid))

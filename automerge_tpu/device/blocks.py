@@ -740,11 +740,25 @@ class BlockStore:
         assert len(self.actors) < (1 << 21), 'actor table exceeds key space'
         return (((doc.astype(np.int64) << 21) | actor) << _SEQ_BITS) | seq
 
+    def _clock_table(self):
+        """The packed (doc << 32 | actor) clock key table, memoized by
+        column ref identity: the hit path of :meth:`clock_merge`
+        scatters seqs in place (keys unchanged), so a warm tick reuses
+        one packing across lookup/merge/purity instead of repacking
+        the O(clock) table three times."""
+        t = getattr(self, '_c_table', None)
+        if t is not None and t[0] is self.c_doc \
+                and t[1] is self.c_actor:
+            return t[2]
+        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        self._c_table = (self.c_doc, self.c_actor, table)
+        return table
+
     def clock_lookup(self, doc, actor):
         """Applied seq per (doc, actor) pair — vectorized."""
         if len(self.c_doc) == 0 or len(doc) == 0:
             return np.zeros(len(doc), np.int32)
-        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        table = self._clock_table()
         probe = (doc.astype(np.int64) << 32) | actor
         pos = np.minimum(np.searchsorted(table, probe), len(table) - 1)
         return np.where(table[pos] == probe, self.c_seq[pos], 0) \
@@ -769,12 +783,26 @@ class BlockStore:
         key_new = key_new[seg_start]
         seq = (seg_max >> 1).astype(np.int32)
         pure = (seg_max & 1).astype(bool)
-        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        table = self._clock_table()
         pos = np.minimum(np.searchsorted(table, key_new),
                          max(len(table) - 1, 0))
         hit = (table[pos] == key_new) if len(table) else \
             np.zeros(len(key_new), bool)
         if hit.any():
+            sharers = getattr(self, '_c_sharers', None)
+            if sharers:
+                # a live patch snapshot aliases c_seq — copy before the
+                # in-place scatter so its apply-time clock stays frozen
+                self.c_seq = self.c_seq.copy()
+                sharers.clear()
+            jr = getattr(self, '_c_journal', None)
+            if jr is not None:
+                # O(delta) rollback record of the in-place scatter (the
+                # _Txn undoes these instead of copying the whole table)
+                ph = pos[hit]
+                jr.append((ph, self.c_seq[ph].copy(),
+                           self.c_pure[ph].copy(),
+                           self.c_seq, self.c_pure))
             adv = seq[hit] > self.c_seq[pos[hit]]
             np.maximum.at(self.c_seq, pos[hit], seq[hit])
             self.c_pure[pos[hit][adv]] = pure[hit][adv]
@@ -788,12 +816,17 @@ class BlockStore:
             self.c_actor = (all_key & 0xFFFFFFFF).astype(np.int32)
             self.c_seq = all_seq.astype(np.int32)
             self.c_pure = all_pure[order]
+            # the replaced arrays are frozen now — snapshots aliasing
+            # them need no copy-on-write protection anymore
+            sh = getattr(self, '_c_sharers', None)
+            if sh:
+                sh.clear()
 
     def clock_pure_lookup(self, doc, actor):
         """Chain purity per (doc, actor) pair (False on miss)."""
         if len(self.c_doc) == 0 or len(doc) == 0:
             return np.zeros(len(doc), bool)
-        table = (self.c_doc.astype(np.int64) << 32) | self.c_actor
+        table = self._clock_table()
         probe = (doc.astype(np.int64) << 32) | actor
         pos = np.minimum(np.searchsorted(table, probe), len(table) - 1)
         return np.where(table[pos] == probe, self.c_pure[pos], False)
@@ -1130,6 +1163,19 @@ def init_store(n_docs):
 
 # -- per-doc local actor coordinates -----------------------------------------
 
+# delta-host master switch: False disables every persistent host-side
+# staging fast path across the engine (the _LocalActors memo below,
+# general.py's staging caches, sorted field index, commit slice path
+# and suffix-window renumber) — the whole-plane A/B arm of
+# bench_incremental_order's host_tick band and the parity oracle for
+# the cached paths. None/True = on.
+_DELTA_HOST = None
+
+
+def _delta_host_on():
+    return _DELTA_HOST is not False
+
+
 class _LocalActors:
     """Per-document actor slots, ordered by actor STRING rank within each
     document — the rank order the conflict kernel relies on
@@ -1158,6 +1204,41 @@ class _LocalActors:
 
     def store_of(self, doc, local):
         return self.store_id[self.doc_start[doc] + local]
+
+
+def _local_actors_for(store, block, b_actor, dep_actor_store, dep_doc):
+    """O(delta) _LocalActors for warm stores: the previous apply's
+    universe is reused when the clock pair set (ref identity of
+    c_doc/c_actor — replaced only when a NEW (doc, actor) pair merges)
+    and the actor string table are unchanged and every pair this block
+    mentions is already a member. The reused universe may be a strict
+    superset of a cold build (pairs from since-buffered changes) —
+    locals stay ordered by actor string rank within each doc, which is
+    the only property the kernels rely on. Anything else rebuilds from
+    the full clock (O(clock pairs log) — the legacy per-tick cost)."""
+    cached = getattr(store, '_la_cache', None) if _delta_host_on() \
+        else None
+    if cached is not None:
+        c_doc_ref, c_actor_ref, n_act, la = cached
+        if (c_doc_ref is store.c_doc and c_actor_ref is store.c_actor
+                and n_act == len(store.actors)):
+            pd = np.concatenate([block.doc, dep_doc])
+            pa = np.concatenate([b_actor, dep_actor_store])
+            if not len(pd):
+                return la
+            if len(la.key):
+                key = (pd.astype(np.int64) << 32) | la.str_rank[pa]
+                p = np.minimum(np.searchsorted(la.key, key),
+                               len(la.key) - 1)
+                if (la.key[p] == key).all():
+                    return la
+    la = _LocalActors(
+        store,
+        np.concatenate([block.doc, dep_doc, store.c_doc]),
+        np.concatenate([b_actor, dep_actor_store, store.c_actor]))
+    store._la_cache = (store.c_doc, store.c_actor,
+                       len(store.actors), la)
+    return la
 
 
 # -- vectorized causal admission ---------------------------------------------
@@ -1597,12 +1678,12 @@ def _admit_and_stage(store, block, max_keys=None, max_actors=None):
     b_actor = a_tab[block.actor] if block.n_changes else z32
     dep_actor_store = a_tab[block.dep_actor] if len(block.dep_actor) else z32
 
-    # per-doc local actor universe: change + dep + already-applied actors
+    # per-doc local actor universe: change + dep + already-applied
+    # actors (memoized across applies — warm ticks reuse it in
+    # O(block pairs))
     dep_doc = np.repeat(block.doc, np.diff(block.dep_ptr))
-    la = _LocalActors(store,
-                      np.concatenate([block.doc, dep_doc, store.c_doc]),
-                      np.concatenate([b_actor, dep_actor_store,
-                                      store.c_actor]))
+    la = _local_actors_for(store, block, b_actor, dep_actor_store,
+                           dep_doc)
 
     try:
         admitted, leftover, R, cmap, adm_order = _admit_block(

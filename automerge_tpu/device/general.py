@@ -110,10 +110,11 @@ class _SeqPool:
     """
 
     __slots__ = ('obj', 'local', 'parent', 'actor', 'elemc', 'visible',
-                 'vis_index', 'tpos', 'idx_ok', 'pos_sorted', 'pos_row',
+                 'vis_index', 'tpos', 'idx_ok', 'idx_linear',
+                 'pos_sorted', 'pos_row',
                  'n_of', 'max_elem_of', 'max_tree', 'max_elem',
                  'mirror', '_epoch', '_host_epoch', '_tpos_epoch',
-                 '_lock')
+                 '_lock', '_elem_cache')
 
     def __init__(self):
         # host lock shared with the owning store: serializes the apply
@@ -136,6 +137,21 @@ class _SeqPool:
         # tree positions (the incremental-update eligibility bit; False
         # forces a whole-object _rga_order rebuild on next touch)
         self.idx_ok = np.zeros(0, bool)
+        # per-OBJECT: the tree is a pure chain (parent[local] ==
+        # local - 1 for every real node), so tree position == local
+        # and a suffix of locals is a suffix of tree positions — the
+        # eligibility bit of the suffix-bounded visibility renumber.
+        # Maintained in O(appended) by _append; never un-falsed (a
+        # branch is permanent until compaction rebuilds the object).
+        self.idx_linear = np.zeros(0, bool)
+        # per-OBJECT staging cache: obj -> [keys_sorted, locals], the
+        # sorted (actor << 32 | elem) -> local index both stagers
+        # consult in O(delta) instead of re-tabulating every node of
+        # every dirty object per tick. Built post-apply for dirty
+        # objects, extended in place by append_batch, dropped
+        # wholesale on rollback (see _Txn) and on snapshot restore
+        # (fresh pool). Heads are excluded (never a lookup target).
+        self._elem_cache = {}
         self.pos_sorted = np.zeros(0, np.int64)
         self.pos_row = np.zeros(0, np.int64)
         self.n_of = np.zeros(0, np.int64)        # per OBJECT row
@@ -166,6 +182,8 @@ class _SeqPool:
             # a fresh object has no device-resident index yet
             self.idx_ok = np.concatenate(
                 [self.idx_ok, np.zeros(pad, bool)])
+            self.idx_linear = np.concatenate(
+                [self.idx_linear, np.zeros(pad, bool)])
 
     def _append(self, obj, local, parent, actor, elemc):
         base = len(self.obj)
@@ -180,10 +198,22 @@ class _SeqPool:
             [self.vis_index, np.full(n, -1, np.int32)])
         self.tpos = np.concatenate([self.tpos, np.zeros(n, np.int32)])
         keys = (obj.astype(np.int64) << 32) | local
-        pos = np.searchsorted(self.pos_sorted, keys)
-        self.pos_sorted = np.insert(self.pos_sorted, pos, keys)
-        self.pos_row = np.insert(self.pos_row, pos,
-                                 base + np.arange(n, dtype=np.int64))
+        new_rows = base + np.arange(n, dtype=np.int64)
+        m = len(self.pos_sorted)
+        if (m == 0 or keys[0] > self.pos_sorted[-1]) and \
+                (n == 1 or (keys[1:] > keys[:-1]).all()):
+            # tail append (sequential typing): skip np.insert's fancy
+            # index handling — a plain concat keeps the order
+            self.pos_sorted = np.concatenate([self.pos_sorted, keys])
+            self.pos_row = np.concatenate([self.pos_row, new_rows])
+        else:
+            pos = np.searchsorted(self.pos_sorted, keys)
+            self.pos_sorted = np.insert(self.pos_sorted, pos, keys)
+            self.pos_row = np.insert(self.pos_row, pos, new_rows)
+        # chain-shape maintenance, O(appended): any node whose parent
+        # is not its predecessor permanently branches the object
+        ok_chain = (local == 0) | (parent == local - 1)
+        np.logical_and.at(self.idx_linear, obj, ok_chain)
 
     def create_heads(self, rows):
         """Batch-create the virtual head node of NEW sequence objects
@@ -195,6 +225,7 @@ class _SeqPool:
         self._append(rows.astype(np.int32), z, z,
                      np.full(len(rows), -1, np.int32), z)
         self.n_of[rows] = 1
+        self.idx_linear[rows] = True     # a lone head is a chain
         self.max_tree = max(self.max_tree, 1)
 
     def append_batch(self, obj, local, parent_local, actor, elemc):
@@ -214,6 +245,29 @@ class _SeqPool:
         self.max_elem_of[uo] = np.maximum(self.max_elem_of[uo], seg_max)
         self.max_tree = max(self.max_tree, int(local[ends].max()) + 1)
         self.max_elem = max(self.max_elem, int(seg_max.max()))
+        # staging-cache upkeep in O(new): resident per-object elemId
+        # indexes absorb the appended nodes (sequential typing appends
+        # ascending keys — a pure tail concat)
+        if self._elem_cache:
+            for k, o in enumerate(uo.tolist()):
+                ent = self._elem_cache.get(o)
+                if ent is None:
+                    continue
+                s, e = starts[k], ends[k] + 1
+                nk = (actor[s:e].astype(np.int64) << 32) | \
+                    elemc[s:e].astype(np.int64)
+                nl = local[s:e].astype(np.int64)
+                if len(nk) > 1 and not (nk[1:] > nk[:-1]).all():
+                    o2 = np.argsort(nk, kind='stable')
+                    nk, nl = nk[o2], nl[o2]
+                keys0, locs0 = ent
+                if not len(keys0) or nk[0] > keys0[-1]:
+                    ent[0] = np.concatenate([keys0, nk])
+                    ent[1] = np.concatenate([locs0, nl])
+                else:
+                    p = np.searchsorted(keys0, nk)
+                    ent[0] = np.insert(keys0, p, nk)
+                    ent[1] = np.insert(locs0, p, nl)
 
     def rows_of_objs(self, objs):
         """(global rows, node counts): all nodes of `objs`, grouped in
@@ -234,6 +288,25 @@ class _SeqPool:
         the _HEAD_KEY sentinel, distinct from every real key)."""
         return (self.actor[rows].astype(np.int64) << 32) | \
             self.elemc[rows].astype(np.int64)
+
+    def elem_index(self, obj):
+        """The staging cache of one object: sorted ``(actor << 32 |
+        elem)`` keys and their node locals (heads excluded). Builds
+        once in O(n_of[obj]); ``append_batch`` extends resident
+        entries in O(new), so warm-doc stagers resolve parents and
+        check duplicates in O(delta log n)."""
+        ent = self._elem_cache.get(obj)
+        if ent is None:
+            rows, _ = self.rows_of_objs(np.asarray([obj], np.int64))
+            real = self.actor[rows] >= 0
+            rows = rows[real]
+            keys = (self.actor[rows].astype(np.int64) << 32) | \
+                self.elemc[rows].astype(np.int64)
+            order = np.argsort(keys, kind='stable')
+            ent = [keys[order],
+                   self.local[rows][order].astype(np.int64)]
+            self._elem_cache[obj] = ent
+        return ent
 
     def sync(self):
         """Materialize the device mirror's visibility/order into the
@@ -354,9 +427,13 @@ class _Txn:
         self.pool_mirror = pool.mirror
         self.pool_epochs = (pool._epoch, pool._host_epoch)
         self.queue = list(store.queue)
+        # clock rollback is journaled, not copied: clock_merge records
+        # (positions, old seqs, old purity, array refs) for every
+        # in-place scatter, so the snapshot is the refs + an empty
+        # journal — O(delta) per apply instead of O(clock table)
         self.c_doc, self.c_actor = store.c_doc, store.c_actor
-        self.c_seq = store.c_seq.copy()
-        self.c_pure = store.c_pure.copy()
+        self.c_seq, self.c_pure = store.c_seq, store.c_pure
+        store._c_journal = []
         self.log = (store.l_key, store.l_order, store._l_sorted,
                     list(store._l_pending), store.l_dep_ptr,
                     store.l_dep_actor, store.l_dep_seq)
@@ -374,7 +451,8 @@ class _Txn:
                           pool.tpos, pool.pos_sorted, pool.pos_row)
         self.pool_n = (pool.n_of.copy(), pool.max_elem_of.copy(),
                        pool.max_tree, pool.max_elem,
-                       pool.idx_ok.copy(), pool._tpos_epoch)
+                       pool.idx_ok.copy(), pool._tpos_epoch,
+                       pool.idx_linear.copy())
         # digest fold is copy-on-fold and reads never interleave an
         # apply, so the array REFERENCE plus the pending length is a
         # complete rollback record — no per-apply copy
@@ -395,6 +473,14 @@ class _Txn:
         store.pool.mirror = self.pool_mirror
         store.pool._epoch, store.pool._host_epoch = self.pool_epochs
         store.queue = self.queue
+        # undo the journaled in-place clock scatters (each entry
+        # carries its own array refs, so undo is correct even after
+        # the miss path replaced the store's arrays), then restore
+        for ph, old_seq, old_pure, arr_seq, arr_pure in \
+                reversed(store._c_journal):
+            arr_seq[ph] = old_seq
+            arr_pure[ph] = old_pure
+        store._c_journal = []
         store.c_doc, store.c_actor, store.c_seq = (self.c_doc,
                                                    self.c_actor,
                                                    self.c_seq)
@@ -426,8 +512,13 @@ class _Txn:
          pool.visible, pool.vis_index, pool.tpos, pool.pos_sorted,
          pool.pos_row) = self.pool_cols
         (pool.n_of, pool.max_elem_of, pool.max_tree,
-         pool.max_elem, pool.idx_ok, pool._tpos_epoch) = self.pool_n
+         pool.max_elem, pool.idx_ok, pool._tpos_epoch,
+         pool.idx_linear) = self.pool_n
+        # the staging caches may hold nodes the rollback just unminted
+        # — drop them wholesale (cold rebuild on next touch)
+        pool._elem_cache.clear()
         store._digest = self.digest
+        store._e_sorted = None
         del store._digest_pending[self.n_digest_pending:]
 
 
@@ -467,6 +558,15 @@ class GeneralStore(BlockStore):
         # overlaps device resolution of block n (the async
         # frontend/backend overlap of SURVEY §2 P3, engine-side)
         self._pending_commit = None
+        # sorted packed-field index over the entry columns:
+        # (e_obj ref anchor, field keys ascending, entry rows aligned).
+        # The prior-entry match consults it in O(touched log E) instead
+        # of re-packing every entry's field key per tick; the commit
+        # maintains it in O(delta log E) and drops it (None) whenever
+        # a cheap in-place update isn't possible — next apply rebuilds.
+        # The ref anchor invalidates it for free on rollback/restore
+        # (those replace e_obj wholesale).
+        self._e_sorted = None
 
     def _commit_pending(self, _surv_u8=None):
         """Fetch the pending apply's survivor bits and fold its entry
@@ -497,25 +597,81 @@ class GeneralStore(BlockStore):
             _update_inbound(self, patch, pc['touched_fields'], surviving,
                             pc['r_seg'], cat['link'][order],
                             cat['value'][order], s_rows)
-        prior_mask = pc['prior_mask']
-        keep_e = ~prior_mask if len(prior_mask) else np.zeros(0, bool)
+        prior_rows = pc['prior_rows']
+        n_e0 = pc['n_entries']
         sel = order[s_rows]          # survivor rows, in cat coordinates
-        self.e_doc = np.concatenate([self.e_doc[keep_e],
-                                     cat['doc'][sel]])
-        self.e_obj = np.concatenate([self.e_obj[keep_e],
-                                     cat['obj'][sel]])
-        self.e_key = np.concatenate([self.e_key[keep_e],
-                                     cat['key'][sel]])
-        self.e_actor = np.concatenate([self.e_actor[keep_e],
-                                       cat['actor'][sel]])
-        self.e_seq = np.concatenate([self.e_seq[keep_e],
-                                     cat['seq'][sel]])
-        self.e_value = np.concatenate([self.e_value[keep_e],
-                                       cat['value'][sel]])
-        self.e_link = np.concatenate([self.e_link[keep_e],
-                                      cat['link'][sel]])
-        self.e_change = np.concatenate([self.e_change[keep_e],
-                                        cat['change'][sel]])
+        n_drop = len(prior_rows)
+        if n_drop == 0:
+            def upd(col, tail):
+                return np.concatenate([col, tail])
+        elif n_drop > 512:
+            # bulk replace (resync-scale): one boolean pass
+            keep_e = np.ones(n_e0, bool)
+            keep_e[prior_rows] = False
+
+            def upd(col, tail):
+                return np.concatenate([col[keep_e], tail])
+        else:
+            # warm tick: a handful of dropped rows — kept-segment
+            # slices instead of an O(entries) boolean gather per column
+            starts = np.concatenate([[0], prior_rows + 1]).tolist()
+            ends = np.append(prior_rows, n_e0).tolist()
+
+            def upd(col, tail):
+                parts = [col[s:e] for s, e in zip(starts, ends)]
+                parts.append(tail)
+                return np.concatenate(parts)
+        self.e_doc = upd(self.e_doc, cat['doc'][sel])
+        old_e_obj = self.e_obj
+        self.e_obj = upd(self.e_obj, cat['obj'][sel])
+        self.e_key = upd(self.e_key, cat['key'][sel])
+        self.e_actor = upd(self.e_actor, cat['actor'][sel])
+        self.e_seq = upd(self.e_seq, cat['seq'][sel])
+        self.e_value = upd(self.e_value, cat['value'][sel])
+        self.e_link = upd(self.e_link, cat['link'][sel])
+        self.e_change = upd(self.e_change, cat['change'][sel])
+
+        # sorted field-index upkeep in O(delta log E): drop the prior
+        # entries at their (already known) sorted positions, compact
+        # the surviving row ids, insert the appended entries. Any
+        # shape this can't do cheaply drops the index — the next
+        # commit rebuilds it once.
+        srt = self._e_sorted
+        drop_pos = pc.get('srt_drop_pos')
+        if (srt is not None and drop_pos is not None
+                and srt[0] is old_e_obj
+                and n_drop <= 4096 and len(sel) <= 65536):
+            if n_drop:
+                vals_k = np.delete(srt[1], drop_pos)
+                rows_k = np.delete(srt[2], drop_pos)
+                rows_k = rows_k - np.searchsorted(prior_rows, rows_k)
+            else:
+                vals_k, rows_k = srt[1], srt[2]
+            new_vals = (cat['obj'][sel].astype(np.int64) << 32) | \
+                cat['key'][sel]
+            new_rows = (n_e0 - n_drop) + \
+                np.arange(len(sel), dtype=np.int64)
+            if len(new_vals) and len(vals_k) \
+                    and new_vals[0] > vals_k[-1] \
+                    and (len(new_vals) == 1
+                         or (new_vals[1:] >= new_vals[:-1]).all()):
+                # fresh fields sort past every resident one (interned
+                # key ids grow monotonically) — pure tail extension
+                self._e_sorted = (self.e_obj,
+                                  np.concatenate([vals_k, new_vals]),
+                                  np.concatenate([rows_k, new_rows]))
+            else:
+                p = np.searchsorted(vals_k, new_vals)
+                self._e_sorted = (self.e_obj,
+                                  np.insert(vals_k, p, new_vals),
+                                  np.insert(rows_k, p, new_rows))
+        elif _blocks._delta_host_on():
+            ef = (self.e_obj.astype(np.int64) << 32) | self.e_key
+            ordv = np.argsort(ef, kind='stable')
+            self._e_sorted = (self.e_obj, ef[ordv],
+                              ordv.astype(np.int64))
+        else:
+            self._e_sorted = None
 
     # -- packed snapshot -----------------------------------------------------
 
@@ -662,6 +818,16 @@ class GeneralStore(BlockStore):
             pool.pos_row = z['p_pos_row']
             pool.n_of = z['p_n_of']
             pool.max_elem_of = z['p_max_elem_of']
+            # chain-shape bit re-derives from the restored tree
+            # columns (not serialized): one O(nodes) pass per resume
+            pool.idx_linear = np.zeros(len(pool.n_of), bool)
+            if len(pool.obj):
+                ok = (pool.local == 0) | (pool.parent == pool.local - 1)
+                lin = np.ones(len(pool.n_of), bool)
+                np.logical_and.at(lin, pool.obj, ok)
+                has = np.zeros(len(pool.n_of), bool)
+                has[pool.obj] = True
+                pool.idx_linear = lin & has
             pool.max_tree = int(pool.n_of.max()) if len(pool.n_of) \
                 else 0
             pool.max_elem = int(pool.elemc.max()) \
@@ -1448,6 +1614,21 @@ _INDEX_MODE = None
 # False = host path always.
 _EDIT_STREAM = None
 
+# suffix-window switch for the incremental index update: None = auto
+# (bound each eligible chain-shaped job's renumber to the suffix
+# window containing every delta anchor and touched node), 'off' =
+# always renumber the whole plane (the whole-plane A/B arm of the
+# host_tick bench band), 'require' = raise when an incremental apply
+# with dirty sequences cannot window (tests: a silent fallback on the
+# end-typing shape is a bug)
+_WINDOW_MODE = None
+
+# staging-cache switch (delta admit/stage): None = auto (keep per-
+# object sorted elemId -> local indexes across applies and let both
+# stagers consult them), False = off (cold-stage every tick — the
+# whole-plane A/B arm / parity oracle)
+_STAGE_CACHE = None
+
 
 def _edit_stream_on():
     if _EDIT_STREAM is None:
@@ -1514,6 +1695,74 @@ def _wire_sizes_wide(d_pad, n_pad, K, nnz_pad):
     return 4 * i32_n + u8_n
 
 
+def _wire_cut(vec, state, cnt):
+    o = state[0]
+    state[0] = o + cnt
+    return vec[o:o + cnt]
+
+
+def _parse_wire_packed(wire, sizes):
+    """Slice the PACKED wire buffer into its typed sections — ONE
+    definition of the section order shared by the rebuild
+    (`_fused_general_packed`) and incremental (`_fused_general_incr`)
+    programs; must stay in lockstep with `_wire_sizes`, the host
+    packing loop and the C++ `amst_fill_wire`. Returns
+    (w1d, d_pos, row_slot, coo_row, job_start, job_n,
+     w2e, seq, coo_val, actor, flags_u8, coo_col)."""
+    d_pad, n_pad, K, nnz_pad = sizes
+    i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
+    i16_n = d_pad + n_pad + nnz_pad
+    i32v = jax.lax.bitcast_convert_type(
+        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+    i16v = jax.lax.bitcast_convert_type(
+        wire[4 * i32_n:4 * i32_n + 2 * i16_n].reshape(i16_n, 2),
+        jnp.int16)
+    u8v = wire[4 * i32_n + 2 * i16_n:]
+    s32, s16, s8 = [0], [0], [0]
+    w1d = _wire_cut(i32v, s32, d_pad)
+    d_pos = _wire_cut(i32v, s32, d_pad)
+    row_slot = _wire_cut(i32v, s32, n_pad)
+    coo_row = _wire_cut(i32v, s32, nnz_pad)
+    job_start = _wire_cut(i32v, s32, K)
+    job_n = _wire_cut(i32v, s32, K)
+    w2e = _wire_cut(i16v, s16, d_pad).astype(jnp.int32)
+    seq = _wire_cut(i16v, s16, n_pad).astype(jnp.int32)
+    coo_val = _wire_cut(i16v, s16, nnz_pad).astype(jnp.int32)
+    actor = _wire_cut(u8v, s8, n_pad).astype(jnp.int32)
+    flags_u8 = _wire_cut(u8v, s8, 2 * (n_pad >> 3))
+    coo_col = _wire_cut(u8v, s8, nnz_pad).astype(jnp.int32)
+    return (w1d, d_pos, row_slot, coo_row, job_start, job_n, w2e, seq,
+            coo_val, actor, flags_u8, coo_col)
+
+
+def _parse_wire_wide(wire, sizes):
+    """The WIDE counterpart of `_parse_wire_packed` (section order of
+    `_wire_sizes_wide` / `amst_fill_wire_wide`). Returns
+    (w1d, w3d, d_pos, row_slot, seq, coo_row, coo_val, job_start,
+     job_n, d_ahi, actor, flags_u8, coo_col)."""
+    d_pad, n_pad, K, nnz_pad = sizes
+    i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
+    i32v = jax.lax.bitcast_convert_type(
+        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
+    u8v = wire[4 * i32_n:]
+    s32, s8 = [0], [0]
+    w1d = _wire_cut(i32v, s32, d_pad)
+    w3d = _wire_cut(i32v, s32, d_pad)
+    d_pos = _wire_cut(i32v, s32, d_pad)
+    row_slot = _wire_cut(i32v, s32, n_pad)
+    seq = _wire_cut(i32v, s32, n_pad)
+    coo_row = _wire_cut(i32v, s32, nnz_pad)
+    coo_val = _wire_cut(i32v, s32, nnz_pad)
+    job_start = _wire_cut(i32v, s32, K)
+    job_n = _wire_cut(i32v, s32, K)
+    d_ahi = _wire_cut(u8v, s8, d_pad).astype(jnp.int32)
+    actor = _wire_cut(u8v, s8, n_pad).astype(jnp.int32)
+    flags_u8 = _wire_cut(u8v, s8, 2 * (n_pad >> 3))
+    coo_col = _wire_cut(u8v, s8, nnz_pad).astype(jnp.int32)
+    return (w1d, w3d, d_pos, row_slot, seq, coo_row, coo_val,
+            job_start, job_n, d_ahi, actor, flags_u8, coo_col)
+
+
 @partial(jax.jit, static_argnames=('sizes', 'num_segments', 'a_pad',
                                    'm_pad', 'has_remap', 'has_old'))
 def _fused_general_packed(w1m, w2m, tpm, wire, n_old, n_rows,
@@ -1533,34 +1782,9 @@ def _fused_general_packed(w1m, w2m, tpm, wire, n_old, n_rows,
     cap = w1m.shape[0]
     nb = n_pad >> 3
 
-    # ONE bitcast per dtype section, then slices (static offsets)
-    i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
-    i16_n = d_pad + n_pad + nnz_pad
-    i32v = jax.lax.bitcast_convert_type(
-        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
-    i16v = jax.lax.bitcast_convert_type(
-        wire[4 * i32_n:4 * i32_n + 2 * i16_n].reshape(i16_n, 2),
-        jnp.int16)
-    u8v = wire[4 * i32_n + 2 * i16_n:]
-
-    def cut(vec, state, cnt):
-        o = state[0]
-        state[0] = o + cnt
-        return vec[o:o + cnt]
-
-    s32, s16, s8 = [0], [0], [0]
-    w1d = cut(i32v, s32, d_pad)
-    d_pos = cut(i32v, s32, d_pad)
-    row_slot = cut(i32v, s32, n_pad)
-    coo_row = cut(i32v, s32, nnz_pad)
-    job_start = cut(i32v, s32, K)
-    job_n = cut(i32v, s32, K)
-    w2e = cut(i16v, s16, d_pad).astype(jnp.int32)
-    seq = cut(i16v, s16, n_pad).astype(jnp.int32)
-    coo_val = cut(i16v, s16, nnz_pad).astype(jnp.int32)
-    actor = cut(u8v, s8, n_pad).astype(jnp.int32)
-    flags_u8 = cut(u8v, s8, 2 * nb)
-    coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+    (w1d, d_pos, row_slot, coo_row, job_start, job_n, w2e, seq,
+     coo_val, actor, flags_u8, coo_col) = _parse_wire_packed(wire,
+                                                            sizes)
 
     if has_remap:
         w1m = (w1m & ~0xFFFF) | jnp.take(rank_remap, w1m & 0xFFFF) \
@@ -1658,30 +1882,9 @@ def _fused_general_wide(w1m, w2m, w3m, tpm, wire, n_old, n_rows,
     cap = w1m.shape[0]
     nb = n_pad >> 3
 
-    i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
-    i32v = jax.lax.bitcast_convert_type(
-        wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
-    u8v = wire[4 * i32_n:]
-
-    def cut(vec, state, cnt):
-        o = state[0]
-        state[0] = o + cnt
-        return vec[o:o + cnt]
-
-    s32, s8 = [0], [0]
-    w1d = cut(i32v, s32, d_pad)
-    w3d = cut(i32v, s32, d_pad)
-    d_pos = cut(i32v, s32, d_pad)
-    row_slot = cut(i32v, s32, n_pad)
-    seq = cut(i32v, s32, n_pad)
-    coo_row = cut(i32v, s32, nnz_pad)
-    coo_val = cut(i32v, s32, nnz_pad)
-    job_start = cut(i32v, s32, K)
-    job_n = cut(i32v, s32, K)
-    d_ahi = cut(u8v, s8, d_pad).astype(jnp.int32)
-    actor = cut(u8v, s8, n_pad).astype(jnp.int32)
-    flags_u8 = cut(u8v, s8, 2 * nb)
-    coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+    (w1d, w3d, d_pos, row_slot, seq, coo_row, coo_val, job_start,
+     job_n, d_ahi, actor, flags_u8, coo_col) = _parse_wire_wide(wire,
+                                                                sizes)
 
     # ---- fold the new nodes into the pos-ordered mirror ----
     tgt_new = d_pos + jnp.arange(d_pad, dtype=jnp.int32)
@@ -1760,7 +1963,7 @@ def _fused_general_wide(w1m, w2m, w3m, tpm, wire, n_old, n_rows,
 @partial(jax.jit, static_argnames=('fmt', 'sizes', 'num_segments',
                                    'a_pad', 'm_pad', 'dm_pad',
                                    'has_remap'))
-def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
+def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, ws, n_old,
                         n_rows, aux, *, fmt, sizes, num_segments,
                         a_pad, m_pad, dm_pad, has_remap):
     """One apply as an INCREMENTAL index update (Jiffy-style batch
@@ -1785,6 +1988,22 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     for objects whose 'tp' plane is current (`pool.idx_ok`); the host
     falls back to the rebuild variant otherwise. ``aux`` is the packed
     format's rank_remap (`has_remap`) or the wide format's rank_table.
+
+    SUFFIX WINDOW (``ws``, int32[K]): for chain-shaped objects
+    (``pool.idx_linear`` — tree position == local index) the host may
+    bound each job to the suffix window [ws_j, n_j) that contains
+    every delta anchor and every touched node: ``job_start`` arrives
+    rebased by ws_j, ``jd_base`` arrives window-RELATIVE, m_pad is the
+    padded WINDOW width, and the plane holds only the window's nodes.
+    Inside the program tp VALUES stay absolute while plane INDICES are
+    window-relative (offset by ws_j); the visible count the window
+    skips (``pvis``) reads from the folded mirror's own vis bits —
+    below-window nodes are untouched by construction, so their
+    pre-update bits are exact. ``ws = 0`` (the non-windowed dispatch)
+    reduces every rebase to the identity. Below-window mirror words
+    are never rewritten (the write-back covers exactly the window),
+    which is what makes the renumber O(window) end to end.
+
     Same wire layout, resolution pipeline and output contract as the
     matching rebuild variant — the parity suite
     (tests/test_sequence_index.py) pins incremental == rebuild ==
@@ -1797,57 +2016,19 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     cap = w1m.shape[0]
     nb = n_pad >> 3
 
-    def cut(vec, state, cnt):
-        o = state[0]
-        state[0] = o + cnt
-        return vec[o:o + cnt]
-
     # ---- wire parse: byte-identical section layouts to the rebuild
     # variants (the host builds ONE wire buffer either way) ----
     if fmt == 'packed':
-        i32_n = 2 * d_pad + n_pad + nnz_pad + 2 * K
-        i16_n = d_pad + n_pad + nnz_pad
-        i32v = jax.lax.bitcast_convert_type(
-            wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
-        i16v = jax.lax.bitcast_convert_type(
-            wire[4 * i32_n:4 * i32_n + 2 * i16_n].reshape(i16_n, 2),
-            jnp.int16)
-        u8v = wire[4 * i32_n + 2 * i16_n:]
-        s32, s16, s8 = [0], [0], [0]
-        w1d = cut(i32v, s32, d_pad)
-        d_pos = cut(i32v, s32, d_pad)
-        row_slot = cut(i32v, s32, n_pad)
-        coo_row = cut(i32v, s32, nnz_pad)
-        job_start = cut(i32v, s32, K)
-        job_n = cut(i32v, s32, K)
-        w2e = cut(i16v, s16, d_pad).astype(jnp.int32)
-        seq = cut(i16v, s16, n_pad).astype(jnp.int32)
-        coo_val = cut(i16v, s16, nnz_pad).astype(jnp.int32)
-        actor = cut(u8v, s8, n_pad).astype(jnp.int32)
-        flags_u8 = cut(u8v, s8, 2 * nb)
-        coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+        (w1d, d_pos, row_slot, coo_row, job_start, job_n, w2e, seq,
+         coo_val, actor, flags_u8, coo_col) = \
+            _parse_wire_packed(wire, sizes)
         if has_remap:
             w1m = (w1m & ~0xFFFF) | jnp.take(aux, w1m & 0xFFFF) \
                 .astype(jnp.int32)
     else:
-        i32_n = 3 * d_pad + 2 * n_pad + 2 * nnz_pad + 2 * K
-        i32v = jax.lax.bitcast_convert_type(
-            wire[:4 * i32_n].reshape(i32_n, 4), jnp.int32)
-        u8v = wire[4 * i32_n:]
-        s32, s8 = [0], [0]
-        w1d = cut(i32v, s32, d_pad)
-        w3d = cut(i32v, s32, d_pad)
-        d_pos = cut(i32v, s32, d_pad)
-        row_slot = cut(i32v, s32, n_pad)
-        seq = cut(i32v, s32, n_pad)
-        coo_row = cut(i32v, s32, nnz_pad)
-        coo_val = cut(i32v, s32, nnz_pad)
-        job_start = cut(i32v, s32, K)
-        job_n = cut(i32v, s32, K)
-        d_ahi = cut(u8v, s8, d_pad).astype(jnp.int32)
-        actor = cut(u8v, s8, n_pad).astype(jnp.int32)
-        flags_u8 = cut(u8v, s8, 2 * nb)
-        coo_col = cut(u8v, s8, nnz_pad).astype(jnp.int32)
+        (w1d, w3d, d_pos, row_slot, seq, coo_row, coo_val, job_start,
+         job_n, d_ahi, actor, flags_u8, coo_col) = \
+            _parse_wire_wide(wire, sizes)
 
     # ---- fold the new nodes in (an existing mirror is a
     # precondition of the incremental path, so always has_old).
@@ -1876,6 +2057,20 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
         w2f = fold(w2m, d_ahi << _WIDE_AHI_SHIFT)
         w3f = fold(w3m, w3d)
     tpf = fold(tpm, jnp.zeros(d_pad, jnp.int32))
+
+    # ---- suffix-window prefix: #visible nodes each job skips below
+    # its window, straight off the folded mirror (positions
+    # [job_start - ws, job_start) hold exactly the skipped locals
+    # [0, ws); new nodes splice above them and carry vis bit 0, and
+    # below-window visibility cannot change this tick). ws = 0 gives
+    # pvis = 0 — the non-windowed dispatch pays one cumsum, nothing
+    # else. ----
+    vshift = _W2_VIS_SHIFT if fmt == 'packed' else _WIDE_VIS_SHIFT
+    visbit = ((w2f >> vshift) & 1).astype(jnp.int32)
+    vcum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(visbit, dtype=jnp.int32)])
+    pvis = jnp.take(vcum, jnp.clip(job_start, 0, cap)) - \
+        jnp.take(vcum, jnp.clip(job_start - ws, 0, cap))
 
     # ---- job planes ----
     l = jnp.arange(m_pad, dtype=jnp.int32)
@@ -1930,17 +2125,20 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     drank = jnp.take_along_axis(s_rank, dcols_c, axis=1)
     # a delta node whose parent pre-existed is a delta ROOT; its
     # anchor is the parent's OLD tree position (front-insert: the
-    # whole group splices immediately after the anchor)
-    p_old = dvalid & (dparent < jd_base[:, None])
+    # whole group splices immediately after the anchor). dparent is an
+    # absolute local index; the window plane rebases it by ws.
+    dparent_rel = dparent - ws[:, None]
+    p_old = dvalid & (dparent_rel < jd_base[:, None])
     anchor = jnp.take_along_axis(
-        tpp, jnp.minimum(jnp.maximum(dparent, 0), m_pad - 1), axis=1)
+        tpp, jnp.clip(dparent_rel, 0, m_pad - 1), axis=1)
 
     def pad1(x, fill):
         return jnp.concatenate(
             [jnp.full((K, 1), fill, x.dtype), x], axis=1)
 
     dpos = _rga_delta_order_batched(
-        pad1(jnp.where(p_old, 0, dparent - jd_base[:, None] + 1), 0),
+        pad1(jnp.where(p_old, 0,
+                       dparent_rel - jd_base[:, None] + 1), 0),
         pad1(jnp.where(p_old, anchor, 0), 0),
         pad1(delem, 0), pad1(drank, 0), pad1(dvalid, True))
     dm1 = dm_pad + 1
@@ -1958,12 +2156,14 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     a_of = jnp.take_along_axis(a_pos, dpos_c, axis=1)
     d_tp = a_of + dpos                 # final position: a + r + 1
     # old-node shift = #{delta anchors < old position}: scatter-add
-    # the anchors, one cumsum — THE merge prefix-sum
+    # the anchors, one cumsum — THE merge prefix-sum (anchor tp values
+    # are absolute; the plane index is window-relative)
     cnt_a = jnp.zeros((K, m_pad), jnp.int32).at[
-        rowi, jnp.where(dvalid1, jnp.minimum(a_of, m_pad - 1), 0)].add(
-        dvalid1.astype(jnp.int32), mode='drop')
+        rowi, jnp.where(dvalid1,
+                        jnp.clip(a_of - ws[:, None], 0, m_pad - 1),
+                        0)].add(dvalid1.astype(jnp.int32), mode='drop')
     cum_a = jnp.cumsum(cnt_a, axis=1)
-    tpp_c = jnp.minimum(jnp.maximum(tpp, 0), m_pad - 1)
+    tpp_c = jnp.clip(tpp - ws[:, None], 0, m_pad - 1)
     shift = jnp.take_along_axis(cum_a, tpp_c, axis=1) - \
         jnp.take_along_axis(cnt_a, tpp_c, axis=1)
     tp_new = jnp.where(is_old_node, tpp + shift, 0)
@@ -1973,10 +2173,12 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     # ---- visibility index over the updated order (one flat
     # permutation scatter + cumsum + gather, as the rebuild's step 4;
     # tp_new is injective per job over the chain, so a plain set
-    # suffices) ----
-    on_chain = valid_plane & (tp_new > 0)
-    tp_sc = jnp.where(on_chain, tp_new, 0)
-    flat_tp = jnp.where(on_chain, rowi * m_pad + tp_sc, K * m_pad) \
+    # suffices). Windowed jobs renumber only the suffix: relative
+    # positions start at 0 (the node AT tp == ws is included) and the
+    # skipped prefix re-enters as the pvis offset. ----
+    on_chain = valid_plane & (tp_new > 0) & (tp_new >= ws[:, None])
+    tp_rel = jnp.where(on_chain, tp_new - ws[:, None], 0)
+    flat_tp = jnp.where(on_chain, rowi * m_pad + tp_rel, K * m_pad) \
         .reshape(-1)
     vis_ord = jnp.zeros((K * m_pad + 1,), bool).at[flat_tp].set(
         (visible & on_chain).reshape(-1),
@@ -1984,7 +2186,8 @@ def _fused_general_incr(w1m, w2m, w3m, tpm, wire, jd_base, n_old,
     vis_rank = (jnp.cumsum(vis_ord, axis=1) - vis_ord) \
         .astype(jnp.int32)
     new_idx = jnp.take_along_axis(
-        vis_rank, jnp.minimum(tp_sc, m_pad - 1), axis=1)
+        vis_rank, jnp.minimum(tp_rel, m_pad - 1), axis=1) + \
+        pvis[:, None]
     new_idx = jnp.where(visible & on_chain, new_idx, -1)
 
     # ---- write the updated vis word + tree positions back. Same
@@ -2095,7 +2298,11 @@ def _incr_eligibility(pool, dirty, n_j, nof_pre, mel_pre, n_old,
     interleaving insert, a first-sight object or an oversized delta
     returns None: the apply takes the whole-object rebuild variant,
     which re-validates the index for its dirty set. Returns
-    ``(dm_pad, jd_base)`` on success."""
+    ``(dm_pad, jd_base, min_rp)`` on success, where ``min_rp[j]`` is
+    the smallest PRE-EXISTING parent local any of job j's delta nodes
+    anchors to (``jd_base[j]`` when none) — the anchor bound the
+    suffix-window pick (`_apply_window`) intersects with the touched
+    rows."""
     K_jobs = len(dirty)
     if K_jobs == 0:
         return None
@@ -2118,6 +2325,7 @@ def _incr_eligibility(pool, dirty, n_j, nof_pre, mel_pre, n_old,
         return None
     dm_pad = opts.pad_nodes(max(dm, 8))
     d_n = n_total - n_old
+    min_rp = old_nof.astype(np.int64).copy()
     if d_n:
         # delta obj column in pos order == the sorted append-order
         # column (pos order sorts by (obj, local); within one object
@@ -2138,7 +2346,57 @@ def _incr_eligibility(pool, dirty, n_j, nof_pre, mel_pre, n_old,
                 if (el <= mel).any():
                     metrics.bump('device_idx_invalidations')
                     return None
-    return dm_pad, old_nof.astype(np.int32)
+                np.minimum.at(min_rp, safe[in_dirty][rooted],
+                              par[rooted].astype(np.int64))
+    return dm_pad, old_nof.astype(np.int32), min_rp
+
+
+def _apply_window(lin_pre, dirty, n_j, jd_base, min_rp, row_slot_v,
+                  job_start_v, job_n_v, m_pad, n_rows, K, opts):
+    """Suffix-window gate + in-place wire rewrite for an incremental
+    apply. A job windows when its pre-append tree is a pure chain
+    (``pool.idx_linear``: parent[local] == local-1 for every real
+    node, so tree position == local and any suffix of locals is a
+    suffix of tree positions) — then nothing below
+    ``ws = min(min rooted delta parent, min touched node local)``
+    can change visibility or index, and the device only needs the
+    plane columns [ws, n). Rewrites the wire's job_start (+= ws),
+    job_n (-= ws) and row_slot (rebased to window columns with the
+    shrunk per-job stride ``w_pad``) sections IN PLACE — the byte
+    layout has no m_pad dependence, so native- and numpy-assembled
+    wires take the identical rewrite. Returns
+    ``(w_pad, ws_k, jd_rel, win_n)`` or None (dispatch whole-plane):
+    only engages when the windowed plane is a strictly smaller jit
+    bucket than the full one, so ``ws = 0`` never reaches a program
+    specialised for windows — zeros in ``ws_k`` padding rows keep the
+    program's math an identity there."""
+    kj = len(dirty)
+    if kj == 0 or int(dirty.max()) >= len(lin_pre):
+        return None
+    if not lin_pre[dirty].all():
+        return None
+    ws = np.minimum(jd_base.astype(np.int64), min_rp)
+    rs = np.asarray(row_slot_v[:n_rows])
+    ok = rs >= 0
+    loc = nd = None
+    if ok.any():
+        loc = rs[ok].astype(np.int64) // m_pad
+        nd = rs[ok].astype(np.int64) % m_pad
+        np.minimum.at(ws, loc, nd)
+    ws = np.maximum(ws, 0)
+    win_n = n_j.astype(np.int64) - ws
+    w_pad = opts.pad_nodes(max(int(win_n.max()), 8))
+    if w_pad >= m_pad:
+        return None
+    if loc is not None:
+        row_slot_v[:n_rows][ok] = \
+            (loc * w_pad + (nd - ws[loc])).astype(np.int32)
+    job_start_v[:kj] = job_start_v[:kj] + ws.astype(np.int32)
+    job_n_v[:kj] = win_n.astype(job_n_v.dtype)
+    jd_rel = (jd_base.astype(np.int64) - ws).astype(np.int32)
+    ws_k = np.zeros(K, np.int32)
+    ws_k[:kj] = ws
+    return w_pad, ws_k, jd_rel, win_n
 
 
 @jax.jit
@@ -2296,7 +2554,7 @@ class GeneralPatch:
                  'f_kind', 'f_has_winner', 'f_value', 'f_actor', 'f_link',
                  's_ptr', 's_actor', 's_value', 's_link', 'seq_edits',
                  'clock_rows', 'keys', 'values', 'actors', '_raw',
-                 '_ready')
+                 '_ready', '__weakref__')
 
     def __init__(self, store):
         self.store = store
@@ -2306,8 +2564,17 @@ class GeneralPatch:
         self.keys = store.keys
         self.values = store.values
         self.actors = store.actors
-        self.clock_rows = (store.c_doc.copy(), store.c_actor.copy(),
-                           store.c_seq.copy())
+        # apply-time clock snapshot by REFERENCE: clock_merge only
+        # replaces these arrays (miss path) or, while this patch is
+        # alive (the weak registration below), copies c_seq before its
+        # in-place scatter — so the hot path, which drops the patch
+        # before the next tick, never pays an O(clock table) copy
+        self.clock_rows = (store.c_doc, store.c_actor, store.c_seq)
+        sharers = getattr(store, '_c_sharers', None)
+        if sharers is None:
+            import weakref
+            sharers = store._c_sharers = weakref.WeakSet()
+        sharers.add(self)
         self._raw = None
         self._ready = True       # empty patches need no device fetch
 
@@ -2501,36 +2768,44 @@ class GeneralPatch:
             dirty, n_j = raw['dirty'], raw['dirty_n']
             gained = raw['gained_max_elem']
             ps_sorted, ps_row = raw['pos_snap']
+            win_ws = raw.get('win_ws')
             for ji, obj_row in enumerate(dirty.tolist()):
                 n = int(n_j[ji])
+                # windowed apply: plane column c is node local ws + c
+                # (the renumber only shipped the suffix window; the
+                # indexes IN the plane words stay absolute)
+                wsj = int(win_ws[ji]) if win_ws is not None else 0
                 new_vis = nv[ji, :n]
                 new_idx = ni[ji, :n].astype(np.int32)
                 prev_idx = pi[ji, :n].astype(np.int32)
                 was_vis = pv[ji, :n]
                 lo, hi = np.searchsorted(ef_obj, [obj_row, obj_row + 1])
                 span = ef_node[lo:hi]
+                sp = span - wsj if wsj else span
                 removes = np.flatnonzero(was_vis & ~new_vis)
                 rm_old = -np.sort(-prev_idx[removes])
-                ins_nodes = np.flatnonzero(new_vis & ~was_vis)
-                ins_nodes = ins_nodes[np.argsort(new_idx[ins_nodes],
-                                                 kind='stable')]
+                ins_cols = np.flatnonzero(new_vis & ~was_vis)
+                ins_cols = ins_cols[np.argsort(new_idx[ins_cols],
+                                               kind='stable')]
+                ins_nodes = ins_cols + wsj
                 # sets only exist among TOUCHED nodes: intersect the
                 # delta-sized touched span instead of a full mask
-                tn = span[(new_vis[span] & was_vis[span])] \
-                    if len(span) else span
-                set_nodes = tn[np.argsort(new_idx[tn],
-                                          kind='stable')]
+                tn = sp[(new_vis[sp] & was_vis[sp])] \
+                    if len(sp) else sp
+                set_cols = tn[np.argsort(new_idx[tn],
+                                         kind='stable')]
+                set_nodes = set_cols + wsj
                 rowsq = ps_row[np.searchsorted(
                     ps_sorted,
                     (np.int64(obj_row) << 32) | ins_nodes)]
                 self.seq_edits[obj_row] = {
                     'max_elem': gained.get(obj_row),
                     'removes': rm_old.astype(np.int64),
-                    'ins_idx': new_idx[ins_nodes],
+                    'ins_idx': new_idx[ins_cols],
                     'ins_fis': fis_of(ins_nodes, lo, span),
                     'ins_actor': pool_actor[rowsq],
                     'ins_elemc': pool_elemc[rowsq],
-                    'set_idx': new_idx[set_nodes],
+                    'set_idx': new_idx[set_cols],
                     'set_fis': fis_of(set_nodes, lo, span),
                 }
         # patch-read closes the tick path: one device fetch + the
@@ -2885,9 +3160,11 @@ def _apply_general(store, block, options, return_timing, txn=None):
     # here) instead of copying O(n_objects) again per apply
     if txn is not None:
         nof_pre, mel_pre = txn.pool_n[0], txn.pool_n[1]
+        lin_pre = txn.pool_n[6]
     else:
         nof_pre = pool.n_of.copy()
         mel_pre = pool.max_elem_of.copy()
+        lin_pre = pool.idx_linear.copy()
 
     # ---- object creation, whole batch (make ops + missing roots) ----
     make_rows = np.flatnonzero(o_act >= _MAKE_MAP)
@@ -2982,12 +3259,15 @@ def _apply_general(store, block, options, return_timing, txn=None):
     ns = None
     if _NATIVE_STAGING is not False and st.keep.all() and block.n_ops:
         from .. import native as _amnative
+        use_ec = (_STAGE_CACHE is not False and _blocks._delta_host_on()
+                  and pool._elem_cache)
         ns = _amnative.stage_general_block(
             block, chg_local, st.a_tab, st.k_tab, omap,
             store._root_row, obj_doc_arr, obj_type_arr, pool,
             st.b_actor,
             pool.mirror['n'] if pool.mirror is not None else 0,
-            obj_uuid=store.obj_uuid)
+            obj_uuid=store.obj_uuid,
+            elem_cache=pool._elem_cache if use_ec else None)
     if _NATIVE_STAGING is True and ns is None:
         raise RuntimeError('native staging required but unavailable')
     if ns is not None:
@@ -3037,25 +3317,55 @@ def _apply_general(store, block, options, return_timing, txn=None):
         seg_new = np.empty(n_new0, np.int64)
         seg_new[order_new] = seg_sorted_new
         r_seg_new = seg_sorted_new.astype(np.int32)
-    # packed (obj << 32 | key) per store entry, cached per entry-table
-    # identity (the columns are replaced at commit, never mutated)
-    cache = getattr(store, '_e_field_cache', None)
-    if cache is not None and cache[0] is store.e_obj:
-        e_field = cache[1]
+    # prior-entry match. Fast path: the store's sorted field index
+    # (maintained across commits) answers "which entries hold a
+    # touched field" in O(touched log E); the legacy path re-packs
+    # every entry's field key and scans O(E) per tick. Both produce
+    # prior_rows ASCENDING and seg_prior aligned — byte-identical
+    # downstream row ordering.
+    srt = store._e_sorted
+    if srt is not None and srt[0] is not store.e_obj:
+        srt = None
+        store._e_sorted = None
+    srt_drop_pos = None
+    if srt is not None and _blocks._delta_host_on():
+        vals_s, rows_s = srt[1], srt[2]
+        if len(touched_fields):
+            lo_s = np.searchsorted(vals_s, touched_fields, 'left')
+            cnt_s = np.searchsorted(vals_s, touched_fields,
+                                    'right') - lo_s
+            srt_drop_pos = _span_indices(lo_s, cnt_s)
+            pru = rows_s[srt_drop_pos]
+            sgu = np.repeat(np.arange(len(touched_fields),
+                                      dtype=np.int64), cnt_s)
+            ordp2 = np.argsort(pru, kind='stable')
+            prior_rows = pru[ordp2]
+            seg_prior = sgu[ordp2]
+        else:
+            srt_drop_pos = np.zeros(0, np.int64)
+            prior_rows = np.zeros(0, np.int64)
+            seg_prior = np.zeros(0, np.int64)
     else:
-        e_field = (store.e_obj.astype(np.int64) << 32) | store.e_key
-        store._e_field_cache = (store.e_obj, e_field)
-    if len(e_field):
-        pos = np.minimum(np.searchsorted(touched_fields, e_field),
-                         max(len(touched_fields) - 1, 0))
-        prior_mask = (touched_fields[pos] == e_field) \
-            if len(touched_fields) else np.zeros(len(e_field), bool)
-        prior_rows = np.flatnonzero(prior_mask)
-        seg_prior = pos[prior_rows]
-    else:
-        prior_mask = np.zeros(0, bool)
-        prior_rows = np.zeros(0, np.int64)
-        seg_prior = np.zeros(0, np.int64)
+        # packed (obj << 32 | key) per store entry, cached per
+        # entry-table identity (columns are replaced at commit)
+        cache = getattr(store, '_e_field_cache', None)
+        if cache is not None and cache[0] is store.e_obj:
+            e_field = cache[1]
+        else:
+            e_field = (store.e_obj.astype(np.int64) << 32) | \
+                store.e_key
+            store._e_field_cache = (store.e_obj, e_field)
+        if len(e_field):
+            pos = np.minimum(np.searchsorted(touched_fields, e_field),
+                             max(len(touched_fields) - 1, 0))
+            prior_mask = (touched_fields[pos] == e_field) \
+                if len(touched_fields) else \
+                np.zeros(len(e_field), bool)
+            prior_rows = np.flatnonzero(prior_mask)
+            seg_prior = pos[prior_rows]
+        else:
+            prior_rows = np.zeros(0, np.int64)
+            seg_prior = np.zeros(0, np.int64)
     F = len(touched_fields)
     S = opts.pad_segments(max(F, 1))
 
@@ -3298,6 +3608,12 @@ def _apply_general(store, block, options, return_timing, txn=None):
                                    np.packbits(del_arr)])
     t2 = time.perf_counter()
 
+    # suffix-window state: set by the incr dispatch branches when the
+    # renumber was bounded to per-job suffix windows (m_eff < m_pad)
+    m_eff = m_pad
+    win_ws = None
+    win_nj = None
+
     if use_packed:
         ranks = np.asarray(store.actor_str_ranks())
         if mir is None:
@@ -3370,20 +3686,50 @@ def _apply_general(store, block, options, return_timing, txn=None):
             elemc_d=wire[4 * i32_n:4 * i32_n + 2 * d_pad]
             .view(np.int16) if native_wire else d_elemc)
         if incr is not None:
-            dm_pad, jd_base = incr
+            dm_pad, jd_base, min_rp = incr
+            ob = 4 * 2 * d_pad
+            rs_v = wire[ob:ob + 4 * n_pad].view(np.int32)
+            ob = 4 * (2 * d_pad + n_pad + nnz_pad)
+            js_v = wire[ob:ob + 4 * K].view(np.int32)
+            jn_v = wire[ob + 4 * K:ob + 8 * K].view(np.int32)
+            win = None
+            if _WINDOW_MODE != 'off' and _blocks._delta_host_on():
+                win = _apply_window(lin_pre, dirty, n_j, jd_base,
+                                    min_rp, rs_v, js_v, jn_v, m_pad,
+                                    n_rows, K, opts)
+            if win is not None:
+                m_eff, ws_k, jd_base, win_nj = win
+                win_ws = ws_k[:len(dirty)].copy()
+                metrics.bump('device_idx_window_applies')
+                if not native_wire:
+                    # the rewrite went through the wire views; keep the
+                    # numpy staging arrays (capture parity) in step
+                    row_slot[:] = rs_v
+                    job_start[:] = js_v
+                    n_j_arr[:] = jn_v
+            else:
+                if _WINDOW_MODE == 'require' and len(dirty):
+                    raise RuntimeError(
+                        "suffix-window path required (_WINDOW_MODE="
+                        "'require') but this apply cannot window")
+                ws_k = np.zeros(K, np.int32)
             jd = np.zeros(K, np.int32)
             jd[:len(dirty)] = jd_base
             _profiler.note_dispatch(
                 'general.fused_incr',
-                ('packed', cap, sizes, S, A, m_pad, dm_pad, has_remap,
+                ('packed', cap, sizes, S, A, m_eff, dm_pad, has_remap,
                  int(remap_dev.shape[0])),
                 rows=n_pad)
+            # numpy operands go straight to the jit C++ fast path — an
+            # explicit jnp.asarray per operand costs a Python-level
+            # device_put (~0.25 ms each on CPU), ~1 ms/tick of pure
+            # dispatch overhead for these tiny arrays
             outs = _fused_general_incr(
-                w1m, w2m, jnp.asarray(_NO_W3), tpm, jnp.asarray(wire),
-                jnp.asarray(jd), np.int32(n_old),
-                jnp.asarray(np.int32(n_rows)), remap_dev,
+                w1m, w2m, _NO_W3, tpm, wire,
+                jd, ws_k, np.int32(n_old),
+                np.int32(n_rows), remap_dev,
                 fmt='packed', sizes=sizes, num_segments=S, a_pad=A,
-                m_pad=m_pad, dm_pad=dm_pad, has_remap=has_remap)
+                m_pad=m_eff, dm_pad=dm_pad, has_remap=has_remap)
             w1o, w2o, tpo = outs[0], outs[1], outs[3]
             surv_u8_dev, winner_dev = outs[4], outs[5]
             vis_planes = outs[6] if len(dirty) else None
@@ -3397,8 +3743,8 @@ def _apply_general(store, block, options, return_timing, txn=None):
                  int(remap_dev.shape[0]), n_old > 0),
                 rows=n_pad)
             outs = _fused_general_packed(
-                w1m, w2m, tpm, jnp.asarray(wire), np.int32(n_old),
-                jnp.asarray(np.int32(n_rows)), remap_dev,
+                w1m, w2m, tpm, wire, np.int32(n_old),
+                np.int32(n_rows), remap_dev,
                 sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
                 has_remap=has_remap, has_old=n_old > 0)
             w1o, w2o, tpo = outs[0], outs[1], outs[2]
@@ -3477,20 +3823,44 @@ def _apply_general(store, block, options, return_timing, txn=None):
             elemc_d=wire[4 * d_pad:8 * d_pad].view(np.int32)
             if native_wire else d_elemc)
         if incr is not None:
-            dm_pad, jd_base = incr
+            dm_pad, jd_base, min_rp = incr
+            ob = 4 * 3 * d_pad
+            rs_v = wire[ob:ob + 4 * n_pad].view(np.int32)
+            ob = 4 * (3 * d_pad + 2 * n_pad + 2 * nnz_pad)
+            js_v = wire[ob:ob + 4 * K].view(np.int32)
+            jn_v = wire[ob + 4 * K:ob + 8 * K].view(np.int32)
+            win = None
+            if _WINDOW_MODE != 'off' and _blocks._delta_host_on():
+                win = _apply_window(lin_pre, dirty, n_j, jd_base,
+                                    min_rp, rs_v, js_v, jn_v, m_pad,
+                                    n_rows, K, opts)
+            if win is not None:
+                m_eff, ws_k, jd_base, win_nj = win
+                win_ws = ws_k[:len(dirty)].copy()
+                metrics.bump('device_idx_window_applies')
+                if not native_wire:
+                    row_slot[:] = rs_v
+                    job_start[:] = js_v
+                    n_j_arr[:] = jn_v
+            else:
+                if _WINDOW_MODE == 'require' and len(dirty):
+                    raise RuntimeError(
+                        "suffix-window path required (_WINDOW_MODE="
+                        "'require') but this apply cannot window")
+                ws_k = np.zeros(K, np.int32)
             jd = np.zeros(K, np.int32)
             jd[:len(dirty)] = jd_base
             _profiler.note_dispatch(
                 'general.fused_incr',
-                ('wide', cap, sizes, S, A, m_pad, dm_pad,
+                ('wide', cap, sizes, S, A, m_eff, dm_pad,
                  int(rank_table_dev.shape[0])),
                 rows=n_pad)
             outs = _fused_general_incr(
-                w1m, w2m, w3m, tpm, jnp.asarray(wire),
-                jnp.asarray(jd), np.int32(n_old),
-                jnp.asarray(np.int32(n_rows)), rank_table_dev,
+                w1m, w2m, w3m, tpm, wire,
+                jd, ws_k, np.int32(n_old),
+                np.int32(n_rows), rank_table_dev,
                 fmt='wide', sizes=sizes, num_segments=S, a_pad=A,
-                m_pad=m_pad, dm_pad=dm_pad, has_remap=False)
+                m_pad=m_eff, dm_pad=dm_pad, has_remap=False)
             w1o, w2o, w3o, tpo = outs[0], outs[1], outs[2], outs[3]
             surv_u8_dev, winner_dev = outs[4], outs[5]
             vis_planes = (outs[6], outs[7]) if len(dirty) else None
@@ -3501,8 +3871,8 @@ def _apply_general(store, block, options, return_timing, txn=None):
                  n_old > 0),
                 rows=n_pad)
             outs = _fused_general_wide(
-                w1m, w2m, w3m, tpm, jnp.asarray(wire), np.int32(n_old),
-                jnp.asarray(np.int32(n_rows)), rank_table_dev,
+                w1m, w2m, w3m, tpm, wire, np.int32(n_old),
+                np.int32(n_rows), rank_table_dev,
                 sizes=sizes, num_segments=S, a_pad=A, m_pad=m_pad,
                 has_old=n_old > 0)
             w1o, w2o, w3o, tpo = outs[0], outs[1], outs[2], outs[3]
@@ -3550,14 +3920,14 @@ def _apply_general(store, block, options, return_timing, txn=None):
              actor_arr.dtype.str, coo_val.dtype.str),
             rows=n_pad)
         outs = _fused_general_resident(
-            *m_cols, jnp.asarray(d_parent), jnp.asarray(d_elemc),
-            jnp.asarray(d_actor), jnp.asarray(d_pos), np.int32(n_old),
-            jnp.asarray(job_start), jnp.asarray(n_j_arr),
+            *m_cols, d_parent, d_elemc,
+            d_actor, d_pos, np.int32(n_old),
+            job_start, n_j_arr,
             rank_table_dev,
-            jnp.asarray(actor_arr), jnp.asarray(seq_arr),
-            jnp.asarray(row_slot), jnp.asarray(flags_u8),
-            jnp.asarray(np.int32(n_rows)), jnp.asarray(coo_row),
-            jnp.asarray(coo_col), jnp.asarray(coo_val),
+            actor_arr, seq_arr,
+            row_slot, flags_u8,
+            np.int32(n_rows), coo_row,
+            coo_col, coo_val,
             num_segments=S, a_pad=A, m_pad=m_pad)
         pool.mirror = {
             'fmt': 'cols', 'cap': cap, 'n': n_total,
@@ -3611,7 +3981,7 @@ def _apply_general(store, block, options, return_timing, txn=None):
             'ops_slot': cap_slot, 'flags_u8': cap_flags,
             'n_rows': n_rows, 'coo_row': coo_row, 'coo_col': coo_col,
             'coo_val': coo_val, 'num_segments': S, 'a_pad': A,
-            'm_pad': m_pad, 'surv_u8': surv_u8_dev,
+            'm_pad': m_eff, 'surv_u8': surv_u8_dev,
             'winner': winner_dev, 'vis_fmt': vis_fmt,
             'vis_planes': vis_planes, 'variant': fmt})
     t3 = time.perf_counter()
@@ -3695,11 +4065,18 @@ def _apply_general(store, block, options, return_timing, txn=None):
         'winner_dev': winner_dev, 'surviving': None,   # set at commit
         'cat': cat, 'order': order, 'vis_fmt': vis_fmt,
         'r_seg': r_seg, 's_rows': None, 'vis_planes': vis_planes,
-        'dirty': dirty, 'dirty_n': n_j, 'rows_flat': rows_flat_thunk,
+        'dirty': dirty, 'rows_flat': rows_flat_thunk,
+        # windowed applies hand the patch read the suffix planes: the
+        # per-job window base maps plane column c to absolute node
+        # local win_ws[j] + c, and dirty_n shrinks to the window
+        # sizes. e_pad = 0 pins the read to the host-unpack branch
+        # (the edit-stream program renumbers whole planes).
+        'dirty_n': n_j if win_ws is None else win_nj,
+        'win_ws': win_ws,
         # edit-stream read geometry: the fused patch-read kernel
         # compacts this tick's edits into [K, e_pad] buffers (edits
         # are bounded by the resolved row count, never the tree size)
-        'm_pad': m_pad, 'e_pad': opts._pad(
+        'm_pad': m_eff, 'e_pad': 0 if win_ws is not None else opts._pad(
             None, max(min(m_pad, n_rows), 1), 'edit_pad'),
         'pos_snap': pos_snap,
         # per-object maxElem SNAPSHOT at apply time: a pipelined reader
@@ -3712,7 +4089,9 @@ def _apply_general(store, block, options, return_timing, txn=None):
     patch._ready = False
     store._pending_commit = {
         'surv_u8_dev': surv_u8_dev, 'n_rows': n_rows,
-        'prior_mask': prior_mask, 'touched_fields': touched_fields,
+        'prior_rows': prior_rows, 'n_entries': len(store.e_key),
+        'srt_drop_pos': srt_drop_pos,
+        'touched_fields': touched_fields,
         'r_seg': r_seg, 'cat': cat, 'order': order, 'patch': patch,
     }
     t4 = time.perf_counter()
@@ -3721,6 +4100,20 @@ def _apply_general(store, block, options, return_timing, txn=None):
     # (the dispatch succeeded, the pending commit is installed), so the
     # bump cannot leak through a rollback
     store._bump_doc_versions(np.unique(o_doc))
+
+    # staging-cache upkeep: each dirty sequence object keeps a sorted
+    # elemId -> local index the NEXT tick's stagers (numpy and native)
+    # consult in O(delta). Population sits AFTER every raise point, so
+    # a rolled-back apply never caches unminted nodes; append_batch
+    # already extended resident entries with this tick's nodes.
+    if _STAGE_CACHE is not False and _blocks._delta_host_on():
+        ec = pool._elem_cache
+        for o in dirty.tolist():
+            if int(o) in ec:
+                metrics.bump('device_stage_cache_hits')
+            else:
+                metrics.bump('device_stage_cache_misses')
+                pool.elem_index(int(o))
 
     metrics.bump('general_batches')
     metrics.bump('general_ops', int(keep.sum()))
@@ -3932,8 +4325,28 @@ def _resolve_ops_numpy(store, block, st, omap, root_ops, obj_doc_arr,
         if need_dup or residA.any() or (e_sel.any() and residB.any()):
             ins_job = np.searchsorted(dirty, g_obj) \
                 if len(ins_rows) else np.zeros(0, np.int64)
-            t_rows, t_counts = pool.rows_of_objs(dirty)
-            t_keys = pool.node_keys(t_rows)
+            # staging cache: warm dirty objects keep a sorted elemId
+            # index (pool.elem_index) — consult it in O(delta log n)
+            # instead of re-tabulating every node of every dirty
+            # object. Heads are excluded from the cache; no query or
+            # dup comp can equal a head comp (real keys shift +1), so
+            # the sorted arrays are interchangeable with the legacy
+            # table's.
+            ec = pool._elem_cache
+            use_cache = (_STAGE_CACHE is not False
+                         and _blocks._delta_host_on()
+                         and all(int(o) in ec for o in dirty.tolist()))
+            if use_cache:
+                ents = [ec[int(o)] for o in dirty.tolist()]
+                t_counts = np.asarray([len(e[0]) for e in ents],
+                                      np.int64)
+                t_keys = np.concatenate([e[0] for e in ents])
+                t_local = np.concatenate([e[1] for e in ents])
+                t_rows = None
+            else:
+                t_rows, t_counts = pool.rows_of_objs(dirty)
+                t_keys = pool.node_keys(t_rows)
+                t_local = None
             # shift keys >= 0 (head sentinel -> 0) and pack (job, key)
             # into one int64 when it fits; else the union fallback
             jb = max(int(np.ceil(np.log2(max(len(dirty), 2)))), 1)
@@ -3953,11 +4366,21 @@ def _resolve_ops_numpy(store, block, st, omap, root_ops, obj_doc_arr,
                                             dtype=np.int64), t_counts)
                 new_comp = (ins_job << (63 - jb)) | new_k1
                 old_comp = (t_job << (63 - jb)) | t_k1
-                ordo = np.argsort(old_comp, kind='stable') \
-                    if (residA.any() or (len(residB)
-                                         and residB.any())) else None
-                old_comp_s = old_comp[ordo] if ordo is not None \
-                    else np.sort(old_comp)
+                need_lookup = residA.any() or (len(residB)
+                                               and residB.any())
+                if use_cache:
+                    # per-job sorted keys + ascending job bits: the
+                    # concatenation is already globally sorted
+                    old_comp_s = old_comp
+                    old_val_s = t_local
+                elif need_lookup:
+                    ordo = np.argsort(old_comp, kind='stable')
+                    old_comp_s = old_comp[ordo]
+                    old_val_s = pool.local[t_rows[ordo]] \
+                        .astype(np.int64)
+                else:
+                    old_comp_s = np.sort(old_comp)
+                    old_val_s = None
                 ordn = np.argsort(new_comp, kind='stable')
                 new_comp_s = new_comp[ordn]
                 if need_dup:
@@ -3988,8 +4411,7 @@ def _resolve_ops_numpy(store, block, st, omap, root_ops, obj_doc_arr,
                             len(old_comp_s) - 1)
                         hit = old_comp_s[p] == comp[miss]
                         mi = np.flatnonzero(miss)
-                        out[mi[hit]] = pool.local[
-                            t_rows[ordo[p[hit]]]]
+                        out[mi[hit]] = old_val_s[p[hit]]
                     return out
 
                 if residA.any():
@@ -4008,7 +4430,12 @@ def _resolve_ops_numpy(store, block, st, omap, root_ops, obj_doc_arr,
                     nodes[residB] = got
             else:
                 # wide keys: the whole-union composite lookup (exact;
-                # overwrites the peephole results with equal values)
+                # overwrites the peephole results with equal values).
+                # Needs the full row table — rebuild it if the cache
+                # path skipped it (rare: >2^21 actors or >2^31 elems)
+                if t_rows is None:
+                    t_rows, t_counts = pool.rows_of_objs(dirty)
+                    t_keys = pool.node_keys(t_rows)
                 t_job = np.repeat(np.arange(len(dirty),
                                             dtype=np.int64), t_counts)
                 ejob = np.searchsorted(dirty, objr[e_sel]) \

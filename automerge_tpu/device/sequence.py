@@ -43,20 +43,20 @@ def _ceil_log2(n):
     return max(bits, 1)
 
 
-def _rga_order(parent, elem, actor, visible, valid):
+def _thread_and_rank(parent, parent_adj, order, valid):
+    """Tree threading + list ranking — the shared middle of
+    :func:`_rga_order` (steps 2-3) and :func:`_rga_delta_order`: from
+    a child-sorted order, derive first-child / next-sibling links,
+    resolve each node's DFS successor by pointer-doubling the ancestor
+    climb, then list-rank the successor chain. Returns int32[n]
+    ``tree_pos`` (head = 0, then 1..chain_len; padding carries
+    garbage)."""
     n = parent.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     rounds = _ceil_log2(n) + 1
-
-    # --- 1. sort children into (parent asc, elem desc, actor desc) ---------
-    # The head (node 0) is nobody's child: bucket it with the padding so it
-    # never receives sibling links of its own.
-    parent_adj = jnp.where(valid & (idx != 0), parent, n)
-    order = jnp.lexsort((-actor, -elem, parent_adj))  # [n] node id per sorted pos
     p_sorted = parent_adj[order]
 
-    # --- 2. thread the tree -------------------------------------------------
-    pos = jnp.arange(n, dtype=jnp.int32)
+    # --- thread the tree ----------------------------------------------------
     is_seg_start = jnp.concatenate([
         jnp.array([True]), p_sorted[1:] != p_sorted[:-1]])
     # first_child[p] = first sorted node whose parent is p (-1 if none)
@@ -86,7 +86,7 @@ def _rga_order(parent, elem, actor, visible, valid):
     succ = jnp.where(first_child[idx] >= 0, first_child[idx], up)
     succ = jnp.where(valid, succ, -1)
 
-    # --- 3. list-rank the successor chain (pointer doubling) ---------------
+    # --- list-rank the successor chain (pointer doubling) -------------------
     # Work in an (n+1)-slot space where slot n is the chain terminator.
     nxt = jnp.where(succ >= 0, succ, n)
     nxt = jnp.concatenate([nxt, jnp.array([n], dtype=jnp.int32)])
@@ -95,7 +95,21 @@ def _rga_order(parent, elem, actor, visible, valid):
         dist = dist + dist[nxt]
         nxt = nxt[nxt]
     dist = dist[:n]                       # steps from node to end of chain
-    tree_pos = dist[0] - dist              # head = 0, then 1..chain_len
+    return (dist[0] - dist).astype(jnp.int32)
+
+
+def _rga_order(parent, elem, actor, visible, valid):
+    n = parent.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    # --- 1. sort children into (parent asc, elem desc, actor desc) ---------
+    # The head (node 0) is nobody's child: bucket it with the padding so it
+    # never receives sibling links of its own.
+    parent_adj = jnp.where(valid & (idx != 0), parent, n)
+    order = jnp.lexsort((-actor, -elem, parent_adj))  # [n] node id per sorted pos
+
+    # --- 2-3. thread + list-rank (shared with the delta orderer) -----------
+    tree_pos = _thread_and_rank(parent, parent_adj, order, valid)
 
     # --- 4. visibility scan -------------------------------------------------
     on_chain = valid & (tree_pos > 0)      # head and padding excluded
@@ -261,45 +275,13 @@ def _rga_delta_order(parent, anchor, elem, actor, valid):
     """
     n = parent.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    rounds = _ceil_log2(n) + 1
 
     parent_adj = jnp.where(valid & (idx != 0), parent, n)
     anchor_k = jnp.where(parent_adj == 0, anchor, 0)
     order = jnp.lexsort((-actor, -elem, anchor_k, parent_adj))
-    p_sorted = parent_adj[order]
 
     # tree threading + list ranking: identical to _rga_order steps 2-3
-    is_seg_start = jnp.concatenate([
-        jnp.array([True]), p_sorted[1:] != p_sorted[:-1]])
-    first_child = jnp.full((n + 1,), -1, dtype=jnp.int32)
-    first_child = first_child.at[jnp.where(is_seg_start, p_sorted, n)].set(
-        jnp.where(is_seg_start, order, -1), mode='drop')
-    first_child = first_child[:n]
-    same_parent_next = jnp.concatenate([
-        p_sorted[1:] == p_sorted[:-1], jnp.array([False])])
-    nxt_in_sort = jnp.concatenate([order[1:], jnp.array([-1], dtype=jnp.int32)])
-    next_sibling = jnp.full((n,), -1, dtype=jnp.int32)
-    next_sibling = next_sibling.at[order].set(
-        jnp.where(same_parent_next, nxt_in_sort, -1))
-    next_sibling = next_sibling.at[0].set(-1)
-
-    has_sib = next_sibling >= 0
-    is_head = idx == 0
-    climb = jnp.where(has_sib | is_head, idx, parent)
-    for _ in range(rounds):
-        climb = climb[climb]
-    up = jnp.where(has_sib[climb], next_sibling[climb], -1)
-    succ = jnp.where(first_child[idx] >= 0, first_child[idx], up)
-    succ = jnp.where(valid, succ, -1)
-
-    nxt = jnp.where(succ >= 0, succ, n)
-    nxt = jnp.concatenate([nxt, jnp.array([n], dtype=jnp.int32)])
-    dist = jnp.where(jnp.arange(n + 1) == n, 0, 1)
-    for _ in range(rounds):
-        dist = dist + dist[nxt]
-        nxt = nxt[nxt]
-    dist = dist[:n]
-    return (dist[0] - dist).astype(jnp.int32)
+    return _thread_and_rank(parent, parent_adj, order, valid)
 
 
 def _rga_delta_order_batched(parent, anchor, elem, actor, valid):

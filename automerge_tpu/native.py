@@ -282,7 +282,8 @@ def _bind_stage(lib):
         _P64, _P64, _P32, _P32, _i64,                    # omap/root/obj
         _P64, _P64, _P64, _P64, _i64,                    # pool tables
         _P32, _P32, _P32, _P32, _P32,                    # pool columns
-        _i64]                                            # n_old_mirror
+        _i64,                                            # n_old_mirror
+        _i64, _P64, _P64, _P64, _P64]                    # staging cache
     lib.amst_stage_general.restype = ctypes.c_void_p
     for name in ('amst_err', 'amst_err_payload', 'amst_fallback',
                  'amst_n_ins', 'amst_n_arows', 'amst_n_dirty',
@@ -673,14 +674,17 @@ class GeneralStagedPlanes:
 
 def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
                         obj_doc, obj_type, pool, b_actor, n_old_mirror,
-                        obj_uuid=None):
+                        obj_uuid=None, elem_cache=None):
     """Run the native stager over an admitted general block.
 
     Returns a :class:`GeneralStagedPlanes`, ``None`` when the library
     is unavailable or the stager requests the numpy fallback
     (late-bound string elemIds), or raises exactly the staging error
     the numpy path would raise (same type, same message).
-    ``obj_uuid`` is the store's object-uuid table (error messages)."""
+    ``obj_uuid`` is the store's object-uuid table (error messages).
+    ``elem_cache`` is the pool's persistent elem index (obj ->
+    [sorted int64 keys, aligned int64 locals]); cached objects skip
+    the stager's per-object pos_sorted tabulation."""
     lib = stage_lib()
     if lib is None:
         return None
@@ -690,6 +694,17 @@ def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
             obj_type, n_of, max_elem_of, pool.pos_sorted, pool.pos_row,
             pool.obj, pool.local, pool.actor, pool.elemc, pool.parent,
             b_actor)
+    n_cache = 0
+    c_objs = c_lens = c_keys = c_locs = _np.empty(0, _np.int64)
+    if elem_cache and len(elem_cache) <= 4096:
+        objs = sorted(elem_cache)
+        ents = [elem_cache[o] for o in objs]
+        c_objs = _np.asarray(objs, _np.int64)
+        c_lens = _np.asarray([len(e[0]) for e in ents], _np.int64)
+        c_keys = _np.asarray([e[0].ctypes.data for e in ents], _np.int64)
+        c_locs = _np.asarray([e[1].ctypes.data for e in ents], _np.int64)
+        n_cache = len(objs)
+        keep = keep + (ents, c_objs, c_lens, c_keys, c_locs)
     h = lib.amst_stage_general(
         block.n_ops, _p8(block.action), _p32(block.obj),
         _p8(block.key_kind), _p32(block.key), _p32(block.key_elem),
@@ -703,7 +718,9 @@ def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
         _p64(pool.pos_sorted), _p64(pool.pos_row), pool.n_nodes,
         _p32(pool.obj), _p32(pool.local), _p32(pool.actor),
         _p32(pool.elemc), _p32(pool.parent),
-        n_old_mirror)
+        n_old_mirror,
+        n_cache, _p64(c_objs), _p64(c_lens), _p64(c_keys),
+        _p64(c_locs))
     if not h:
         raise MemoryError('native staging allocation failed')
     err = int(lib.amst_err(h))

@@ -195,6 +195,13 @@ CONVERGENCE_COUNTERS = (
 #   device_idx_update_ms       observe series: fenced run time of
 #                              SAMPLED incremental-index applies (the
 #                              merge pass's own phase attribution)
+#   device_idx_window_applies  incremental dispatches that engaged the
+#                              suffix-bounded visibility renumber (a
+#                              strictly smaller plane than the mirror)
+#   device_stage_cache_hits/_misses
+#                              staging-cache consults per dirty object:
+#                              hit = the persistent elemId index was
+#                              resident, miss = built cold this tick
 #   device_utilization         gauge: device ms / wall ms of the last
 #                              sampled apply
 #   mem_device_plane_bytes     gauge: resident device mirror bytes
@@ -218,7 +225,9 @@ DEVICE_COUNTERS = (
     'device_run_ms', 'device_patch_read_ms',
     'device_idx_incremental_applies', 'device_idx_rebuild_applies',
     'device_idx_invalidations', 'device_idx_delta_nodes',
-    'device_idx_update_ms', 'device_utilization',
+    'device_idx_update_ms', 'device_idx_window_applies',
+    'device_stage_cache_hits', 'device_stage_cache_misses',
+    'device_utilization',
     'mem_device_plane_bytes', 'mem_device_packed_bytes',
     'mem_device_wide_bytes', 'mem_device_cols_bytes',
     'mem_device_plane_peak_bytes', 'mem_journal_bytes',

@@ -982,6 +982,7 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
     ``device_{run,patch_read,idx_update}_ms`` series are cited per
     arm (profiler cadence forced to 1 so every tick attributes)."""
     from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.device import blocks as _blocks
     from automerge_tpu.device import general as G
     from automerge_tpu.device import profiler as _prof
     from automerge_tpu.utils.metrics import metrics as _m
@@ -1005,10 +1006,24 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
         p.diffs(0)
         return store, prev
 
-    def run_arm(mode, edit_stream):
+    _PHASES = ('device_admit_ms', 'device_pack_ms',
+               'device_dispatch_ms')
+
+    def run_arm(mode, edit_stream, delta_host=True):
+        """One measured arm. ``delta_host=False`` pins the PRE-ISSUE-16
+        host path: whole-plane staging (no persistent elemId caches),
+        full-plane visibility renumber (no suffix window), per-tick
+        clock/dict rebuilds — the O(doc)-host A/B baseline for the
+        ``host_tick`` band."""
         prev_mode, prev_es = G._INDEX_MODE, G._EDIT_STREAM
         prev_cad = _prof.set_sample_every(1)
+        prev_dh = _blocks._DELTA_HOST
+        prev_win, prev_sc = G._WINDOW_MODE, G._STAGE_CACHE
         G._INDEX_MODE, G._EDIT_STREAM = mode, edit_stream
+        if not delta_host:
+            _blocks._DELTA_HOST = False
+            G._WINDOW_MODE = 'off'
+            G._STAGE_CACHE = False
         try:
             store, prev_key = build()
             elem = n_chars
@@ -1032,7 +1047,7 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
                 block = store.encode_changes([ch])
                 if t == warm:
                     for s in ('device_run_ms', 'device_patch_read_ms',
-                              'device_idx_update_ms'):
+                              'device_idx_update_ms') + _PHASES:
                         _m.reset_series(s)
                 t0 = time.perf_counter()
                 p = G.apply_general_block(store, block)
@@ -1049,13 +1064,26 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
                     _m.quantile('device_patch_read_ms', 0.5) or 0,
                 'idx_update_ms_p50':
                     _m.quantile('device_idx_update_ms', 0.5) or 0,
+                # sampled host-phase attribution (cadence 1: every
+                # warm tick splits admit -> pack -> dispatch)
+                'admit_ms_p50':
+                    _m.quantile('device_admit_ms', 0.5) or 0,
+                'pack_ms_p50':
+                    _m.quantile('device_pack_ms', 0.5) or 0,
+                'dispatch_ms_p50':
+                    _m.quantile('device_dispatch_ms', 0.5) or 0,
             }
         finally:
             G._INDEX_MODE, G._EDIT_STREAM = prev_mode, prev_es
+            _blocks._DELTA_HOST = prev_dh
+            G._WINDOW_MODE, G._STAGE_CACHE = prev_win, prev_sc
             _prof.set_sample_every(prev_cad)
 
-    before = dict(_m.counters)
     rebuild = run_arm('rebuild', False)
+    # whole-plane host arm (ISSUE 16 baseline): incremental device
+    # index, but O(doc) host staging + full-plane renumber each tick
+    host = run_arm(None, None, delta_host=False)
+    before = dict(_m.counters)
     incr = run_arm(None, None)      # shipped defaults: incremental +
     #                                 auto edit-stream (device-link
     #                                 backends fetch delta buffers;
@@ -1063,6 +1091,12 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
     incr_applies = _m.counters.get('device_idx_incremental_applies',
                                    0) - before.get(
         'device_idx_incremental_applies', 0)
+    window_applies = _m.counters.get('device_idx_window_applies',
+                                     0) - before.get(
+        'device_idx_window_applies', 0)
+    cache_hits = _m.counters.get('device_stage_cache_hits',
+                                 0) - before.get(
+        'device_stage_cache_hits', 0)
     out = {
         'doc_nodes': n_chars,
         'rebuild_tick_ms_p50': rebuild['tick_ms_p50'],
@@ -1077,6 +1111,19 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
         'patch_read_improvement_x': rebuild['patch_read_ms_p50']
         / max(incr['patch_read_ms_p50'], 1e-9),
         'incremental_applies': incr_applies,
+        # O(delta) host path (ISSUE 16): whole-plane-staging arm +
+        # phase attribution + fast-path engagement counters
+        'host_plane_tick_ms_p50': host['tick_ms_p50'],
+        'host_tick_speedup_x': host['tick_ms_p50']
+        / max(incr['tick_ms_p50'], 1e-9),
+        'warm_admit_ms_p50': incr['admit_ms_p50'],
+        'warm_pack_ms_p50': incr['pack_ms_p50'],
+        'warm_dispatch_ms_p50': incr['dispatch_ms_p50'],
+        'host_plane_admit_ms_p50': host['admit_ms_p50'],
+        'host_plane_pack_ms_p50': host['pack_ms_p50'],
+        'host_plane_dispatch_ms_p50': host['dispatch_ms_p50'],
+        'window_applies': window_applies,
+        'stage_cache_hits': cache_hits,
     }
     log(f'incremental-order[{n_chars}-char doc, {batch}-char ticks]: '
         f'cold-rebuild {out["rebuild_tick_ms_p50"]:.2f} ms/tick '
@@ -1088,6 +1135,17 @@ def bench_incremental_order(n_chars=32768, ticks=48, warm=8, batch=8):
         f'{out["speedup_x"]:.1f}x; patch read '
         f'{out["patch_read_improvement_x"]:.1f}x; '
         f'{out["incremental_applies"]} incremental applies')
+    log(f'  host phases[admit/pack/dispatch ms]: whole-plane '
+        f'{out["host_plane_admit_ms_p50"]:.2f}/'
+        f'{out["host_plane_pack_ms_p50"]:.2f}/'
+        f'{out["host_plane_dispatch_ms_p50"]:.2f} '
+        f'({out["host_plane_tick_ms_p50"]:.2f} ms/tick) -> O(delta) '
+        f'{out["warm_admit_ms_p50"]:.2f}/'
+        f'{out["warm_pack_ms_p50"]:.2f}/'
+        f'{out["warm_dispatch_ms_p50"]:.2f} = '
+        f'{out["host_tick_speedup_x"]:.1f}x host-tick; '
+        f'{out["window_applies"]} window applies, '
+        f'{out["stage_cache_hits"]} cache hits')
     return out
 
 
@@ -1115,6 +1173,19 @@ def incremental_order_json(res):
         'incremental_order_patch_read_improvement_x':
             round(res['patch_read_improvement_x'], 2),
         'incremental_order_applies': res['incremental_applies'],
+        'incremental_order_host_plane_ms_p50':
+            round(res['host_plane_tick_ms_p50'], 3),
+        'incremental_order_host_tick_speedup_x':
+            round(res['host_tick_speedup_x'], 2),
+        'incremental_order_warm_admit_ms_p50':
+            round(res['warm_admit_ms_p50'], 3),
+        'incremental_order_warm_pack_ms_p50':
+            round(res['warm_pack_ms_p50'], 3),
+        'incremental_order_warm_dispatch_ms_p50':
+            round(res['warm_dispatch_ms_p50'], 3),
+        'incremental_order_window_applies': res['window_applies'],
+        'incremental_order_stage_cache_hits':
+            res['stage_cache_hits'],
     }
 
 

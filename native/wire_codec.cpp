@@ -877,7 +877,17 @@ void* amst_stage_general(
         const int32_t* p_obj, const int32_t* p_local,
         const int32_t* p_actor, const int32_t* p_elemc,
         const int32_t* p_parent,
-        int64_t n_old_mirror) {
+        int64_t n_old_mirror,
+        // persistent staging cache (may be empty): sorted object rows
+        // with per-object sorted (actor << 32 | elem) key arrays and
+        // aligned node locals, borrowed from the host for the duration
+        // of this call. cache_keys/cache_locs carry the ARRAY BASE
+        // ADDRESSES as int64 (one per cached object). Lookup semantics
+        // are byte-identical to the lazily built old_tabs below — the
+        // cache just skips the O(n_of) per-object tabulation.
+        int64_t n_cache, const int64_t* cache_objs,
+        const int64_t* cache_lens, const int64_t* cache_keys,
+        const int64_t* cache_locs) {
     using namespace stage;
     auto* s = new (std::nothrow) Stager();
     if (!s) return nullptr;
@@ -1106,7 +1116,27 @@ void* amst_stage_general(
         std::sort(tab.begin(), tab.end());
         return tab;
     };
+    auto cache_slot = [&](int64_t o) -> int64_t {
+        if (n_cache == 0) return -1;
+        const int64_t* it =
+            std::lower_bound(cache_objs, cache_objs + n_cache, o);
+        return (it != cache_objs + n_cache && *it == o)
+            ? it - cache_objs : -1;
+    };
     auto old_lookup = [&](int64_t o, int64_t k) -> int64_t {
+        int64_t ci = cache_slot(o);
+        if (ci >= 0) {
+            // host-persistent index: same sorted unique keys the lazy
+            // tab would hold, so lookup results are identical
+            const int64_t* keys =
+                reinterpret_cast<const int64_t*>(cache_keys[ci]);
+            const int64_t* locs =
+                reinterpret_cast<const int64_t*>(cache_locs[ci]);
+            int64_t len = cache_lens[ci];
+            const int64_t* it = std::lower_bound(keys, keys + len, k);
+            return (it != keys + len && *it == k)
+                ? locs[it - keys] : -1;
+        }
         const auto& tab = old_tab(o);
         auto it = std::lower_bound(
             tab.begin(), tab.end(),

@@ -541,6 +541,8 @@ class TestFaultCounters:
             'device_admit_ms', 'device_pack_ms',
             'device_dispatch_ms', 'device_run_ms',
             'device_patch_read_ms', 'device_utilization',
+            'device_idx_window_applies', 'device_stage_cache_hits',
+            'device_stage_cache_misses',
             'mem_device_plane_bytes', 'mem_device_plane_peak_bytes',
             'mem_journal_bytes', 'mem_park_shard_bytes'}
 

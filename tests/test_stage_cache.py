@@ -1,0 +1,319 @@
+"""O(delta) admit/stage path: persistent staging caches (ISSUE 16).
+
+The contract under test: the per-object elemId -> local staging cache
+(`_SeqPool._elem_cache`, consulted by BOTH the numpy resolver and the
+C++ stager) changes nothing but time. Every staged plane and packed
+wire byte must match the cold, whole-plane staging, the cache must
+survive exactly the lifecycle the apply txn promises (populated after
+a successful apply, extended in O(new) by append_batch, cleared by
+rollback, absent after eviction's fresh-store rebuild, valid across
+state absorb), and the clock-merge undo journal must restore the
+vector clock exactly on rollback.
+"""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import blocks
+from automerge_tpu.device import general
+from automerge_tpu.text import Text
+from automerge_tpu.utils.metrics import metrics
+
+from test_sequence_index import (_materialize, _typing_changes,
+                                 _via_general, _via_oracle)
+
+
+_HAS_NATIVE = native.stage_available()
+_NATIVE_PARAMS = [False] + ([True] if _HAS_NATIVE else [])
+
+PLANE_KEYS = ('ops_actor', 'ops_seq', 'ops_slot', 'flags_u8',
+              'coo_row', 'coo_col', 'coo_val')
+
+
+class _CacheArm:
+    """Run one arm with the staging cache forced on/off, capturing the
+    staged planes of every apply."""
+
+    def __init__(self, stage_cache, force_native=None):
+        self.stage_cache = stage_cache
+        self.force_native = force_native
+        self.captures = []
+
+    def __enter__(self):
+        self._prev = (general._STAGE_CACHE, general._STAGE_CAPTURE,
+                      general._NATIVE_STAGING)
+        general._STAGE_CACHE = self.stage_cache
+        if self.force_native is not None:
+            general._NATIVE_STAGING = self.force_native
+        general._STAGE_CAPTURE = lambda c: self.captures.append(
+            {k: np.asarray(c[k]).copy() for k in PLANE_KEYS})
+        return self
+
+    def __exit__(self, *exc):
+        (general._STAGE_CACHE, general._STAGE_CAPTURE,
+         general._NATIVE_STAGING) = self._prev
+
+
+def _assert_same_captures(a, b):
+    assert len(a) == len(b)
+    for ci, (ca, cb) in enumerate(zip(a, b)):
+        for k in PLANE_KEYS:
+            assert ca[k].dtype == cb[k].dtype, (ci, k)
+            assert ca[k].shape == cb[k].shape, (ci, k)
+            assert (ca[k] == cb[k]).all(), (ci, k)
+
+
+class TestStagingParity:
+    @pytest.mark.parametrize('force_native', _NATIVE_PARAMS)
+    def test_warm_staging_byte_matches_cold(self, force_native):
+        """The acceptance gate: cached staging emits byte-identical
+        planes (and documents) to whole-plane staging, and the cached
+        arm actually took the cache path."""
+        changes = _typing_changes(n=32)
+        oracle = _materialize(_via_oracle(changes))
+        results = {}
+        for cached in (None, False):
+            base = dict(metrics.counters)
+            with _CacheArm(cached, force_native) as arm:
+                doc, st = _via_general(changes, mode=None)
+            hits = metrics.counters.get(
+                'device_stage_cache_hits', 0) - base.get(
+                'device_stage_cache_hits', 0)
+            results[cached] = (arm.captures, _materialize(doc),
+                               st, hits)
+        warm, cold = results[None], results[False]
+        assert warm[1] == oracle
+        assert cold[1] == oracle
+        _assert_same_captures(warm[0], cold[0])
+        # the warm arm consulted resident entries (not a fresh build
+        # per tick) — per-change typing re-touches one object
+        assert warm[3] >= 10
+        assert cold[3] == 0
+
+    @pytest.mark.parametrize('force_native', _NATIVE_PARAMS)
+    def test_concurrent_edits_byte_match(self, force_native):
+        """Multi-actor blocks: dup prechecks and parent resolution of
+        REMOTE ops must hit the cache identically."""
+        obj = '00000000-0000-4000-8000-00000000c0de'
+        init = [{'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'makeText', 'obj': obj},
+            {'action': 'link', 'obj': ROOT_ID, 'key': 't',
+             'value': obj},
+            {'action': 'ins', 'obj': obj, 'key': '_head', 'elem': 1},
+            {'action': 'set', 'obj': obj, 'key': 'a:1', 'value': 'x'},
+        ]}]
+        waves = [init]
+        for s in range(2, 8):
+            waves.append([
+                {'actor': 'a', 'seq': s, 'deps': {}, 'ops': [
+                    {'action': 'ins', 'obj': obj,
+                     'key': f'a:{s - 1}', 'elem': s},
+                    {'action': 'set', 'obj': obj, 'key': f'a:{s}',
+                     'value': 'y'}]},
+                {'actor': 'b', 'seq': s - 1, 'deps': {}, 'ops': [
+                    {'action': 'ins', 'obj': obj, 'key': '_head',
+                     'elem': 100 + s},
+                    {'action': 'set', 'obj': obj,
+                     'key': f'b:{100 + s}', 'value': 'z'}]},
+            ])
+        results = {}
+        for cached in (None, False):
+            with _CacheArm(cached, force_native) as arm:
+                store = general.init_store(1)
+                for wave in waves:
+                    p = general.apply_general_block(
+                        store, store.encode_changes([wave]))
+                    p.to_patches()
+                results[cached] = (arm.captures,
+                                   store.doc_fields(0))
+        _assert_same_captures(results[None][0], results[False][0])
+        assert results[None][1] == results[False][1]
+
+
+class TestCacheLifecycle:
+    def _seed_store(self, n=6, n_docs=1):
+        obj = '00000000-0000-4000-8000-00000000feed'
+        store = general.init_store(n_docs)
+        ops = [{'action': 'makeText', 'obj': obj},
+               {'action': 'link', 'obj': ROOT_ID, 'key': 't',
+                'value': obj}]
+        prev = '_head'
+        for i in range(1, n + 1):
+            ops.append({'action': 'ins', 'obj': obj, 'key': prev,
+                        'elem': i})
+            ops.append({'action': 'set', 'obj': obj, 'key': f'w:{i}',
+                        'value': 'x'})
+            prev = f'w:{i}'
+        wave = [[{'actor': 'w', 'seq': 1, 'deps': {}, 'ops': ops}]] \
+            + [[] for _ in range(n_docs - 1)]
+        p = general.apply_general_block(store,
+                                        store.encode_changes(wave))
+        p.to_patches()
+        return store, obj, prev
+
+    def test_append_batch_extends_entries_exactly(self):
+        """A resident entry extended in O(new) must equal the entry a
+        cold rebuild would produce."""
+        store, obj, prev = self._seed_store()
+        pool = store.pool
+        row = store.obj_uuid.index(obj)
+        pool.elem_index(row)            # force-resident before the tick
+        for s in (2, 3):
+            ops = []
+            for i in (s * 100, s * 100 + 1):
+                ops.append({'action': 'ins', 'obj': obj, 'key': prev,
+                            'elem': i})
+                ops.append({'action': 'set', 'obj': obj,
+                            'key': f'w:{i}', 'value': 'y'})
+                prev = f'w:{i}'
+            p = general.apply_general_block(
+                store, store.encode_changes(
+                    [[{'actor': 'w', 'seq': s, 'deps': {},
+                       'ops': ops}]]))
+            p.to_patches()
+        extended = [a.copy() for a in pool._elem_cache[row]]
+        pool._elem_cache.clear()
+        rebuilt = pool.elem_index(row)
+        assert np.array_equal(extended[0], rebuilt[0])
+        assert np.array_equal(extended[1], rebuilt[1])
+
+    def test_rollback_clears_cache_and_next_apply_recovers(self):
+        """A failed dispatch unwinds the txn: the cache must not keep
+        locals the rollback just unminted."""
+        store, obj, prev = self._seed_store()
+        fields_before = store.doc_fields(0)
+        nxt = [{'actor': 'w', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': prev, 'elem': 50},
+            {'action': 'set', 'obj': obj, 'key': 'w:50',
+             'value': '!'}]}]
+        block = store.encode_changes([nxt])
+
+        def boom(*a, **k):
+            raise RuntimeError('injected dispatch failure')
+
+        saved = (general._fused_general_incr,
+                 general._fused_general_packed,
+                 general._fused_general_wide,
+                 general._fused_general_resident)
+        (general._fused_general_incr, general._fused_general_packed,
+         general._fused_general_wide,
+         general._fused_general_resident) = (boom,) * 4
+        try:
+            with pytest.raises(RuntimeError, match='injected'):
+                general.apply_general_block(store, block)
+        finally:
+            (general._fused_general_incr,
+             general._fused_general_packed,
+             general._fused_general_wide,
+             general._fused_general_resident) = saved
+        assert store.pool._elem_cache == {}
+        assert store.doc_fields(0) == fields_before
+        # the SAME block re-applies cleanly against the rolled-back
+        # store and the cache repopulates
+        p = general.apply_general_block(store,
+                                        store.encode_changes([nxt]))
+        p.to_patches()
+        row = store.obj_uuid.index(obj)
+        ent = store.pool.elem_index(row)
+        assert 50 in (ent[0] & 0xFFFFFFFF)
+
+    def test_clock_rollback_restores_merge(self):
+        """clock_merge's in-place scatter is journaled, not copied:
+        rollback must restore c_seq/c_pure exactly."""
+        store, obj, prev = self._seed_store()
+        pre = (store.c_doc.copy(), store.c_actor.copy(),
+               store.c_seq.copy(), store.c_pure.copy())
+        nxt = [{'actor': 'w', 'seq': 2, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': obj, 'key': prev, 'elem': 60},
+            {'action': 'set', 'obj': obj, 'key': 'w:60',
+             'value': '!'}]}]
+        block = store.encode_changes([nxt])
+
+        def boom(*a, **k):
+            raise RuntimeError('injected dispatch failure')
+
+        saved = (general._fused_general_incr,
+                 general._fused_general_packed,
+                 general._fused_general_wide,
+                 general._fused_general_resident)
+        (general._fused_general_incr, general._fused_general_packed,
+         general._fused_general_wide,
+         general._fused_general_resident) = (boom,) * 4
+        try:
+            with pytest.raises(RuntimeError, match='injected'):
+                general.apply_general_block(store, block)
+        finally:
+            (general._fused_general_incr,
+             general._fused_general_packed,
+             general._fused_general_wide,
+             general._fused_general_resident) = saved
+        assert np.array_equal(store.c_doc, pre[0])
+        assert np.array_equal(store.c_actor, pre[1])
+        assert np.array_equal(store.c_seq, pre[2])
+        assert np.array_equal(store.c_pure, pre[3])
+        # and the merge applies for real on the clean retry
+        p = general.apply_general_block(store,
+                                        store.encode_changes([nxt]))
+        p.to_patches()
+        a_row = store.actors.index('w')
+        sel = (store.c_doc == 0) & (store.c_actor == a_row)
+        assert store.c_seq[sel].max() == 2
+
+    def test_eviction_rebuild_starts_cold(self):
+        """drop_doc_state re-applies survivors into a FRESH store —
+        no stale entries can survive by construction."""
+        from automerge_tpu.sync.general_doc_set import GeneralDocSet
+        import automerge_tpu as am
+        ds = GeneralDocSet(4)
+        for i in range(2):
+            doc = am.change(am.init(f'actor-{i:03d}'),
+                            lambda d: d.update({'text': Text()}))
+            doc = am.change(doc,
+                            lambda d: d['text'].insert_at(0, *'abcd'))
+            ds.set_doc(f'doc-{i}', doc)
+        old_pool = ds.store.pool
+        assert old_pool._elem_cache      # warmed by the applies
+        ds.extract_doc_state(['doc-1'])
+        ds.drop_doc_state(['doc-1'])
+        assert ds.store.pool is not old_pool
+        assert ds.materialize('doc-0')['text'] == 'abcd'
+
+    def test_absorb_keeps_resident_entries_valid(self):
+        """absorb_doc_states appends whole NEW objects: entries
+        resident for the receiving store's own objects must still
+        equal a cold rebuild afterwards."""
+        from automerge_tpu import compaction
+        changes = _typing_changes(n=8, deletes=False)
+        _, st = _via_general(changes, mode=None)
+        payload = compaction.extract_doc_states(
+            st.store, [0])[0]['state']
+        decoded = compaction.decode_state_snapshot(payload)
+
+        host, obj, prev = self._seed_store(n_docs=2)
+        pool = host.pool
+        row = host.obj_uuid.index(obj)
+        ent_pre = [a.copy() for a in pool.elem_index(row)]
+        compaction.absorb_doc_states(host, [(1, payload, decoded)])
+        assert np.array_equal(pool._elem_cache[row][0], ent_pre[0])
+        assert np.array_equal(pool._elem_cache[row][1], ent_pre[1])
+        pool._elem_cache.clear()
+        rebuilt = pool.elem_index(row)
+        assert np.array_equal(ent_pre[0], rebuilt[0])
+        assert np.array_equal(ent_pre[1], rebuilt[1])
+
+
+class TestDeltaHostArm:
+    def test_whole_plane_arm_matches(self):
+        """blocks._DELTA_HOST=False (the bench A/B arm) disables every
+        delta-host path at once and must change nothing but time."""
+        changes = _typing_changes(n=24)
+        oracle = _materialize(_via_oracle(changes))
+        prev = blocks._DELTA_HOST
+        blocks._DELTA_HOST = False
+        try:
+            doc, _ = _via_general(changes, mode=None)
+        finally:
+            blocks._DELTA_HOST = prev
+        assert _materialize(doc) == oracle

@@ -1,0 +1,260 @@
+"""Suffix-bounded visibility renumber: parity + gating (ISSUE 16).
+
+The contract under test: when a warm chain-shaped sequence takes an
+append-only tick, the windowed dispatch (`general._apply_window`
+rewriting the wire so `_fused_general_incr` renumbers only the
+[ws, n) suffix of each dirty plane) produces byte-identical documents,
+visibility columns and tree positions to the whole-plane renumber
+(`_WINDOW_MODE='off'`). Shapes the window must DECLINE — mid-chain
+inserts (the object permanently leaves `idx_linear`), cold objects,
+tiny planes — fall back to the full renumber and still match the
+oracle. `_WINDOW_MODE='require'` turns a silent decline on a warm
+append into a loud failure, pinning the fast path in CI the same way
+`_INDEX_MODE='require'` pins the incremental index.
+"""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import frontend as Frontend
+from automerge_tpu import native
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.device import general
+from automerge_tpu.device import general_backend as GB
+from automerge_tpu.utils.metrics import metrics
+
+from test_sequence_index import (_materialize, _tp_of,
+                                 _typing_changes, _via_oracle)
+
+
+_HAS_NATIVE = native.stage_available()
+_NATIVE_PARAMS = [False] + ([True] if _HAS_NATIVE else [])
+
+OBJ = '00000000-0000-4000-8000-000000000516'
+
+
+class _WindowMode:
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        self._prev = general._WINDOW_MODE
+        general._WINDOW_MODE = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        general._WINDOW_MODE = self._prev
+
+
+def _assert_state_parity(st_a, st_b):
+    st_a.store.pool.sync()
+    st_b.store.pool.sync()
+    assert np.array_equal(st_a.store.pool.visible,
+                          st_b.store.pool.visible)
+    assert np.array_equal(st_a.store.pool.vis_index,
+                          st_b.store.pool.vis_index)
+    tp_a, tp_b = _tp_of(st_a.store), _tp_of(st_b.store)
+    if tp_a is not None and tp_b is not None:
+        assert np.array_equal(tp_a, tp_b), 'tp plane diverged'
+
+
+def _typing_wave(actor, seq, prev, elems):
+    ops = []
+    for e in elems:
+        ops.append({'action': 'ins', 'obj': OBJ, 'key': prev,
+                    'elem': e})
+        ops.append({'action': 'set', 'obj': OBJ,
+                    'key': f'{actor}:{e}', 'value': 'x'})
+        prev = f'{actor}:{e}'
+    return [{'actor': actor, 'seq': seq, 'deps': {}, 'ops': ops}], prev
+
+
+def _seed(n_chars=48):
+    store = general.init_store(1)
+    ops = [{'action': 'makeText', 'obj': OBJ},
+           {'action': 'link', 'obj': ROOT_ID, 'key': 't',
+            'value': OBJ}]
+    prev = '_head'
+    for i in range(1, n_chars + 1):
+        ops.append({'action': 'ins', 'obj': OBJ, 'key': prev,
+                    'elem': i})
+        ops.append({'action': 'set', 'obj': OBJ, 'key': f'w:{i}',
+                    'value': 'x'})
+        prev = f'w:{i}'
+    p = general.apply_general_block(
+        store, store.encode_changes(
+            [[{'actor': 'w', 'seq': 1, 'deps': {}, 'ops': ops}]]))
+    p.to_patches()
+    return store, prev
+
+
+def _via_general_split(changes, split, tail_mode, force_native=None):
+    """Apply `changes` through the general backend per-change,
+    switching `_WINDOW_MODE` to `tail_mode` from index `split` on.
+    Returns (frontend doc, state, window applies in the tail)."""
+    prev_n = general._NATIVE_STAGING
+    if force_native is not None:
+        general._NATIVE_STAGING = force_native
+    try:
+        state = GB.init()
+        doc = Frontend.init({'backend': GB})
+        base = None
+        for i, c in enumerate(changes):
+            if i == split:
+                base = dict(metrics.counters)
+            if i >= split:
+                with _WindowMode(tail_mode):
+                    state, patch = GB.apply_changes(state, [c])
+            else:
+                state, patch = GB.apply_changes(state, [c])
+            patch['state'] = state
+            doc = Frontend.apply_patch(doc, patch)
+        wins = metrics.counters.get(
+            'device_idx_window_applies', 0) - (base or {}).get(
+            'device_idx_window_applies', 0)
+        return doc, state, wins
+    finally:
+        general._NATIVE_STAGING = prev_n
+
+
+class TestWindowParity:
+    @pytest.mark.parametrize('force_native', _NATIVE_PARAMS)
+    def test_end_typing_windows_and_matches_full(self, force_native):
+        """Warm end-of-document typing: every tick after the seed must
+        take the window ('require' raises otherwise) and the resulting
+        store state must equal the whole-plane arm's."""
+        changes = _typing_changes(n=64, deletes=False)
+        split = 40
+        oracle = _materialize(_via_oracle(changes))
+        doc_w, st_w, n_w = _via_general_split(
+            changes, split, 'require', force_native)
+        doc_f, st_f, n_f = _via_general_split(
+            changes, split, 'off', force_native)
+        assert _materialize(doc_w) == oracle
+        assert _materialize(doc_f) == oracle
+        assert n_w == len(changes) - split
+        assert n_f == 0
+        _assert_state_parity(st_w, st_f)
+
+    def test_window_state_equals_off_arm_blockwise(self):
+        """Same comparison on raw blocks (no frontend): windowed and
+        whole-plane stores byte-match on visibility, order and text."""
+        results = {}
+        for mode in (None, 'off'):
+            store, prev = _seed()
+            with _WindowMode(mode):
+                seq = 2
+                for k in range(6):
+                    wave, prev = _typing_wave(
+                        'w', seq, prev,
+                        range(100 + 4 * k, 104 + 4 * k))
+                    p = general.apply_general_block(
+                        store, store.encode_changes([wave]))
+                    p.to_patches()
+                    seq += 1
+            store.pool.sync()
+            results[mode] = store
+        a, b = results[None], results['off']
+        assert a.doc_fields(0) == b.doc_fields(0)
+        assert np.array_equal(a.pool.visible, b.pool.visible)
+        assert np.array_equal(a.pool.vis_index, b.pool.vis_index)
+        ta, tb = _tp_of(a), _tp_of(b)
+        assert ta is not None and tb is not None
+        assert np.array_equal(ta, tb)
+
+    def test_mid_insert_breaks_linearity_and_still_matches(self):
+        """A mid-chain insert may still window ITS OWN tick (the
+        suffix bound is the insert's parent position, not the tail)
+        but it breaks `idx_linear` for good: every LATER tick must
+        decline to the full renumber, and the document must stay
+        correct either way."""
+        results = {}
+        for mode in (None, 'off'):
+            store, prev = _seed(n_chars=24)
+            with _WindowMode(mode):
+                # mid insert: parent is char 3, not the tail
+                wave = [{'actor': 'm', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'ins', 'obj': OBJ, 'key': 'w:3',
+                     'elem': 900},
+                    {'action': 'set', 'obj': OBJ, 'key': 'm:900',
+                     'value': 'M'}]}]
+                p = general.apply_general_block(
+                    store, store.encode_changes([wave]))
+                p.to_patches()
+                # the object left idx_linear for good: tail appends
+                # keep declining
+                base = dict(metrics.counters)
+                wave2, _ = _typing_wave('w', 2, prev, [800, 801])
+                p = general.apply_general_block(
+                    store, store.encode_changes([wave2]))
+                p.to_patches()
+                wins = metrics.counters.get(
+                    'device_idx_window_applies', 0) - base.get(
+                    'device_idx_window_applies', 0)
+            store.pool.sync()
+            results[mode] = (store, wins)
+        (a, wins_a), (b, wins_b) = results[None], results['off']
+        assert wins_a == 0 and wins_b == 0
+        row = a.obj_uuid.index(OBJ)
+        assert not a.pool.idx_linear[row]
+        assert a.doc_fields(0) == b.doc_fields(0)
+        assert np.array_equal(a.pool.visible, b.pool.visible)
+        assert np.array_equal(a.pool.vis_index, b.pool.vis_index)
+
+    def test_concurrent_tail_appends_window_parity(self):
+        """Two actors appending after the same tail node in one block:
+        still a chain? No — the second append branches the tree, so
+        the window may only engage while the shape holds; whatever the
+        gate decides, state must match the off arm."""
+        results = {}
+        for mode in (None, 'off'):
+            store, prev = _seed(n_chars=32)
+            with _WindowMode(mode):
+                wave = [
+                    {'actor': 'a', 'seq': 1, 'deps': {}, 'ops': [
+                        {'action': 'ins', 'obj': OBJ, 'key': prev,
+                         'elem': 500},
+                        {'action': 'set', 'obj': OBJ, 'key': 'a:500',
+                         'value': 'A'}]},
+                    {'actor': 'b', 'seq': 1, 'deps': {}, 'ops': [
+                        {'action': 'ins', 'obj': OBJ, 'key': prev,
+                         'elem': 600},
+                        {'action': 'set', 'obj': OBJ, 'key': 'b:600',
+                         'value': 'B'}]},
+                ]
+                p = general.apply_general_block(
+                    store, store.encode_changes([wave]))
+                p.to_patches()
+                # follow-on end append by one actor
+                wave2, _ = _typing_wave('a', 2, 'b:600', [501, 502])
+                p = general.apply_general_block(
+                    store, store.encode_changes([wave2]))
+                p.to_patches()
+            store.pool.sync()
+            results[mode] = store
+        a, b = results[None], results['off']
+        assert a.doc_fields(0) == b.doc_fields(0)
+        assert np.array_equal(a.pool.visible, b.pool.visible)
+        assert np.array_equal(a.pool.vis_index, b.pool.vis_index)
+
+    def test_require_raises_when_window_declines(self):
+        """'require' is a CI tripwire: an incremental apply the window
+        gate declines (here: a tail append on an object that already
+        branched out of `idx_linear`) must raise instead of silently
+        renumbering the whole plane."""
+        store, prev = _seed(n_chars=24)
+        wave = [{'actor': 'm', 'seq': 1, 'deps': {}, 'ops': [
+            {'action': 'ins', 'obj': OBJ, 'key': 'w:3', 'elem': 900},
+            {'action': 'set', 'obj': OBJ, 'key': 'm:900',
+             'value': 'M'}]}]
+        p = general.apply_general_block(store,
+                                        store.encode_changes([wave]))
+        p.to_patches()
+        row = store.obj_uuid.index(OBJ)
+        assert not store.pool.idx_linear[row]
+        wave2, _ = _typing_wave('w', 2, prev, [1000])
+        with _WindowMode('require'):
+            with pytest.raises(RuntimeError, match='window'):
+                p = general.apply_general_block(
+                    store, store.encode_changes([wave2]))
+                p.to_patches()
