@@ -308,15 +308,14 @@ class DenseMapStore:
         self.host = _blocks.BlockStore(n_docs, retain_log=retain_log)
         self._sharding = None
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            axis = mesh.axis_names[0]
+            from ..parallel.mesh import doc_sharding
             # whole documents per shard (doc-locality: apply scatters
             # stay shard-local), so the DOC count must divide
             if n_docs % mesh.devices.size:
                 raise ValueError(
                     f'{n_docs} docs do not divide over '
                     f'{mesh.devices.size} devices')
-            self._sharding = NamedSharding(mesh, PartitionSpec(axis, None))
+            self._sharding = doc_sharding(mesh, ndim=2)
         self._applier = None          # lazy device-phase worker thread
         self._jobs = None
         self._last_async = None

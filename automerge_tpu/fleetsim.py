@@ -149,12 +149,34 @@ SCENARIOS = {
                      spawn_per_tick=6400,
                      slo={'convergence_ms_p99_max': 600_000.0}),
     },
+    'hot_shard': {
+        'desc': 'zipf load over a sharded fleet whose docs all start '
+                'pinned on shard 0 (worst-case initial placement): '
+                'red without the placement knob, green once the '
+                'controller drains hot docs to the cold shards',
+        'smoke': dict(n_nodes=1, n_docs=32, ticks=22, drain=4,
+                      ops_per_tick=32, alpha=1.1, n_shards=4,
+                      slo={'shard_imbalance_max': 2.2,
+                           'min_migrations': 1},
+                      controller_kwargs=dict(
+                          hold=2, cooldown=3, placement_min_ops=16,
+                          placement_ratio=1.5, migrate_batch=3)),
+        # full scale widens the doc/op axes and the mesh cut; the
+        # skew/verdict dynamics are the smoke shape scaled up
+        'full': dict(n_nodes=1, n_docs=256, ticks=36, drain=4,
+                     ops_per_tick=256, alpha=1.1, n_shards=8,
+                     slo={'shard_imbalance_max': 2.2,
+                          'min_migrations': 1},
+                     controller_kwargs=dict(
+                         hold=2, cooldown=3, placement_min_ops=64,
+                         placement_ratio=1.5, migrate_batch=8)),
+    },
 }
 
 # Scenarios whose SLO verdict flips red -> green when the controller
 # is enabled (the acceptance matrix bench_fleet_sim gates as
 # fleet_sim_adaptive_wins).
-ADAPTIVE_SCENARIOS = ('flash_crowd', 'diurnal')
+ADAPTIVE_SCENARIOS = ('flash_crowd', 'diurnal', 'hot_shard')
 
 # Scorecard defaults; per-scenario 'slo' entries override. Every
 # bound grades a value read from the telemetry surface. The
@@ -252,7 +274,7 @@ def build_schedule(scenario, seed=DEFAULT_SEED, scale='smoke'):
 
     for t in range(1, spec['ticks'] + 1):
         tick = {'writes': {}}
-        if scenario in ('zipf', 'reconnect_storm'):
+        if scenario in ('zipf', 'reconnect_storm', 'hot_shard'):
             if scenario == 'reconnect_storm':
                 if t == spec['partition_at']:
                     # sever node 0 from everyone: an isolated writer
@@ -376,6 +398,8 @@ class FleetSim:
     def run(self):
         spec = self.schedule['spec']
         scenario = self.schedule['scenario']
+        if spec.get('n_shards'):
+            return self._run_sharded(spec, scenario)
         n_nodes = spec['n_nodes']
         hb = spec['heartbeat_every']
         # per-link counter slices of earlier fleets in this process
@@ -522,6 +546,172 @@ class FleetSim:
                                 non_green_polls=non_green_polls,
                                 critical_polls=critical_polls,
                                 polls=polls))
+
+    # -- the sharded-fleet lane (hot_shard) ----------------------------------
+
+    def _run_sharded(self, spec, scenario):
+        """A sharded-fleet scenario: one
+        :class:`~.sync.sharded.ShardedGeneralDocSet` node whose docs
+        all start PINNED on shard 0 (the deliberate worst-case
+        placement), driven tick-by-tick with the controller's
+        placement knob attached (or not — the red lane). The verdict
+        reads only the telemetry surface: the placement block's
+        imbalance, the migration tallies, quarantine/divergence totals
+        and the health rollup."""
+        from .sync.sharded import ShardedGeneralDocSet
+        metrics.drop_scope('node/')
+        metrics.reset_series('sync_convergence_ms')
+        metrics.bump('sim_scenario_runs')
+        metrics.bump('sim_actors_spawned', self.schedule['n_actors'])
+        sharded = ShardedGeneralDocSet(spec['n_docs'] + 8,
+                                       n_shards=spec['n_shards'])
+        for d in range(spec['n_docs']):
+            sharded.placement.pin(f'doc{d}', 0)
+        if self.controller:
+            kwargs = dict(spec.get('controller_kwargs', {}))
+            kwargs.update(self.controller_kwargs)
+            FleetController(sharded, **kwargs)
+        metrics.subscribe(self._collect)
+        try:
+            ticks = self.schedule['ticks']
+            if metrics.active:
+                metrics.emit('sim_scenario_start', scenario=scenario,
+                             seed=self.schedule['seed'],
+                             n_shards=spec['n_shards'],
+                             n_docs=spec['n_docs'],
+                             controller=self.controller)
+
+            def apply_tick(tick):
+                by_doc = {}
+                load = 0
+                for _, doc_id, changes in tick['writes']:
+                    by_doc.setdefault(doc_id, []).extend(changes)
+                    load += sum(len(c['ops']) for c in changes)
+                if by_doc:
+                    sharded.apply_changes_batch(by_doc)
+                metrics.bump('sim_ticks')
+                if load:
+                    metrics.bump('sim_ops_injected', load)
+                if metrics.active:
+                    metrics.emit('counter', sim_load_ops=load)
+                sharded.tick()
+
+            apply_tick(ticks[0])       # seed phase
+            self._events.clear()
+            imbalances = []            # per loaded tick, from telemetry
+            non_green_polls = 0
+            critical_polls = 0
+            polls = 0
+            peak_resident = 0
+            t0 = time.perf_counter()
+            for i, tick in enumerate(ticks[1:]):
+                apply_tick(tick)
+                load = sharded.shard_load()
+                if sum(load['apply_ops']):
+                    imbalances.append(load['imbalance'])
+                if i % 2 == 1:
+                    polls += 1
+                    st = sharded.fleet_status(docs=False)
+                    peak_resident = max(
+                        peak_resident,
+                        st['memory']['device_plane_bytes'])
+                    state = st['health']['state']
+                    if state != 'green':
+                        non_green_polls += 1
+                    if state == 'critical':
+                        critical_polls += 1
+            for _ in range(spec.get('drain', 0)):
+                apply_tick({'writes': []})
+            dt = time.perf_counter() - t0
+            return self._score_sharded(
+                spec, scenario, sharded, dt, imbalances,
+                dict(non_green_polls=non_green_polls,
+                     critical_polls=critical_polls, polls=polls,
+                     peak_resident=peak_resident))
+        finally:
+            metrics.unsubscribe(self._collect)
+
+    def _score_sharded(self, spec, scenario, sharded, dt, imbalances,
+                       polled):
+        slo = dict(DEFAULT_SLO)
+        slo.update(spec.get('slo', {}))
+        status = sharded.fleet_status(docs=False)
+        placement = status['placement']
+        # the settled operating point: mean imbalance over the last
+        # few LOADED quanta (the gauge the dashboards graph)
+        tail = imbalances[-5:] if imbalances else [1.0]
+        settled = sum(tail) / len(tail)
+        final_health = status['health']['state']
+
+        checks = {}
+
+        def check(name, value, ok, bound):
+            checks[name] = {'value': value, 'bound': bound,
+                            'ok': bool(ok)}
+
+        check('quarantined', status['totals']['quarantined'],
+              status['totals']['quarantined'] <=
+              slo['quarantined_max'], slo['quarantined_max'])
+        check('diverged', status['totals']['diverged'],
+              status['totals']['diverged'] <= slo['diverged_max'],
+              slo['diverged_max'])
+        check('final_health', final_health,
+              _HEALTH_RANK[final_health] <=
+              _HEALTH_RANK[slo['final_health']], slo['final_health'])
+        check('critical_polls', polled['critical_polls'],
+              polled['critical_polls'] <= slo['critical_polls_max'],
+              slo['critical_polls_max'])
+        check('shard_imbalance', round(settled, 3),
+              settled <= slo['shard_imbalance_max'],
+              slo['shard_imbalance_max'])
+        if 'min_migrations' in slo:
+            check('migrations', placement['migrations'],
+                  placement['migrations'] >= slo['min_migrations'],
+                  slo['min_migrations'])
+
+        verdict = 'green' if all(c['ok'] for c in checks.values()) \
+            else 'red'
+        actions = dict(sharded.controller.actions) \
+            if sharded.controller is not None else {}
+        result = {
+            'scenario': scenario,
+            'seed': self.schedule['seed'],
+            'controller': self.controller,
+            'verdict': verdict,
+            'checks': checks,
+            'n_ops': self.schedule['n_ops'],
+            'n_actors': self.schedule['n_actors'],
+            'ops_per_sec': round(self.schedule['n_ops'] /
+                                 max(dt, 1e-9), 1),
+            'wall_s': round(dt, 3),
+            'convergence_ms_p99': None,
+            'peak_resident_bytes': polled['peak_resident'],
+            'peak_memory_pressure': 0.0,
+            'non_green_polls': polled['non_green_polls'],
+            'polls': polled['polls'],
+            'final_health': final_health,
+            'shard_imbalance': round(settled, 3),
+            'migrations': placement['migrations'],
+            'per_shard': placement['per_shard'],
+            'control_actions': actions,
+            'control_action_total': sum(actions.values()),
+            'schedule_digest': self.schedule['digest'],
+            'state_digests': sharded.heartbeat_digests(),
+            'events': list(self._events),
+        }
+        if self.collect_views:
+            result['views'] = [canonical(doc_set_view(sharded))]
+        if metrics.active:
+            metrics.emit(
+                'sim_scenario', scenario=scenario, verdict=verdict,
+                controller=self.controller,
+                ops_per_sec=result['ops_per_sec'],
+                shard_imbalance=result['shard_imbalance'],
+                migrations=result['migrations'],
+                control_action_total=result['control_action_total'],
+                failed=[n for n, c in checks.items()
+                        if not c['ok']])
+        return result
 
     # -- the SLO scorecard (telemetry surface only) --------------------------
 

@@ -191,6 +191,100 @@ def sharded_general_step(mesh, ops_actor, ops_seq, ops_slot, boundary,
             'vis_index': np.asarray(ordered['vis_index'])}
 
 
+@lru_cache(maxsize=16)
+def _fleet_rollup_fn(mesh):
+    spec = P(DOC_AXIS, None)
+
+    def body(stats):
+        return jax.lax.psum(jnp.sum(stats, axis=0), DOC_AXIS)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=P()))
+
+
+def fleet_rollup(mesh, per_shard):
+    """Cross-shard fleet-statistic reduction: ``per_shard`` is an
+    ``[S, k]`` matrix of per-shard stat vectors (doc counts, dirty
+    totals, byte estimates, digest-valid flags — whatever the caller
+    stacks); the return is the length-``k`` fleet total.
+
+    Over a real multi-device mesh the reduction runs as a ``psum``
+    under ``shard_map`` — the collective form of the rollup
+    ``ShardedGeneralDocSet.fleet_status()`` serves, so a pod-scale
+    fleet aggregates over the ICI instead of hauling every shard's
+    stats to one host. On a single device (or when the shard axis does
+    not divide over the mesh) it degrades to the numerically identical
+    numpy sum. Values ride as int64 host-side; the device path clips
+    to int32 lanes (JAX x64 is off), which bounds each STAT at 2 GiB
+    per shard — fine for counts/estimates, callers with wider values
+    keep the numpy path."""
+    arr = np.asarray(per_shard, np.int64)
+    if arr.ndim != 2:
+        raise ValueError('per_shard must be [n_shards, k]')
+    n_dev = 0 if mesh is None else mesh.devices.size
+    if n_dev <= 1 or (np.abs(arr) >= 2**31).any():
+        return arr.sum(axis=0)
+    s = arr.shape[0]
+    s_pad = -(-max(s, 1) // n_dev) * n_dev
+    padded = np.zeros((s_pad, arr.shape[1]), np.int32)
+    padded[:s] = arr
+    placed = shard_docs(mesh, jnp.asarray(padded))
+    return np.asarray(_fleet_rollup_fn(mesh)(placed), np.int64)
+
+
+def sharded_fleet_order(mesh, shard_jobs):
+    """The BATCHED-apply ordering entry for a sharded fleet: every
+    shard's dirty-object job planes (``(parent, elem, actor, visible,
+    valid)`` per shard, each ``[k_i, m_i]``) pack into one job plane
+    with the job axis aligned so each mesh device orders one shard's
+    jobs, then ONE :func:`sharded_rga_jobs` dispatch runs the RGA pass
+    for the whole fleet — S per-shard vmap dispatches collapse into a
+    single shard_map program with psum'd fleet stats.
+
+    Returns ``(per-shard output list, stats)`` where each output dict
+    slices back to that shard's real jobs — bit-identical to running
+    :func:`~automerge_tpu.device.sequence._rga_order` per shard
+    (equality-gated in tests/test_sharded_fleet.py)."""
+    n_shards = len(shard_jobs)
+    if n_shards == 0:
+        return [], {'visible_total': 0, 'jobs': 0}
+    ks = [max(p[0].shape[0], 1) for p in shard_jobs]
+    ms = [p[0].shape[1] if p[0].ndim == 2 else 1 for p in shard_jobs]
+    k_align = max(ks)
+    m = max(max(ms), 1)
+
+    def pack(field, fill=0, head_valid=False):
+        out = np.full((n_shards * k_align, m), fill,
+                      np.asarray(shard_jobs[0][field]).dtype
+                      if shard_jobs else np.int32)
+        if head_valid:
+            out[:, :] = 0
+            out[:, 0] = 1              # padded jobs: lone valid head
+        for s, planes in enumerate(shard_jobs):
+            a = np.asarray(planes[field])
+            if a.ndim == 1:
+                a = a[:, None]
+            out[s * k_align:s * k_align + a.shape[0], :a.shape[1]] = a
+        return out
+
+    parent = pack(0).astype(np.int32)
+    elem = pack(1).astype(np.int32)
+    actor = pack(2).astype(np.int32)
+    visible = pack(3).astype(bool)
+    valid = pack(4, head_valid=True).astype(bool)
+    out, stats = sharded_rga_jobs(mesh, parent, elem, actor, visible,
+                                  valid)
+    per_shard = []
+    for s, planes in enumerate(shard_jobs):
+        k_s, m_s = np.asarray(planes[0]).shape
+        per_shard.append({
+            name: np.asarray(arr)[s * k_align:s * k_align + k_s]
+            [..., :m_s] if np.asarray(arr).ndim == 2
+            else np.asarray(arr)[s * k_align:s * k_align + k_s]
+            for name, arr in out.items()})
+    return per_shard, stats
+
+
 def sharded_rga_jobs(mesh, parent, elem, actor, visible, valid):
     """Order a batch of insertion trees with the job axis sharded over
     `mesh`. Pads the job axis to the mesh size; padded jobs are a lone

@@ -22,8 +22,30 @@ def make_mesh(n_devices=None, axis=DOC_AXIS, devices=None):
     return Mesh(np.asarray(devices), (axis,))
 
 
+def doc_sharding(mesh, ndim=1, axis=None):
+    """The canonical doc-axis :class:`NamedSharding`: leading axis split
+    over the mesh, trailing axes replicated within the shard. This is
+    the ONE place a doc-major placement spec is constructed — the dense
+    store's plane placement, :func:`shard_docs` and the sharded doc set
+    all route through it, so doc-locality (whole documents per device)
+    cannot drift between call sites.
+    """
+    name = axis if axis is not None else mesh.axis_names[0]
+    return NamedSharding(mesh, P(name, *([None] * (ndim - 1))))
+
+
 def shard_docs(mesh, *arrays, axis=DOC_AXIS):
     """Place arrays with their leading (document) axis split over the mesh."""
-    sharding = NamedSharding(mesh, P(axis))
+    sharding = doc_sharding(mesh, axis=axis)
     placed = tuple(jax.device_put(a, sharding) for a in arrays)
     return placed if len(placed) != 1 else placed[0]
+
+
+def shard_device(mesh, shard, n_shards=None):
+    """The device owning logical shard ``shard`` of an ``n_shards``-way
+    doc partition over ``mesh`` (round-robin when there are more shards
+    than devices). Returns None for an empty mesh."""
+    devices = mesh.devices.reshape(-1)
+    if devices.size == 0:
+        return None
+    return devices[shard % devices.size]

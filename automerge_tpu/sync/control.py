@@ -12,7 +12,7 @@ pressure, cheaply): once per serving quantum it reads the SAME
 exported telemetry surface the dashboards read — the
 :meth:`~.general_doc_set.GeneralDocSet.evaluate_health` signal set and
 the per-link ``peer/<id>/`` counter slices — and actuates exactly
-three knobs:
+four knobs:
 
 - **Admission token rates** — sustained ``busy`` replies while the
   debt buckets show LOW utilization (the valve is bouncing off its
@@ -33,6 +33,11 @@ three knobs:
   latency at the edge, never to corruption) and dumps a
   ``load_shed`` flight-recorder incident; sustained green restores
   the previous rates.
+- **Doc placement** — on a sharded fleet
+  (:class:`~.sharded.ShardedGeneralDocSet`), sustained per-shard
+  apply-rate skew drains the hottest docs to the coldest shard via
+  live migration (``control.migrate`` span, ``control_migrations``);
+  a balanced fleet never migrates.
 
 Every rule is hysteretic by construction — a signal must breach for
 ``hold`` consecutive quanta before an action fires, each action arm
@@ -80,6 +85,14 @@ class FleetController:
     ``narrow_after`` — quanta with zero fresh busy replies before the
     rates narrow one step back toward base.
     ``shed_factor`` — the rate multiple a critical fleet sheds to.
+    ``placement_ratio`` — per-shard apply-rate skew (hottest shard's
+    share over the mean) that counts as imbalance for the placement
+    knob; sustained breach for ``hold`` quanta drains hot docs.
+    ``placement_min_ops`` — window op floor below which the placement
+    rule never evaluates (an idle fleet has no meaningful skew).
+    ``migrate_batch`` — docs drained per placement action (each batch
+    is one source-store rebuild — keep it small and let hysteresis
+    spread the drain over quanta).
     """
 
     def __init__(self, serving, hold=3, cooldown=8,
@@ -88,7 +101,8 @@ class FleetController:
                  compact_cooldown=32,
                  widen_factor=1.5, max_widen=8.0,
                  util_widen_max=1.0, narrow_after=12,
-                 shed_factor=0.25, attach=True):
+                 shed_factor=0.25, placement_ratio=2.0,
+                 placement_min_ops=16, migrate_batch=4, attach=True):
         self.serving = serving
         self.inner = getattr(serving, 'inner', serving)
         self.hold = hold
@@ -103,6 +117,10 @@ class FleetController:
         self.util_widen_max = util_widen_max
         self.narrow_after = narrow_after
         self.shed_factor = shed_factor
+        self.placement_ratio = placement_ratio
+        self.placement_min_ops = placement_min_ops
+        self.migrate_batch = migrate_batch
+        self._imbalance_run = 0
         # the configured operating point the controller steers around
         # (and never raises past)
         self._watermark_base = getattr(serving, 'low_watermark', None)
@@ -201,6 +219,7 @@ class FleetController:
         self._shed_rule(state)
         self._memory_rule(signals)
         self._admission_rule(signals)
+        self._placement_rule()
 
     def tick(self):
         """Standalone driver (no serving tick): evaluate health and
@@ -358,6 +377,57 @@ class FleetController:
             self._act('tokens_narrow', 'control_tokens_narrowed',
                       'tokens', narrow, rate_factor=round(new, 3))
             self._quiet_run = 0
+
+    def _placement_rule(self):
+        """The placement knob (ROADMAP "placement knob", ISSUE 17): a
+        sharded fleet whose hottest shard sustains more than
+        ``placement_ratio`` times the mean apply rate drains its
+        hottest docs to the COLDEST shard — live migration
+        (:meth:`~.sharded.ShardedGeneralDocSet.migrate_docs`) under
+        the standard hysteresis: ``hold`` consecutive breached quanta
+        to arm, the ``placement`` knob's cooldown between drains. A
+        balanced (or idle) fleet evaluates to a couple of numpy
+        reductions and returns without touching anything — the
+        do-nothing guarantee extends to this knob."""
+        sharded = self.serving if hasattr(self.serving, 'placement') \
+            else getattr(self.serving, 'sharded', None)
+        if sharded is None or getattr(sharded, 'n_shards', 1) < 2:
+            return
+        load = sharded.shard_load()
+        rates = load['apply_ops']
+        total = sum(rates)
+        if total < self.placement_min_ops:
+            self._imbalance_run = 0
+            return
+        mean = total / len(rates)
+        hot = max(range(len(rates)), key=lambda s: rates[s])
+        ratio = rates[hot] / mean
+        if ratio < self.placement_ratio:
+            self._imbalance_run = 0
+            return
+        self._imbalance_run += 1
+        if self._imbalance_run < self.hold or \
+                not self._cooled('placement'):
+            return
+        # cold shards by apply rate, resident bytes breaking ties
+        resident = load['resident_bytes']
+        cold = sorted((s for s in range(len(rates)) if s != hot),
+                      key=lambda s: (rates[s], resident[s]))
+        docs = sharded.hottest_docs(hot, self.migrate_batch)
+        if not docs or not cold:
+            return
+        # spread the batch coldest-first so the hot clique splits up
+        # instead of re-forming on a single destination
+        plan = {doc: cold[i % len(cold)]
+                for i, doc in enumerate(docs)}
+
+        def migrate():
+            sharded.migrate_docs(plan)
+
+        self._act('migrate', 'control_migrations', 'placement',
+                  migrate, docs=len(plan), src=hot, dst=cold[0],
+                  ratio=round(ratio, 2))
+        self._imbalance_run = 0
 
     # -- operator surface ----------------------------------------------------
 

@@ -271,11 +271,35 @@ COMPACTION_COUNTERS = (
 #   control_load_sheds         critical health: rates cut to the shed
 #                              fraction (+ a load_shed incident dump)
 #   control_shed_restores      sustained green: pre-shed rates restored
+#   control_migrations         hot-doc drains the placement knob fired
+#                              (sync/sharded.py migrations ride the
+#                              placement_* family below; this counts
+#                              the CONTROLLER deciding to move docs)
 CONTROL_COUNTERS = (
     'control_actions', 'control_tokens_widened',
     'control_tokens_narrowed', 'control_watermark_lowered',
     'control_watermark_raised', 'control_compactions',
-    'control_load_sheds', 'control_shed_restores')
+    'control_load_sheds', 'control_shed_restores',
+    'control_migrations')
+
+# Doc-placement counters (sync/sharded.py — the sharded fleet's
+# placement map and live doc migration observability):
+#   placement_migrations       docs migrated between shards
+#   placement_migrated_bytes   checksummed migration-unit bytes shipped
+#   placement_migrate_ms       end-to-end per-batch migration latency
+#   placement_fenced_changes   changes buffered behind an in-flight
+#                              migration fence (re-routed after the
+#                              placement flip, never dropped)
+#   placement_overrides        explicit placement pins currently
+#                              installed over the consistent-hash ring
+#   shard_apply_ops            ops admitted through shard-routed applies
+#   shard_imbalance_ratio      gauge: hottest shard's apply share over
+#                              the mean (1.0 = perfectly balanced)
+PLACEMENT_COUNTERS = (
+    'placement_migrations', 'placement_migrated_bytes',
+    'placement_migrate_ms', 'placement_fenced_changes',
+    'placement_overrides', 'shard_apply_ops',
+    'shard_imbalance_ratio')
 
 # Fleet-simulator counters (automerge_tpu/fleetsim.py — the workload
 # generator's own telemetry, so a scenario run is auditable from the
@@ -295,7 +319,8 @@ SIM_COUNTERS = (
 ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
                           SYNC_COUNTERS + CONVERGENCE_COUNTERS +
                           DEVICE_COUNTERS + COMPACTION_COUNTERS +
-                          CONTROL_COUNTERS + SIM_COUNTERS)
+                          CONTROL_COUNTERS + PLACEMENT_COUNTERS +
+                          SIM_COUNTERS)
 
 # Observe-series name suffixes: a registered name ending in one of
 # these is a histogram series (count/sum/max + buckets), not a scalar

@@ -24,4 +24,15 @@ import jax  # noqa: E402
 jax.config.update('jax_platforms', 'cpu')
 assert jax.devices()[0].platform == 'cpu'
 
+# Persistent compilation cache (.jax_cache/, gitignored): the suite is
+# compile-dominated on CPU, and every process otherwise re-pays every
+# XLA compile from zero. Correctness is unaffected — the cache key
+# covers program, flags, and backend — and a cold cache only means the
+# first run is as slow as before.
+jax.config.update(
+    'jax_compilation_cache_dir',
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 '.jax_cache'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

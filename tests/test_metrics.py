@@ -351,7 +351,8 @@ class TestRegistryDriftGuard:
     NAME_RE = re.compile(
         r"(?:bump|set_gauge|observe|ratchet|_act)\(\s*"
         r"(?:'[a-z0-9_]+',\s*)?'"
-        r"((?:sync|serving|fleet|device|mem|compaction|control|sim)_"
+        r"((?:sync|serving|fleet|device|mem|compaction|control|sim"
+        r"|placement|shard)_"
         r"[a-z0-9_]+)'")
 
     def _package_names(self):
@@ -373,10 +374,11 @@ class TestRegistryDriftGuard:
         missing = bumped - registered
         assert not missing, (
             f'sync_/serving_/fleet_/device_/mem_/compaction_/'
-            f'control_/sim_ counters bumped in automerge_tpu/ but '
-            f'absent from FAULT_COUNTERS/SERVING_COUNTERS/'
-            f'SYNC_COUNTERS/CONVERGENCE_COUNTERS/DEVICE_COUNTERS/'
-            f'COMPACTION_COUNTERS/CONTROL_COUNTERS/SIM_COUNTERS: '
+            f'control_/placement_/shard_/sim_ counters bumped in '
+            f'automerge_tpu/ but absent from FAULT_COUNTERS/'
+            f'SERVING_COUNTERS/SYNC_COUNTERS/CONVERGENCE_COUNTERS/'
+            f'DEVICE_COUNTERS/COMPACTION_COUNTERS/CONTROL_COUNTERS/'
+            f'PLACEMENT_COUNTERS/SIM_COUNTERS: '
             f'{sorted(missing)}')
 
     def test_no_registered_name_is_dead(self):
@@ -388,7 +390,8 @@ class TestRegistryDriftGuard:
         dead = {n for n in registered
                 if n.startswith(('sync_', 'serving_', 'fleet_',
                                  'device_', 'mem_', 'compaction_',
-                                 'control_', 'sim_'))} \
+                                 'control_', 'placement_', 'shard_',
+                                 'sim_'))} \
             - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
 
@@ -399,7 +402,8 @@ class TestRegistryDriftGuard:
         for reg in (M.FAULT_COUNTERS, M.SERVING_COUNTERS,
                     M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS,
                     M.DEVICE_COUNTERS, M.COMPACTION_COUNTERS,
-                    M.CONTROL_COUNTERS, M.SIM_COUNTERS):
+                    M.CONTROL_COUNTERS, M.PLACEMENT_COUNTERS,
+                    M.SIM_COUNTERS):
             dup = seen & set(reg)
             assert not dup, f'registered twice: {sorted(dup)}'
             seen |= set(reg)
