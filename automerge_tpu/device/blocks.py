@@ -675,15 +675,24 @@ class BlockStore:
         # references a COMMITTED change — a rolled-back apply can never
         # leave a stale body here. With N peers each change encodes
         # once and fans out N times; retransmits reuse the same bytes.
-        # Two formats share the contract: v1 entries are compact JSON
+        # Three formats share the contract: v1 entries are compact JSON
         # bytes, v2 entries (_wire_cache_v2) are columnar
-        # ``(body, lits)`` pairs — a mixed-version fleet encodes each
-        # change at most once PER FORMAT.
+        # ``(body, lits)`` pairs, v3 entries (_wire_cache_v3) the same
+        # shape with RLE bodies (the session-table remap happens at
+        # message assembly, so the cached encoding stays session-
+        # independent and shareable across peers) — a mixed-version
+        # fleet encodes each change at most once PER FORMAT.
         self._wire_cache = {}
         self._wire_cache_v2 = {}
+        self._wire_cache_v3 = {}
         self._wire_cache_bytes = 0
         self.wire_cache_hits = 0
         self.wire_cache_misses = 0
+        # live wire-v3 session tables registered against this store
+        # (weakrefs: a closed connection's table just drops) — cleared
+        # alongside the wire caches so no session-table remap state
+        # survives a cache invalidation
+        self._wire_sessions = []
         self.log_truncated = False            # True after snapshot resume
         self._str_rank_cache = (0, None, None)
         # per-doc state digest: XOR fold of the content hashes of every
@@ -1026,7 +1035,8 @@ class BlockStore:
             except ValueError as err:
                 errors[d] = err
         cache = self._wire_cache if version == 1 else \
-            self._wire_cache_v2
+            self._wire_cache_v2 if version == 2 else \
+            self._wire_cache_v3
         out = {}
         # one cache probe per change: misses record their output slot
         # and are patched in place after the per-block batched emit
@@ -1047,7 +1057,8 @@ class BlockStore:
         if misses:
             from .. import wire as _wire
             encoder = _wire.encode_change_rows if version == 1 \
-                else _wire.encode_change_rows_columnar
+                else _wire.encode_change_rows_columnar if version == 2 \
+                else _wire.encode_change_rows_columnar_v3
             for block, entries in misses.values():
                 n_miss += len(entries)
                 encoded = encoder(block, [c for c, _, _, _ in entries])
@@ -1064,7 +1075,7 @@ class BlockStore:
         return out, errors
 
     def adopt_wire_cache(self, old_store, drop_docs=()):
-        """Carry the per-change encode caches (both wire formats)
+        """Carry the per-change encode caches (all three wire formats)
         across a store rebuild (doc eviction), DROPPING the evicted
         docs' entries. Safe under the cache's never-invalidate
         contract: every surviving entry was created at serve time from
@@ -1073,29 +1084,57 @@ class BlockStore:
         ``(doc, actor, seq)`` holds the same change body, so the
         cached bytes stay exact. Entries of ``drop_docs`` are released
         with the docs' store rows (an evicted doc that faults back in
-        re-encodes on next serve)."""
+        re-encodes on next serve). Live session-table registrations
+        carry over too — their remap state is content-addressed, so a
+        rebuild never invalidates it, but a clear must still reach
+        them."""
         drop = set(int(d) for d in drop_docs)
         kept = {k: v for k, v in old_store._wire_cache.items()
                 if k[0] not in drop}
         kept2 = {k: v for k, v in old_store._wire_cache_v2.items()
                  if k[0] not in drop}
+        kept3 = {k: v for k, v in old_store._wire_cache_v3.items()
+                 if k[0] not in drop}
         self._wire_cache = kept
         self._wire_cache_v2 = kept2
+        self._wire_cache_v3 = kept3
         self._wire_cache_bytes = \
             sum(len(v) for v in kept.values()) + \
-            sum(_wire_entry_bytes(v) for v in kept2.values())
+            sum(_wire_entry_bytes(v) for v in kept2.values()) + \
+            sum(_wire_entry_bytes(v) for v in kept3.values())
         self.wire_cache_hits = old_store.wire_cache_hits
         self.wire_cache_misses = old_store.wire_cache_misses
+        self._wire_sessions = [ref for ref in old_store._wire_sessions
+                               if ref() is not None]
         metrics.set_gauge('sync_wire_cache_bytes',
                           self._wire_cache_bytes)
 
+    def register_wire_session(self, table):
+        """Track a live wire-v3 sender session table against this
+        store (weakref — a closed connection's table just drops), so
+        :meth:`clear_wire_cache` can reset session remap state along
+        with the encodings it was built over."""
+        import weakref
+        self._wire_sessions = [ref for ref in self._wire_sessions
+                               if ref() is not None]
+        self._wire_sessions.append(weakref.ref(table))
+
     def clear_wire_cache(self):
-        """Drop every cached change encoding (both formats) — a bench/
-        test hook; the caches refill lazily at next serve."""
+        """Drop every cached change encoding (all formats) AND reset
+        every registered wire-v3 session table (each mints a fresh
+        epoch, so peers simply see a new sid and re-learn defs) — a
+        bench/test hook; the caches refill lazily at next serve."""
         self._wire_cache.clear()
         self._wire_cache_v2.clear()
+        self._wire_cache_v3.clear()
         self._wire_cache_bytes = 0
         self.wire_cache_hits = self.wire_cache_misses = 0
+        for ref in self._wire_sessions:
+            table = ref()
+            if table is not None:
+                table.reset()
+        self._wire_sessions = [ref for ref in self._wire_sessions
+                               if ref() is not None]
         metrics.set_gauge('sync_wire_cache_bytes', 0)
 
     # -- per-doc state digests ----------------------------------------------

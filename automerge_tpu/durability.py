@@ -358,11 +358,11 @@ class DurableDocSet:
         (without this, changes acknowledged over a WireConnection
         would vanish in a crash — the dict path was journaled, the
         columnar path was not). v1 payloads are UTF-8 JSON and journal
-        as text; columnar v2 containers are binary and journal
+        as text; columnar v2/v3 containers are binary and journal
         base64-armored (the journal record framing is JSON)."""
-        from .wire import COLUMNAR_MAGIC
+        from .wire import COLUMNAR_MAGIC, COLUMNAR_MAGIC_V3
         if isinstance(data, (bytes, bytearray)) and \
-                bytes(data[:4]) == COLUMNAR_MAGIC:
+                bytes(data[:4]) in (COLUMNAR_MAGIC, COLUMNAR_MAGIC_V3):
             import base64
             self.journal.append(
                 {'wireb64': base64.b64encode(bytes(data)).decode(

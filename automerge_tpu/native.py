@@ -742,25 +742,31 @@ def stage_general_block(block, chg_local, a_tab, k_tab, omap, root_row,
 
 
 # ---------------------------------------------------------------------------
-# Native columnar v2 codec (the amwe_emit_columnar / amst_parse_columnar
-# entry points of libamwire.so): the JSON-free binary wire format.
-# Emit returns varint column bodies plus per-change global ref lists —
-# the host maps refs to tagged literal bytes (wire.py), so the Python
-# fallback is byte-identical by construction. Parse fills the same
-# Parsed struct the JSON parsers fill (extracted via the amwc_*
-# accessors in wire._extract_block).
+# Native columnar v2/v3 codec (the amwe_emit_columnar[_v3] /
+# amst_parse_columnar[_v3] entry points of libamwire.so): the JSON-free
+# binary wire format. Emit returns varint column bodies plus per-change
+# global ref lists — the host maps refs to tagged literal bytes
+# (wire.py), so the Python fallback is byte-identical by construction.
+# Parse fills the same Parsed struct the JSON parsers fill (extracted
+# via the amwc_* accessors in wire._extract_block). v3 adds RLE on the
+# action and obj columns; the session string-table layer lives entirely
+# host-side (wire.py), so the C boundary is unchanged beyond the two
+# extra symbols.
 
 _COLUMNAR_LIB = None
 _COLUMNAR_ATTEMPTED = False
 
+_COL_EMIT_ARGTYPES = [
+    _i64, _P64,                                  # rows
+    _P32, _P32, _P32, _P32, _P32,                # change columns
+    _P32, _P8, _P32, _P8, _P32, _P32, _P32,      # op columns
+    _P32]                                        # value column
+
 
 def _bind_columnar(lib):
-    lib.amwe_emit_columnar.argtypes = [
-        _i64, _P64,                                  # rows
-        _P32, _P32, _P32, _P32, _P32,                # change columns
-        _P32, _P8, _P32, _P8, _P32, _P32, _P32,      # op columns
-        _P32]                                        # value column
-    lib.amwe_emit_columnar.restype = ctypes.c_void_p
+    for emit in (lib.amwe_emit_columnar, lib.amwe_emit_columnar_v3):
+        emit.argtypes = _COL_EMIT_ARGTYPES
+        emit.restype = ctypes.c_void_p
     lib.amwe_col_bytes.argtypes = [ctypes.c_void_p]
     lib.amwe_col_bytes.restype = _i64
     lib.amwe_col_refs.argtypes = [ctypes.c_void_p]
@@ -770,14 +776,15 @@ def _bind_columnar(lib):
     lib.amwe_col_fill.restype = None
     lib.amwe_col_free.argtypes = [ctypes.c_void_p]
     lib.amwe_col_free.restype = None
-    lib.amst_parse_columnar.argtypes = [ctypes.c_char_p, _i64]
-    lib.amst_parse_columnar.restype = ctypes.c_void_p
+    for parse in (lib.amst_parse_columnar, lib.amst_parse_columnar_v3):
+        parse.argtypes = [ctypes.c_char_p, _i64]
+        parse.restype = ctypes.c_void_p
     return lib
 
 
 def columnar_lib():
-    """The columnar v2 codec library, or None (no native codec / stale
-    binary without the columnar symbols /
+    """The columnar v2/v3 codec library, or None (no native codec /
+    stale binary without the columnar symbols /
     AUTOMERGE_TPU_NATIVE_COLUMNAR=0)."""
     global _COLUMNAR_LIB, _COLUMNAR_ATTEMPTED
     if _COLUMNAR_ATTEMPTED:
@@ -800,14 +807,35 @@ def columnar_available():
     return columnar_lib() is not None
 
 
+def columnar_v3_available():
+    """_bind_columnar binds the v2 and v3 symbols together (a stale
+    .so missing either fails the whole bind), so v3 availability is
+    the same predicate — kept distinct so CI can assert the v3 emit/
+    parse arms by name."""
+    lib = columnar_lib()
+    return lib is not None and \
+        hasattr(lib, 'amwe_emit_columnar_v3') and \
+        hasattr(lib, 'amst_parse_columnar_v3')
+
+
 def emit_columnar_rows(block, rows_arr):
-    """Native columnar emit of general-block change rows: one
+    """Native columnar v2 emit of general-block change rows: one
     ``(body bytes, global ref list)`` per row, or None when the library
     is unavailable (the caller falls back to the Python emitter)."""
+    return _emit_columnar_rows(block, rows_arr, 'amwe_emit_columnar')
+
+
+def emit_columnar_rows_v3(block, rows_arr):
+    """Native columnar v3 emit (RLE action/obj columns) — same contract
+    as :func:`emit_columnar_rows`."""
+    return _emit_columnar_rows(block, rows_arr, 'amwe_emit_columnar_v3')
+
+
+def _emit_columnar_rows(block, rows_arr, sym):
     lib = columnar_lib()
     if lib is None:
         return None
-    h = lib.amwe_emit_columnar(
+    h = getattr(lib, sym)(
         len(rows_arr), _p64(rows_arr),
         _p32(block.actor), _p32(block.seq),
         _p32(block.dep_ptr), _p32(block.dep_actor),

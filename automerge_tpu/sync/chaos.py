@@ -124,8 +124,9 @@ class ChaosFleet:
             self._conn_kwargs['wire'] = True
         # per-node wire-format version: an int pins every node, a list
         # pins per node (None entries = the build default) — the
-        # mixed-version interop schedules run v1 and v2 peers in ONE
-        # fleet and must still converge byte-identically
+        # mixed-version interop schedules run v1/v2/v3 peers in ONE
+        # fleet and must still converge byte-identically (a pair
+        # speaks min(sides), so one pinned node downgrades its links)
         if wire_version is None or isinstance(wire_version, int):
             self.node_wire_version = [wire_version] * len(self.doc_sets)
         else:
@@ -230,8 +231,11 @@ class ChaosFleet:
             env['kind'] = 'garbage'
         elif mode == 4:
             payload = env.get('payload')
-            # flip one bit in a binary payload section — blob or the
-            # v2 literal tab, both under the CRC32-over-bytes checksum
+            # flip one bit in a binary payload section — blob, the v2
+            # literal tab or the v3 session-definition tab, all under
+            # the CRC32-over-bytes checksum (a flipped v3 tab must be
+            # caught by the envelope sum and repaired by retransmit,
+            # never poison the receiver's session table)
             field = self.rng.choice(('blob', 'tab'))
             part = payload.get(field) if isinstance(payload, dict) \
                 else None

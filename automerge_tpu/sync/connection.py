@@ -153,7 +153,10 @@ def validate_wire_msg(msg):
     """Validate the multi-doc WIRE data-message schema (the columnar
     counterpart of a per-doc ``{docId, clock, changes}`` dict message):
     ``wire`` the format version (1 = JSON-blob spans, 2 = columnar
-    binary spans + a shared ``tab`` literal table); ``docs`` a
+    binary spans + a shared ``tab`` literal table, 3 = RLE columnar
+    spans referencing a SESSION string table — ``tab`` carries the
+    message's new table definitions and ``sid`` the sender's table
+    epoch); ``docs`` a
     non-empty list of doc-id strings; ``clocks`` an aligned list of
     ``str -> non-negative int`` clock dicts; ``counts`` an aligned
     list of per-doc change counts; ``lens`` the per-change byte
@@ -168,17 +171,21 @@ def validate_wire_msg(msg):
     if not isinstance(msg, dict):
         _reject(f'wire message is {type(msg).__name__}, not a dict')
     version = msg.get('wire')
-    if version not in (1, 2) or isinstance(version, bool):
-        _reject(f'wire version is not 1 or 2: {version!r}')
+    if version not in (1, 2, 3) or isinstance(version, bool):
+        _reject(f'wire version is not 1, 2 or 3: {version!r}')
     maxv = msg.get('maxv')
     if maxv is not None and (not isinstance(maxv, int)
                              or isinstance(maxv, bool) or maxv < 1):
         _reject(f'wire maxv is not a positive int: {maxv!r}')
-    if version == 2:
+    if version >= 2:
         tab = msg.get('tab')
         if not isinstance(tab, (bytes, bytearray)):
-            _reject(f'wire v2 tab is not bytes: '
+            _reject(f'wire v{version} tab is not bytes: '
                     f'{type(tab).__name__}')
+    if version >= 3:
+        sid = msg.get('sid')
+        if not isinstance(sid, int) or isinstance(sid, bool) or sid < 0:
+            _reject(f'wire v3 sid is not a non-negative int: {sid!r}')
     docs = msg.get('docs')
     if not isinstance(docs, (list, tuple)) or not docs:
         _reject(f'wire docs is not a non-empty list: {docs!r}')
@@ -239,11 +246,14 @@ def validate_wire_msg(msg):
 # un-advertised (old) peers fall back to the legacy snapshot path
 STATE_VERSION = 1
 
-# highest wire-blob format this build speaks: 2 = columnar binary
-# spans + shared literal tables (JSON-free receive path); 1 = the
-# PR 5 JSON-blob spans, kept for mixed-fleet interop and pinnable via
-# WireConnection(wire_version=1)
-WIRE_VERSION = 2
+# highest wire-blob format this build speaks: 3 = RLE columnar spans +
+# session-scoped string tables (actor uuids / hot keys ship once per
+# connection); 2 = columnar binary spans + per-message literal tables
+# (JSON-free receive path); 1 = the PR 5 JSON-blob spans, kept for
+# mixed-fleet interop. Lower versions stay pinnable via
+# WireConnection(wire_version=...) and negotiation takes the min of
+# both ends' maxv stamps.
+WIRE_VERSION = 3
 
 # the flow-control sizing unit for served encode-cache entries — the
 # ONE sizing rule, shared with the cache-byte accounting in
@@ -635,9 +645,9 @@ class WireConnection(BatchingConnection):
                 '(GeneralDocSet: apply_wire + a store serving '
                 'get_missing_changes_wire); use Connection or '
                 'BatchingConnection for other doc sets')
-        if wire_version not in (1, 2):
+        if wire_version not in (1, 2, 3):
             raise ValueError(
-                f'wire_version must be 1 or 2, got {wire_version!r}')
+                f'wire_version must be 1, 2 or 3, got {wire_version!r}')
         # per-peer flow control: soft cap on one outgoing message's
         # blob bytes — data spans past the cap carry to the next tick
         # (re-served from the encode cache, so deferral costs no
@@ -664,6 +674,14 @@ class WireConnection(BatchingConnection):
         self._pending_send = {}       # doc_id -> None (insertion order)
         self._incoming_wire = []
         self._incoming_state = []
+        # wire v3 session string tables. Sender: ONE SessionStringTable
+        # (lazily created on the first v3 data send — its fresh module-
+        # unique `sid` is the session epoch every outgoing v3 message
+        # stamps). Receiver: ref -> literal maps keyed by the PEER's
+        # sid; at most two epochs stay live (the current one plus the
+        # one a reconnecting peer just abandoned), older epochs drop.
+        self._tx_table = None
+        self._rx_tables = {}
 
     def open(self):
         """Advertise every doc WITHOUT materializing handles: the wire
@@ -718,10 +736,20 @@ class WireConnection(BatchingConnection):
                 # it pins to the receiver's advertised maxv)
                 _reject(f"wire version {msg['wire']} not spoken here "
                         f"(max {self.wire_version})")
+            if msg['wire'] >= 3:
+                # resolve session refs NOW, in arrival order — the
+                # rewrite into per-message-tab form happens before any
+                # bookkeeping, so an unresolvable ref (table state
+                # lost) aborts the whole delivery cleanly: the
+                # envelope is never acked and the sender's retransmit
+                # repairs it, exactly like a checksum drop
+                msg = self._resolve_session_msg(msg)
             self.metrics.bump('sync_msgs_received')
             self.metrics.bump('sync_wire_msgs_received')
-            if msg['wire'] >= 2:
+            if msg['wire'] == 2:
                 self.metrics.bump('sync_wire_v2_msgs_received')
+            elif msg['wire'] >= 3:
+                self.metrics.bump('sync_wire_v3_msgs_received')
             # clock bookkeeping happens immediately, in arrival order —
             # exactly the dict data path
             for doc_id, clock in zip(msg['docs'], msg['clocks']):
@@ -758,6 +786,65 @@ class WireConnection(BatchingConnection):
         if isinstance(maxs, int) and not isinstance(maxs, bool) \
                 and maxs > self._peer_state_version:
             self._peer_state_version = min(maxs, STATE_VERSION)
+
+    def _resolve_session_msg(self, msg):
+        """Rewrite one incoming v3 message from session-table form
+        (spans referencing the peer's session-wide refs, ``tab``
+        carrying this message's new defs) into the self-contained
+        per-message-tab form the buffered flush consumes. Defs install
+        idempotently (dup/retransmit-safe); an unknown ref raises
+        ValueError — the sender defines every literal in EVERY message
+        until one is acked, so this only happens when the receiver's
+        table state is lost (e.g. a restart), and the unacked envelope
+        repairs via retransmit, never quarantine."""
+        from .. import wire as _wire
+        sid = msg['sid']
+        refs = self._rx_tables.get(sid)
+        if refs is None:
+            while len(self._rx_tables) >= 2:
+                # drop the oldest epoch (insertion order): a sender
+                # only ever speaks its newest sid, and retransmits of
+                # a dead session die with their connection
+                del self._rx_tables[next(iter(self._rx_tables))]
+            refs = self._rx_tables[sid] = {}
+        for ref, lit in _wire.decode_session_defs(msg['tab']):
+            refs[ref] = lit
+        try:
+            entries = _wire.decode_session_spans(
+                msg['blob'], msg['lens'], refs)
+        except ValueError:
+            self.metrics.bump('sync_wire_table_stale_refs')
+            raise
+        spans, tab = _wire.assemble_columnar_spans(entries)
+        return {**msg, 'tab': tab, 'blob': b''.join(spans),
+                'lens': [len(s) for s in spans]}
+
+    def note_wire_acked(self, payload):
+        """Envelope-layer feedback (the resilient shell's ack hook):
+        a stored v3 wire payload was acknowledged — its defs become
+        session-confirmed (bare references from now on) and its ref
+        uses unpin. Stateless: the refs re-derive from the payload
+        itself, so no per-seq side table exists to leak."""
+        if self._tx_table is None or not isinstance(payload, dict) \
+                or payload.get('wire') != 3 \
+                or payload.get('sid') != self._tx_table.sid:
+            return
+        from .. import wire as _wire
+        def_refs, used = _wire.session_payload_refs(payload)
+        self._tx_table.note_acked(def_refs, used)
+
+    def note_wire_dead(self, payload):
+        """Envelope-layer feedback: a stored v3 wire payload died
+        permanently (retry budget exhausted) — unpin its ref uses so
+        eviction can reclaim them; its defs stay unconfirmed and
+        re-define on next use."""
+        if self._tx_table is None or not isinstance(payload, dict) \
+                or payload.get('wire') != 3 \
+                or payload.get('sid') != self._tx_table.sid:
+            return
+        from .. import wire as _wire
+        _, used = _wire.session_payload_refs(payload)
+        self._tx_table.note_dead(used)
 
     def _flush_pending(self):
         return bool(self._incoming or self._incoming_wire
@@ -801,39 +888,46 @@ class WireConnection(BatchingConnection):
         """Merge the buffered wire blobs per document and apply in one
         fused codec->stager pass per FORMAT: v1 JSON spans concatenate
         into the JSON multi-doc shape, v2 columnar spans (plus their
-        messages' shared literal tabs) stitch into one binary container
-        — the zero-``json.loads`` path. A mixed-version tick (v1 and v2
-        peers buffered together) costs at most one fused apply per
-        format."""
+        messages' shared literal tabs) stitch into one AMW2 container,
+        v3 spans (already rewritten to per-message-tab form at receive)
+        into one AMW3 container — both zero-``json.loads`` paths. A
+        mixed-version tick (v1/v2/v3 peers buffered together) costs at
+        most one fused apply per format."""
         if not self._incoming_wire:
             return {}
         segs_by_doc = {}                 # v1: doc_id -> [json bytes]
         spans_by_doc = {}                # v2: doc_id -> [(tab_i, span)]
+        spans3_by_doc = {}               # v3: doc_id -> [(tab_i, span)]
         tabs = []
+        tabs3 = []
         n_changes = 0
         for msg in self._incoming_wire:
             blob, lens = msg['blob'], msg['lens']
-            v2 = msg['wire'] >= 2
-            if v2:
+            v = msg['wire']
+            if v >= 3:
+                tab_i = len(tabs3)
+                tabs3.append(bytes(msg['tab']))
+                bucket = spans3_by_doc
+            elif v == 2:
                 tab_i = len(tabs)
                 tabs.append(bytes(msg['tab']))
+                bucket = spans_by_doc
+            else:
+                bucket = segs_by_doc
             pos = 0
             k = 0
             for doc_id, count in zip(msg['docs'], msg['counts']):
                 if not count:
                     continue
-                if v2:
-                    segs = spans_by_doc.setdefault(doc_id, [])
-                else:
-                    segs = segs_by_doc.setdefault(doc_id, [])
+                segs = bucket.setdefault(doc_id, [])
                 for ln in lens[k:k + count]:
                     span = blob[pos:pos + ln]
-                    segs.append((tab_i, span) if v2 else span)
+                    segs.append((tab_i, span) if v >= 2 else span)
                     pos += ln
                 k += count
                 n_changes += count
         self._incoming_wire = []
-        if not segs_by_doc and not spans_by_doc:
+        if not segs_by_doc and not spans_by_doc and not spans3_by_doc:
             return {}
         self.metrics.bump('sync_changes_received', n_changes)
         out = {}
@@ -859,6 +953,18 @@ class WireConnection(BatchingConnection):
                 tabs, list(spans_by_doc.values()))
             out.update(self._apply_wire_isolated(
                 data, spans_by_doc, decode_v2))
+        if spans3_by_doc:
+            from .. import wire as _wire
+
+            def decode_v3(spans):
+                data_1 = _wire.build_columnar_container(
+                    tabs3, [spans], version=3)
+                return _wire.columnar_container_to_changes(data_1)[0]
+
+            data = _wire.build_columnar_container(
+                tabs3, list(spans3_by_doc.values()), version=3)
+            out.update(self._apply_wire_isolated(
+                data, spans3_by_doc, decode_v3))
         retry = getattr(self._doc_set, 'retry_quarantined', None)
         if retry is not None:
             held = [d for d in out if d in self._doc_set.quarantined]
@@ -969,9 +1075,10 @@ class WireConnection(BatchingConnection):
     def _flush_outgoing_traced(self):
         pending = list(self._pending_send)
         self._pending_send.clear()
-        # the negotiated DATA format for this peer: v2 columnar once
-        # the peer has advertised maxv >= 2, v1 JSON spans until then
-        # (and forever, against a v1-only peer)
+        # the negotiated DATA format for this peer: min(ours, their
+        # advertised maxv) — v3 session columnar between two v3 ends,
+        # v2 per-message columnar against a v2 peer, v1 JSON spans
+        # until a peer advertises at all (and forever against v1)
         version = min(self.wire_version, self._peer_wire_version)
         # serving doc sets fault evicted docs back in before the serve
         # (a sync touch); docs the peer's clock already covers stay
@@ -1081,7 +1188,37 @@ class WireConnection(BatchingConnection):
         # format, exactly the envelope-v pattern; `maxv` rides every
         # message a v2-capable sender ships, which is the whole
         # negotiation.
-        if chunks and version >= 2:
+        tab_hits = tab_misses = 0
+        if chunks and version >= 3:
+            from .. import wire as _wire
+            table = self._tx_table
+            if table is None:
+                table = self._tx_table = _wire.SessionStringTable()
+                register = getattr(self._doc_set.store,
+                                   'register_wire_session', None)
+                if register is not None:
+                    register(table)
+            h0, m0, e0 = table.hits, table.misses, table.evictions
+            spans, tab, _used = _wire.assemble_session_spans(
+                chunks, table)
+            tab_hits, tab_misses = table.hits - h0, table.misses - m0
+            if table.evictions != e0:
+                self.metrics.bump('sync_wire_table_evictions',
+                                  table.evictions - e0)
+            lens = [len(s) for s in spans]
+            blob = b''.join(spans)
+            msg = {'wire': 3, 'sid': table.sid, 'docs': docs,
+                   'clocks': clocks, 'counts': counts, 'lens': lens,
+                   'blob': blob, 'tab': tab}
+            self.metrics.bump('sync_wire_v3_msgs_sent')
+            self.metrics.bump('sync_wire_table_hits', tab_hits)
+            self.metrics.bump('sync_wire_table_misses', tab_misses)
+            self.metrics.set_gauge('sync_wire_table_entries',
+                                   len(table))
+            self.metrics.set_gauge('sync_wire_table_bytes',
+                                   table.bytes)
+            payload_bytes = len(blob) + len(tab)
+        elif chunks and version >= 2:
             from .. import wire as _wire
             spans, tab = _wire.assemble_columnar_spans(chunks)
             lens = [len(s) for s in spans]
@@ -1107,5 +1244,7 @@ class WireConnection(BatchingConnection):
         if self.metrics.active:
             self.metrics.emit('sync_wire_send', docs=len(docs),
                               changes=len(lens), v=msg['wire'],
-                              blob_bytes=payload_bytes)
+                              blob_bytes=payload_bytes,
+                              tab_hits=tab_hits,
+                              tab_misses=tab_misses)
         self._send_msg(msg)

@@ -172,6 +172,12 @@ class GeneralDocSet:
         # can report per-CONNECTION backpressure/admission state
         # instead of only process-wide counters
         self.connections = {}
+        # wire-v3 session records, one per peer id: {'acked': doc_id ->
+        # clock}, written live by the registered ResilientConnection.
+        # A NEW connection to a known peer resumes its record — the
+        # O(divergence) reconnect seed; a replaced doc set starts empty
+        # (crash recovery: nothing to resume against)
+        self.wire_sessions = {}
         # vectorized twin of the view cache's versions: _view_ver[i]
         # is the applied version the cached view of doc i was built at
         # (-1 = no view) — fleet_status() derives the dirty TOTAL from
@@ -1014,23 +1020,27 @@ class GeneralDocSet:
         """Batched admission straight from WIRE BYTES: either the JSON
         text of per-document change lists (``[[change, ...], ...]``,
         native codec with key kinds resolved against this store's
-        object table) or a columnar v2 container (``AMW2`` magic —
-        varint op columns + shared literal tables, parsed with ZERO
-        JSON anywhere), then the native stager inside one fused apply
+        object table) or a columnar v2/v3 container (``AMW2``/``AMW3``
+        magic — varint op columns + shared literal tables, v3 with RLE
+        action/obj columns, parsed with ZERO JSON anywhere), then the
+        native stager inside one fused apply
         — no per-op Python on the whole path. ``doc_ids`` names the
         documents the arrays correspond to (defaults to positional
         ``doc-<i>`` ids, created on first touch). Falls back to the
         pure-Python edges when the codec library is unavailable.
 
         Returns the list of touched :class:`GeneralDocHandle`."""
-        from ..wire import (COLUMNAR_MAGIC, parse_columnar_block,
-                            parse_general_block)
+        from ..wire import (COLUMNAR_MAGIC, COLUMNAR_MAGIC_V3,
+                            parse_columnar_block, parse_general_block)
         from ..device.blocks import ChangeBlock
         t0 = _time.perf_counter()
-        columnar = isinstance(data, (bytes, bytearray, memoryview)) \
-            and bytes(data[:4]) == COLUMNAR_MAGIC
-        with _metrics.trace_span('wire.parse', n_bytes=len(data),
-                                 v=2 if columnar else 1):
+        head = bytes(data[:4]) \
+            if isinstance(data, (bytes, bytearray, memoryview)) else b''
+        columnar = head in (COLUMNAR_MAGIC, COLUMNAR_MAGIC_V3)
+        with _metrics.trace_span(
+                'wire.parse', n_bytes=len(data),
+                v=3 if head == COLUMNAR_MAGIC_V3
+                else 2 if columnar else 1):
             if columnar:
                 block = parse_columnar_block(data)
             else:

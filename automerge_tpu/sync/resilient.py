@@ -287,7 +287,7 @@ class ResilientConnection:
                  jitter=2, heartbeat_every=16, seed=0,
                  admission=None, shared_admission=None,
                  max_msg_bytes=None, peer_id=None, scope=None,
-                 hb_digests=True, wire_version=None):
+                 hb_digests=True, wire_version=None, resume=True):
         self._send_raw = send_msg
         if wire:
             kwargs = {} if wire_version is None \
@@ -357,6 +357,29 @@ class ResilientConnection:
         # received, and the doc set's convergence watermark is the
         # minimum clock EVERY live peer has acked
         self._peer_acked = {}          # doc_id -> {actor: seq}
+        # O(divergence) reconnect (wire v3): the doc set keeps one
+        # wire-session record per peer id ({'acked': doc_id -> clock},
+        # written live — _peer_acked IS the record's dict). A NEW
+        # connection to a known peer over the SAME doc set resumes the
+        # record: both clock maps of the inner connection seed from the
+        # peer's acked clocks, so the first flush serves exactly the
+        # clock-diffed divergence window (one v3 message under a fresh
+        # table epoch) instead of a full-history re-advertise cycle.
+        # resume=False (or a replaced doc set, whose registry is empty)
+        # starts clean — the crash-recovery posture.
+        if wire and peer_id is not None:
+            sessions = getattr(doc_set, 'wire_sessions', None)
+            if sessions is not None:
+                rec = sessions.get(peer_id) if resume else None
+                if rec is not None:
+                    self._peer_acked = rec['acked']
+                    for doc_id, clock in self._peer_acked.items():
+                        self._conn._their_clock[doc_id] = dict(clock)
+                        self._conn._our_clock[doc_id] = dict(clock)
+                    self.metrics.bump('sync_wire_session_resumes')
+                else:
+                    sessions[peer_id] = {'acked': self._peer_acked}
+                    self.metrics.bump('sync_wire_session_resets')
         # heartbeats advertise per-doc state digests when the doc set
         # maintains them (divergence audit); hb_digests=False pins the
         # v1 heartbeat shape
@@ -496,6 +519,11 @@ class ResilientConnection:
         absent) carry no data, so their loss needs no rollback."""
         if not isinstance(payload, dict):
             return
+        # wire v3: unpin the dead payload's session refs so table
+        # eviction can reclaim them (its defs stay unconfirmed)
+        hook = getattr(self._conn, 'note_wire_dead', None)
+        if hook is not None:
+            hook(payload)
         their = self._conn._their_clock
         if 'state' in payload and 'docs' in payload:
             # every span of a state-bootstrap message is data
@@ -619,7 +647,13 @@ class ResilientConnection:
             if rec is not None:
                 # the peer confirmed this envelope: the payload clock
                 # it carried is now ACKED — the lag/convergence signal
-                self._fold_acked(rec.envelope.get('payload'))
+                payload = rec.envelope.get('payload')
+                self._fold_acked(payload)
+                # wire v3: the session-table defs this payload carried
+                # are now peer-confirmed (bare refs from here on)
+                hook = getattr(self._conn, 'note_wire_acked', None)
+                if hook is not None:
+                    hook(payload)
             return None
         if kind == 'busy':
             return self._receive_busy(env)
@@ -809,10 +843,32 @@ class ResilientConnection:
         self.metrics.bump('sync_heartbeats_received')
         doc_set = self._conn._doc_set
         # a heartbeat is the peer's authoritative state advert: every
-        # clock it carries is ACKED (the lag/convergence signal)
+        # clock it carries is ACKED (the lag/convergence signal).
+        # REGRESSION heal first: an advertised clock strictly BELOW
+        # the recorded acked clock means the peer lost state (a crash
+        # restart without the session record — a resumed session can
+        # only advance). The recorded floor is a lie now: reset both
+        # the acked record and the serve-side their-clock DOWN to what
+        # the peer actually advertises and mark the doc pending, so
+        # the next flush re-serves the lost tail. Gate on an EMPTY
+        # unacked map: while envelopes are in flight (including
+        # busy-deferred redeliveries) an advert legitimately trails
+        # the acked floor — in batching mode an ack means BUFFERED,
+        # and a causal gap parks later changes until the missing
+        # envelope redelivers. Only when the retransmit layer has
+        # nothing outstanding is a persisting regression proof of
+        # lost peer state rather than repair still in progress.
         for doc_id, clock in clocks.items():
-            if isinstance(clock, dict):
-                clock_union(self._peer_acked, doc_id, clock)
+            if not isinstance(clock, dict):
+                continue
+            acked = self._peer_acked.get(doc_id)
+            if acked and not self._sent \
+                    and any(clock.get(a, 0) < s
+                            for a, s in acked.items()):
+                self._peer_acked[doc_id] = dict(clock)
+                self._conn._their_clock[doc_id] = dict(clock)
+                self._conn.maybe_send_changes(doc_id)
+            clock_union(self._peer_acked, doc_id, clock)
         self._note_acked(list(clocks))
         if digests:
             self._audit_digests(clocks, digests)
@@ -1035,8 +1091,25 @@ class ResilientConnection:
                     out[label] = max(0, -bucket.tokens)
             return out
 
+        # per-link wire surface: negotiated format version (min of both
+        # ends' maxv, 0 on non-wire links) and the v3 session-table
+        # pressure — what an operator reads to see which links talk v3
+        # and how big their tables run
+        wire_version = 0
+        table_entries = table_bytes = 0
+        ours = getattr(self._conn, 'wire_version', None)
+        if ours is not None:
+            wire_version = min(ours,
+                               self._conn._peer_wire_version)
+            table = getattr(self._conn, '_tx_table', None)
+            if table is not None:
+                table_entries = len(table)
+                table_bytes = table.bytes
         return {
             'peer': self.peer_id,
+            'wire_version': wire_version,
+            'table_entries': table_entries,
+            'table_bytes': table_bytes,
             'in_flight': len(self._sent),
             'backpressure_depth': self.backpressure_depth,
             'busy_sent': scoped.get('sync_busy_sent', 0),

@@ -125,12 +125,32 @@ SERVING_COUNTERS = (
 #                                    apply latency (dict + wire paths)
 #   sync_flush_ms                    observe series: connection flush
 #                                    latency (apply + outgoing send)
+#   sync_wire_v3_msgs_*              v3 (session-table) data messages
+#   sync_wire_table_entries          gauge: sender session-table size
+#   sync_wire_table_bytes            gauge: sender session-table bytes
+#   sync_wire_table_hits             literal occurrences sent as BARE
+#                                    session refs (acked entries)
+#   sync_wire_table_misses           occurrences that still shipped a
+#                                    def (new or not-yet-acked)
+#   sync_wire_table_evictions        LRU ref recyclings under budget
+#   sync_wire_table_stale_refs       receive-side unknown session ref
+#                                    (table state lost) — the envelope
+#                                    goes unacked and retransmit
+#                                    repairs it
+#   sync_wire_session_resumes        reconnects that resumed a peer's
+#                                    recorded session (O(divergence))
+#   sync_wire_session_resets         sessions started/reset clean
 SYNC_COUNTERS = (
     'sync_msgs_sent', 'sync_msgs_received',
     'sync_changes_sent', 'sync_changes_received',
     'sync_snapshots_sent', 'sync_snapshots_received',
     'sync_wire_msgs_sent', 'sync_wire_msgs_received',
     'sync_wire_v2_msgs_sent', 'sync_wire_v2_msgs_received',
+    'sync_wire_v3_msgs_sent', 'sync_wire_v3_msgs_received',
+    'sync_wire_table_entries', 'sync_wire_table_bytes',
+    'sync_wire_table_hits', 'sync_wire_table_misses',
+    'sync_wire_table_evictions', 'sync_wire_table_stale_refs',
+    'sync_wire_session_resumes', 'sync_wire_session_resets',
     'sync_wire_bytes_sent', 'sync_wire_parse_ms',
     'sync_apply_ms', 'sync_flush_ms')
 

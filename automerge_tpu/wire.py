@@ -507,6 +507,15 @@ parseGeneralBlock = parse_general_block
 import struct as _struct
 
 COLUMNAR_MAGIC = b'AMW2'
+# v3 containers: same framing as AMW2, two column changes inside the
+# change body — the action|key_kind byte column and the obj column are
+# run-length encoded (see _emit_columnar_v3_py). The heavy literal
+# dedup moved up a layer: v3 MESSAGES reference a per-connection
+# session string table instead of re-shipping a per-message tab
+# (SessionStringTable below), but by the time spans stitch into this
+# container the receiver has already resolved session refs back to
+# message-local form, so the container stays self-contained.
+COLUMNAR_MAGIC_V3 = b'AMW3'
 
 # literal tags (match native/wire_codec.cpp)
 _TAG_STR, _TAG_INT, _TAG_FLOAT = 0, 1, 2
@@ -741,6 +750,111 @@ def _emit_columnar_py(block, c):
     return bytes(o), refs
 
 
+def _emit_columnar_v3_py(block, c):
+    """One change row's v3 ``(body, refs)`` — keep step-identical with
+    amwe_emit_columnar_v3. Same two-pass ref walk and varint columns as
+    v2 except two columns run-length encode:
+
+    - action column: ``{(key_kind<<4 | action) byte, uvarint extra}``
+      pairs, each covering ``extra+1`` consecutive ops (a run of list
+      inserts costs 2 bytes total, not 1 byte per op);
+    - obj column: ``{svarint delta(obj_local), uvarint extra}`` runs —
+      the delta base carries across runs exactly like v2's per-op
+      deltas, so a single-object change costs 2 bytes.
+
+    The greedy maximal-run choice is deterministic, which is what makes
+    the Python and native emitters byte-identical by construction."""
+    seen = {}
+    refs = []
+
+    def local(kind, idx):
+        k = (kind << 32) | int(idx)
+        i = seen.get(k)
+        if i is None:
+            i = seen[k] = len(refs)
+            refs.append(k)
+        return i
+
+    action, obj, key_kind, key = block.action, block.obj, \
+        block.key_kind, block.key
+    ops = range(block.op_ptr[c], block.op_ptr[c + 1])
+    # pass 1: canonical ref order — IDENTICAL to v2 (the session table
+    # upstairs dedups by content, so ref order only needs determinism)
+    local(_REF_ACTOR, block.actor[c])
+    for j in range(block.dep_ptr[c], block.dep_ptr[c + 1]):
+        local(_REF_ACTOR, block.dep_actor[j])
+    for j in ops:
+        a = int(action[j])
+        local(_REF_OBJ, obj[j])
+        kk = int(key_kind[j])
+        if kk == _KEY_STR:
+            local(_REF_KEY, key[j])
+        elif kk == _KEY_ELEM:
+            local(_REF_ACTOR, key[j])
+        if a in (_SET, _LINK) and block.value[j] >= 0:
+            local(_REF_VAL, block.value[j])
+    # pass 2: body columns
+    o = bytearray()
+    _uv(o, int(block.seq[c]))
+    _uv(o, int(block.dep_ptr[c + 1] - block.dep_ptr[c]))
+    for j in range(block.dep_ptr[c], block.dep_ptr[c + 1]):
+        _uv(o, local(_REF_ACTOR, block.dep_actor[j]))
+        _uv(o, int(block.dep_seq[j]))
+    _uv(o, len(ops))
+    run_b, run_n = -1, 0
+    for j in ops:
+        b = (int(key_kind[j]) << 4) | int(action[j])
+        if b == run_b:
+            run_n += 1
+            continue
+        if run_n:
+            o.append(run_b)
+            _uv(o, run_n - 1)
+        run_b, run_n = b, 1
+    if run_n:
+        o.append(run_b)
+        _uv(o, run_n - 1)
+    prev = 0
+    run_v, run_n = -1, 0
+    for j in ops:
+        lo = local(_REF_OBJ, obj[j])
+        if lo == run_v and run_n:
+            run_n += 1
+            continue
+        if run_n:
+            _sv(o, run_v - prev)
+            _uv(o, run_n - 1)
+            prev = run_v
+        run_v, run_n = lo, 1
+    if run_n:
+        _sv(o, run_v - prev)
+        _uv(o, run_n - 1)
+    prev_e = 0
+    for j in ops:
+        kk = int(key_kind[j])
+        if kk == _KEY_STR:
+            _uv(o, local(_REF_KEY, key[j]))
+        elif kk == _KEY_ELEM:
+            _uv(o, local(_REF_ACTOR, key[j]))
+            ke = int(block.key_elem[j])
+            _sv(o, ke - prev_e)
+            prev_e = ke
+    prev_i = 0
+    for j in ops:
+        if int(action[j]) != _INS:
+            continue
+        el = int(block.elem[j])
+        _sv(o, el - prev_i)
+        prev_i = el
+    for j in ops:
+        a = int(action[j])
+        if a not in (_SET, _LINK):
+            continue
+        vrow = int(block.value[j])
+        _uv(o, local(_REF_VAL, vrow) + 1 if vrow >= 0 else 0)
+    return bytes(o), refs
+
+
 def _refs_to_lits(refs, tagged, vlits):
     """Map one change's global ref list to its literal byte tuple."""
     a_t, k_t, o_t = tagged
@@ -785,6 +899,34 @@ def encode_change_rows_columnar(block, rows):
             for body, refs in emitted]
 
 
+def encode_change_rows_columnar_v3(block, rows):
+    """The v3 twin of :func:`encode_change_rows_columnar`: RLE
+    action/obj columns, same ``(body, lits)`` contract — the session
+    layer (not the message layer) dedups the literals per CONNECTION.
+    Native ``amwe_emit_columnar_v3`` when available, byte-identical
+    Python fallback otherwise; ``_NATIVE_COLUMNAR = True`` raises
+    instead of falling back (the CI forced-native lane)."""
+    if not block.is_general():
+        raise TypeError('columnar v3 encodes general blocks only')
+    rows_arr = np.asarray([int(r) for r in rows], np.int64)
+    tagged = _block_tagged_lits(block)
+    sel, use, v = _op_selection(block, rows_arr)
+    vlits = _tagged_value_lits(block, use, v)
+    emitted = None
+    if _NATIVE_COLUMNAR is not False:
+        from . import native as _native
+        emitted = _native.emit_columnar_rows_v3(block, rows_arr)
+        if emitted is None and _NATIVE_COLUMNAR is True:
+            raise RuntimeError(
+                'native columnar codec forced (_NATIVE_COLUMNAR=True) '
+                'but the library is unavailable')
+    if emitted is None:
+        emitted = [_emit_columnar_v3_py(block, c)
+                   for c in rows_arr.tolist()]
+    return [(body, _refs_to_lits(refs, tagged, vlits))
+            for body, refs in emitted]
+
+
 def assemble_columnar_spans(entries):
     """Assemble cached ``(body, lits)`` entries into one message:
     returns ``(spans, tab)`` — per-change span bytes (remap + body)
@@ -816,13 +958,15 @@ def assemble_columnar_spans(entries):
     return spans, bytes(t)
 
 
-def build_columnar_container(tabs, spans_by_doc):
+def build_columnar_container(tabs, spans_by_doc, version=2):
     """Stitch one receive tick's worth of v2 messages into the single
     container ``parse_columnar_block`` consumes: ``tabs`` is the
     message literal tables, ``spans_by_doc`` one list of
     ``(tab_idx, span)`` per document (container doc order = the
-    caller's doc_ids order)."""
-    out = bytearray(COLUMNAR_MAGIC)
+    caller's doc_ids order). ``version=3`` stamps the ``AMW3`` magic —
+    same framing, RLE change bodies inside."""
+    out = bytearray(COLUMNAR_MAGIC_V3 if version >= 3
+                    else COLUMNAR_MAGIC)
     _uv(out, len(tabs))
     for tab in tabs:
         _uv(out, len(tab))
@@ -840,10 +984,13 @@ def build_columnar_container(tabs, spans_by_doc):
 def _parse_columnar_py(data):
     """Pure-Python columnar container parse -> general ChangeBlock
     (the fallback twin of amst_parse_columnar: same bounds checks, same
-    column conventions, TaggedValues for the lazy value spans)."""
+    column conventions, TaggedValues for the lazy value spans).
+    Dispatches on the magic: ``AMW2`` per-op action/obj columns,
+    ``AMW3`` the RLE pairs — everything else is shared."""
     from .device.blocks import TaggedValues
     r = _ColReader(data)
-    if len(data) < 4 or data[:4] != COLUMNAR_MAGIC:
+    v3 = len(data) >= 4 and data[:4] == COLUMNAR_MAGIC_V3
+    if len(data) < 4 or (not v3 and data[:4] != COLUMNAR_MAGIC):
         r.fail('bad columnar magic')
     r.pos = 4
     n_tabs = r.uv()
@@ -938,7 +1085,7 @@ def _parse_columnar_py(data):
             if n_ops > nbytes:
                 s.fail('op count exceeds span')
             acts, kinds = [], []
-            for _ in range(n_ops):
+            while len(acts) < n_ops:
                 if s.pos >= s.end:
                     s.fail('truncated action column')
                 b = data[s.pos]
@@ -946,17 +1093,30 @@ def _parse_columnar_py(data):
                 a, kk = b & 0x0F, b >> 4
                 if a > 6 or kk > _KEY_NONE:
                     s.fail('bad action/kind byte')
-                acts.append(a)
-                kinds.append(kk)
+                n = 1
+                if v3:
+                    n = s.uv() + 1
+                    if len(acts) + n > n_ops:
+                        s.fail('action run overflows op count')
+                acts.extend([a] * n)
+                kinds.extend([kk] * n)
             action.extend(acts)
             key_kind.extend(kinds)
             prev_o = 0
-            for i in range(n_ops):
+            filled_o = 0
+            while filled_o < n_ops:
                 prev_o += s.sv()
                 if not 0 <= prev_o < n_lits:
                     s.fail('obj literal out of range')
-                obj_col.append(intern_str(tab, locals_[prev_o], objs,
-                                          obj_of, 'o'))
+                n = 1
+                if v3:
+                    n = s.uv() + 1
+                    if filled_o + n > n_ops:
+                        s.fail('obj run overflows op count')
+                oid = intern_str(tab, locals_[prev_o], objs,
+                                 obj_of, 'o')
+                obj_col.extend([oid] * n)
+                filled_o += n
             prev_e = 0
             for i in range(n_ops):
                 kk = kinds[i]
@@ -1031,9 +1191,10 @@ def _parse_columnar_py(data):
 
 
 def parse_columnar_block(data):
-    """Parse a columnar v2 container into a general
+    """Parse a columnar v2/v3 container into a general
     :class:`~automerge_tpu.device.blocks.ChangeBlock` — the JSON-free
-    receive edge (native ``amst_parse_columnar`` when available;
+    receive edge (native ``amst_parse_columnar`` /
+    ``amst_parse_columnar_v3`` when available, dispatched on the magic;
     ``_NATIVE_COLUMNAR = True`` raises instead of falling back). No
     store is consulted: key kinds ship explicitly in the format."""
     if isinstance(data, (bytearray, memoryview)):
@@ -1043,7 +1204,10 @@ def parse_columnar_block(data):
         lib = _native.columnar_lib()
         if lib is not None:
             from .device.blocks import TaggedValues
-            h = lib.amst_parse_columnar(data, len(data))
+            parse = lib.amst_parse_columnar_v3 \
+                if data[:4] == COLUMNAR_MAGIC_V3 \
+                else lib.amst_parse_columnar
+            h = parse(data, len(data))
             if not h:
                 raise MemoryError('columnar codec allocation failed')
             try:
@@ -1059,10 +1223,286 @@ def parse_columnar_block(data):
 
 
 def columnar_container_to_changes(data):
-    """Decode a v2 container back to per-document dict change lists —
-    the quarantine-isolation and journal-replay fallback (NOT the hot
-    path; the fused apply consumes the block directly)."""
+    """Decode a v2/v3 container back to per-document dict change lists
+    — the quarantine-isolation and journal-replay fallback (NOT the
+    hot path; the fused apply consumes the block directly)."""
     return parse_columnar_block(data).to_changes()
+
+
+# ---------------------------------------------------------------------------
+# Wire v3 session string tables.
+#
+# v2 dedups literals per MESSAGE: every warm tick re-ships the same
+# actor uuids and hot keys in its `tab`. v3 moves the table up to the
+# CONNECTION: the sender keeps a session-scoped string table (epoch
+# `sid` + a next-ref watermark), v3 spans reference literals by
+# session-wide varint ref, and each message carries only the DEFS the
+# session has not confirmed yet. The protocol is QPACK-shaped
+# (acked-only bare references) so it survives loss, reordering and
+# duplication without any extra round trips:
+#
+#   - a literal ships as a `(ref, lit)` def in every message that uses
+#     it until one of those messages is ACKED; only then do later
+#     messages reference it bare. Defs install idempotently, so any
+#     single message is decodable from acked state alone — dup and
+#     out-of-order delivery are harmless, and retransmits re-ship the
+#     stored envelope verbatim (checksum/trace machinery untouched).
+#   - ref ids recycle under an LRU byte budget, but only refs that are
+#     ACKED with ZERO in-flight (pending) uses: every envelope that
+#     references a ref holds a pending count until it acks or dies, so
+#     a recycled ref can never be resolved against a stale definition
+#     by a conforming receiver (which resolves at RECEIVE time, in
+#     arrival order, before acking).
+#   - the receiver keys its ref maps by `sid`; a fresh connection mints
+#     a fresh epoch, so reconnects never alias a dead session's refs.
+#
+# An unknown ref at the receiver (possible only after losing table
+# state, e.g. a peer restarting mid-session) raises plain ValueError —
+# the envelope is NOT acked, and the sender's retransmit/exhaustion/
+# heartbeat machinery repairs it like any other delivery failure,
+# never via quarantine.
+
+import heapq as _heapq
+import itertools as _itertools
+
+_session_ids = _itertools.count(1)
+
+# accounting overhead per table entry (the list cell + two dict slots);
+# keeps the byte gauge honest for many tiny literals
+_TABLE_ENTRY_OVERHEAD = 64
+
+
+class SessionStringTable:
+    """Sender-side wire-v3 session string table: content -> session
+    ref, with QPACK-style acked/pending bookkeeping and LRU ref
+    recycling under ``max_bytes``. One per WireConnection; the `sid`
+    epoch stamps every outgoing v3 message."""
+
+    __slots__ = ('sid', 'max_bytes', 'entries', 'by_ref', 'next_ref',
+                 'free_refs', 'bytes', 'hits', 'misses', 'evictions',
+                 '_clock', '__weakref__')
+
+    # entries[lit] = [ref, acked, pending, last_use]
+    _REF, _ACKED, _PENDING, _LAST_USE = 0, 1, 2, 3
+
+    def __init__(self, max_bytes=1 << 20):
+        self.sid = next(_session_ids)
+        self.max_bytes = max_bytes
+        self.entries = {}
+        self.by_ref = {}
+        self.next_ref = 0
+        self.free_refs = []
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._clock = 0
+
+    def __len__(self):
+        return len(self.entries)
+
+    def reset(self):
+        """Tear the session down and mint a FRESH epoch: every entry
+        drops and the next message goes out under a new ``sid``, so
+        the peer simply starts a new rx table and re-learns defs —
+        always safe (in-flight envelopes of the old sid still decode
+        against the peer's retained old epoch, and their acks no-op
+        against the new sid)."""
+        self.sid = next(_session_ids)
+        self.entries.clear()
+        self.by_ref.clear()
+        self.next_ref = 0
+        self.free_refs = []
+        self.bytes = 0
+
+    def intern(self, lit):
+        """``(ref, needs_def)`` for one literal. ``needs_def`` until a
+        message defining it is acked — hit/miss counters measure
+        exactly the bare-reference savings."""
+        self._clock += 1
+        e = self.entries.get(lit)
+        if e is not None:
+            e[3] = self._clock
+            if e[1]:
+                self.hits += 1
+                return e[0], False
+            self.misses += 1
+            return e[0], True
+        if self.free_refs:
+            ref = _heapq.heappop(self.free_refs)
+        else:
+            ref = self.next_ref
+            self.next_ref += 1
+        self.entries[lit] = [ref, False, 0, self._clock]
+        self.by_ref[ref] = lit
+        self.bytes += len(lit) + _TABLE_ENTRY_OVERHEAD
+        self.misses += 1
+        return ref, True
+
+    def note_pending(self, refs):
+        """One in-flight envelope now references ``refs`` (distinct
+        per message): pin them against recycling until it acks or
+        dies."""
+        for ref in refs:
+            lit = self.by_ref.get(ref)
+            if lit is not None:
+                self.entries[lit][2] += 1
+
+    def note_acked(self, def_refs, used_refs):
+        """An envelope acked: its defs are session-confirmed (bare
+        references allowed from now on) and its uses unpinned."""
+        for ref in def_refs:
+            lit = self.by_ref.get(ref)
+            if lit is not None:
+                self.entries[lit][1] = True
+        self._unpin(used_refs)
+
+    def note_dead(self, used_refs):
+        """An envelope died permanently (retry budget exhausted):
+        unpin its uses — its defs were never confirmed, so the
+        literals stay in needs_def state and re-define on next use."""
+        self._unpin(used_refs)
+
+    def _unpin(self, refs):
+        for ref in refs:
+            lit = self.by_ref.get(ref)
+            if lit is not None:
+                e = self.entries[lit]
+                if e[2] > 0:
+                    e[2] -= 1
+
+    def evict_to_budget(self):
+        """LRU-recycle refs past the byte budget. Only entries with no
+        in-flight use are eligible (acked or not — an unacked entry
+        was never referenced bare, so dropping it is always safe); a
+        freed ref id returns to the allocation pool and its next
+        definition overwrites it receiver-side."""
+        if self.bytes <= self.max_bytes:
+            return
+        victims = sorted((e[3], lit)
+                         for lit, e in self.entries.items() if not e[2])
+        for _, lit in victims:
+            if self.bytes <= self.max_bytes:
+                break
+            ref = self.entries.pop(lit)[0]
+            del self.by_ref[ref]
+            _heapq.heappush(self.free_refs, ref)
+            self.bytes -= len(lit) + _TABLE_ENTRY_OVERHEAD
+            self.evictions += 1
+
+
+def encode_session_defs(defs):
+    """``[(ref, lit)]`` -> the v3 message ``tab`` bytes:
+    ``uvarint n_defs { uvarint ref  uvarint len  lit }*``."""
+    t = bytearray()
+    _uv(t, len(defs))
+    for ref, lit in defs:
+        _uv(t, ref)
+        _uv(t, len(lit))
+        t += lit
+    return bytes(t)
+
+
+def decode_session_defs(tab):
+    """The v3 ``tab`` bytes -> ``[(ref, lit)]`` (bounds-checked; a
+    corrupt tab raises ValueError and the envelope layer repairs by
+    retransmit)."""
+    tab = bytes(tab)
+    t = _ColReader(tab)
+    n = t.uv()
+    if n > len(tab):
+        t.fail('session def count exceeds tab')
+    out = []
+    for _ in range(n):
+        ref = t.uv()
+        llen = t.uv()
+        if llen == 0 or llen > t.end - t.pos:
+            t.fail('bad session def literal length')
+        out.append((ref, tab[t.pos:t.pos + llen]))
+        t.pos += llen
+    if t.pos != t.end:
+        t.fail('trailing bytes in session tab')
+    return out
+
+
+def assemble_session_spans(entries, table):
+    """The v3 message assembly: cached ``(body, lits)`` entries against
+    the sender's session ``table``. Returns ``(spans, tab, used_refs)``
+    — spans are ``uvarint n_lits {svarint delta(session ref)}* body``
+    (the v2 span shape with session-wide refs instead of message-local
+    indices), ``tab`` the defs this message must carry. The caller pins
+    ``used_refs`` per envelope (``note_pending`` already called here)
+    and feeds acks/deaths back via ``note_acked``/``note_dead``."""
+    spans = []
+    new_defs = {}
+    used = set()
+    for body, lits in entries:
+        buf = bytearray()
+        _uv(buf, len(lits))
+        prev = 0
+        for lit in lits:
+            ref, needs_def = table.intern(lit)
+            if needs_def:
+                new_defs[ref] = lit
+            used.add(ref)
+            _sv(buf, ref - prev)
+            prev = ref
+        buf += body
+        spans.append(bytes(buf))
+    table.note_pending(used)
+    table.evict_to_budget()
+    return spans, encode_session_defs(sorted(new_defs.items())), used
+
+
+def decode_session_spans(blob, lens, refs):
+    """Resolve one v3 message's spans against the receiver's ref map:
+    returns ``[(body, lits)]`` in message-local form (the
+    :func:`assemble_columnar_spans` input shape — the receiver rewrites
+    the message into a self-contained per-message-tab form before
+    buffering). An unresolvable ref raises ValueError: the envelope is
+    not acked and the sender's retransmit repairs it."""
+    blob = bytes(blob)
+    entries = []
+    pos = 0
+    for ln in lens:
+        s = _ColReader(blob, pos=pos, end=pos + ln)
+        n_lits = s.uv()
+        if n_lits == 0 or n_lits > ln:
+            s.fail('bad session span literal count')
+        lits = []
+        prev = 0
+        for _ in range(n_lits):
+            prev += s.sv()
+            lit = refs.get(prev)
+            if lit is None:
+                raise ValueError(
+                    f'wire v3 session ref {prev} unknown (table state '
+                    f'lost?); dropping for retransmit repair')
+            lits.append(lit)
+        entries.append((blob[s.pos:pos + ln], tuple(lits)))
+        pos += ln
+    return entries
+
+
+def session_payload_refs(payload):
+    """Stateless re-derivation of ``(def_refs, used_refs)`` from a
+    STORED v3 wire payload (the sender's own envelope, so malformed
+    input is impossible in practice): re-parses the ``tab`` defs and
+    the span headers. The ack/death bookkeeping hooks use this so no
+    seq -> refs side table is needed."""
+    defs = decode_session_defs(payload['tab'])
+    blob = bytes(payload['blob'])
+    used = set()
+    pos = 0
+    for ln in payload['lens']:
+        s = _ColReader(blob, pos=pos, end=pos + ln)
+        n_lits = s.uv()
+        prev = 0
+        for _ in range(n_lits):
+            prev += s.sv()
+            used.add(prev)
+        pos += ln
+    return [ref for ref, _ in defs], used
 
 
 parseColumnarBlock = parse_columnar_block

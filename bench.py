@@ -1776,6 +1776,224 @@ def bench_wire_parse(n_docs=2048, gen_docs=1024, gen_list_ops=22):
     return len(data), block.n_ops, t_nat, t_py, col
 
 
+def _env_bytes(o):
+    """Transport-size proxy for an envelope: binary fields count
+    exactly (blob/tab/state dominate wire cost), scalars and structure
+    at flat JSON-ish rates — version-fair, so the v2/v3 and
+    resumed/cold ratios below are apples to apples."""
+    if isinstance(o, (bytes, bytearray)):
+        return len(o)
+    if isinstance(o, str):
+        return len(o) + 2
+    if o is None or isinstance(o, bool):
+        return 4
+    if isinstance(o, (int, float)):
+        return 8
+    if isinstance(o, dict):
+        return 2 + sum(_env_bytes(k) + _env_bytes(v) + 2
+                       for k, v in o.items())
+    if isinstance(o, (list, tuple)):
+        return 2 + sum(_env_bytes(v) + 1 for v in o)
+    return len(str(o))
+
+
+def bench_reconnect(n_docs=10000, divergent=200):
+    """Wire v3 O(divergence) reconnect + warm session-table
+    compression.
+
+    Reconnect lane: an ``n_docs`` fleet replicates over a peer-scoped
+    resilient v3 link, the peer disconnects, ``divergent`` docs
+    advance one change each, and the link re-establishes. The RESUMED
+    session (recorded acked clock) must serve exactly the divergence
+    window; the COLD baseline (``resume=False`` — fresh session
+    state, the pre-v3 behaviour) re-advertises the whole fleet.
+    ``reconnect_bytes_ratio`` = cold bytes / resumed bytes.
+
+    Compression lane: a config-5-shaped pair runs to acked steady
+    state on wire v2 and v3; the SAME warm update schedule then ticks
+    through both. ``wire_v3_compression_ratio`` = v2 warm payload
+    bytes / v3 warm payload bytes (blob + tab) — the session table's
+    actor-uuid/hot-key dedup plus the RLE columns."""
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.sync import ResilientConnection
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+
+    def pair(a, b, version=None, resume=True, record=None):
+        conns = {}
+
+        def send_to(name):
+            def send(env):
+                if record is not None:
+                    record.append(env)
+                conns[name].receive_msg(env)
+            return send
+
+        kw = {} if version is None else {'wire_version': version}
+        ca = ResilientConnection(a, send_to('b'), wire=True,
+                                 peer_id='b', resume=resume, **kw)
+        cb = ResilientConnection(b, send_to('a'), wire=True,
+                                 peer_id='a', resume=resume, **kw)
+        conns['a'], conns['b'] = ca, cb
+        return ca, cb
+
+    def drive(ca, cb, rounds):
+        for _ in range(rounds):
+            ca.flush()
+            cb.flush()
+            ca.tick()
+            cb.tick()
+
+    # -- reconnect lane ------------------------------------------------------
+    a = GeneralDocSet(n_docs)
+    b = GeneralDocSet(n_docs)
+    batch = {}
+    for i in range(n_docs):
+        batch[f'doc{i}'] = [
+            {'actor': f'ac-{i:08d}', 'seq': 1, 'deps': {},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                      'value': i}]}]
+    a.apply_changes_batch(batch)
+    ca, cb = pair(a, b)
+    ca.open()
+    cb.open()
+    drive(ca, cb, 12)
+    assert len(b.doc_ids) == n_docs, 'initial replication incomplete'
+    ca.close()
+    cb.close()
+
+    adv = {}
+    for i in range(divergent):
+        adv[f'doc{i}'] = [
+            {'actor': f'ac-{i:08d}', 'seq': 2,
+             'deps': {f'ac-{i:08d}': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                      'value': n_docs + i}]}]
+    a.apply_changes_batch(adv)
+
+    resumed = []
+    t0 = time.perf_counter()
+    ca, cb = pair(a, b, record=resumed)
+    ca.open()
+    cb.open()
+    drive(ca, cb, 12)
+    reconnect_ms = (time.perf_counter() - t0) * 1e3
+    ca.close()
+    cb.close()
+    assert b.materialize('doc0') == {'k': n_docs}
+    reconnect_bytes = sum(_env_bytes(e) for e in resumed)
+
+    # cold baseline: same divergence, session state torn down
+    b2 = GeneralDocSet(n_docs)
+    ca, cb = pair(a, b2, resume=False)
+    ca.open()
+    cb.open()
+    drive(ca, cb, 6)
+    ca.close()
+    cb.close()
+    cold = []
+    ca, cb = pair(a, b2, resume=False, record=cold)
+    ca.open()
+    cb.open()
+    drive(ca, cb, 12)
+    ca.close()
+    cb.close()
+    cold_bytes = sum(_env_bytes(e) for e in cold)
+
+    log(f'reconnect[{n_docs} docs, {divergent} divergent]: resumed '
+        f'{reconnect_bytes / 1e3:.1f} KB in {reconnect_ms:.1f} ms '
+        f'({reconnect_bytes / max(divergent, 1):.0f} B/change); cold '
+        f're-establish {cold_bytes / 1e3:.1f} KB -> ratio '
+        f'{cold_bytes / max(reconnect_bytes, 1):.1f}x')
+
+    # -- warm compression lane ----------------------------------------------
+    def warm_payload_bytes(version):
+        # uuid-length hex actors, as real automerge peers mint: the
+        # session table's whole job is to stop re-shipping these
+        src = GeneralDocSet(256)
+        actors = [f'{d:032x}' for d in range(64)]
+        src.apply_changes_batch(
+            {f'doc{d}': [
+                {'actor': actors[d], 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'meta', 'value': d}]}]
+             for d in range(64)})
+        dst = GeneralDocSet(256)
+        wire_msgs = []
+
+        def tap(env):
+            p = env.get('payload') if isinstance(env, dict) else None
+            if isinstance(p, dict) and p.get('wire') and \
+                    sum(p.get('counts', ())):
+                wire_msgs.append(len(p['blob']) + len(p['tab'])
+                                 if 'tab' in p else len(p['blob']))
+
+        conns = {}
+        ca = ResilientConnection(
+            src, lambda env: tap(env) or
+            conns['b'].receive_msg(env),
+            wire=True, peer_id='b', wire_version=version)
+        cb = ResilientConnection(
+            dst, lambda env: conns['a'].receive_msg(env),
+            wire=True, peer_id='a', wire_version=version)
+        conns['a'], conns['b'] = ca, cb
+        ca.open()
+        cb.open()
+        drive(ca, cb, 10)              # cold sync + acks: tables warm
+        wire_msgs.clear()
+        for r in range(2, 10):         # warm steady state: same actors
+            upd = {}
+            for d in range(64):
+                upd[f'doc{d}'] = [
+                    {'actor': actors[d], 'seq': r,
+                     'deps': {actors[d]: r - 1},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': 'meta', 'value': r * 100 + d}]}]
+            src.apply_changes_batch(upd)
+            drive(ca, cb, 3)
+        ca.close()
+        cb.close()
+        return sum(wire_msgs)
+
+    v2_bytes = warm_payload_bytes(2)
+    v3_bytes = warm_payload_bytes(3)
+    ratio = v2_bytes / max(v3_bytes, 1)
+    log(f'wire-v3 warm compression: v2 {v2_bytes / 1e3:.1f} KB, v3 '
+        f'{v3_bytes / 1e3:.1f} KB -> {ratio:.2f}x')
+
+    return {
+        'reconnect_bytes': reconnect_bytes,
+        'reconnect_ms': reconnect_ms,
+        'reconnect_bytes_per_change':
+            reconnect_bytes / max(divergent, 1),
+        'reconnect_cold_bytes': cold_bytes,
+        'reconnect_bytes_ratio':
+            cold_bytes / max(reconnect_bytes, 1),
+        'wire_v3_warm_bytes': v3_bytes,
+        'wire_v2_warm_bytes': v2_bytes,
+        'wire_v3_compression_ratio': ratio,
+    }
+
+
+def reconnect_cli(argv):
+    """``python bench.py --reconnect [--smoke]``: the CI-gated wire-v3
+    lane (one JSON line; hardware-independent ratio bands in
+    PERF_BUDGETS.json). The smoke lane scales the fleet down; its
+    ratio keys carry their own (looser) bands under a
+    ``reconnect_smoke_`` prefix."""
+    smoke_lane = '--smoke' in argv
+    res = bench_reconnect(n_docs=1000 if smoke_lane else 10000,
+                          divergent=50 if smoke_lane else 200)
+    if smoke_lane:
+        res = {f'reconnect_smoke_{k}' if not k.startswith('reconnect')
+               else k.replace('reconnect_', 'reconnect_smoke_', 1): v
+               for k, v in res.items()}
+    print(json.dumps({
+        'bench': 'reconnect',
+        'reconnect_smoke': 1 if smoke_lane else 0,
+        **res,
+    }), flush=True)
+
+
 def bench_snapshot_resume(n_changes=20000, n_keys=8):
     """Checkpoint/resume: the packed snapshot loads with no CRDT replay
     (closure metadata only), vs the change log's full replay."""
@@ -2586,6 +2804,8 @@ if __name__ == '__main__':
         fleet_sim_cli(sys.argv[1:])
     elif '--incremental-order' in sys.argv[1:]:
         incremental_order_cli(sys.argv[1:])
+    elif '--reconnect' in sys.argv[1:]:
+        reconnect_cli(sys.argv[1:])
     elif '--smoke' in sys.argv[1:]:
         smoke()
     else:

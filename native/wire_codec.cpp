@@ -1819,8 +1819,9 @@ void amwe_free(void* h) { delete static_cast<emitjson::Emitted*>(h); }
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
-// Columnar wire blob v2 (the amwe_emit_columnar / amst_parse_columnar
-// entry points): the JSON-free binary change encoding of the sync tick.
+// Columnar wire blob v2/v3 (the amwe_emit_columnar[_v3] /
+// amst_parse_columnar[_v3] entry points): the JSON-free binary change
+// encoding of the sync tick.
 //
 // One change encodes as a varint/delta-packed COLUMN body referencing a
 // LOCAL literal list (first-occurrence order over actor, deps, then each
@@ -1836,7 +1837,7 @@ void amwe_free(void* h) { delete static_cast<emitjson::Emitted*>(h); }
 // The parse side consumes the multi-message container the receiving
 // WireConnection assembles:
 //
-//   container := "AMW2"
+//   container := "AMW2" | "AMW3"
 //                uvarint n_tabs  { uvarint nbytes  tab }*
 //                uvarint n_docs  { uvarint n_changes
 //                                  { uvarint tab_idx
@@ -1853,6 +1854,18 @@ void amwe_free(void* h) { delete static_cast<emitjson::Emitted*>(h); }
 //                        svarint delta(key_elem) }*
 //                { ins: svarint delta(elem) }*               elem col
 //                { set/link: uvarint val_local+1 | 0 }*      value col
+//
+// v3 (magic "AMW3") RLEs the two most repetitive columns and leaves
+// the rest byte-identical to v2:
+//
+//   action col (v3) := { (key_kind<<4 | action) byte
+//                        uvarint extra }*       runs fill n_ops slots
+//   obj col    (v3) := { svarint delta(obj_local)
+//                        uvarint extra }*       delta base carries
+//                                               across runs
+//
+// extra = run length - 1; runs are greedy maximal, so emit is
+// deterministic and the Python fallback is byte-identical.
 //
 // and fills the SAME Parsed struct the JSON parsers fill, so the
 // existing amwc_* accessors extract it into a ChangeBlock and the
@@ -1977,18 +1990,18 @@ bool intern_lit(const ColTab& tab, std::vector<int32_t>& memo,
     return true;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Emit change rows of a retained general block in columnar v2 form.
-// Returns bodies (varint columns referencing LOCAL literal ids) plus
-// the per-change global ref lists the host maps to tagged literal
-// bytes. Two passes per change, both in the SAME row-major ref order
-// (actor, deps, then per op: obj, key, value) — the pure-Python
-// fallback walks identically, which is what makes the two emitters
-// byte-identical by construction.
-void* amwe_emit_columnar(
+// Emit change rows of a retained general block in columnar form
+// (version 2 or 3). Returns bodies (varint columns referencing LOCAL
+// literal ids) plus the per-change global ref lists the host maps to
+// tagged literal bytes. Two passes per change, both in the SAME
+// row-major ref order (actor, deps, then per op: obj, key, value) —
+// the pure-Python fallback walks identically, which is what makes the
+// two emitters byte-identical by construction. v3 differs from v2
+// only in the body: the action|key_kind byte column and the obj-delta
+// column are RLE'd as { value, uvarint extra } greedy maximal runs
+// (extra = run length - 1; the decoder knows n_ops, so no run count).
+void* emit_columnar_impl(
+    int version,
     int64_t n_rows, const int64_t* rows,
     const int32_t* actor, const int32_t* seq,
     const int32_t* dep_ptr, const int32_t* dep_actor,
@@ -2040,13 +2053,52 @@ void* amwe_emit_columnar(
         }
         int32_t n_ops = op_ptr[c + 1] - op_ptr[c];
         put_uv(o, static_cast<uint64_t>(n_ops));
-        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++)
-            o += static_cast<char>((key_kind[j] << 4) | action[j]);
-        int64_t prev = 0;
-        for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
-            int64_t lo = local(2, obj[j]);
-            put_sv(o, lo - prev);
-            prev = lo;
+        if (version >= 3) {
+            // action column, RLE: byte + uvarint(run - 1)
+            int run_b = -1;
+            int64_t run_n = 0;
+            for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+                int b = (key_kind[j] << 4) | action[j];
+                if (b == run_b) { run_n++; continue; }
+                if (run_n) {
+                    o += static_cast<char>(run_b);
+                    put_uv(o, static_cast<uint64_t>(run_n - 1));
+                }
+                run_b = b;
+                run_n = 1;
+            }
+            if (run_n) {
+                o += static_cast<char>(run_b);
+                put_uv(o, static_cast<uint64_t>(run_n - 1));
+            }
+            // obj column, RLE: svarint delta + uvarint(run - 1);
+            // the delta base carries ACROSS runs
+            int64_t prev = 0, run_v = -1;
+            run_n = 0;
+            for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+                int64_t lo = local(2, obj[j]);
+                if (lo == run_v && run_n) { run_n++; continue; }
+                if (run_n) {
+                    put_sv(o, run_v - prev);
+                    put_uv(o, static_cast<uint64_t>(run_n - 1));
+                    prev = run_v;
+                }
+                run_v = lo;
+                run_n = 1;
+            }
+            if (run_n) {
+                put_sv(o, run_v - prev);
+                put_uv(o, static_cast<uint64_t>(run_n - 1));
+            }
+        } else {
+            for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++)
+                o += static_cast<char>((key_kind[j] << 4) | action[j]);
+            int64_t prev = 0;
+            for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
+                int64_t lo = local(2, obj[j]);
+                put_sv(o, lo - prev);
+                prev = lo;
+            }
         }
         int64_t prev_e = 0;
         for (int32_t j = op_ptr[c]; j < op_ptr[c + 1]; j++) {
@@ -2078,6 +2130,36 @@ void* amwe_emit_columnar(
     return e;
 }
 
+}  // namespace
+
+extern "C" {
+
+void* amwe_emit_columnar(
+    int64_t n_rows, const int64_t* rows,
+    const int32_t* actor, const int32_t* seq,
+    const int32_t* dep_ptr, const int32_t* dep_actor,
+    const int32_t* dep_seq,
+    const int32_t* op_ptr, const int8_t* action, const int32_t* obj,
+    const int8_t* key_kind, const int32_t* key, const int32_t* key_elem,
+    const int32_t* elem, const int32_t* value) {
+    return emit_columnar_impl(2, n_rows, rows, actor, seq, dep_ptr,
+                              dep_actor, dep_seq, op_ptr, action, obj,
+                              key_kind, key, key_elem, elem, value);
+}
+
+void* amwe_emit_columnar_v3(
+    int64_t n_rows, const int64_t* rows,
+    const int32_t* actor, const int32_t* seq,
+    const int32_t* dep_ptr, const int32_t* dep_actor,
+    const int32_t* dep_seq,
+    const int32_t* op_ptr, const int8_t* action, const int32_t* obj,
+    const int8_t* key_kind, const int32_t* key, const int32_t* key_elem,
+    const int32_t* elem, const int32_t* value) {
+    return emit_columnar_impl(3, n_rows, rows, actor, seq, dep_ptr,
+                              dep_actor, dep_seq, op_ptr, action, obj,
+                              key_kind, key, key_elem, elem, value);
+}
+
 int64_t amwe_col_bytes(void* h) {
     return static_cast<int64_t>(static_cast<ColEmitted*>(h)->body.size());
 }
@@ -2098,13 +2180,19 @@ void amwe_col_fill(void* h, char* body, int64_t* body_off,
 
 void amwe_col_free(void* h) { delete static_cast<ColEmitted*>(h); }
 
-// Parse a columnar v2 container into the SAME Parsed struct the JSON
-// parsers fill (extract through the amwc_* accessors, free with
+}  // extern "C"
+
+namespace {
+
+// Parse a columnar v2/v3 container into the SAME Parsed struct the
+// JSON parsers fill (extract through the amwc_* accessors, free with
 // amwc_free). Value spans point at tagged literal bytes (tag byte
 // included) inside the container — decoded lazily host-side, so the
 // whole parse is JSON-free. Every count and index is bounds-checked;
-// malformed input sets Parsed.error.
-void* amst_parse_columnar(const char* buf, int64_t len) {
+// malformed input sets Parsed.error. v3 reads the action and obj
+// columns as RLE runs (run fills bounded against n_ops), everything
+// else is shared.
+void* parse_columnar_impl(int version, const char* buf, int64_t len) {
     auto* out = new (std::nothrow) Parsed();
     if (!out) return nullptr;
     out->general = true;
@@ -2118,7 +2206,8 @@ void* amst_parse_columnar(const char* buf, int64_t len) {
             : r.err;
         return out;
     };
-    if (len < 4 || std::memcmp(buf, "AMW2", 4) != 0)
+    const char* magic = version >= 3 ? "AMW3" : "AMW2";
+    if (len < 4 || std::memcmp(buf, magic, 4) != 0)
         return bail("bad columnar magic");
     r.p += 4;
 
@@ -2233,8 +2322,9 @@ void* amst_parse_columnar(const char* buf, int64_t len) {
             if (n_ops > nbytes)
                 { s.fail("op count exceeds span"); return sbail(); }
             size_t op0 = out->action.size();
-            // action column (packed with the key kind)
-            for (uint64_t i = 0; i < n_ops; i++) {
+            // action column (packed with the key kind; v3 RLE runs)
+            uint64_t filled = 0;
+            while (filled < n_ops) {
                 if (s.p >= s.end)
                     { s.fail("truncated action column"); return sbail(); }
                 uint8_t b = *s.p++;
@@ -2242,28 +2332,52 @@ void* amst_parse_columnar(const char* buf, int64_t len) {
                 int8_t kk = static_cast<int8_t>(b >> 4);
                 if (a > kMakeText || kk > kKeyNone)
                     { s.fail("bad action/kind byte"); return sbail(); }
-                out->action.push_back(a);
-                out->key_kind.push_back(kk);
-                out->obj.push_back(-1);
-                out->key.push_back(-1);
-                out->key_elem.push_back(0);
-                out->elem.push_back(0);
-                out->value.push_back(-1);
+                uint64_t run = 1;
+                if (version >= 3) {
+                    uint64_t extra;
+                    if (!s.uv(extra)) return sbail();
+                    if (extra >= n_ops - filled)
+                        { s.fail("action run overflows op count");
+                          return sbail(); }
+                    run = extra + 1;
+                }
+                for (uint64_t k = 0; k < run; k++) {
+                    out->action.push_back(a);
+                    out->key_kind.push_back(kk);
+                    out->obj.push_back(-1);
+                    out->key.push_back(-1);
+                    out->key_elem.push_back(0);
+                    out->elem.push_back(0);
+                    out->value.push_back(-1);
+                }
+                filled += run;
             }
-            // obj column
+            // obj column (v3 RLE runs; the delta base carries across)
             int64_t prev_o = 0;
-            for (uint64_t i = 0; i < n_ops; i++) {
+            uint64_t filled_o = 0;
+            while (filled_o < n_ops) {
                 int64_t dlt;
                 if (!s.sv(dlt)) return sbail();
                 prev_o += dlt;
                 if (prev_o < 0 || prev_o >= static_cast<int64_t>(n_lits))
                     { s.fail("obj literal out of range");
                       return sbail(); }
+                uint64_t run = 1;
+                if (version >= 3) {
+                    uint64_t extra;
+                    if (!s.uv(extra)) return sbail();
+                    if (extra >= n_ops - filled_o)
+                        { s.fail("obj run overflows op count");
+                          return sbail(); }
+                    run = extra + 1;
+                }
                 int32_t obj_id;
                 if (!intern_lit(tab, tab.o_memo, lit_of(prev_o), buf,
                                 out->objs, s, obj_id))
                     return sbail();
-                out->obj[op0 + i] = obj_id;
+                for (uint64_t k = 0; k < run; k++)
+                    out->obj[op0 + filled_o + k] = obj_id;
+                filled_o += run;
             }
             // key column
             int64_t prev_e = 0;
@@ -2350,6 +2464,18 @@ void* amst_parse_columnar(const char* buf, int64_t len) {
     out->n_docs = static_cast<int64_t>(n_docs);
     detect_dup_fields(*out);
     return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* amst_parse_columnar(const char* buf, int64_t len) {
+    return parse_columnar_impl(2, buf, len);
+}
+
+void* amst_parse_columnar_v3(const char* buf, int64_t len) {
+    return parse_columnar_impl(3, buf, len);
 }
 
 }  // extern "C"

@@ -549,8 +549,8 @@ class TestWireV2Interop:
         src.apply_changes_batch(rich_schedule(4))
         dst = GeneralDocSet(4)
         ma, mb = [], []
-        ca = WireConnection(src, ma.append)
-        cb = WireConnection(dst, mb.append)
+        ca = WireConnection(src, ma.append, wire_version=2)
+        cb = WireConnection(dst, mb.append, wire_version=2)
         ca.open()
         cb.open()
         pump(ca, cb, ma, mb, rounds=2)     # negotiation: adverts only
@@ -610,8 +610,8 @@ class TestWireV2Interop:
         for _ in range(3):
             dst = GeneralDocSet(4)
             ma, mb = [], []
-            ca = WireConnection(src, ma.append)
-            cb = WireConnection(dst, mb.append)
+            ca = WireConnection(src, ma.append, wire_version=2)
+            cb = WireConnection(dst, mb.append, wire_version=2)
             ca.open()
             cb.open()
             pump(ca, cb, ma, mb)
@@ -633,9 +633,11 @@ class TestWireV2Interop:
         dst = GeneralDocSet(4)
         q01, q10 = [], []
         c0 = ResilientConnection(src, q01.append, wire=True,
-                                 backoff_base=1, jitter=0)
+                                 backoff_base=1, jitter=0,
+                                 wire_version=2)
         c1 = ResilientConnection(dst, q10.append, wire=True,
-                                 backoff_base=1, jitter=0)
+                                 backoff_base=1, jitter=0,
+                                 wire_version=2)
         c0.open()
         c1.open()
         before = metrics.counters.get('sync_retransmit_wire_bytes', 0)
@@ -689,7 +691,7 @@ class TestValidateWireV2Msg:
         assert validate_wire_msg(msg) is msg
 
     @pytest.mark.parametrize('mutate, match', [
-        (lambda m: m.update(wire=3), 'version'),
+        (lambda m: m.update(wire=4), 'version'),
         (lambda m: m.update(wire=True), 'version'),
         (lambda m: m.pop('tab'), 'tab'),
         (lambda m: m.update(tab='text'), 'tab'),

@@ -1,0 +1,793 @@
+"""Wire-format v3 suite: RLE columns + session-scoped string tables +
+O(divergence) reconnect.
+
+The v3 codec must be byte-identical between the native emitter and the
+pure-Python fallback (same greedy maximal-run RLE, a construction
+property the fuzz keeps honest) and bit-exact through emit -> session
+assembly -> container -> parse on both parse paths. The session table
+is QPACK-style acked-only-bare-reference: a literal ships as a
+definition in EVERY message until one def-carrying envelope acks, and
+only then rides as a bare varint ref — so arrival-order resolution
+never needs a sender round-trip, and an unknown ref is always a
+dropped-envelope symptom repaired by retransmit (plain ValueError,
+never quarantine). Reconnect with a resumed session record serves
+exactly the divergence window, never full history.
+"""
+
+import json
+import random
+
+import pytest
+
+from automerge_tpu import native, wire
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.sync import (GeneralDocSet, MessageRejected,
+                                ResilientConnection, WireConnection)
+from automerge_tpu.sync.chaos import (ChaosFleet, canonical,
+                                      doc_set_view)
+from automerge_tpu.sync.connection import validate_wire_msg
+from automerge_tpu.utils.metrics import metrics
+
+from test_wire_v2 import _encode_block, rich_doc
+from test_wire_sync import flush_all, pump, rich_schedule
+
+
+def _container_v3_of(block, rows=None):
+    """Emit rows of a block and assemble ONE v3 container the way a
+    single-message tick would (per-message tab, no session state)."""
+    rows = list(range(block.n_changes)) if rows is None else rows
+    entries = wire.encode_change_rows_columnar_v3(block, rows)
+    spans, tab = wire.assemble_columnar_spans(entries)
+    per_doc = [[] for _ in range(block.n_docs)]
+    for c, span in zip(rows, spans):
+        per_doc[block.doc[c]].append((0, span))
+    return wire.build_columnar_container([tab], per_doc, version=3)
+
+
+def _runny_doc(d, n_runs=4, run_len=6):
+    """Change shapes that exercise the RLE columns: long runs of the
+    same action on the same object."""
+    lst = f'00000000-0000-4000-8000-{d:012x}'
+    ops = [
+        {'action': 'makeList', 'obj': lst},
+        {'action': 'link', 'obj': ROOT_ID, 'key': 'items',
+         'value': lst},
+        {'action': 'ins', 'obj': lst, 'key': '_head', 'elem': 1}]
+    elem = 1
+    for _ in range(run_len - 1):
+        ops.append({'action': 'ins', 'obj': lst,
+                    'key': f'r0-{d}:{elem}', 'elem': elem + 1})
+        elem += 1
+    for r in range(n_runs):
+        for i in range(1, run_len + 1):
+            ops.append({'action': 'set', 'obj': lst,
+                        'key': f'r0-{d}:{min(i, elem)}',
+                        'value': r * 100 + i})
+    return [{'actor': f'r0-{d}', 'seq': 1, 'deps': {}, 'ops': ops}]
+
+
+class TestV3EmitParity:
+    """Native and Python v3 emitters are byte-identical."""
+
+    @pytest.mark.skipif(not native.columnar_available(),
+                        reason='native columnar codec unavailable')
+    @pytest.mark.parametrize('make', [rich_doc, _runny_doc])
+    def test_native_matches_python(self, make, monkeypatch):
+        block = _encode_block([make(d) for d in range(5)])
+        rows = list(range(block.n_changes))
+        got_native = wire.encode_change_rows_columnar_v3(block, rows)
+        monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', False)
+        got_py = wire.encode_change_rows_columnar_v3(block, rows)
+        assert got_native == got_py        # bodies AND literal tuples
+
+    @pytest.mark.skipif(not native.columnar_available(),
+                        reason='native columnar codec unavailable')
+    def test_fuzz_parity(self, monkeypatch):
+        rng = random.Random(1337)
+        for trial in range(10):
+            docs = []
+            for d in range(rng.randrange(1, 4)):
+                if rng.random() < 0.5:
+                    docs.append(rich_doc(d, n_items=rng.randrange(1, 6)))
+                else:
+                    docs.append(_runny_doc(d,
+                                           n_runs=rng.randrange(1, 5),
+                                           run_len=rng.randrange(2, 9)))
+            block = _encode_block(docs)
+            rows = list(range(block.n_changes))
+            rng.shuffle(rows)
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', True)
+            got_native = wire.encode_change_rows_columnar_v3(block,
+                                                             rows)
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', False)
+            assert got_native == \
+                wire.encode_change_rows_columnar_v3(block, rows)
+
+    def test_forced_native_raises_when_unavailable(self, monkeypatch):
+        block = _encode_block([rich_doc(0)])
+        monkeypatch.setattr(native, 'emit_columnar_rows_v3',
+                            lambda *a, **k: None)
+        monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', True)
+        with pytest.raises(RuntimeError, match='native columnar'):
+            wire.encode_change_rows_columnar_v3(block, [0])
+
+
+class TestV3RoundTrip:
+    """v3 container round-trips bit-exact on both parse paths and
+    decodes to the same changes as the v2 container of the block."""
+
+    def _assert_roundtrip(self, docs, monkeypatch):
+        block = _encode_block(docs)
+        data = _container_v3_of(block)
+        assert data[:4] == wire.COLUMNAR_MAGIC_V3
+        want = block.to_changes()
+        for forced in (True, False) if native.columnar_available() \
+                else (False,):
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', forced)
+            assert wire.parse_columnar_block(data).to_changes() \
+                == want
+        monkeypatch.undo()
+
+    def test_rich_docs(self, monkeypatch):
+        self._assert_roundtrip([rich_doc(d) for d in range(4)],
+                               monkeypatch)
+
+    def test_runny_docs(self, monkeypatch):
+        self._assert_roundtrip([_runny_doc(d) for d in range(3)],
+                               monkeypatch)
+
+    def test_v3_decodes_same_changes_as_v2(self):
+        block = _encode_block([rich_doc(d) for d in range(3)])
+        rows = list(range(block.n_changes))
+        entries2 = wire.encode_change_rows_columnar(block, rows)
+        entries3 = wire.encode_change_rows_columnar_v3(block, rows)
+        # same literals, different (usually smaller-or-equal) bodies
+        assert [lits for _, lits in entries2] == \
+            [lits for _, lits in entries3]
+        v2 = wire.parse_columnar_block(_v2_container(block, rows))
+        v3 = wire.parse_columnar_block(_container_v3_of(block, rows))
+        assert v2.to_changes() == v3.to_changes()
+
+
+def _v2_container(block, rows):
+    entries = wire.encode_change_rows_columnar(block, rows)
+    spans, tab = wire.assemble_columnar_spans(entries)
+    per_doc = [[] for _ in range(block.n_docs)]
+    for c, span in zip(rows, spans):
+        per_doc[block.doc[c]].append((0, span))
+    return wire.build_columnar_container([tab], per_doc)
+
+
+class TestV3Corruption:
+    """Corrupt v3 containers fail LOUDLY (ValueError) on both parse
+    paths — run overflows included, which only exist in v3."""
+
+    def _data(self):
+        return _container_v3_of(
+            _encode_block([_runny_doc(d) for d in range(2)]))
+
+    def _paths(self, monkeypatch):
+        paths = [False]
+        if native.columnar_available():
+            paths.append(True)
+        return paths
+
+    @pytest.mark.parametrize('mangle', [
+        lambda d: d[:3],                          # truncated magic
+        lambda d: b'AMW9' + d[4:],                # unknown magic
+        lambda d: d[:len(d) // 2],                # torn container
+        lambda d: d + b'\x00',                    # trailing bytes
+    ])
+    def test_structural(self, mangle, monkeypatch):
+        data = mangle(self._data())
+        for forced in self._paths(monkeypatch):
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', forced)
+            with pytest.raises(ValueError):
+                wire.parse_columnar_block(data)
+
+    def test_bit_flip_fuzz_never_crashes(self, monkeypatch):
+        data = self._data()
+        want = wire.parse_columnar_block(data).to_changes()
+        rng = random.Random(2025)
+        for forced in self._paths(monkeypatch):
+            monkeypatch.setattr(wire, '_NATIVE_COLUMNAR', forced)
+            for _ in range(60):
+                i = rng.randrange(len(data))
+                bad = data[:i] + \
+                    bytes([data[i] ^ (1 << rng.randrange(8))]) + \
+                    data[i + 1:]
+                try:
+                    wire.parse_columnar_block(bad)
+                except ValueError:
+                    pass                  # loud failure is the contract
+        assert wire.parse_columnar_block(data).to_changes() == want
+
+
+class TestSessionTable:
+    def test_define_until_acked_then_bare(self):
+        t = wire.SessionStringTable()
+        ref, needs_def = t.intern(b'actor-uuid')
+        assert needs_def and t.misses == 1
+        # unacked: the SAME literal still ships as a definition
+        ref2, needs_def2 = t.intern(b'actor-uuid')
+        assert ref2 == ref and needs_def2 and t.misses == 2
+        t.note_pending({ref})
+        t.note_acked({ref}, {ref})
+        ref3, needs_def3 = t.intern(b'actor-uuid')
+        assert ref3 == ref and not needs_def3 and t.hits == 1
+
+    def test_eviction_recycles_refs_lru_first(self):
+        t = wire.SessionStringTable(max_bytes=1)
+        refs = []
+        for i in range(4):
+            ref, _ = t.intern(b'lit-%d' % i)
+            t.note_acked({ref}, set())
+            refs.append(ref)
+        t.evict_to_budget()
+        assert t.evictions > 0 and t.free_refs
+        # a new intern reuses the lowest freed ref, not a fresh one
+        ref, needs_def = t.intern(b'fresh')
+        assert needs_def and ref == min(refs)
+
+    def test_pending_entries_are_pinned(self):
+        t = wire.SessionStringTable(max_bytes=1)
+        ref, _ = t.intern(b'in-flight')
+        t.note_pending({ref})
+        t.evict_to_budget()
+        assert b'in-flight' in t.entries   # pinned while unacked
+        t.note_acked({ref}, {ref})
+        t.evict_to_budget()
+        assert b'in-flight' not in t.entries
+
+    def test_reset_mints_new_epoch(self):
+        t = wire.SessionStringTable()
+        ref, _ = t.intern(b'x')
+        old_sid = t.sid
+        t.reset()
+        assert t.sid > old_sid
+        assert len(t) == 0 and t.bytes == 0 and not t.by_ref
+
+    def test_byte_accounting(self):
+        t = wire.SessionStringTable()
+        t.intern(b'abcd')
+        assert t.bytes == 4 + wire._TABLE_ENTRY_OVERHEAD
+
+
+class TestSessionCodec:
+    def test_defs_roundtrip(self):
+        defs = [(0, b'actor-a'), (3, b'{"k":1}'), (7, b'x')]
+        tab = wire.encode_session_defs(defs)
+        assert wire.decode_session_defs(tab) == defs
+
+    @pytest.mark.parametrize('mangle', [
+        lambda t: t[:-1],                          # torn
+        lambda t: t + b'\x00',                     # trailing
+        lambda t: t[:1] + b'\x00\x00' + t[3:],     # zero-length lit
+    ])
+    def test_corrupt_defs_raise(self, mangle):
+        tab = wire.encode_session_defs([(0, b'ab'), (1, b'cd')])
+        with pytest.raises(ValueError):
+            wire.decode_session_defs(mangle(tab))
+
+    def test_spans_roundtrip_through_table(self):
+        block = _encode_block([rich_doc(d) for d in range(3)])
+        rows = list(range(block.n_changes))
+        entries = wire.encode_change_rows_columnar_v3(block, rows)
+        table = wire.SessionStringTable()
+        spans, tab, used = wire.assemble_session_spans(entries, table)
+        refs = dict(wire.decode_session_defs(tab))
+        got = wire.decode_session_spans(
+            b''.join(spans), [len(s) for s in spans], refs)
+        assert got == [(body, tuple(lits)) for body, lits in entries]
+        assert used == set(refs)
+
+    def test_unknown_ref_raises_for_retransmit(self):
+        block = _encode_block([rich_doc(0)])
+        entries = wire.encode_change_rows_columnar_v3(block, [0])
+        table = wire.SessionStringTable()
+        spans, _tab, _ = wire.assemble_session_spans(entries, table)
+        # a receiver whose table lost the defs (dropped envelope)
+        with pytest.raises(ValueError, match='retransmit'):
+            wire.decode_session_spans(
+                b''.join(spans), [len(s) for s in spans], {})
+
+
+class TestValidateWireV3Msg:
+    def _good_v3(self):
+        blob = b'\x01\x00some-span-bytes'
+        return {'wire': 3, 'maxv': 3, 'sid': 1, 'docs': ['d0'],
+                'clocks': [{'a': 1}], 'counts': [1],
+                'lens': [len(blob)], 'blob': blob, 'tab': b'\x00'}
+
+    def test_accepts_good(self):
+        msg = self._good_v3()
+        assert validate_wire_msg(msg) is msg
+
+    @pytest.mark.parametrize('mutate, match', [
+        (lambda m: m.pop('sid'), 'sid'),
+        (lambda m: m.update(sid=-1), 'sid'),
+        (lambda m: m.update(sid=True), 'sid'),
+        (lambda m: m.pop('tab'), 'tab'),
+        (lambda m: m.update(wire=4), 'version'),
+    ])
+    def test_rejects_malformed(self, mutate, match):
+        msg = self._good_v3()
+        mutate(msg)
+        with pytest.raises(MessageRejected, match=match):
+            validate_wire_msg(msg)
+
+    def test_v2_receiver_rejects_v3(self):
+        dst = GeneralDocSet(4)
+        cb = WireConnection(dst, lambda m: None, wire_version=2)
+        with pytest.raises(MessageRejected, match='not spoken'):
+            cb.receive_msg(self._good_v3())
+
+
+class TestV3Interop:
+    """Negotiation + steady-state: a v3 pair ships session-ref'd
+    columnar data, a v2/v1 receiver pins the link down, and the warm
+    path stops re-shipping literals."""
+
+    def _pump_recorded(self, src, dst, dst_version=3, src_version=3):
+        ma, mb, rec = [], [], []
+        ca = WireConnection(src, ma.append, wire_version=src_version)
+        cb = WireConnection(dst, mb.append, wire_version=dst_version)
+        ca.open()
+        cb.open()
+        for _ in range(60):
+            flush_all(ca, cb)
+            if not (ma or mb):
+                break
+            for m in ma[:]:
+                ma.remove(m)
+                rec.append(m)
+                cb.receive_msg(m)
+            for m in mb[:]:
+                mb.remove(m)
+                ca.receive_msg(m)
+        flush_all(ca, cb)
+        return rec, ca, cb
+
+    def test_v3_pair_ships_session_data(self):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule())
+        dst = GeneralDocSet(4)
+        rec, ca, _cb = self._pump_recorded(src, dst)
+        assert canonical(doc_set_view(src)) == \
+            canonical(doc_set_view(dst))
+        data = [m for m in rec if 'wire' in m and sum(m['counts'])]
+        assert data and all(m['wire'] == 3 for m in data)
+        assert all(isinstance(m['sid'], int) for m in data)
+        assert all(m.get('maxv') == 3 for m in rec if 'wire' in m)
+        assert ca._tx_table is not None
+        assert data[0]['sid'] == ca._tx_table.sid
+
+    @pytest.mark.parametrize('pin, expect', [(2, 2), (1, 1)])
+    def test_older_receiver_pins_link(self, pin, expect):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule())
+        dst = GeneralDocSet(4)
+        rec, _ca, _cb = self._pump_recorded(src, dst, dst_version=pin)
+        assert canonical(doc_set_view(src)) == \
+            canonical(doc_set_view(dst))
+        data = [m for m in rec if 'wire' in m and sum(m['counts'])]
+        assert data and all(m['wire'] == expect for m in data)
+        assert all('sid' not in m for m in data)
+
+    def test_warm_path_stops_shipping_literals(self):
+        """Second round of changes from the SAME actors over an acked
+        (resilient) link: the actor uuids and hot keys ride as bare
+        refs — table hits > 0 and the warm tab no longer re-defines
+        the actor literal. Bare refs need acks, so this runs the
+        resilient envelope protocol, not the raw message layer."""
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(4))
+        dst = GeneralDocSet(4)
+        conns = {}
+        sent = []
+        ca = ResilientConnection(
+            src, lambda env: sent.append(env) or
+            conns['b'].receive_msg(env),
+            wire=True, peer_id='b')
+        cb = ResilientConnection(
+            dst, lambda env: conns['a'].receive_msg(env),
+            wire=True, peer_id='a')
+        conns['a'], conns['b'] = ca, cb
+        ca.open()
+        cb.open()
+        _drive(ca, cb)
+        table = ca.connection._tx_table
+        assert table is not None and table.hits == 0
+        warm = {}
+        for d in range(4):
+            warm[f'doc{d}'] = [
+                {'actor': f'w1-{d}', 'seq': 2,
+                 'deps': {f'w1-{d}': 1},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'n', 'value': d + 100}]}]
+        src.apply_changes_batch(warm)
+        sent.clear()
+        _drive(ca, cb)
+        data = [e['payload'] for e in sent
+                if isinstance(e.get('payload'), dict)
+                and e['payload'].get('wire') and
+                sum(e['payload'].get('counts', ()))]
+        assert data and data[0]['wire'] == 3
+        assert table.hits > 0
+        # the actor uuid literal was defined cold; warm it is a ref
+        defs = wire.decode_session_defs(data[0]['tab'])
+        assert all(not lit.startswith(b'\x00w1-') for _, lit in defs)
+        assert dst.materialize('doc2')['n'] == 102
+
+    def test_v3_receive_path_is_json_free(self, monkeypatch):
+        import json as _json
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(4))
+        dst = GeneralDocSet(4)
+        ma, mb = [], []
+        ca = WireConnection(src, ma.append, wire_version=3)
+        cb = WireConnection(dst, mb.append, wire_version=3)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb, rounds=2)      # negotiation: adverts only
+        ca.flush()
+        data = [m for m in ma if 'wire' in m and sum(m['counts'])]
+        assert data and data[0]['wire'] == 3
+
+        def boom(*a, **k):
+            raise AssertionError('json.loads on the v3 receive path')
+
+        for m in ma:
+            cb.receive_msg(m)
+        monkeypatch.setattr(_json, 'loads', boom)
+        try:
+            cb.flush()
+        finally:
+            monkeypatch.undo()
+        assert dst.materialize('doc2')['items'] == [2]
+
+    def test_fleet_status_reports_link_wire_state(self):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(2))
+        dst = GeneralDocSet(4)
+        q01, q10 = [], []
+        c0 = ResilientConnection(src, q01.append, wire=True,
+                                 peer_id='dst')
+        c1 = ResilientConnection(dst, q10.append, wire=True,
+                                 peer_id='src')
+        c0.open()
+        c1.open()
+        for _ in range(10):
+            c0.flush()
+            c1.flush()
+            for env in q01[:]:
+                q01.remove(env)
+                c1.receive_msg(env)
+            for env in q10[:]:
+                q10.remove(env)
+                c0.receive_msg(env)
+            c0.tick()
+            c1.tick()
+        assert dst.materialize('doc0')['items'] == [0]
+        row = src.fleet_status(docs=False)['connections']['dst']
+        assert row['wire_version'] == 3
+        assert row['table_entries'] > 0
+        assert row['table_bytes'] > 0
+
+
+class TestV3Chaos:
+    """Mixed-version fleets under chaos (drop/dup/corrupt — the
+    corruptor bit-flips 'tab' too) converge byte-identically with zero
+    quarantines."""
+
+    def _build(self):
+        def build():
+            a = GeneralDocSet(8)
+            a.apply_changes_batch(rich_schedule(4))
+            b = GeneralDocSet(8)
+            b.apply_changes_batch({'doc1': [
+                {'actor': 'zz-b', 'seq': 1, 'deps': {}, 'ops': [
+                    {'action': 'set', 'obj': ROOT_ID, 'key': 'b',
+                     'value': 'B'}]}]})
+            return [a, b, GeneralDocSet(8)]
+        return build
+
+    @pytest.mark.parametrize('versions', [
+        [3, 3, 3], [3, 2, 3], [3, 1, 2]])
+    def test_mixed_version_chaos_byte_identical(self, versions):
+        build = self._build()
+        clean = ChaosFleet(build(), seed=7, wire=True)
+        clean.run(max_ticks=300)
+        want = [canonical(v) for v in clean.views()]
+        clean.close()
+
+        chaotic = ChaosFleet(build(), seed=11, drop=0.25, dup=0.1,
+                             corrupt=0.15, delay=2, wire=True,
+                             wire_version=versions)
+        chaotic.run(max_ticks=2000)
+        got = [canonical(v) for v in chaotic.views()]
+        chaotic.close()
+        assert got == want
+        for ds in chaotic.doc_sets:
+            assert not ds.quarantined
+
+    @pytest.mark.skipif(not native.columnar_available(),
+                        reason='native columnar codec unavailable')
+    @pytest.mark.parametrize('force', [True, False])
+    def test_v3_fleet_converges_under_forced_codec(self, force):
+        """CI forced lanes: v3 replication with the columnar codec
+        pinned native (raise-on-fallback) and pinned pure-Python."""
+        build = self._build()
+        prev = wire._NATIVE_COLUMNAR
+        wire._NATIVE_COLUMNAR = force
+        try:
+            clean = ChaosFleet(build(), seed=5, wire=True)
+            clean.run(max_ticks=300)
+            want = [canonical(v) for v in clean.views()]
+            clean.close()
+            chaotic = ChaosFleet(build(), seed=6, drop=0.2,
+                                 corrupt=0.1, wire=True,
+                                 wire_version=3)
+            chaotic.run(max_ticks=2000)
+            got = [canonical(v) for v in chaotic.views()]
+            chaotic.close()
+            assert got == want
+            for ds in chaotic.doc_sets:
+                assert not ds.quarantined
+        finally:
+            wire._NATIVE_COLUMNAR = prev
+
+
+def _pair(a, b, conns, resume=True):
+    """Two peer-scoped resilient endpoints over direct delivery."""
+    ca = ResilientConnection(a, lambda env: conns['b'].receive_msg(env),
+                             wire=True, peer_id='b', resume=resume)
+    cb = ResilientConnection(b, lambda env: conns['a'].receive_msg(env),
+                             wire=True, peer_id='a', resume=resume)
+    conns['a'], conns['b'] = ca, cb
+    return ca, cb
+
+
+def _drive(ca, cb, rounds=10):
+    for _ in range(rounds):
+        ca.flush()
+        cb.flush()
+        ca.tick()
+        cb.tick()
+
+
+class TestReconnectResume:
+    """O(divergence) reconnect: the session record bounds the first
+    flush after re-establishment to exactly the divergence window."""
+
+    N = 20
+
+    def _seed(self):
+        a, b = GeneralDocSet(32), GeneralDocSet(32)
+        batch = {}
+        for i in range(self.N):
+            batch[f'doc{i}'] = [
+                {'actor': f'al-{i:04d}', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'k', 'value': i}]}]
+        a.apply_changes_batch(batch)
+        return a, b
+
+    def test_resume_serves_only_divergence(self):
+        a, b = self._seed()
+        conns = {}
+        ca, cb = _pair(a, b, conns)
+        ca.open()
+        cb.open()
+        _drive(ca, cb)
+        assert all(b.materialize(f'doc{i}') == {'k': i}
+                   for i in range(self.N))
+        ca.close()
+        cb.close()
+        # offline: TWO docs advance
+        for i in (3, 7):
+            a.apply_changes_batch({f'doc{i}': [
+                {'actor': f'al-{i:04d}', 'seq': 2,
+                 'deps': {f'al-{i:04d}': 1},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'k', 'value': 100 + i}]}]})
+        before = metrics.counters.get('sync_wire_session_resumes', 0)
+        served = []
+        ca2 = ResilientConnection(
+            a, lambda env: served.append(env) or
+            conns['b'].receive_msg(env),
+            wire=True, peer_id='b')
+        cb2 = ResilientConnection(
+            b, lambda env: conns['a'].receive_msg(env),
+            wire=True, peer_id='a')
+        conns['a'], conns['b'] = ca2, cb2
+        ca2.open()
+        cb2.open()
+        _drive(ca2, cb2)
+        assert b.materialize('doc3') == {'k': 103}
+        assert b.materialize('doc7') == {'k': 107}
+        assert metrics.counters.get('sync_wire_session_resumes', 0) \
+            >= before + 2
+        # the divergence bound: data envelopes carried ONLY the two
+        # advanced docs — never a full-history re-send
+        changed = set()
+        for env in served:
+            p = env.get('payload')
+            if isinstance(p, dict) and p.get('wire') and \
+                    sum(p.get('counts', ())):
+                changed.update(d for d, n in zip(p['docs'],
+                                                 p['counts']) if n)
+        assert changed == {'doc3', 'doc7'}
+
+    def test_resume_off_reships_everything(self):
+        a, b = self._seed()
+        conns = {}
+        ca, cb = _pair(a, b, conns)
+        ca.open()
+        cb.open()
+        _drive(ca, cb)
+        ca.close()
+        cb.close()
+        before = metrics.counters.get('sync_wire_session_resets', 0)
+        ca2, cb2 = _pair(a, b, conns, resume=False)
+        ca2.open()
+        cb2.open()
+        assert metrics.counters.get('sync_wire_session_resets', 0) \
+            > before
+        _drive(ca2, cb2)
+        assert all(b.materialize(f'doc{i}') == {'k': i}
+                   for i in range(self.N))
+
+    def test_heartbeat_heals_crashed_peer(self):
+        """The peer crash-restarts from an OLD snapshot: its truthful
+        heartbeat advertises clocks BELOW the resumed acked floor.
+        Once nothing is in flight, the heal resets the floor down and
+        re-serves the lost tail."""
+        a, b = self._seed()
+        conns = {}
+        ca, cb = _pair(a, b, conns)
+        ca.open()
+        cb.open()
+        _drive(ca, cb)
+        a.apply_changes_batch({'doc5': [
+            {'actor': 'al-0005', 'seq': 2, 'deps': {'al-0005': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                      'value': 500}]}]})
+        _drive(ca, cb)
+        assert b.materialize('doc5') == {'k': 500}
+        ca.close()
+        cb.close()
+        # b restarts from the pre-update snapshot: seq-1 state only
+        b2 = GeneralDocSet(32)
+        batch = {}
+        for i in range(self.N):
+            batch[f'doc{i}'] = [
+                {'actor': f'al-{i:04d}', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': 'k', 'value': i}]}]
+        b2.apply_changes_batch(batch)
+        ca2 = ResilientConnection(
+            a, lambda env: conns['b'].receive_msg(env),
+            wire=True, peer_id='b', heartbeat_every=2)
+        cb2 = ResilientConnection(
+            b2, lambda env: conns['a'].receive_msg(env),
+            wire=True, peer_id='a', heartbeat_every=2)
+        conns['a'], conns['b'] = ca2, cb2
+        ca2.open()
+        cb2.open()
+        # a resumed an acked floor of seq 2 for doc5 — a lie now
+        assert ca2._peer_acked.get('doc5', {}).get('al-0005') == 2
+        _drive(ca2, cb2, rounds=20)
+        assert b2.materialize('doc5') == {'k': 500}
+
+
+class TestV3WireCacheEviction:
+    """Satellite: v3 wire-cache entries survive adopt_wire_cache with
+    correct byte accounting, and clear_wire_cache() resets live
+    session tables (fresh epoch) so remapped stores never serve stale
+    session refs."""
+
+    def test_adopt_carries_v3_entries(self):
+        from automerge_tpu.device.blocks import _wire_entry_bytes
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(3))
+        store = src.store
+        # populate the v3 cache via the connection path
+        dst = GeneralDocSet(4)
+        ma, mb = [], []
+        ca = WireConnection(src, ma.append, wire_version=3)
+        cb = WireConnection(dst, mb.append, wire_version=3)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb)
+        assert store._wire_cache_v3
+        fresh = GeneralDocSet(16).store
+        fresh.adopt_wire_cache(store, drop_docs=[0])
+        assert fresh._wire_cache_v3
+        assert all(k[0] != 0 for k in fresh._wire_cache_v3)
+        assert fresh._wire_cache_bytes == sum(
+            _wire_entry_bytes(v)
+            for v in fresh._wire_cache_v2.values()) + sum(
+            _wire_entry_bytes(v)
+            for v in fresh._wire_cache_v3.values()) + sum(
+            len(v) for v in fresh._wire_cache.values())
+
+    def test_clear_resets_live_session_tables(self):
+        src = GeneralDocSet(16)
+        src.apply_changes_batch(rich_schedule(2))
+        dst = GeneralDocSet(4)
+        ma, mb = [], []
+        ca = WireConnection(src, ma.append, wire_version=3)
+        cb = WireConnection(dst, mb.append, wire_version=3)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb)
+        table = ca._tx_table
+        assert table is not None and len(table)
+        old_sid = table.sid
+        src.store.clear_wire_cache()
+        assert table.sid > old_sid and len(table) == 0
+        # the link keeps working after the epoch change
+        src.apply_changes_batch({'doc0': [
+            {'actor': 'w1-0', 'seq': 2, 'deps': {'w1-0': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+                      'value': 42}]}]})
+        pump(ca, cb, ma, mb)
+        assert dst.materialize('doc0')['n'] == 42
+
+    def test_evict_and_fault_in_mid_session(self, tmp_path):
+        """Serving doc set under a byte budget: a doc is evicted and
+        faulted back in MID-SESSION; the continued v3 sync converges
+        byte-identically."""
+        from automerge_tpu.sync.serving import ServingDocSet
+        inner = GeneralDocSet(16)
+        inner.apply_changes_batch(rich_schedule(4))
+        src = ServingDocSet(inner, str(tmp_path / 'src'))
+        dst = GeneralDocSet(8)
+        ma, mb = [], []
+        ca = WireConnection(src.inner, ma.append, wire_version=3)
+        cb = WireConnection(dst, mb.append, wire_version=3)
+        ca.open()
+        cb.open()
+        pump(ca, cb, ma, mb)
+        # squeeze: park most docs, then fault back in via new writes
+        total = int(src.store.doc_byte_estimates()[
+            :len(src.ids)].sum())
+        src.memory_budget_bytes = max(total // 4, 1)
+        src.tick()
+        assert src._n_evictions > 0
+        update = {'doc1': [
+            {'actor': 'w1-1', 'seq': 2, 'deps': {'w1-1': 1},
+             'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'n',
+                      'value': 77}]}]}
+        src.apply_changes_batch(update)
+        pump(ca, cb, ma, mb)
+        # oracle: the same schedule on a never-evicted set
+        oracle = GeneralDocSet(16)
+        oracle.apply_changes_batch(rich_schedule(4))
+        oracle.apply_changes_batch(update)
+        assert canonical(doc_set_view(dst)) == \
+            canonical(doc_set_view(oracle))
+        assert dst.materialize('doc1')['n'] == 77
+
+
+class TestV3Durability:
+    def test_v3_container_journals_and_replays(self, tmp_path):
+        """An AMW3 container WALs (base64-armored) and crash-recovery
+        replays it through the fused path, byte-identical."""
+        from automerge_tpu.durability import DurableDocSet
+        sched = [rich_doc(d) for d in range(3)]
+        block = _encode_block(sched)
+        data = _container_v3_of(block)
+        doc_ids = [f'doc{d}' for d in range(3)]
+
+        ds = DurableDocSet(GeneralDocSet(8), str(tmp_path))
+        ds.apply_wire(data, doc_ids=doc_ids)
+        want = {d: ds.doc_set.materialize(d) for d in doc_ids}
+        ds.close()
+
+        rec = DurableDocSet.recover(str(tmp_path),
+                                    lambda: GeneralDocSet(8))
+        got = {d: rec.doc_set.materialize(d) for d in doc_ids}
+        assert got == want
+        rec.close()

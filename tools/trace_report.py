@@ -129,6 +129,26 @@ def wire_throughput(events):
     return out
 
 
+def session_table_summary(events):
+    """Wire-v3 session string-table efficiency from ``sync_wire_send``
+    instants: each v3 send stamps how many literal occurrences rode as
+    bare refs (``tab_hits``) vs shipped a definition (``tab_misses``),
+    so a trace shows the warm-session hit rate next to the raw wire
+    MB/s. Returns ``(n_v3_sends, hits, misses)``."""
+    sends = hits = misses = 0
+    for e in events:
+        if e.get('event') != 'sync_wire_send' or e.get('v') != 3:
+            continue
+        h, m = e.get('tab_hits'), e.get('tab_misses')
+        if not isinstance(h, (int, float)) or \
+                not isinstance(m, (int, float)):
+            continue
+        sends += 1
+        hits += int(h)
+        misses += int(m)
+    return sends, hits, misses
+
+
 def split_scenarios(events):
     """Segment an event stream on the simulator's markers: returns a
     list of ``{'start': event, 'summary': event-or-None, 'events':
@@ -270,6 +290,13 @@ def main(argv=None):
             rate = total / (ms / 1e3) / 1e6 if ms else 0.0
             print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
                   f'{ms:.1f} ms -> {rate:.0f} MB/s')
+        sends, hits, misses = session_table_summary(events)
+        if sends:
+            lookups = hits + misses
+            rate = 100.0 * hits / lookups if lookups else 0.0
+            print(f'  wire.session_table: {sends} v3 sends, '
+                  f'{hits}/{lookups} literals as bare refs '
+                  f'({rate:.0f}% hit rate)')
         for name, (n, total) in sorted(
                 device_phase_summary(events).items()):
             print(f'  {name}: {n} spans, {total:.2f} ms total')
