@@ -19,9 +19,13 @@ from .connection import (Connection, BatchingConnection, WireConnection,
 from .resilient import (ResilientConnection, AdmissionControl,
                         TokenBucket)
 from .control import FleetController
+from .transport import (TransportEndpoint, FrameDecoder, FrameError,
+                        encode_frame)
 
 __all__ = ['DocSet', 'DeviceDocSet', 'DenseDocSet', 'GeneralDocSet',
            'ServingDocSet', 'WatchableDoc', 'Connection',
            'BatchingConnection', 'WireConnection', 'MessageRejected',
            'validate_msg', 'validate_wire_msg', 'ResilientConnection',
-           'AdmissionControl', 'TokenBucket', 'FleetController']
+           'AdmissionControl', 'TokenBucket', 'FleetController',
+           'TransportEndpoint', 'FrameDecoder', 'FrameError',
+           'encode_frame']

@@ -397,3 +397,366 @@ class ChaosFleet:
                 pass                     # the crashed side's handler is gone
             self.queues[(owner, peer)].clear()
             self._make_conn(owner, peer).open()
+
+
+# -- socket-level chaos (PR 19) ------------------------------------------------
+#
+# Everything above injects faults on an IN-PROCESS fabric: envelopes
+# are Python objects and a "partition" is a list clear. The classes
+# below move the same seeded adversity to REAL loopback TCP: a
+# fault-injecting proxy per peer pair (latency, jitter, chunk drop /
+# duplicate — which corrupt the byte stream and exercise the frame
+# codec's CRC reset path — mid-frame cuts, hard partitions) under a
+# SocketChaosFleet that mirrors ChaosFleet's driver API, so the PR 13
+# scenario schedules replay unchanged over actual sockets and compare
+# byte-identical against the clean in-process oracle.
+
+import asyncio  # noqa: E402
+
+
+class ChaosProxy:
+    """A fault-injecting TCP proxy for ONE peer pair: the dialing
+    endpoint connects here instead of to its peer, and every byte
+    stream crossing the proxy suffers the configured faults.
+
+    Chunk-level drop/duplicate deliberately CORRUPT the framed stream
+    (TCP itself never loses bytes mid-connection) — that is the point:
+    the frame codec must catch the damage by CRC, reset the session
+    and let the envelope layer repair by retransmit. ``cut`` forwards
+    half a chunk then kills the pipe (a mid-frame connection reset —
+    the torn-tail path). ``partition()`` stops the listener and aborts
+    live pipes (a dead cable: re-dials get ECONNREFUSED and back off)
+    until ``heal()`` re-opens the same port.
+
+    ``target_port_of`` is a callable so a restarted peer (new server
+    port) is re-routable without rebuilding the proxy."""
+
+    def __init__(self, target_port_of, host='127.0.0.1', seed=0,
+                 latency_ms=0.0, jitter_ms=0.0, drop=0.0, dup=0.0,
+                 cut=0.0, corrupt=0.0):
+        self.target_port_of = target_port_of
+        self.host = host
+        self.rng = random.Random(seed)
+        self.latency_ms = latency_ms
+        self.jitter_ms = jitter_ms
+        self.drop = drop
+        self.dup = dup
+        self.cut = cut
+        self.corrupt = corrupt
+        self.partitioned = False
+        self.port = None
+        self._server = None
+        self.pipes = set()
+        self.stats = Counter()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port or 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def _handle(self, creader, cwriter):
+        if self.partitioned:
+            cwriter.close()
+            return
+        try:
+            sreader, swriter = await asyncio.open_connection(
+                self.host, self.target_port_of())
+        except OSError:
+            cwriter.close()
+            return
+        pipe = (cwriter, swriter)
+        self.pipes.add(pipe)
+        pumps = (asyncio.ensure_future(self._pump(creader, swriter)),
+                 asyncio.ensure_future(self._pump(sreader, cwriter)))
+        try:
+            await asyncio.wait(pumps,
+                               return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for task in pumps:
+                if not task.done():
+                    task.cancel()
+            self.pipes.discard(pipe)
+            for writer in pipe:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    async def _pump(self, reader, writer):
+        try:
+            while True:
+                data = await reader.read(4096)
+                if not data or self.partitioned:
+                    return
+                if self.latency_ms or self.jitter_ms:
+                    await asyncio.sleep(
+                        (self.latency_ms +
+                         self.rng.random() * self.jitter_ms) / 1e3)
+                roll = self.rng.random()
+                if roll < self.drop:
+                    self.stats['dropped'] += 1
+                    continue
+                if roll < self.drop + self.cut:
+                    self.stats['cut'] += 1
+                    writer.write(data[:max(1, len(data) // 2)])
+                    await writer.drain()
+                    return              # mid-frame reset
+                if roll < self.drop + self.cut + self.corrupt:
+                    # flip one byte: whole-chunk drop/dup usually
+                    # stays FRAME-aligned (TCP coalesces writes), so
+                    # this is the fault that reliably exercises the
+                    # codec's CRC reject -> stream reset -> re-dial
+                    # path at the socket level
+                    self.stats['corrupted'] += 1
+                    i = self.rng.randrange(len(data))
+                    data = data[:i] + bytes([data[i] ^ 0x40]) \
+                        + data[i + 1:]
+                writer.write(data)
+                if self.dup and self.rng.random() < self.dup:
+                    self.stats['dupped'] += 1
+                    writer.write(data)
+                await writer.drain()
+        except (ConnectionError, OSError):
+            return
+
+    def _kill_pipes(self):
+        for cwriter, swriter in list(self.pipes):
+            for writer in (cwriter, swriter):
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    pass
+
+    async def sever(self):
+        """Dead cable: stop listening (new dials are refused — the
+        endpoints' re-dial backoff takes over) and abort live pipes."""
+        self.partitioned = True
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+        self._kill_pipes()
+
+    async def heal(self):
+        self.partitioned = False
+        if self._server is None:
+            await self.start()         # same recorded port
+
+    async def close(self):
+        self.partitioned = True
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+        self._kill_pipes()
+
+
+class SocketChaosFleet:
+    """:class:`ChaosFleet`'s driver API over REAL loopback sockets:
+    one :class:`~.transport.TransportEndpoint` per node (each hosting
+    its doc set under one mux key), every pair joined through a
+    :class:`ChaosProxy`, ticked synchronously — the fleet owns a
+    private event loop, so the callers (tests, bench, schedule
+    replay) stay plain synchronous code.
+
+    Unlike the in-process fabric there is no seeded delivery ORDER:
+    TCP + asyncio schedule delivery. The comparand is unchanged
+    anyway — CRDT convergence makes the FINAL state byte-identical
+    regardless of arrival order, which is exactly what the schedule
+    replays assert against the clean oracle."""
+
+    def __init__(self, doc_sets, seed=0, drop=0.0, dup=0.0, cut=0.0,
+                 corrupt=0.0, latency_ms=0.0, jitter_ms=0.0,
+                 heartbeat_every=8, conn_kwargs=None,
+                 suspect_after=24, dead_after=48, max_queue=1024,
+                 resume=True, dset='fleet'):
+        self.loop = asyncio.new_event_loop()
+        self.doc_sets = list(doc_sets)
+        self.dset = dset
+        self.seed = seed
+        self.now = 0
+        self._latency = latency_ms + jitter_ms
+        ck = dict(conn_kwargs or {})
+        ck.setdefault('heartbeat_every', heartbeat_every)
+        self._conn_kwargs = ck
+        self._ep_kwargs = dict(suspect_after=suspect_after,
+                               dead_after=dead_after,
+                               max_queue=max_queue, resume=resume,
+                               redial_backoff=(1, 8))
+        self._fault_kwargs = dict(latency_ms=latency_ms,
+                                  jitter_ms=jitter_ms, drop=drop,
+                                  dup=dup, cut=cut, corrupt=corrupt)
+        self.endpoints = []
+        self.proxies = {}              # (a, b) with a < b
+        self._run(self._start())
+
+    def _run(self, coro):
+        return self.loop.run_until_complete(coro)
+
+    def _make_endpoint(self, node, **overrides):
+        from .transport import TransportEndpoint
+        kwargs = dict(self._ep_kwargs)
+        kwargs.update(overrides)
+        return TransportEndpoint(
+            f'node{node}', {self.dset: self.doc_sets[node]},
+            conn_kwargs=dict(self._conn_kwargs), **kwargs)
+
+    async def _start(self):
+        n = len(self.doc_sets)
+        for i in range(n):
+            ep = self._make_endpoint(i)
+            await ep.start()
+            self.endpoints.append(ep)
+        for a in range(n):
+            for b in range(a + 1, n):
+                proxy = ChaosProxy(
+                    (lambda b=b: self.endpoints[b].port),
+                    seed=self.seed * 1009 + a * 37 + b,
+                    **self._fault_kwargs)
+                await proxy.start()
+                self.proxies[(a, b)] = proxy
+                await self.endpoints[a].connect(
+                    f'node{b}', '127.0.0.1', proxy.port)
+        await self._pump(8)            # let the HELLOs land
+
+    async def _pump(self, rounds):
+        for _ in range(rounds):
+            await asyncio.sleep(0)
+        if self._latency:
+            # real latency faults are wall-clock: give the delayed
+            # chunks time to clear their timers
+            await asyncio.sleep(self._latency * 1.5 / 1e3)
+
+    # -- ChaosFleet driver API ----------------------------------------------
+
+    def tick(self):
+        self.now += 1
+        self._run(self._tick_async())
+
+    async def _tick_async(self):
+        for ep in self.endpoints:
+            if not ep.closed:
+                await ep.tick()
+        for ds in self.doc_sets:
+            t = getattr(ds, 'tick', None)
+            if t is not None:
+                t()
+        await self._pump(6)
+
+    def partition(self, a, b):
+        self._run(self.proxies[(min(a, b), max(a, b))].sever())
+
+    def heal(self, a, b):
+        self._run(self.proxies[(min(a, b), max(a, b))].heal())
+
+    def kill(self, node):
+        """Abrupt process death: sockets abort, nothing closes
+        cleanly — peers only find out from their failure detectors."""
+        self._run(self.endpoints[node].kill())
+
+    def restart(self, node, doc_set=None, resume=True):
+        """Bring a killed node back: a NEW endpoint (new epoch — the
+        surviving peers rebuild their links through the wire-session
+        resume path) hosting ``doc_set`` (default: the node's previous
+        doc set, the recovered-from-durable-state posture). Pairs
+        where the restarted node dials reconnect here; pairs dialing
+        INTO it re-dial on their own backoff, routed by the proxies'
+        late-bound target ports."""
+        if doc_set is not None:
+            self.doc_sets[node] = doc_set
+        ep = self._make_endpoint(node, resume=resume)
+        self.endpoints[node] = ep
+
+        async def go():
+            await ep.start()
+            for (a, b), proxy in self.proxies.items():
+                if a == node:
+                    await ep.connect(f'node{b}', '127.0.0.1',
+                                     proxy.port)
+            await self._pump(8)
+        self._run(go())
+
+    def pending(self):
+        return any(not ep.closed and ep.pending()
+                   for ep in self.endpoints)
+
+    def views(self):
+        return [doc_set_view(ds) for ds in self.doc_sets]
+
+    def converged(self):
+        views = [canonical(v) for v in self.views()]
+        return all(v == views[0] for v in views[1:])
+
+    def run(self, max_ticks=2000, min_ticks=0):
+        """Tick until byte-identical convergence and a quiet fabric;
+        raises past ``max_ticks`` (a schedule that defeats the
+        transport is a failure, not a hang)."""
+        start = self.now
+        while self.now - start < max_ticks:
+            self.tick()
+            if self.now - start >= min_ticks and not self.pending() \
+                    and self.converged():
+                return self.now
+        raise RuntimeError(
+            f'socket fleet failed to converge within {max_ticks} '
+            f'ticks')
+
+    def close(self):
+        async def go():
+            for ep in self.endpoints:
+                if not ep.closed:
+                    await ep.close()
+            for proxy in self.proxies.values():
+                await proxy.close()
+            await asyncio.sleep(0)
+        self._run(go())
+        self._run(asyncio.sleep(0.01))  # unwind cancellations
+        self.loop.close()
+
+
+def replay_schedule_over_sockets(schedule, chaos=None, doc_sets=None,
+                                 max_ticks=4000, **fleet_kwargs):
+    """Re-run a fleetsim scenario schedule (``build_schedule``) over
+    real loopback sockets through the fault-injecting proxies, then
+    converge. Returns the canonical per-node views plus the
+    quarantine/divergence totals — the byte-identity comparand
+    against :func:`~automerge_tpu.fleetsim.run_oracle`."""
+    spec = schedule['spec']
+    if doc_sets is None:
+        from .general_doc_set import GeneralDocSet
+        doc_sets = [GeneralDocSet(spec['n_docs'] + 8)
+                    for _ in range(spec['n_nodes'])]
+    fleet = SocketChaosFleet(
+        doc_sets, seed=schedule['seed'] + 7,
+        heartbeat_every=spec['heartbeat_every'],
+        **dict(chaos or {}), **fleet_kwargs)
+    try:
+        for tick in schedule['ticks']:
+            for a, b in tick.get('partition', ()):
+                fleet.partition(a, b)
+            for a, b in tick.get('heal', ()):
+                fleet.heal(a, b)
+            by_node = {}
+            for node, doc_id, changes in tick['writes']:
+                by_node.setdefault(node, {})[doc_id] = changes
+            for node, batch in by_node.items():
+                doc_sets[node].apply_changes_batch(batch)
+            fleet.tick()
+        ticks = fleet.run(max_ticks=max_ticks)
+        return {
+            'views': [canonical(v) for v in fleet.views()],
+            'ticks': ticks,
+            'quarantined': sum(len(getattr(ds, 'quarantined', ()) or
+                                   ()) for ds in doc_sets),
+            'diverged': sum(len(getattr(ds, 'diverged', ()) or ())
+                            for ds in doc_sets),
+        }
+    finally:
+        fleet.close()

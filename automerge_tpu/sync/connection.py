@@ -682,6 +682,16 @@ class WireConnection(BatchingConnection):
         # one a reconnecting peer just abandoned), older epochs drop.
         self._tx_table = None
         self._rx_tables = {}
+        # delta-clock baseline (v3 warm-link advert compression): per
+        # doc, the highest clock PROVEN shared with the peer — folded
+        # only from payload clocks the peer explicitly acked (ack =>
+        # delivered => the receiver folded that very clock into its
+        # view of us, so eliding those entries from a later shipped
+        # clock loses it nothing; plain union reconstructs exactly).
+        # Outgoing v3 clocks ship only the entries above this
+        # baseline; a fresh session (empty baseline) ships full
+        # clocks — the session-reset fallback.
+        self._adv_acked = {}
 
     def open(self):
         """Advertise every doc WITHOUT materializing handles: the wire
@@ -824,7 +834,18 @@ class WireConnection(BatchingConnection):
         a stored v3 wire payload was acknowledged — its defs become
         session-confirmed (bare references from now on) and its ref
         uses unpin. Stateless: the refs re-derive from the payload
-        itself, so no per-seq side table exists to leak."""
+        itself, so no per-seq side table exists to leak. Every acked
+        payload clock (wire AND state) also advances the delta-clock
+        baseline: those entries are proven delivered, so later
+        adverts elide them."""
+        if isinstance(payload, dict):
+            docs = payload.get('docs')
+            clocks = payload.get('clocks')
+            if isinstance(docs, list) and isinstance(clocks, list) \
+                    and len(docs) == len(clocks):
+                for doc_id, clock in zip(docs, clocks):
+                    if isinstance(clock, dict) and clock:
+                        clock_union(self._adv_acked, doc_id, clock)
         if self._tx_table is None or not isinstance(payload, dict) \
                 or payload.get('wire') != 3 \
                 or payload.get('sid') != self._tx_table.sid:
@@ -845,6 +866,36 @@ class WireConnection(BatchingConnection):
         from .. import wire as _wire
         _, used = _wire.session_payload_refs(payload)
         self._tx_table.note_dead(used)
+
+    def note_clock_regressed(self, doc_id, clock):
+        """Membership of the regression heal (resilient.py's
+        heartbeat branch): the peer provably lost state down to
+        ``clock`` — the delta baseline must regress with it, or later
+        adverts would elide entries the peer no longer holds."""
+        self._adv_acked[doc_id] = dict(clock)
+
+    def _ship_clock(self, doc_id, clock, version, advert=False):
+        """The clock dict actually SHIPPED for a doc: on a warm v3
+        link, only the entries above the peer-acked baseline (the
+        receiver reconstructs exactly by union — every elided entry
+        already reached it inside an acked payload it folded).
+        Adverts never collapse to {}: an empty clock on a zero-count
+        span is protocol-identical to a REQUEST, so a fully-elided
+        advert ships whole instead."""
+        if version < 3:
+            return dict(clock)
+        base = self._adv_acked.get(doc_id)
+        if not base:
+            return dict(clock)
+        delta = {a: s for a, s in clock.items()
+                 if s > base.get(a, 0)}
+        if advert and not delta and clock:
+            return dict(clock)
+        elided = len(clock) - len(delta)
+        if elided:
+            self.metrics.bump('sync_wire_clock_entries_elided',
+                              elided)
+        return delta
 
     def _flush_pending(self):
         return bool(self._incoming or self._incoming_wire
@@ -1162,14 +1213,16 @@ class WireConnection(BatchingConnection):
                 clock_union(self._their_clock, doc_id, clock)
                 clock_union(self._our_clock, doc_id, clock)
                 docs.append(doc_id)
-                clocks.append(dict(clock))
+                clocks.append(self._ship_clock(doc_id, clock,
+                                               version))
                 counts.append(len(blobs))
                 chunks.extend(blobs)
                 continue
             if clock != self._our_clock.get(doc_id, {}):
                 clock_union(self._our_clock, doc_id, clock)
                 docs.append(doc_id)
-                clocks.append(dict(clock))
+                clocks.append(self._ship_clock(doc_id, clock,
+                                               version, advert=True))
                 counts.append(0)
         if deferred:
             # carry past the cap to the next tick, in order; the

@@ -65,6 +65,12 @@ DEFAULT_HEALTH_THRESHOLDS = {
     # pinned hot; well past it, the process is headed for the OOM
     # killer.
     'memory_pressure': (1.0, 2.0),
+    # membership: registered links whose peer the transport failure
+    # detector has declared dead (`link_state == 'down'`). One dead
+    # peer degrades the fleet (writes keep applying locally, its
+    # traffic parks); the signal is a live count, so a healed peer
+    # clears it on the next evaluation.
+    'membership': (1, None),
 }
 _HEALTH_RANK = {'green': 0, 'degraded': 1, 'critical': 2}
 
@@ -195,6 +201,16 @@ class GeneralDocSet:
         # observed into sync_convergence_ms) once every registered
         # peer's acked clock covers the doc's clock
         self._births = {}
+        # membership: peers the transport failure detector declared
+        # dead (note_peer_down/note_peer_up), and the convergence
+        # births PARKED against them — a birth can never close while
+        # a registered peer is down, so it moves aside (not leaked,
+        # not reported as a forever-growing pending figure) and is
+        # restored when the last down peer heals. Convergence latency
+        # stays truthful: the original birth stamp survives the park,
+        # so downtime counts.
+        self._down_peers = set()
+        self._parked_births = {}
         # health/SLO rollup state (fleet_status()['health']);
         # health_extra (callable -> dict) merges wrapper-layer signals
         # (the serving layer's parked count), health_incident fires on
@@ -440,9 +456,59 @@ class GeneralDocSet:
         if not self.connections:
             return
         t = _time.perf_counter()
+        if self._down_peers:
+            # with a peer down the fleet provably cannot cover new
+            # writes: park the birth directly (restored on heal,
+            # earliest stamp kept) instead of letting pending_births
+            # grow for the whole outage
+            parked = self._parked_births
+            for doc_id in doc_ids:
+                parked.setdefault(doc_id, t)
+            if doc_ids:
+                _metrics.bump('membership_births_parked',
+                              len(doc_ids))
+            return
         births = self._births
         for doc_id in doc_ids:
             births[doc_id] = t
+
+    def note_peer_down(self, peer_id):
+        """Membership hook — the transport failure detector declared
+        ``peer_id`` dead. Park every pending convergence birth: none
+        of them can close while a registered peer acks nothing, and
+        leaking them as an ever-growing ``pending_births`` would read
+        as a convergence bug instead of the outage it is. The original
+        birth stamps survive, so convergence latency keeps counting
+        the downtime when the births are restored on heal."""
+        self._down_peers.add(peer_id)
+        if self._births:
+            moved = 0
+            parked = self._parked_births
+            for doc_id, t0 in self._births.items():
+                prev = parked.get(doc_id)
+                parked[doc_id] = t0 if prev is None \
+                    else min(prev, t0)
+                moved += 1
+            self._births.clear()
+            _metrics.bump('membership_births_parked', moved)
+
+    notePeerDown = note_peer_down
+
+    def note_peer_up(self, peer_id):
+        """Membership hook — a down peer healed. Once NO registered
+        peer remains down, restore the parked births (earliest stamp
+        wins, so re-parked docs never shorten their own latency) and
+        let the normal ack flow close them."""
+        self._down_peers.discard(peer_id)
+        if self._down_peers or not self._parked_births:
+            return
+        births = self._births
+        for doc_id, t0 in self._parked_births.items():
+            prev = births.get(doc_id)
+            births[doc_id] = t0 if prev is None else min(prev, t0)
+        self._parked_births.clear()
+
+    notePeerUp = note_peer_up
 
     def note_peer_ack(self, doc_ids, clock_of=None):
         """A registered link folded new acked clocks for ``doc_ids``:
@@ -895,6 +961,7 @@ class GeneralDocSet:
         return {'replication_lag_ops': lag,
                 'lagging_docs': lagging,
                 'pending_births': len(self._births),
+                'parked_births': len(self._parked_births),
                 'convergence_ms_p99':
                     _metrics.quantile('sync_convergence_ms', 0.99),
                 'diverged': {d: dict(rec)
@@ -948,6 +1015,12 @@ class GeneralDocSet:
                    'admission_debt': debt,
                    'backpressure_depth': backpressure,
                    'recompile_storm': max(0, storm),
+                   # registered links whose peer the failure detector
+                   # declared dead RIGHT NOW — a live count, so a
+                   # healed peer clears the signal
+                   'membership': sum(
+                       1 for c in self.connections.values()
+                       if getattr(c, 'link_state', 'up') == 'down'),
                    'parked': 0}
         if self.health_extra is not None:
             signals.update(self.health_extra())
@@ -1105,6 +1178,7 @@ class GeneralDocSet:
                 # births can never close — drop them instead of
                 # reporting a forever-growing pending_births figure
                 self._births.clear()
+                self._parked_births.clear()
 
     unregisterConnection = unregister_connection
 

@@ -373,9 +373,16 @@ class ResilientConnection:
                 rec = sessions.get(peer_id) if resume else None
                 if rec is not None:
                     self._peer_acked = rec['acked']
+                    adv = getattr(self._conn, '_adv_acked', None)
                     for doc_id, clock in self._peer_acked.items():
                         self._conn._their_clock[doc_id] = dict(clock)
                         self._conn._our_clock[doc_id] = dict(clock)
+                        if adv is not None:
+                            # the delta-clock baseline resumes with
+                            # the session: the record's entries are
+                            # all peer-confirmed, so the first warm
+                            # adverts elide them too
+                            adv[doc_id] = dict(clock)
                     self.metrics.bump('sync_wire_session_resumes')
                 else:
                     sessions[peer_id] = {'acked': self._peer_acked}
@@ -384,6 +391,10 @@ class ResilientConnection:
         # maintains them (divergence audit); hb_digests=False pins the
         # v1 heartbeat shape
         self.hb_digests = hb_digests
+        # membership state, driven by the transport failure detector
+        # (set_link_state): 'up' | 'suspect' | 'down'. In-process links
+        # have no detector and stay 'up' forever.
+        self.link_state = 'up'
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -431,6 +442,23 @@ class ResilientConnection:
                 return flush()
         self._deferred_links = []
         return flush()
+
+    def set_link_state(self, state):
+        """Membership hook — the transport failure detector drives
+        this. ``'down'`` parks the retransmit loop and heartbeat
+        (tick() freezes ``_sent`` instead of burning the retry budget
+        against a provably dead peer); leaving ``'down'`` re-dues every
+        parked envelope for the next quantum, because the backoff
+        schedule accumulated against a dead link measures nothing about
+        congestion on the healed one. ``'suspect'`` changes no
+        behavior — retransmits and heartbeats keep probing."""
+        prev = self.link_state
+        if state == prev:
+            return
+        self.link_state = state
+        if prev == 'down':
+            for rec in self._sent.values():
+                rec.due = min(rec.due, self._now + 1)
 
     # -- outbound ------------------------------------------------------------
 
@@ -867,6 +895,13 @@ class ResilientConnection:
                             for a, s in acked.items()):
                 self._peer_acked[doc_id] = dict(clock)
                 self._conn._their_clock[doc_id] = dict(clock)
+                # the delta-clock baseline must regress with the acked
+                # record, or the next advert would elide entries the
+                # peer no longer has (connection.py note_clock_regressed)
+                regressed = getattr(self._conn,
+                                    'note_clock_regressed', None)
+                if regressed is not None:
+                    regressed(doc_id, clock)
                 self._conn.maybe_send_changes(doc_id)
             clock_union(self._peer_acked, doc_id, clock)
         self._note_acked(list(clocks))
@@ -945,6 +980,18 @@ class ResilientConnection:
         if self.admission is not None:
             self.admission.tick()      # shared controllers are ticked
             #                            once per quantum by their owner
+        if self.link_state == 'down':
+            # membership park: the failure detector declared this peer
+            # dead, so burning the retry budget would only exhaust
+            # every in-flight envelope — rolling back its optimistic
+            # clocks and re-requesting via heartbeat once the peer
+            # heals, for nothing. Park instead: ``_sent`` keeps its
+            # contents and attempt counts frozen, the heartbeat stays
+            # quiet (no point beating a dead link), and
+            # set_link_state('up') re-dues everything immediately.
+            if self._sent:
+                self.metrics.bump('membership_retries_parked')
+            return
         # seqs are minted monotonically and entries only deleted, so
         # dict order IS ascending seq order — no re-sort per quantum
         for seq in list(self._sent):
@@ -1107,6 +1154,7 @@ class ResilientConnection:
                 table_bytes = table.bytes
         return {
             'peer': self.peer_id,
+            'state': self.link_state,
             'wire_version': wire_version,
             'table_entries': table_entries,
             'table_bytes': table_bytes,

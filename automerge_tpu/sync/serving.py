@@ -764,6 +764,19 @@ class ServingDocSet:
 
     noteDivergence = note_divergence
 
+    def note_peer_down(self, peer_id):
+        """Membership hook with a black box: park the inner doc set's
+        pending births, then dump a ``peer_down`` incident — the
+        retained events of the beats before the failure detector
+        declared the peer dead (the first thing an operator wants
+        when a node vanishes mid-schedule)."""
+        self.inner.note_peer_down(peer_id)
+        if self.flight_recorder is not None:
+            dump_incident(self.flight_recorder, self.dir_path,
+                          'peer_down', peer=peer_id)
+
+    notePeerDown = note_peer_down
+
     # -- health --------------------------------------------------------------
 
     def _serving_health_signals(self):

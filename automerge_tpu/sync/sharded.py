@@ -254,6 +254,11 @@ class ShardedGeneralDocSet:
         self._health_last_exhausted = 0
         self._health_last_retraces = None
         self._births = {}
+        # membership hooks the borrowed convergence/status evaluators
+        # consult (a sharded set has no transport links of its own, so
+        # these stay empty unless a binding marks peers down)
+        self._parked_births = {}
+        self._down_peers = set()
 
     # -- placement / routing -------------------------------------------------
 

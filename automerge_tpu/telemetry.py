@@ -14,7 +14,11 @@ tooling with ZERO new dependencies:
   scrape shows both the aggregates and the per-link slices. Every
   REGISTERED name renders even when never bumped — a dashboard keyed
   on a registered metric can never silently read nothing
-  (tests/test_metrics.py asserts it).
+  (tests/test_metrics.py asserts it). PR 19's ``transport_*`` (socket
+  framing/mux) and ``membership_*`` (failure detector) registries
+  export through the same path with no exporter changes — the
+  ``node/<id>/...`` scopes the transport stamps become labels exactly
+  like the per-peer connection scopes.
 - :func:`dump_chrome_trace` — completed ``span`` events (from a
   :class:`~automerge_tpu.utils.metrics.FlightRecorder`, a subscriber
   log, or a replayed incident file) as Chrome-trace/Perfetto JSON:

@@ -151,6 +151,7 @@ SYNC_COUNTERS = (
     'sync_wire_table_hits', 'sync_wire_table_misses',
     'sync_wire_table_evictions', 'sync_wire_table_stale_refs',
     'sync_wire_session_resumes', 'sync_wire_session_resets',
+    'sync_wire_clock_entries_elided',
     'sync_wire_bytes_sent', 'sync_wire_parse_ms',
     'sync_apply_ms', 'sync_flush_ms')
 
@@ -332,6 +333,64 @@ SIM_COUNTERS = (
     'sim_scenario_runs', 'sim_ticks', 'sim_ops_injected',
     'sim_actors_spawned')
 
+# Socket-transport counters (sync/transport.py — the real-TCP binding
+# around the envelope protocol; every frame that crosses a socket is
+# accounted here, so the wire-level health of a link is auditable
+# without tcpdump):
+#   transport_frames_sent/_received    CRC-framed envelopes written to /
+#                              decoded off a socket
+#   transport_bytes_sent/_received     raw socket bytes (framing
+#                              overhead included — this is the number
+#                              the reconnect byte-accounting gates)
+#   transport_frame_errors     frames rejected by the codec (bad magic,
+#                              out-of-bounds length prefix, CRC
+#                              mismatch, malformed header) — each one
+#                              resets the stream and re-dials; the
+#                              envelope layer repairs by retransmit
+#   transport_partial_frames   torn tails: a connection died mid-frame
+#                              (the partial bytes are discarded, never
+#                              parsed)
+#   transport_frames_dropped   outgoing frames collapsed out of a
+#                              bounded per-peer queue (oldest-advert
+#                              first) or inbound frames for an unknown
+#                              doc set / pre-handshake peer
+#   transport_connects         sockets dialed successfully (first dial
+#                              per link)
+#   transport_accepts          inbound sockets adopted after a HELLO
+#   transport_reconnects       successful re-dials of a previously
+#                              connected link
+#   transport_disconnects      sockets lost (EOF, reset, frame error)
+TRANSPORT_COUNTERS = (
+    'transport_frames_sent', 'transport_frames_received',
+    'transport_bytes_sent', 'transport_bytes_received',
+    'transport_frame_errors', 'transport_partial_frames',
+    'transport_frames_dropped', 'transport_connects',
+    'transport_accepts', 'transport_reconnects',
+    'transport_disconnects')
+
+# Liveness/membership counters (sync/transport.py failure detector +
+# the membership hooks in general_doc_set.py / resilient.py — the
+# fleet noticing a dead peer instead of retrying forever):
+#   membership_transitions     up/suspect/down state changes on any
+#                              peer link
+#   membership_peer_down_total peers declared dead (each first
+#                              detection also emits a `peer_down`
+#                              event and, on a serving node, dumps a
+#                              flight-recorder incident)
+#   membership_peers_up/_suspect/_down   gauges: current peer-link
+#                              states as seen by this endpoint
+#   membership_retries_parked  retransmit passes skipped because the
+#                              peer is `down` (the retry budget is
+#                              parked, not burned)
+#   membership_births_parked   pending convergence births parked
+#                              against a down peer (restored on heal,
+#                              never leaked)
+MEMBERSHIP_COUNTERS = (
+    'membership_transitions', 'membership_peer_down_total',
+    'membership_peers_up', 'membership_peers_suspect',
+    'membership_peers_down', 'membership_retries_parked',
+    'membership_births_parked')
+
 # Every registered counter/gauge/series name, in one tuple — the
 # telemetry exporter (automerge_tpu/telemetry.py) renders ALL of these
 # even when never bumped, and tests/test_metrics.py asserts none is
@@ -340,7 +399,8 @@ ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
                           SYNC_COUNTERS + CONVERGENCE_COUNTERS +
                           DEVICE_COUNTERS + COMPACTION_COUNTERS +
                           CONTROL_COUNTERS + PLACEMENT_COUNTERS +
-                          SIM_COUNTERS)
+                          SIM_COUNTERS + TRANSPORT_COUNTERS +
+                          MEMBERSHIP_COUNTERS)
 
 # Observe-series name suffixes: a registered name ending in one of
 # these is a histogram series (count/sum/max + buckets), not a scalar

@@ -1994,6 +1994,204 @@ def reconnect_cli(argv):
     }), flush=True)
 
 
+def bench_transport(n_docsets=8, beats=24, n_docs=1200,
+                    divergent=50, link_samples=30):
+    """Real-socket transport lane (PR 19).
+
+    Three figures, all over actual loopback TCP through
+    :class:`~automerge_tpu.sync.transport.TransportEndpoint`:
+
+    * ``transport_link_floor_ms_p50/_p99`` — single-change write ->
+      replicated-and-acked round trips over one socket (recorded,
+      not banded: wall-clock floors are hardware-dependent);
+    * ``transport_mux_overhead_x`` — per-beat drain time of
+      ``n_docsets`` doc sets multiplexed over ONE socket vs the same
+      schedule over ``n_docsets`` separate socket pairs. The mux must
+      cost <= 1.2x the dedicated-socket arm (banded) — the whole
+      point of session multiplexing is that one framed stream carries
+      the fleet without a fan-in penalty;
+    * ``transport_reconnect_bytes_ratio`` — a killed-and-restarted
+      peer re-establishing over a REAL re-dial: cold (``resume=False``
+      — fresh sessions, full re-advertisement) bytes over resumed
+      (wire-session path serves only the divergence window) bytes.
+      Banded >= 3x, the socket analogue of the in-process smoke
+      ``reconnect_bytes_ratio``.
+    """
+    import asyncio
+
+    from automerge_tpu.common import ROOT_ID
+    from automerge_tpu.sync.chaos import (SocketChaosFleet,
+                                          canonical, doc_set_view)
+    from automerge_tpu.sync.general_doc_set import GeneralDocSet
+    from automerge_tpu.sync.transport import TransportEndpoint
+    from automerge_tpu.utils.metrics import metrics
+
+    def change(actor, seq=1, value=0, deps=None):
+        return {'actor': actor, 'seq': seq, 'deps': deps or {},
+                'ops': [{'action': 'set', 'obj': ROOT_ID, 'key': 'k',
+                         'value': value}]}
+
+    def counter_total(name):
+        return sum(v for k, v in metrics.counters.items()
+                   if k.endswith(name))
+
+    # -- link floor: replicated-and-acked round trips --------------------
+    sets = [GeneralDocSet(64), GeneralDocSet(64)]
+    fleet = SocketChaosFleet(sets, seed=1)
+    for r in range(1, 4):              # warm the socket + sessions
+        sets[0].apply_changes_batch(
+            {'warm': [change('w', seq=r, value=r,
+                             deps={'w': r - 1} if r > 1 else None)]})
+        fleet.run(max_ticks=200)
+    samples = []
+    for r in range(link_samples):
+        sets[0].apply_changes_batch(
+            {f'd{r}': [change(f'a{r}', value=r)]})
+        t0 = time.perf_counter()
+        fleet.run(max_ticks=200)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    fleet.close()
+    link_p50 = float(np.percentile(samples, 50))
+    link_p99 = float(np.percentile(samples, 99))
+    log(f'transport[link floor]: {link_p50:.2f} ms p50, '
+        f'{link_p99:.2f} ms p99 over {link_samples} round trips')
+
+    # -- mux fan-in: one socket vs n_docsets sockets ---------------------
+    def mux_arm(shared):
+        a_sets = [GeneralDocSet(64) for _ in range(n_docsets)]
+        b_sets = [GeneralDocSet(64) for _ in range(n_docsets)]
+        loop = asyncio.new_event_loop()
+        per_beat = []
+        try:
+            if shared:
+                eps = [TransportEndpoint(
+                           'a', {f's{i}': a_sets[i]
+                                 for i in range(n_docsets)}),
+                       TransportEndpoint(
+                           'b', {f's{i}': b_sets[i]
+                                 for i in range(n_docsets)})]
+                dials = [(eps[0], 'b', eps[1])]
+            else:
+                eps = []
+                dials = []
+                for i in range(n_docsets):
+                    ea = TransportEndpoint(f'a{i}',
+                                           {'s': a_sets[i]})
+                    eb = TransportEndpoint(f'b{i}',
+                                           {'s': b_sets[i]})
+                    eps += [ea, eb]
+                    dials.append((ea, f'b{i}', eb))
+
+            async def drain():
+                for _ in range(400):
+                    for ep in eps:
+                        await ep.tick()
+                    for _ in range(6):
+                        await asyncio.sleep(0)
+                    if not any(ep.pending() for ep in eps):
+                        return
+                raise RuntimeError('mux arm failed to drain')
+
+            async def go():
+                for ep in eps:
+                    await ep.start()
+                for ea, name, eb in dials:
+                    await ea.connect(name, '127.0.0.1', eb.port)
+                await drain()          # handshakes + empty adverts
+                for t in range(beats):
+                    for i in range(n_docsets):
+                        a_sets[i].apply_changes_batch(
+                            {f'doc{t}':
+                             [change(f'w{i}-{t}', value=t)]})
+                    t0 = time.perf_counter()
+                    await drain()
+                    per_beat.append(
+                        (time.perf_counter() - t0) * 1e3)
+                for ep in eps:
+                    await ep.close()
+            loop.run_until_complete(go())
+            loop.run_until_complete(asyncio.sleep(0.01))
+        finally:
+            loop.close()
+        for i in range(n_docsets):
+            assert canonical(doc_set_view(a_sets[i])) == \
+                canonical(doc_set_view(b_sets[i])), \
+                'mux arm did not converge'
+        return float(np.percentile(per_beat, 50))
+
+    mux_ms = mux_arm(shared=True)
+    sep_ms = mux_arm(shared=False)
+    mux_overhead = mux_ms / max(sep_ms, 1e-9)
+    log(f'transport[mux fan-in]: {n_docsets} doc sets over 1 socket '
+        f'{mux_ms:.2f} ms/beat vs {n_docsets} sockets '
+        f'{sep_ms:.2f} ms/beat -> {mux_overhead:.2f}x')
+
+    # -- reconnect over a real re-dial: resumed vs cold ------------------
+    def socket_reconnect_bytes(resume):
+        src = GeneralDocSet(n_docs + 8)
+        dst = GeneralDocSet(n_docs + 8)
+        src.apply_changes_batch(
+            {f'doc{i}': [change(f'ac-{i:08d}', value=i)]
+             for i in range(n_docs)})
+        rf = SocketChaosFleet([src, dst], seed=2, resume=resume)
+        try:
+            rf.run(max_ticks=2000)     # initial replication
+            assert len(dst.doc_ids) == n_docs, \
+                'socket replication incomplete'
+            rf.kill(1)
+            src.apply_changes_batch(
+                {f'doc{i}': [change(f'ac-{i:08d}', seq=2,
+                                    value=n_docs + i,
+                                    deps={f'ac-{i:08d}': 1})]
+                 for i in range(divergent)})
+            before = counter_total('transport_bytes_sent')
+            rf.restart(1, resume=resume)
+            rf.run(max_ticks=2000)
+            assert dst.materialize('doc0') == {'k': n_docs}
+            return counter_total('transport_bytes_sent') - before
+        finally:
+            rf.close()
+
+    resumed_bytes = socket_reconnect_bytes(resume=True)
+    cold_bytes = socket_reconnect_bytes(resume=False)
+    ratio = cold_bytes / max(resumed_bytes, 1)
+    log(f'transport[reconnect {n_docs} docs, {divergent} divergent]: '
+        f'resumed re-dial {resumed_bytes / 1e3:.1f} KB, cold '
+        f'{cold_bytes / 1e3:.1f} KB -> {ratio:.1f}x')
+
+    return {
+        'transport_link_floor_ms_p50': round(link_p50, 3),
+        'transport_link_floor_ms_p99': round(link_p99, 3),
+        'transport_mux_docsets': n_docsets,
+        'transport_mux_ms_per_beat': round(mux_ms, 3),
+        'transport_separate_ms_per_beat': round(sep_ms, 3),
+        'transport_mux_overhead_x': round(mux_overhead, 3),
+        'transport_reconnect_resumed_bytes': resumed_bytes,
+        'transport_reconnect_cold_bytes': cold_bytes,
+        'transport_reconnect_bytes_ratio': round(ratio, 2),
+    }
+
+
+def transport_cli(argv):
+    """``python bench.py --transport [--smoke]``: the CI-gated
+    real-socket lane (one JSON line; hardware-independent ratio bands
+    in PERF_BUDGETS.json). The smoke lane scales the fleet down; its
+    banded keys carry a ``transport_smoke_`` prefix."""
+    smoke_lane = '--smoke' in argv
+    if smoke_lane:
+        res = bench_transport(n_docsets=6, beats=12, n_docs=450,
+                              divergent=15, link_samples=20)
+        res = {k.replace('transport_', 'transport_smoke_', 1): v
+               for k, v in res.items()}
+    else:
+        res = bench_transport()
+    print(json.dumps({
+        'bench': 'transport',
+        'transport_smoke': 1 if smoke_lane else 0,
+        **res,
+    }), flush=True)
+
+
 def bench_snapshot_resume(n_changes=20000, n_keys=8):
     """Checkpoint/resume: the packed snapshot loads with no CRDT replay
     (closure metadata only), vs the change log's full replay."""
@@ -2806,6 +3004,8 @@ if __name__ == '__main__':
         incremental_order_cli(sys.argv[1:])
     elif '--reconnect' in sys.argv[1:]:
         reconnect_cli(sys.argv[1:])
+    elif '--transport' in sys.argv[1:]:
+        transport_cli(sys.argv[1:])
     elif '--smoke' in sys.argv[1:]:
         smoke()
     else:

@@ -352,7 +352,7 @@ class TestRegistryDriftGuard:
         r"(?:bump|set_gauge|observe|ratchet|_act)\(\s*"
         r"(?:'[a-z0-9_]+',\s*)?'"
         r"((?:sync|serving|fleet|device|mem|compaction|control|sim"
-        r"|placement|shard)_"
+        r"|placement|shard|transport|membership)_"
         r"[a-z0-9_]+)'")
 
     def _package_names(self):
@@ -391,7 +391,8 @@ class TestRegistryDriftGuard:
                 if n.startswith(('sync_', 'serving_', 'fleet_',
                                  'device_', 'mem_', 'compaction_',
                                  'control_', 'placement_', 'shard_',
-                                 'sim_'))} \
+                                 'sim_', 'transport_',
+                                 'membership_'))} \
             - bumped
         assert not dead, f'registered but never bumped: {sorted(dead)}'
 
@@ -403,7 +404,8 @@ class TestRegistryDriftGuard:
                     M.SYNC_COUNTERS, M.CONVERGENCE_COUNTERS,
                     M.DEVICE_COUNTERS, M.COMPACTION_COUNTERS,
                     M.CONTROL_COUNTERS, M.PLACEMENT_COUNTERS,
-                    M.SIM_COUNTERS):
+                    M.SIM_COUNTERS, M.TRANSPORT_COUNTERS,
+                    M.MEMBERSHIP_COUNTERS):
             dup = seen & set(reg)
             assert not dup, f'registered twice: {sorted(dup)}'
             seen |= set(reg)

@@ -1,0 +1,526 @@
+"""Socket transport suite: framing, fuzz, mux, membership.
+
+The frame codec is the trust boundary between a hostile byte stream
+and the envelope protocol: every fuzz case below must either decode
+the original frames, reset the stream through a COUNTED FrameError,
+or account a torn tail — never a hang, never a quarantine. On top:
+the delta-clock elision (satellite 1), the membership retransmit park
+(satellite 2) and the endpoint's kill/restart acceptance path —
+failure detection within the heartbeat deadline, a peer_down
+incident, and a session resume that serves only the divergence
+window.
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.common import ROOT_ID
+from automerge_tpu.durability import load_incident
+from automerge_tpu.sync import (FrameDecoder, FrameError,
+                                GeneralDocSet, ResilientConnection,
+                                ServingDocSet, WireConnection)
+from automerge_tpu.sync.chaos import (SocketChaosFleet, canonical,
+                                      doc_set_view)
+from automerge_tpu.sync.transport import (CHANNELS, encode_ctl_frame,
+                                          encode_frame)
+from automerge_tpu.utils.metrics import FlightRecorder, metrics
+
+
+def change(actor, seq=1, key='k', value=1, deps=None):
+    return {'actor': actor, 'seq': seq, 'deps': deps or {}, 'ops': [
+        {'action': 'set', 'obj': ROOT_ID, 'key': key,
+         'value': value}]}
+
+
+def write(ds, doc_id, actor, value, seq=1):
+    ds.apply_changes_batch(
+        {doc_id: [change(actor, seq=seq, value=value)]})
+
+
+def env_data(seq=1, payload=None):
+    return {'v': 2, 'kind': 'data', 'seq': seq, 'sum': 0,
+            'payload': payload if payload is not None
+            else {'docs': ['d0'], 'clocks': [{'a': 1}]}}
+
+
+def total(name):
+    return sum(v for k, v in metrics.counters.items()
+               if k.endswith(name))
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+
+
+class TestFrameCodec:
+    def test_roundtrip_plain(self):
+        frame = encode_frame('fleet', env_data())
+        out = FrameDecoder().feed(frame)
+        assert out == [('env', 'fleet', env_data())]
+
+    def test_roundtrip_binary_fields(self):
+        """bytes-valued payload fields ship raw in the body and come
+        back as bytes — JSON never sees (or base64s) a wire blob."""
+        payload = {'docs': ['d0'], 'blob': b'\x00\xff' * 300,
+                   'tab': b'', 'n': 3, 'name': 'café'}
+        frame = encode_frame('fleet', env_data(payload=payload))
+        [(kind, dset, env)] = FrameDecoder().feed(frame)
+        assert (kind, dset) == ('env', 'fleet')
+        assert env['payload']['blob'] == payload['blob']
+        assert env['payload']['tab'] == b''
+        assert env['payload']['n'] == 3
+        assert env['payload']['name'] == 'café'
+
+    def test_ctl_roundtrip(self):
+        frame = encode_ctl_frame({'hello': 1, 'node': 'n0',
+                                  'epoch': 7})
+        out = FrameDecoder().feed(frame)
+        assert out == [('ctl', None,
+                        {'hello': 1, 'node': 'n0', 'epoch': 7})]
+
+    @pytest.mark.parametrize('kind,chan', [
+        ('data', 'data'), ('ack', 'ack'), ('busy', 'busy'),
+        ('hb', 'hb')])
+    def test_channel_byte(self, kind, chan):
+        env = dict(env_data())
+        env['kind'] = kind
+        assert encode_frame('f', env)[2] == CHANNELS[chan]
+
+    def test_state_payload_gets_state_channel(self):
+        env = env_data(payload={'docs': ['d0'], 'state': b'snap'})
+        assert encode_frame('f', env)[2] == CHANNELS['state']
+
+    def test_byte_at_a_time_feed(self):
+        """Interleaved partial reads are the NORMAL stream case: one
+        byte per feed still yields every frame, in order."""
+        frames = [encode_frame('f', env_data(seq=i))
+                  for i in range(4)]
+        dec = FrameDecoder()
+        out = []
+        for b in b''.join(frames):
+            out += dec.feed(bytes([b]))
+        assert [e['seq'] for _k, _d, e in out] == [0, 1, 2, 3]
+        assert dec.buffered == 0
+
+
+# ---------------------------------------------------------------------------
+# framing fuzz (satellite: every case recovers, resets cleanly, or
+# raises a counted protocol error — never a hang, never a quarantine)
+
+
+class TestFramingFuzz:
+    def test_truncated_frame_is_a_counted_torn_tail(self):
+        frame = encode_frame('f', env_data())
+        before = total('transport_partial_frames')
+        dec = FrameDecoder()
+        assert dec.feed(frame[:len(frame) - 3]) == []
+        dec.eof()
+        assert total('transport_partial_frames') == before + 1
+        # the decoder is reusable after the reset
+        assert dec.feed(frame) == [('env', 'f', env_data())]
+
+    def test_bit_flipped_length_prefix_is_rejected_not_buffered(self):
+        """A flipped high bit in the length prefix asks the decoder
+        to buffer gigabytes for a frame that will never complete —
+        MAX_FRAME_BYTES rejects it as a counted error instead."""
+        frame = bytearray(encode_frame('f', env_data()))
+        frame[3] |= 0x80               # hlen's high byte
+        before = total('transport_frame_errors')
+        with pytest.raises(FrameError):
+            FrameDecoder().feed(bytes(frame))
+        assert total('transport_frame_errors') == before + 1
+
+    def test_bad_magic_rejected(self):
+        frame = b'XX' + encode_frame('f', env_data())[2:]
+        with pytest.raises(FrameError):
+            FrameDecoder().feed(frame)
+
+    def test_crc_catches_body_flip(self):
+        frame = bytearray(encode_frame('f', env_data(
+            payload={'docs': ['d0'], 'blob': b'abcdef'})))
+        frame[-2] ^= 0x01
+        with pytest.raises(FrameError):
+            FrameDecoder().feed(bytes(frame))
+
+    def test_error_resets_stream_then_fresh_frames_decode(self):
+        good = encode_frame('f', env_data(seq=9))
+        bad = bytearray(good)
+        bad[-1] ^= 0xFF
+        dec = FrameDecoder()
+        with pytest.raises(FrameError):
+            dec.feed(bytes(bad) + good)  # good frame after the bad
+        # the reset dropped everything buffered (the stream is not
+        # trustworthy past a CRC failure) — but the decoder itself
+        # keeps working on the re-dialed stream
+        assert dec.buffered == 0
+        assert dec.feed(good) == [('env', 'f', env_data(seq=9))]
+
+    def test_fuzz_mutations_never_hang_or_mislead(self):
+        """Seeded fuzz over whole streams: random byte flips, random
+        truncations, random garbage splices, random chunking. Every
+        rep must yield a PREFIX-or-subset of the original frames
+        (CRC'd frames are either intact or rejected — a mutated frame
+        can never decode to different content) or raise a counted
+        FrameError."""
+        rng = random.Random(0xF7A)
+        envs = [env_data(seq=i, payload={
+            'docs': [f'd{i}'], 'clocks': [{'a': i + 1}],
+            'blob': bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(64)))})
+            for i in range(6)]
+        stream = b''.join(encode_frame('f', e) for e in envs)
+        originals = [('env', 'f', e) for e in envs]
+        for rep in range(300):
+            data = bytearray(stream)
+            mode = rep % 3
+            if mode == 0:              # flip 1-4 bytes
+                for _ in range(rng.randrange(1, 5)):
+                    data[rng.randrange(len(data))] ^= \
+                        1 << rng.randrange(8)
+            elif mode == 1:            # truncate
+                del data[rng.randrange(len(data)):]
+            else:                      # splice garbage mid-stream
+                at = rng.randrange(len(data))
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 40)))
+                data[at:at] = junk
+            dec = FrameDecoder()
+            out = []
+            errors_before = total('transport_frame_errors')
+            try:
+                at = 0
+                while at < len(data):
+                    n = rng.randrange(1, 512)
+                    out += dec.feed(bytes(data[at:at + n]))
+                    at += n
+                dec.eof()
+            except FrameError:
+                assert total('transport_frame_errors') == \
+                    errors_before + 1
+            # decoded frames are a subset of the originals, intact:
+            # corruption can suppress frames, never alter them
+            for item in out:
+                assert item in originals
+
+
+# ---------------------------------------------------------------------------
+# delta-encoded clock adverts (satellite 1)
+
+
+class TestDeltaClocks:
+    def _pair(self):
+        """A resilient WIRE pair: the ack flow is what folds acked
+        clocks into the sender's elision baseline — bare wire
+        connections never ack, so they never elide."""
+        src, dst = GeneralDocSet(16), GeneralDocSet(16)
+        ma, mb = [], []
+        ra = ResilientConnection(src, ma.append, batching=True,
+                                 wire=True, heartbeat_every=0)
+        rb = ResilientConnection(dst, mb.append, batching=True,
+                                 wire=True, heartbeat_every=0)
+        ra.open()
+        rb.open()
+        return src, dst, ra, rb, ma, mb
+
+    def _pump(self, ra, rb, ma, mb, rounds=40):
+        for _ in range(rounds):
+            ra.flush()
+            rb.flush()
+            if not (ma or mb):
+                return
+            for m in ma[:]:
+                ma.remove(m)
+                rb.receive_msg(m)
+            for m in mb[:]:
+                mb.remove(m)
+                ra.receive_msg(m)
+
+    def test_ship_clock_elides_acked_entries(self):
+        src, dst, ra, rb, ma, mb = self._pair()
+        write(src, 'doc0', 'a', 1)
+        self._pump(ra, rb, ma, mb)
+        # the first exchange acked {'a': 1}; a later advert for the
+        # same doc ships only what GREW past that baseline
+        wire = ra._conn
+        assert wire._adv_acked.get('doc0') == {'a': 1}
+        before = total('sync_wire_clock_entries_elided')
+        shipped = wire._ship_clock('doc0', {'a': 1, 'b': 2}, 3)
+        assert shipped == {'b': 2}
+        assert total('sync_wire_clock_entries_elided') == before + 1
+
+    def test_fresh_session_ships_full_clocks(self):
+        """No acked baseline (new or reset session) -> full clocks,
+        nothing elided: the fallback IS the old protocol."""
+        src = GeneralDocSet(4)
+        ca = WireConnection(src, lambda m: None, wire_version=3)
+        assert ca._ship_clock('doc0', {'a': 3, 'b': 1}, 3) == \
+            {'a': 3, 'b': 1}
+
+    def test_v2_peer_never_sees_deltas(self):
+        src, dst, ra, rb, ma, mb = self._pair()
+        write(src, 'doc0', 'a', 1)
+        self._pump(ra, rb, ma, mb)
+        assert ra._conn._ship_clock('doc0', {'a': 1, 'b': 2}, 2) == \
+            {'a': 1, 'b': 2}
+
+    def test_fully_elided_advert_ships_whole(self):
+        """An advert whose every entry is elided would be WIRE-
+        IDENTICAL to a request (empty clock, zero count) — it must
+        ship the full clock instead."""
+        src, dst, ra, rb, ma, mb = self._pair()
+        write(src, 'doc0', 'a', 1)
+        self._pump(ra, rb, ma, mb)
+        assert ra._conn._ship_clock(
+            'doc0', {'a': 1}, 3, advert=True) == {'a': 1}
+
+    def test_regression_heal_resets_the_baseline(self):
+        src, dst, ra, rb, ma, mb = self._pair()
+        write(src, 'doc0', 'a', 1)
+        self._pump(ra, rb, ma, mb)
+        ra._conn.note_clock_regressed('doc0', {})
+        assert ra._conn._ship_clock('doc0', {'a': 1}, 3) == {'a': 1}
+
+    def test_deltas_converge_identically(self):
+        """End to end: a multi-beat session with elision active
+        converges to the same views as the doc sets' own state."""
+        src, dst, ra, rb, ma, mb = self._pair()
+        before = total('sync_wire_clock_entries_elided')
+        for beat in range(4):
+            for d in range(3):
+                write(src, f'doc{d}', f'a{beat}', beat + d,
+                      seq=1)
+            self._pump(ra, rb, ma, mb)
+        assert canonical(doc_set_view(src)) == \
+            canonical(doc_set_view(dst))
+        assert total('sync_wire_clock_entries_elided') > before
+
+
+# ---------------------------------------------------------------------------
+# membership park (satellite 2)
+
+
+class TestMembershipPark:
+    def _conn(self):
+        ds = GeneralDocSet(8)
+        sent = []
+        conn = ResilientConnection(ds, sent.append, batching=True,
+                                   heartbeat_every=4)
+        conn.open()
+        return ds, conn, sent
+
+    def test_down_parks_retransmits_and_freezes_the_budget(self):
+        ds, conn, sent = self._conn()
+        write(ds, 'doc0', 'a', 1)
+        conn.flush()
+        assert conn._sent, 'no unacked envelope to park'
+        attempts = {s: r.attempts for s, r in conn._sent.items()}
+        conn.set_link_state('down')
+        before_parked = total('membership_retries_parked')
+        n_sent = len(sent)
+        for _ in range(60):            # way past every backoff due
+            conn.tick()
+        assert len(sent) == n_sent, 'retransmitted against a down peer'
+        assert {s: r.attempts for s, r in conn._sent.items()} == \
+            attempts, 'retry budget burned while parked'
+        assert total('membership_retries_parked') > before_parked
+
+    def test_down_parks_the_heartbeat_too(self):
+        ds, conn, sent = self._conn()
+        conn.set_link_state('down')
+        for _ in range(20):
+            conn.tick()
+        assert not any(e.get('kind') == 'hb' for e in sent)
+
+    def test_up_re_dues_everything_immediately(self):
+        ds, conn, sent = self._conn()
+        write(ds, 'doc0', 'a', 1)
+        conn.flush()
+        conn.set_link_state('down')
+        for _ in range(10):
+            conn.tick()
+        n_sent = len(sent)
+        conn.set_link_state('up')
+        conn.tick()
+        conn.tick()
+        assert len(sent) > n_sent, 'no retransmit after the link healed'
+
+    def test_suspect_changes_nothing(self):
+        ds, conn, sent = self._conn()
+        write(ds, 'doc0', 'a', 1)
+        conn.flush()
+        conn.set_link_state('suspect')
+        n_sent = len(sent)
+        for _ in range(20):
+            conn.tick()
+        assert len(sent) > n_sent, 'suspect must keep retransmitting'
+
+    def test_connection_status_reports_link_state(self):
+        ds, conn, _sent = self._conn()
+        assert conn.connection_status()['state'] == 'up'
+        conn.set_link_state('down')
+        assert conn.connection_status()['state'] == 'down'
+
+
+# ---------------------------------------------------------------------------
+# endpoint: mux, membership, kill/restart acceptance
+
+
+class TestTransportEndpoint:
+    def test_two_nodes_converge_over_real_sockets(self):
+        sets = [GeneralDocSet(16) for _ in range(2)]
+        fleet = SocketChaosFleet(sets, seed=3)
+        try:
+            for t in range(6):
+                write(sets[t % 2], f'doc{t}', f'a{t}', t)
+                fleet.tick()
+            fleet.run(max_ticks=300)
+            assert canonical(doc_set_view(sets[0])) == \
+                canonical(doc_set_view(sets[1]))
+            ep = fleet.endpoints[0]
+            assert ep.membership() == {'node1': 'up'}
+            st = sets[0].fleet_status(docs=False)
+            assert st['connections']['node1']['state'] == 'up'
+            assert total('transport_frames_sent') > 0
+            assert total('transport_bytes_received') > 0
+        finally:
+            fleet.close()
+
+    def test_one_socket_multiplexes_every_doc_set(self):
+        """Two hosted doc sets, ONE socket pair: both converge, and
+        only one connect happens per direction."""
+        a0, a1 = GeneralDocSet(8), GeneralDocSet(8)
+        b0, b1 = GeneralDocSet(8), GeneralDocSet(8)
+        import asyncio
+        from automerge_tpu.sync.transport import TransportEndpoint
+        loop = asyncio.new_event_loop()
+        try:
+            ea = TransportEndpoint('a', {'s0': a0, 's1': a1})
+            eb = TransportEndpoint('b', {'s0': b0, 's1': b1})
+
+            async def go():
+                await ea.start()
+                await eb.start()
+                await ea.connect('b', '127.0.0.1', eb.port)
+                write(a0, 'x', 'w0', 1)
+                write(b1, 'y', 'w1', 2)
+                for _ in range(120):
+                    await ea.tick()
+                    await eb.tick()
+                    for _ in range(6):
+                        await asyncio.sleep(0)
+                    if not (ea.pending() or eb.pending()):
+                        break
+                await ea.close()
+                await eb.close()
+            loop.run_until_complete(go())
+            loop.run_until_complete(asyncio.sleep(0.01))
+        finally:
+            loop.close()
+        assert canonical(doc_set_view(a0)) == \
+            canonical(doc_set_view(b0))
+        assert canonical(doc_set_view(a1)) == \
+            canonical(doc_set_view(b1))
+
+    def test_transparent_reconnect_keeps_sessions(self):
+        """A TCP blip (socket dies, process doesn't) re-dials under
+        the SAME epoch: the live connections and their v3 session
+        tables survive — no session reset, no session resume."""
+        sets = [GeneralDocSet(16) for _ in range(2)]
+        fleet = SocketChaosFleet(sets, seed=4)
+        try:
+            for t in range(4):
+                write(sets[t % 2], f'doc{t}', f'a{t}', t)
+                fleet.tick()
+            fleet.run(max_ticks=300)
+            ep = fleet.endpoints[0]
+            conn_before = ep.connection_for('node1', 'fleet')
+            resumes = total('sync_wire_session_resumes')
+            resets = total('sync_wire_session_resets')
+
+            async def blip():
+                link = ep.peers['node1']
+                link.writer.transport.abort()
+            fleet._run(blip())
+            write(sets[0], 'after', 'z', 1)
+            fleet.run(max_ticks=300, min_ticks=3)
+            assert canonical(doc_set_view(sets[0])) == \
+                canonical(doc_set_view(sets[1]))
+            assert ep.connection_for('node1', 'fleet') is conn_before
+            assert total('sync_wire_session_resumes') == resumes
+            assert total('sync_wire_session_resets') == resets
+            assert total('transport_reconnects') > 0
+        finally:
+            fleet.close()
+
+    def test_kill_detect_incident_restart_resume(self, tmp_path):
+        """The acceptance path end to end: kill a peer mid-run ->
+        down within the heartbeat deadline, membership health signal
+        fires, peer_down incident dumps; writes keep applying locally
+        and new births PARK; restart -> resume serves only the
+        divergence window (session resumes, recovery bytes a fraction
+        of the initial sync) and every signal clears."""
+        inner = GeneralDocSet(64)
+        serving = ServingDocSet(inner, str(tmp_path / 'srv'),
+                                flight_recorder=FlightRecorder(256))
+        other = GeneralDocSet(64)
+        fleet = SocketChaosFleet([serving, other], seed=11,
+                                 suspect_after=6, dead_after=12)
+        try:
+            bytes_start = total('transport_bytes_sent')
+            for t in range(10):
+                write(serving, f'doc{t}', f'a{t}', t)
+                fleet.tick()
+            fleet.run(max_ticks=400)
+            initial_bytes = total('transport_bytes_sent') - bytes_start
+
+            fleet.kill(1)
+            ep0 = fleet.endpoints[0]
+            deadline = fleet.now + 12 + 8   # dead_after + redial grace
+            while fleet.now < deadline and \
+                    ep0.membership().get('node1') != 'down':
+                fleet.tick()
+            assert ep0.membership()['node1'] == 'down', \
+                'death not detected within the heartbeat deadline'
+            health = serving.evaluate_health()
+            assert health['state'] != 'green'
+            assert health['signals']['membership'] >= 1
+            st = serving.fleet_status(docs=False)
+            assert st['connections']['node1']['state'] == 'down'
+            files = sorted((tmp_path / 'srv' / 'incidents').glob(
+                '*peer_down*'))
+            assert files, 'no peer_down incident dumped'
+            _events, trigger = load_incident(str(files[0]))
+            assert trigger['kind'] == 'peer_down'
+            assert trigger['peer'] == 'node1'
+
+            # graceful degradation: local writes apply, births park
+            write(serving, 'newdoc', 'late', 1)
+            for _ in range(3):
+                fleet.tick()
+            conv = serving.fleet_status(docs=False)['convergence']
+            assert conv.get('parked_births', 0) >= 1
+            assert total('membership_retries_parked') > 0
+
+            resumes = total('sync_wire_session_resumes')
+            bytes_before = total('transport_bytes_sent')
+            fleet.restart(1)           # same doc set: durable state
+            fleet.run(max_ticks=600)
+            recovery_bytes = total('transport_bytes_sent') \
+                - bytes_before
+            assert total('sync_wire_session_resumes') > resumes, \
+                'restart did not take the session resume path'
+            assert canonical(doc_set_view(serving)) == \
+                canonical(doc_set_view(other))
+            # divergence-window accounting: recovery re-serves ONE
+            # doc (plus handshake), not the ten-doc initial sync
+            assert recovery_bytes < initial_bytes, (
+                f'recovery resent too much: {recovery_bytes} vs '
+                f'initial {initial_bytes}')
+            health = serving.evaluate_health()
+            assert health['signals']['membership'] == 0
+            assert serving.fleet_status(
+                docs=False)['convergence'].get('parked_births') == 0
+            assert not serving.quarantined and not other.quarantined
+        finally:
+            fleet.close()
