@@ -117,6 +117,61 @@ def encode_state_snapshot(st):
     return pack_snapshot(_STATE_MAGIC + zlib.compress(body, 6))
 
 
+def state_warm_literals(chunks, budget=64 * 1024):
+    """Deterministic wire-v3 warm-up literal list from ``'state'``
+    bootstrap payloads: the actor/key strings of each snapshot's JSON
+    header, as tagged wire literals, in docs order with actors before
+    keys, first occurrence winning, capped at ``budget`` literal
+    bytes. BOTH ends of a bootstrap derive this list from the same
+    payload bytes — the serving peer from the chunks it ships, the
+    bootstrapping peer from the chunks it receives — so sequential
+    refs assigned from 0 in list order agree by construction
+    (:meth:`~automerge_tpu.wire.SessionStringTable.warm` /
+    the receiver's enumerate seed). Header-only: a ``decompressobj``
+    inflates just each container's JSON head, never the column
+    planes. A payload that fails to parse contributes nothing (it
+    will quarantine at absorb time; warm-up must never raise)."""
+    from .durability import unpack_snapshot
+    from .snapshot import SnapshotCorruptError
+    from .wire import _TAG_STR
+    lits, seen, cost = [], set(), 0
+    for chunk in chunks:
+        try:
+            payload = unpack_snapshot(bytes(chunk))
+            if payload[:len(_STATE_MAGIC)] != _STATE_MAGIC:
+                continue
+            d = zlib.decompressobj()
+            head = d.decompress(payload[len(_STATE_MAGIC):], 4)
+            (hlen,) = _LEN.unpack_from(head, 0)
+            body = head[4:]
+            while len(body) < hlen and d.unconsumed_tail:
+                body += d.decompress(d.unconsumed_tail,
+                                     hlen - len(body))
+            header = json.loads(body[:hlen].decode())
+        except (SnapshotCorruptError, zlib.error, struct.error,
+                ValueError, UnicodeDecodeError):
+            continue
+        if not isinstance(header, dict) or \
+                header.get('format') != STATE_FORMAT:
+            continue
+        for field in ('actors', 'keys'):
+            strs = header.get(field)
+            if not isinstance(strs, list):
+                continue
+            for s in strs:
+                if not isinstance(s, str) or not s:
+                    continue
+                lit = bytes([_TAG_STR]) + s.encode('utf-8')
+                if lit in seen:
+                    continue
+                if cost + len(lit) > budget:
+                    return lits
+                seen.add(lit)
+                cost += len(lit)
+                lits.append(lit)
+    return lits
+
+
 def decode_state_snapshot(data):
     """Validate + decode an :func:`encode_state_snapshot` payload back
     into the column dict. Raises

@@ -576,7 +576,7 @@ class SocketChaosFleet:
                  corrupt=0.0, latency_ms=0.0, jitter_ms=0.0,
                  heartbeat_every=8, conn_kwargs=None,
                  suspect_after=24, dead_after=48, max_queue=1024,
-                 resume=True, dset='fleet'):
+                 resume=True, dset='fleet', eager=True):
         self.loop = asyncio.new_event_loop()
         self.doc_sets = list(doc_sets)
         self.dset = dset
@@ -589,7 +589,7 @@ class SocketChaosFleet:
         self._ep_kwargs = dict(suspect_after=suspect_after,
                                dead_after=dead_after,
                                max_queue=max_queue, resume=resume,
-                               redial_backoff=(1, 8))
+                               redial_backoff=(1, 8), eager=eager)
         self._fault_kwargs = dict(latency_ms=latency_ms,
                                   jitter_ms=jitter_ms, drop=drop,
                                   dup=dup, cut=cut, corrupt=corrupt)
@@ -707,6 +707,44 @@ class SocketChaosFleet:
         raise RuntimeError(
             f'socket fleet failed to converge within {max_ticks} '
             f'ticks')
+
+    def settle(self, max_rounds=400):
+        """Event-driven drain to convergence: poke every endpoint
+        once (flushing whatever the sync side staged), then just let
+        the event loop run — receives kick their own eager flushes,
+        acks ship inline, and the fleet quiesces WITHOUT a single
+        tick quantum. This is the eager fast path's convergence
+        driver: the time :meth:`settle` takes is the transport's real
+        link floor, where :meth:`run` pays the tick schedule. Returns
+        the number of pump rounds used; raises past ``max_rounds``.
+        Heartbeats/keepalives/failure detection do NOT advance here —
+        chaos schedules that need them still drive :meth:`tick`."""
+        async def go():
+            for ep in self.endpoints:
+                if not ep.closed:
+                    await ep.poke()
+            quiet = 0
+            for i in range(max_rounds):
+                await self._pump(2)
+                # pending() dips false between conversation legs while
+                # bytes are still in flight, and converged() is a full
+                # materialize — only pay for it after the fabric has
+                # been quiet for a few consecutive rounds
+                quiet = 0 if self.pending() else quiet + 1
+                if quiet >= 3:
+                    if self.converged():
+                        return i + 1
+                    quiet = 0
+                    for ep in self.endpoints:   # quiet but divergent:
+                        if not ep.closed:       # nudge staged work out
+                            await ep.poke()
+            return None
+        rounds = self._run(go())
+        if rounds is None:
+            raise RuntimeError(
+                f'socket fleet failed to settle within {max_rounds} '
+                f'pump rounds')
+        return rounds
 
     def close(self):
         async def go():

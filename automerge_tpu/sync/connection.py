@@ -20,6 +20,16 @@ from ..utils.metrics import metrics
 from .doc_set import backend_of as _backend_of
 
 
+# wire-v3 session warm-up from 'state' bootstraps (ISSUE 20): a peer
+# bootstrapping from a state snapshot pre-seeds its session string
+# table with the snapshot's actor/key literals, so its first warm
+# flush ships bare refs instead of redefining strings the serving
+# peer demonstrably holds. Module-level so the bench can A/B the
+# definition-byte savings; correctness never depends on it (a missed
+# warm-up just means normal define-on-first-use).
+SESSION_WARMUP = True
+
+
 class MessageRejected(ValueError):
     """An incoming sync message failed envelope/schema validation.
 
@@ -682,6 +692,16 @@ class WireConnection(BatchingConnection):
         # one a reconnecting peer just abandoned), older epochs drop.
         self._tx_table = None
         self._rx_tables = {}
+        # wire-v3 warm-up bookkeeping: `_warm_served` is the literal
+        # list this side shipped inside a 'state' bootstrap (kept to
+        # seed OUR rx map for the peer's warmed session when its first
+        # v3 message arrives carrying the 'warm' stamp); fixed once
+        # per connection so both ends agree on which snapshot set
+        # defines the warm refs. `_warm_announce` stamps outgoing v3
+        # messages until one is acked (the peer's seed is then proven
+        # applied).
+        self._warm_served = None
+        self._warm_announce = False
         # delta-clock baseline (v3 warm-link advert compression): per
         # doc, the highest clock PROVEN shared with the peer — folded
         # only from payload clocks the peer explicitly acked (ack =>
@@ -735,6 +755,10 @@ class WireConnection(BatchingConnection):
             for doc_id, clock in zip(msg['docs'], msg['clocks']):
                 self._their_clock = clock_union(self._their_clock,
                                                 doc_id, clock)
+            if SESSION_WARMUP and msg.get('warm') and \
+                    min(self.wire_version,
+                        self._peer_wire_version) >= 3:
+                self._warm_from_state(msg)
             self._incoming_state.append(msg)
             return None
         if isinstance(msg, dict) and 'wire' in msg:
@@ -797,6 +821,40 @@ class WireConnection(BatchingConnection):
                 and maxs > self._peer_state_version:
             self._peer_state_version = min(maxs, STATE_VERSION)
 
+    def _warm_from_state(self, msg):
+        """The bootstrapping peer's half of wire-v3 warm-up: derive
+        the served snapshots' actor/key literal list (identical to
+        what the sender derived — same bytes, same helper) and
+        pre-seed OUR session string table with it, entries acked, so
+        the first warm flush back ships bare refs. Outgoing v3
+        messages then carry the ``'warm'`` stamp until one acks,
+        telling the sender to seed its receive map by enumerating the
+        same list. Skipped whenever the table already allocated refs
+        (warm refs must never collide with organic ones)."""
+        if self._tx_table is not None and len(self._tx_table):
+            return
+        from .. import wire as _wire
+        from ..compaction import state_warm_literals
+        blob = memoryview(msg['blob'])
+        chunks, pos = [], 0
+        for ln in msg['lens']:
+            chunks.append(blob[pos:pos + ln])
+            pos += ln
+        lits = state_warm_literals(chunks)
+        if not lits:
+            return
+        if self._tx_table is None:
+            table = self._tx_table = _wire.SessionStringTable()
+            register = getattr(self._doc_set.store,
+                               'register_wire_session', None)
+            if register is not None:
+                register(table)
+        n = self._tx_table.warm(lits)
+        if n:
+            self._warm_announce = True
+            self.metrics.bump('sync_wire_session_warmups')
+            self.metrics.bump('sync_wire_warm_literals', n)
+
     def _resolve_session_msg(self, msg):
         """Rewrite one incoming v3 message from session-table form
         (spans referencing the peer's session-wide refs, ``tab``
@@ -817,6 +875,16 @@ class WireConnection(BatchingConnection):
                 # a dead session die with their connection
                 del self._rx_tables[next(iter(self._rx_tables))]
             refs = self._rx_tables[sid] = {}
+        if msg.get('warm') and self._warm_served is not None \
+                and not refs:
+            # the peer warmed its session from OUR 'state' bootstrap:
+            # its refs 0..n-1 are the literal list we recorded when we
+            # served it, in enumerate order (setdefault-idempotent —
+            # retransmits and organic defs never clash: the peer's
+            # organic refs start past the warm block)
+            for i, lit in enumerate(self._warm_served):
+                refs[i] = lit
+            self.metrics.bump('sync_wire_session_warmups')
         for ref, lit in _wire.decode_session_defs(msg['tab']):
             refs[ref] = lit
         try:
@@ -853,6 +921,10 @@ class WireConnection(BatchingConnection):
         from .. import wire as _wire
         def_refs, used = _wire.session_payload_refs(payload)
         self._tx_table.note_acked(def_refs, used)
+        if self._warm_announce and payload.get('warm'):
+            # a warm-stamped message acked: the peer decoded it, so
+            # its receive map is provably seeded — stop stamping
+            self._warm_announce = False
 
     def note_wire_dead(self, payload):
         """Envelope-layer feedback: a stored v3 wire payload died
@@ -1101,6 +1173,17 @@ class WireConnection(BatchingConnection):
                'lens': lens, 'blob': blob, 'maxs': STATE_VERSION}
         if self.wire_version >= 2:
             msg['maxv'] = self.wire_version
+        if SESSION_WARMUP and self._warm_served is None and \
+                min(self.wire_version, self._peer_wire_version) >= 3:
+            # wire-v3 warm-up offer: remember the literal list these
+            # snapshots define and stamp the message, so the
+            # bootstrapping peer may pre-seed its session table with
+            # refs we can resolve (enumerating the SAME list)
+            from ..compaction import state_warm_literals
+            lits = state_warm_literals(chunks)
+            if lits:
+                self._warm_served = lits
+                msg['warm'] = 1
         self.metrics.bump('sync_msgs_sent')
         self.metrics.bump('sync_state_msgs_sent')
         self.metrics.bump('sync_wire_bytes_sent', len(blob))
@@ -1263,7 +1346,10 @@ class WireConnection(BatchingConnection):
             msg = {'wire': 3, 'sid': table.sid, 'docs': docs,
                    'clocks': clocks, 'counts': counts, 'lens': lens,
                    'blob': blob, 'tab': tab}
+            if self._warm_announce:
+                msg['warm'] = 1
             self.metrics.bump('sync_wire_v3_msgs_sent')
+            self.metrics.bump('sync_wire_def_bytes_sent', len(tab))
             self.metrics.bump('sync_wire_table_hits', tab_hits)
             self.metrics.bump('sync_wire_table_misses', tab_misses)
             self.metrics.set_gauge('sync_wire_table_entries',

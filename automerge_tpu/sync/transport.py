@@ -10,7 +10,14 @@ fleet into a server:
   frames (bad magic, bit-flipped length prefixes, CRC mismatches)
   raise a COUNTED :class:`FrameError` that resets the stream, never a
   crash and never a quarantine (the envelope layer's checksums and
-  retransmits repair whatever the reset dropped);
+  retransmits repair whatever the reset dropped). The codec is
+  zero-copy on both directions: :func:`encode_frame_iov` emits an
+  iovec (header struct + JSON head + the payload's byte fields,
+  spliced without re-copies, CRC folded across the parts) that the
+  write loop drains with ONE ``writelines`` per batch, and
+  :class:`FrameDecoder` parses head/body as :class:`memoryview`
+  slices over a compacting ring buffer, so a received frame is never
+  copied before its CRC check;
 
 * :class:`TransportEndpoint` — server + client in one object, with
   **session multiplexing**: ONE socket per peer pair carries every
@@ -23,6 +30,23 @@ fleet into a server:
   untouched); a CHANGED epoch means the peer restarted, so both sides
   rebuild their links through the wire-session ``resume=True`` path
   and the first flush serves only the divergence window;
+
+* an **eager fast path** (``eager=True``, the default) — staging an
+  envelope kicks the peer link's flusher immediately instead of
+  waiting for the next :meth:`~TransportEndpoint.tick`, with an
+  adaptive micro-coalescing window: the flusher task is scheduled for
+  the NEXT event-loop turn, so every envelope staged in the current
+  synchronous burst rides one flush/one ``writelines``, and kicks
+  that arrive while a drain is in flight coalesce into the drain's
+  next batch (``transport_coalesced_batches``). ``tick()`` keeps
+  ownership of heartbeats, keepalives, re-dial backoff and the
+  failure detector ONLY — on an idle link the staged→socket latency
+  is the syscall floor, not the tick quantum. ``eager=False`` keeps
+  the tick-quantized path alive as the bench A/B baseline. Liveness
+  frames (HELLO, keepalive pings, ``busy`` backpressure replies)
+  bypass the data queue — front-of-queue, flushed on the next wakeup
+  even mid-window — so micro-batching can never delay failure
+  detection;
 
 * a **liveness/membership layer** — a heartbeat-deadline failure
   detector in logical-tick units (configurable ``suspect_after`` /
@@ -76,6 +100,12 @@ CHANNELS = {'data': 0, 'ack': 1, 'busy': 2, 'hb': 3, 'state': 4,
             'ctl': 5}
 CHANNEL_NAMES = {v: k for k, v in CHANNELS.items()}
 
+# ring-buffer compaction threshold: consumed bytes at the front of
+# the decode buffer are reclaimed once they pass this, so steady-state
+# decoding never memmoves per frame and the buffer never grows
+# unboundedly either
+COMPACT_AT = 64 * 1024
+
 # process-wide endpoint epoch mint: a TransportEndpoint stamps its
 # epoch into every HELLO, so the far side can tell a transparent TCP
 # reconnect (same epoch — keep the live connections and their session
@@ -102,10 +132,15 @@ def _channel_of(env):
     return CHANNELS.get(kind, CHANNELS['data'])
 
 
-def encode_frame(dset, env):
-    """One envelope -> one CRC-framed byte string. Binary payload
-    fields (wire blobs, session tabs, state snapshots) are lifted out
-    of the JSON header and shipped raw in the body."""
+def encode_frame_iov(dset, env):
+    """One envelope -> ``(channel, parts, nbytes)``: the frame as an
+    iovec of byte chunks ready for ``writer.writelines``, never
+    joined. Binary payload fields (wire blobs, session tabs, state
+    snapshots) are lifted out of the JSON header and spliced into the
+    iovec AS-IS — an immutable ``bytes`` blob ships without a single
+    copy; mutable buffers (``bytearray``/``memoryview``) are
+    snapshotted once, because the frame may sit in a send queue after
+    the caller reuses its buffer. The CRC folds across the parts."""
     payload = env.get('payload')
     # classify BEFORE the binary fields lift out — a state snapshot
     # is recognized by its (bytes-valued) 'state' payload field
@@ -119,7 +154,9 @@ def encode_frame(dset, env):
             head_payload = {k: v for k, v in payload.items()
                             if k not in names}
             for f in names:
-                part = bytes(payload[f])
+                part = payload[f]
+                if not isinstance(part, bytes):
+                    part = bytes(part)
                 binfields.append([f, len(part)])
                 body_parts.append(part)
             env = {**env, 'payload': head_payload}
@@ -127,11 +164,23 @@ def encode_frame(dset, env):
     if binfields:
         head['b'] = binfields
     head_bytes = json.dumps(head, separators=(',', ':')).encode('utf-8')
-    body = b''.join(body_parts)
-    crc = zlib.crc32(body, zlib.crc32(head_bytes))
-    return _HEADER.pack(FRAME_MAGIC, channel,
-                        len(head_bytes), len(body), crc) \
-        + head_bytes + body
+    crc = zlib.crc32(head_bytes)
+    blen = 0
+    for part in body_parts:
+        crc = zlib.crc32(part, crc)
+        blen += len(part)
+    parts = [_HEADER.pack(FRAME_MAGIC, channel, len(head_bytes),
+                          blen, crc), head_bytes]
+    parts.extend(body_parts)
+    return channel, parts, _HEADER.size + len(head_bytes) + blen
+
+
+def encode_frame(dset, env):
+    """One envelope -> one CRC-framed byte string (the joined form of
+    :func:`encode_frame_iov` — tests and tools that index into the
+    frame use this; the hot path ships the iovec unjoined)."""
+    _channel, parts, _n = encode_frame_iov(dset, env)
+    return b''.join(parts)
 
 
 def encode_ctl_frame(ctl):
@@ -151,16 +200,32 @@ class FrameDecoder:
     a CRC mismatch, an unparseable header — raises :class:`FrameError`
     after bumping ``transport_frame_errors``. :meth:`eof` accounts a
     torn tail (connection died mid-frame) under
-    ``transport_partial_frames`` and discards it unparsed."""
+    ``transport_partial_frames`` and discards it unparsed.
 
-    def __init__(self, max_frame_bytes=MAX_FRAME_BYTES, scope=None):
+    Internally a compacting ring buffer: frames are parsed through
+    :class:`memoryview` slices over the receive buffer — header
+    fields via ``unpack_from``, the CRC check and the JSON parse
+    straight off the views — so no byte of a frame is copied before
+    its CRC verifies. Consumed bytes accumulate at the front
+    (``_pos``) and are reclaimed in one ``del`` once they pass
+    ``compact_at`` (or the buffer empties), amortizing compaction to
+    O(1) per byte instead of a memmove per frame."""
+
+    def __init__(self, max_frame_bytes=MAX_FRAME_BYTES, scope=None,
+                 compact_at=COMPACT_AT):
         self.max_frame_bytes = max_frame_bytes
         self.metrics = scope if scope is not None else metrics
+        self.compact_at = compact_at
         self._buf = bytearray()
+        self._pos = 0
 
     def _error(self, reason):
         self.metrics.bump('transport_frame_errors')
-        self._buf.clear()
+        # reassign rather than clear: feed() may hold live memoryviews
+        # over the old buffer (a resize would raise BufferError); the
+        # old bytearray is dropped when the last view releases
+        self._buf = bytearray()
+        self._pos = 0
         raise FrameError(reason)
 
     def feed(self, data):
@@ -168,71 +233,101 @@ class FrameDecoder:
         completed by ``data``: ``('env', dset, envelope)`` or
         ``('ctl', None, ctl_dict)``."""
         self._buf += data
+        buf = self._buf
+        pos = self._pos
+        end = len(buf)
         out = []
-        while len(self._buf) >= _HEADER.size:
-            magic, _chan, hlen, blen, crc = \
-                _HEADER.unpack_from(self._buf)
-            if magic != FRAME_MAGIC:
-                self._error('bad frame magic')
-            if hlen == 0 or hlen + blen > self.max_frame_bytes:
-                self._error(
-                    'frame length out of bounds (corrupt prefix)')
-            total = _HEADER.size + hlen + blen
-            if len(self._buf) < total:
-                break                    # torn tail: wait for more
-            head = bytes(self._buf[_HEADER.size:_HEADER.size + hlen])
-            body = bytes(self._buf[_HEADER.size + hlen:total])
-            if zlib.crc32(body, zlib.crc32(head)) != crc:
-                self._error('frame crc mismatch')
-            del self._buf[:total]
-            try:
-                obj = json.loads(head.decode('utf-8'))
-            except (UnicodeDecodeError, ValueError):
-                self._error('frame header is not valid json')
-            if not isinstance(obj, dict):
-                self._error('frame header is not an object')
-            ctl = obj.get('ctl')
-            if ctl is not None:
-                if not isinstance(ctl, dict):
-                    self._error('ctl frame is not an object')
+        mv = memoryview(buf)
+        head = body = None
+        try:
+            while end - pos >= _HEADER.size:
+                magic, _chan, hlen, blen, crc = \
+                    _HEADER.unpack_from(buf, pos)
+                if magic != FRAME_MAGIC:
+                    self._error('bad frame magic')
+                if hlen == 0 or hlen + blen > self.max_frame_bytes:
+                    self._error(
+                        'frame length out of bounds (corrupt prefix)')
+                total = _HEADER.size + hlen + blen
+                if end - pos < total:
+                    break                # torn tail: wait for more
+                hstart = pos + _HEADER.size
+                head = mv[hstart:hstart + hlen]
+                body = mv[hstart + hlen:pos + total]
+                if zlib.crc32(body, zlib.crc32(head)) != crc:
+                    self._error('frame crc mismatch')
+                pos += total
+                try:
+                    obj = json.loads(str(head, 'utf-8'))
+                except (UnicodeDecodeError, ValueError):
+                    self._error('frame header is not valid json')
+                if not isinstance(obj, dict):
+                    self._error('frame header is not an object')
+                ctl = obj.get('ctl')
+                if ctl is not None:
+                    if not isinstance(ctl, dict):
+                        self._error('ctl frame is not an object')
+                    self.metrics.bump('transport_frames_received')
+                    out.append(('ctl', None, ctl))
+                    continue
+                dset = obj.get('d')
+                env = obj.get('e')
+                if not isinstance(dset, str) \
+                        or not isinstance(env, dict):
+                    self._error('frame header missing docset/envelope')
+                binfields = obj.get('b')
+                if binfields:
+                    payload = env.get('payload')
+                    if not isinstance(payload, dict) \
+                            or not isinstance(binfields, list):
+                        self._error('binary fields without a payload')
+                    bpos = 0
+                    for entry in binfields:
+                        if not (isinstance(entry, list)
+                                and len(entry) == 2
+                                and isinstance(entry[0], str)
+                                and isinstance(entry[1], int)
+                                and entry[1] >= 0):
+                            self._error('malformed binary field entry')
+                        field, n = entry
+                        # the frame's ONLY copy, and only after the
+                        # CRC proved the bytes: the payload field must
+                        # outlive the ring buffer's next compaction
+                        payload[field] = bytes(body[bpos:bpos + n])
+                        bpos += n
+                    if bpos != blen:
+                        self._error('binary fields disagree with body')
                 self.metrics.bump('transport_frames_received')
-                out.append(('ctl', None, ctl))
-                continue
-            dset = obj.get('d')
-            env = obj.get('e')
-            if not isinstance(dset, str) or not isinstance(env, dict):
-                self._error('frame header missing docset/envelope')
-            binfields = obj.get('b')
-            if binfields:
-                payload = env.get('payload')
-                if not isinstance(payload, dict) \
-                        or not isinstance(binfields, list):
-                    self._error('binary fields without a payload')
-                pos = 0
-                for entry in binfields:
-                    if not (isinstance(entry, list) and len(entry) == 2
-                            and isinstance(entry[0], str)
-                            and isinstance(entry[1], int)
-                            and entry[1] >= 0):
-                        self._error('malformed binary field entry')
-                    field, n = entry
-                    payload[field] = body[pos:pos + n]
-                    pos += n
-                if pos != blen:
-                    self._error('binary fields disagree with body')
-            self.metrics.bump('transport_frames_received')
-            out.append(('env', dset, env))
+                out.append(('env', dset, env))
+        finally:
+            # sub-view slices export the buffer independently of mv:
+            # the LAST frame's head/body must drop too, or the del
+            # below raises BufferError on a still-exported bytearray
+            head = body = None
+            mv.release()
+        # views released: the buffer is resizable again. Reclaim the
+        # consumed prefix wholesale when it empties or grows past the
+        # compaction threshold.
+        self._pos = pos
+        if pos:
+            if pos == len(buf):
+                self._buf = bytearray()
+                self._pos = 0
+            elif pos >= self.compact_at:
+                del buf[:pos]
+                self._pos = 0
         return out
 
     def eof(self):
         """The stream ended; account any torn tail."""
-        if self._buf:
+        if len(self._buf) - self._pos:
             self.metrics.bump('transport_partial_frames')
-            self._buf.clear()
+        self._buf = bytearray()
+        self._pos = 0
 
     @property
     def buffered(self):
-        return len(self._buf)
+        return len(self._buf) - self._pos
 
 
 class _PeerLink:
@@ -248,7 +343,7 @@ class _PeerLink:
         self.writer = None
         self.reader_task = None
         self.writer_task = None
-        self.outq = deque()            # (channel, frame bytes)
+        self.outq = deque()            # (channel, iovec parts, nbytes)
         self.wake = asyncio.Event()
         self.state = 'up'
         self.last_seen = 0
@@ -256,6 +351,12 @@ class _PeerLink:
         self.redial_at = 0
         self.dialing = False
         self.had_socket = False
+        # eager fast path: the in-flight flusher task and the
+        # coalescing latch (a kick during a drain folds into the
+        # drain's next batch instead of spawning a second task)
+        self.flusher = None
+        self.flush_again = False
+        self.kicker = None             # doc-changed handler, if eager
 
 
 class TransportEndpoint:
@@ -273,13 +374,17 @@ class TransportEndpoint:
     ``max_queue`` bounds each peer's outgoing frame queue; past it the
     oldest heartbeat/advert frame collapses first (the envelope layer
     re-advertises), then the oldest frame overall (retransmit
-    repairs).
+    repairs). ``eager`` (default on) is the fast path: staging an
+    envelope schedules an immediate flush on the next event-loop turn
+    instead of waiting for ``tick()`` — ``eager=False`` keeps the
+    tick-quantized path as the A/B baseline.
     """
 
     def __init__(self, node_id, doc_sets, host='127.0.0.1', port=0, *,
                  conn_kwargs=None, resume=True, suspect_after=24,
                  dead_after=64, max_queue=1024,
-                 redial_backoff=(1, 16), max_frame_bytes=None):
+                 redial_backoff=(1, 16), max_frame_bytes=None,
+                 eager=True):
         self.node_id = node_id
         self.doc_sets = dict(doc_sets)
         self.host = host
@@ -294,6 +399,7 @@ class TransportEndpoint:
         self.redial_base, self.redial_max = redial_backoff
         self._probe_every = max(1, suspect_after // 4)
         self.max_frame_bytes = max_frame_bytes or MAX_FRAME_BYTES
+        self.eager = eager
         self.epoch = next(_EPOCH_COUNTER)
         self.peers = {}                # peer_id -> _PeerLink
         self.now = 0
@@ -330,6 +436,7 @@ class TransportEndpoint:
             self._server.close()
         for link in self.peers.values():
             self._cancel_tasks(link)
+            self._drop_kicker(link)
             if link.writer is not None:
                 try:
                     link.writer.close()
@@ -358,6 +465,7 @@ class TransportEndpoint:
             self._server.close()
         for link in self.peers.values():
             self._cancel_tasks(link)
+            self._drop_kicker(link)
             if link.writer is not None:
                 transport = link.writer.transport
                 try:
@@ -368,10 +476,21 @@ class TransportEndpoint:
         await asyncio.sleep(0)
 
     def _cancel_tasks(self, link):
-        for task in (link.reader_task, link.writer_task):
+        for task in (link.reader_task, link.writer_task,
+                     link.flusher):
             if task is not None and not task.done():
                 task.cancel()
-        link.reader_task = link.writer_task = None
+        link.reader_task = link.writer_task = link.flusher = None
+
+    def _drop_kicker(self, link):
+        if link.kicker is None:
+            return
+        for ds in self.doc_sets.values():
+            try:
+                ds.unregister_handler(link.kicker)
+            except Exception:
+                pass
+        link.kicker = None
 
     # -- dialing / handshake -------------------------------------------------
 
@@ -454,6 +573,18 @@ class TransportEndpoint:
             conn.link_state = link.state
             link.conns[name] = conn
             conn.open()
+        if self.eager and link.kicker is None:
+            # eager staging hook: any doc change (local write or
+            # received apply) kicks this link's flusher. The flush
+            # itself runs as a task on the NEXT loop turn, so handler
+            # ordering vs the conns' own doc_changed (which stages
+            # the envelope) does not matter — by the time the flusher
+            # runs, everything staged this turn is visible.
+            def kicker(doc_id, doc, _link=link):
+                self._kick(_link)
+            link.kicker = kicker
+            for ds in self.doc_sets.values():
+                ds.register_handler(kicker)
 
     def _sender(self, link, name):
         def send(env):
@@ -465,7 +596,7 @@ class TransportEndpoint:
     def _enqueue(self, link, dset, env):
         if self.closed:
             return
-        frame = encode_frame(dset, env)
+        channel, parts, nbytes = encode_frame_iov(dset, env)
         q = link.outq
         if len(q) >= self.max_queue:
             # graceful degradation: the queue is bounded, and the
@@ -474,27 +605,101 @@ class TransportEndpoint:
             # nothing; only when no advert remains does the oldest
             # frame overall go (the envelope layer retransmits it)
             dropped = False
-            for i, (chan, _f) in enumerate(q):
-                if chan == CHANNELS['hb']:
+            for i, entry in enumerate(q):
+                if entry[0] == CHANNELS['hb']:
                     del q[i]
                     dropped = True
                     break
             if not dropped:
                 q.popleft()
             self.metrics.bump('transport_frames_dropped')
-        q.append((frame[2], frame))
+        entry = (channel, parts, nbytes)
+        if channel == CHANNELS['busy']:
+            # backpressure replies are liveness: they bypass the data
+            # queue so a saturated link cannot delay the signal that
+            # would relieve it
+            self._insert_liveness(link, entry)
+        else:
+            q.append(entry)
         link.wake.set()
 
-    def _enqueue_ctl(self, link, ctl, front=False):
-        entry = (CHANNELS['ctl'], encode_ctl_frame(ctl))
+    def _enqueue_ctl(self, link, ctl, front=False, liveness=False):
+        frame = encode_ctl_frame(ctl)
+        entry = (CHANNELS['ctl'], [frame], len(frame))
         if front:
             # the HELLO must be the FIRST frame on a fresh socket —
             # the queue may hold data frames from before the socket
             # died, and the acceptor drops anything pre-handshake
             link.outq.appendleft(entry)
+        elif liveness:
+            self._insert_liveness(link, entry)
         else:
             link.outq.append(entry)
         link.wake.set()
+
+    def _insert_liveness(self, link, entry):
+        """Front-of-queue insertion for liveness frames (keepalive
+        pings, busy replies): ahead of every queued data frame but
+        BEHIND any leading ctl frames, so a pending HELLO stays the
+        first frame on its socket. The next writelines batch carries
+        it regardless of how deep the data backlog is."""
+        q = link.outq
+        i = 0
+        for e in q:
+            if e[0] != CHANNELS['ctl']:
+                break
+            i += 1
+        q.insert(i, entry)
+
+    # -- eager fast path -----------------------------------------------------
+
+    def _kick(self, link):
+        """Schedule an immediate flush of this link's staged
+        envelopes. Called on every doc change and every received
+        batch. The flusher task runs on the next event-loop turn —
+        that turn boundary IS the micro-coalescing window: everything
+        staged in the current synchronous burst (a batched apply's
+        doc_changed fan-out, a receive's follow-ups) rides one flush
+        and one writelines. A kick landing while a drain is in flight
+        latches ``flush_again`` instead of spawning a second task, so
+        under load arrivals coalesce into the next batch. Outside the
+        event loop this is a no-op — ``tick()`` or ``poke()`` drains
+        sync-side staging."""
+        if not self.eager or self.closed or not link.conns:
+            return
+        if link.flusher is not None and not link.flusher.done():
+            link.flush_again = True
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        link.flush_again = False
+        link.flusher = loop.create_task(self._flush_link(link))
+
+    async def _flush_link(self, link):
+        self.metrics.bump('transport_eager_flushes')
+        while not self.closed:
+            link.flush_again = False
+            for conn in list(link.conns.values()):
+                conn.flush()
+            if not link.flush_again:
+                return
+            # kicks arrived while draining: one more pass next turn,
+            # carrying everything that accumulated meanwhile
+            self.metrics.bump('transport_coalesced_batches')
+            await asyncio.sleep(0)
+
+    async def poke(self):
+        """Flush envelopes staged from OUTSIDE the event loop (the
+        sync façade applies writes, then pokes): one direct flush per
+        link plus a yield so the write loops run. The event-driven
+        quiesce driver — :meth:`tick` is not needed for data to
+        move."""
+        for link in list(self.peers.values()):
+            for conn in list(link.conns.values()):
+                conn.flush()
+        await asyncio.sleep(0)
 
     def _attach_writer(self, link, writer):
         if link.writer is not None and link.writer is not writer:
@@ -513,19 +718,33 @@ class TransportEndpoint:
     async def _write_loop(self, link, writer):
         try:
             while not self.closed and link.writer is writer:
-                while link.outq and link.writer is writer:
-                    _chan, frame = link.outq.popleft()
-                    writer.write(frame)
-                    self.metrics.bump('transport_frames_sent')
-                    self.metrics.bump('transport_bytes_sent',
-                                      len(frame))
-                await writer.drain()
-                if link.writer is not writer:
-                    return
-                if link.outq:
+                q = link.outq
+                if not q:
+                    link.wake.clear()
+                    await link.wake.wait()
                     continue
-                link.wake.clear()
-                await link.wake.wait()
+                # drain the WHOLE queue into one writelines/drain
+                # cycle: no per-frame write() calls, no join — the
+                # iovec parts go straight to the transport. There is
+                # no await between the pops and the writelines, so a
+                # socket swap cannot strand popped frames.
+                parts = []
+                frames = 0
+                nbytes = 0
+                while q:
+                    entry = q.popleft()
+                    parts.extend(entry[1])
+                    frames += 1
+                    nbytes += entry[2]
+                with self.metrics.trace_span('transport.write',
+                                             frames=frames,
+                                             bytes=nbytes):
+                    writer.writelines(parts)
+                    await writer.drain()
+                self.metrics.bump('transport_frames_sent', frames)
+                self.metrics.bump('transport_bytes_sent', nbytes)
+                self.metrics.observe('transport_frames_per_syscall',
+                                     frames)
         except (ConnectionError, OSError):
             self._detach_socket(link, writer)
         except asyncio.CancelledError:
@@ -544,7 +763,10 @@ class TransportEndpoint:
                     break
                 self.metrics.bump('transport_bytes_received',
                                   len(data))
-                for kind, dset, obj in decoder.feed(data):
+                with self.metrics.trace_span('transport.read',
+                                             bytes=len(data)):
+                    events = decoder.feed(data)
+                for kind, dset, obj in events:
                     if kind == 'ctl':
                         link = self._handle_ctl(link, obj, writer)
                     elif link is None:
@@ -552,6 +774,12 @@ class TransportEndpoint:
                         self.metrics.bump('transport_frames_dropped')
                     else:
                         self._dispatch(link, dset, obj)
+                if link is not None and events:
+                    # a received batch usually stages follow-ups
+                    # (acks ship inline, but applies stage adverts
+                    # and responses) — kick so they leave this turn,
+                    # not next tick
+                    self._kick(link)
         except FrameError:
             pass                        # counted; stream resets below
         except (ConnectionError, OSError):
@@ -661,7 +889,11 @@ class TransportEndpoint:
         """One scheduling quantum, driven by the owner: re-dial lost
         links (capped backoff), tick + flush every multiplexed
         connection, then run the failure detector. Must run inside
-        the event loop — it yields once so IO progresses."""
+        the event loop — it yields once so IO progresses. With the
+        eager path on, data no longer WAITS for this (staging kicks
+        its own flush); tick keeps heartbeats, keepalives, backoff
+        and membership on the quantum schedule, and its closing flush
+        is the safety net for anything staged outside the loop."""
         self.now += 1
         for link in list(self.peers.values()):
             if link.writer is None and link.dial is not None \
@@ -691,9 +923,11 @@ class TransportEndpoint:
             # silently dead socket (the write errors, the link
             # detaches and re-dials). Without it, two peers that mark
             # each other down deadlock: both park, nobody speaks.
+            # Liveness insertion: the ping goes ahead of any queued
+            # data, so a saturated queue cannot delay the probe.
             if link.state != 'up' and link.writer is not None \
                     and self.now % self._probe_every == 0:
-                self._enqueue_ctl(link, {'ping': 1})
+                self._enqueue_ctl(link, {'ping': 1}, liveness=True)
         for link in self.peers.values():
             for conn in link.conns.values():
                 conn.flush()
@@ -709,6 +943,8 @@ class TransportEndpoint:
         flush, and quiescing before that flush would strand them."""
         for link in self.peers.values():
             if link.outq:
+                return True
+            if link.flusher is not None and not link.flusher.done():
                 return True
             for conn in link.conns.values():
                 if conn._sent or conn.backpressure_depth:

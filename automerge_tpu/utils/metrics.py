@@ -140,6 +140,18 @@ SERVING_COUNTERS = (
 #   sync_wire_session_resumes        reconnects that resumed a peer's
 #                                    recorded session (O(divergence))
 #   sync_wire_session_resets         sessions started/reset clean
+#   sync_wire_session_warmups        session string tables pre-seeded
+#                                    from a 'state' bootstrap (both
+#                                    sides derive the SAME literal
+#                                    order from the snapshot, so the
+#                                    first warm flush ships bare refs)
+#   sync_wire_warm_literals          literals interned by those
+#                                    warm-ups (definition bytes the
+#                                    first warm flush did NOT ship)
+#   sync_wire_def_bytes_sent         v3 per-message tab bytes (session
+#                                    defs) — the warm-up bench reads
+#                                    post-bootstrap definition savings
+#                                    off this
 SYNC_COUNTERS = (
     'sync_msgs_sent', 'sync_msgs_received',
     'sync_changes_sent', 'sync_changes_received',
@@ -151,6 +163,8 @@ SYNC_COUNTERS = (
     'sync_wire_table_hits', 'sync_wire_table_misses',
     'sync_wire_table_evictions', 'sync_wire_table_stale_refs',
     'sync_wire_session_resumes', 'sync_wire_session_resets',
+    'sync_wire_session_warmups', 'sync_wire_warm_literals',
+    'sync_wire_def_bytes_sent',
     'sync_wire_clock_entries_elided',
     'sync_wire_bytes_sent', 'sync_wire_parse_ms',
     'sync_apply_ms', 'sync_flush_ms')
@@ -360,13 +374,25 @@ SIM_COUNTERS = (
 #   transport_reconnects       successful re-dials of a previously
 #                              connected link
 #   transport_disconnects      sockets lost (EOF, reset, frame error)
+#   transport_eager_flushes    eager fast path: flusher tasks kicked
+#                              by a staged envelope or received batch
+#                              (the drains that did NOT wait for a
+#                              tick quantum)
+#   transport_coalesced_batches  kicks that landed while a drain was
+#                              in flight and folded into its next
+#                              batch — the micro-coalescing window
+#                              engaging under load
+#   transport_frames_per_syscall  observe series: frames drained per
+#                              writelines/drain cycle (batching
+#                              efficiency of the zero-copy write loop)
 TRANSPORT_COUNTERS = (
     'transport_frames_sent', 'transport_frames_received',
     'transport_bytes_sent', 'transport_bytes_received',
     'transport_frame_errors', 'transport_partial_frames',
     'transport_frames_dropped', 'transport_connects',
     'transport_accepts', 'transport_reconnects',
-    'transport_disconnects')
+    'transport_disconnects', 'transport_eager_flushes',
+    'transport_coalesced_batches', 'transport_frames_per_syscall')
 
 # Liveness/membership counters (sync/transport.py failure detector +
 # the membership hooks in general_doc_set.py / resilient.py — the
@@ -405,7 +431,7 @@ ALL_COUNTER_REGISTRIES = (FAULT_COUNTERS + SERVING_COUNTERS +
 # Observe-series name suffixes: a registered name ending in one of
 # these is a histogram series (count/sum/max + buckets), not a scalar
 # — the exporter zero-fills it as an empty histogram.
-HIST_SUFFIXES = ('_ms', '_rows')
+HIST_SUFFIXES = ('_ms', '_rows', '_per_syscall')
 
 
 # -- histogram geometry --------------------------------------------------------

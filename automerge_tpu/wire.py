@@ -1315,6 +1315,35 @@ class SessionStringTable:
         self.free_refs = []
         self.bytes = 0
 
+    def warm(self, lits):
+        """Pre-seed a FRESH session from a ``'state'`` bootstrap's
+        literal list (wire-v3 warm-up): refs assign sequentially from
+        0 in list order — the peer seeds its receive map by
+        enumerating the SAME deterministically-derived list
+        (:func:`~automerge_tpu.compaction.state_warm_literals`) — and
+        entries start ACKED, because the peer demonstrably holds every
+        literal (it produced the very snapshot they came from), so the
+        first warm flush ships bare refs with no definitions. A
+        duplicate literal burns its ref number instead of skipping it,
+        keeping positional parity with the peer's enumerate whatever
+        the input. No-op on a table that has already allocated refs:
+        warm refs must never collide with organically interned ones.
+        Returns the number of literals seeded."""
+        if self.entries or self.next_ref or self.free_refs:
+            return 0
+        n = 0
+        for lit in lits:
+            ref = self.next_ref
+            self.next_ref += 1
+            if lit in self.entries:
+                continue
+            self._clock += 1
+            self.entries[lit] = [ref, True, 0, self._clock]
+            self.by_ref[ref] = lit
+            self.bytes += len(lit) + _TABLE_ENTRY_OVERHEAD
+            n += 1
+        return n
+
     def intern(self, lit):
         """``(ref, needs_def)`` for one literal. ``needs_def`` until a
         message defining it is acked — hit/miss counters measure

@@ -1960,6 +1960,74 @@ def bench_reconnect(n_docs=10000, divergent=200):
     log(f'wire-v3 warm compression: v2 {v2_bytes / 1e3:.1f} KB, v3 '
         f'{v3_bytes / 1e3:.1f} KB -> {ratio:.2f}x')
 
+    # -- state-bootstrap session warm-up lane ---------------------------
+    def bootstrap_def_bytes(warmup):
+        """Post-bootstrap definition bytes shipped by a peer that cold-
+        bootstrapped from 'state' snapshots and then writes with the
+        snapshot's own (uuid) actors/keys: with SESSION_WARMUP the
+        session table pre-seeds from the snapshot headers, so the
+        first warm flush ships bare refs instead of redefining every
+        literal the serving peer demonstrably holds."""
+        from automerge_tpu import compaction as C
+        from automerge_tpu.sync import connection as _conn
+        prev = _conn.SESSION_WARMUP
+        _conn.SESSION_WARMUP = warmup
+        try:
+            src = GeneralDocSet(80)
+            actors = [f'{d:032x}' for d in range(64)]
+            src.apply_changes_batch(
+                {f'doc{d}': [
+                    {'actor': actors[d], 'seq': 1, 'deps': {},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': f'k{d % 7}', 'value': d}]}]
+                 for d in range(64)})
+            C.compact_docset(src)
+            dst = GeneralDocSet(80)
+            def_bytes = []
+
+            def tap(env):
+                p = env.get('payload') if isinstance(env, dict) \
+                    else None
+                if isinstance(p, dict) and p.get('wire', 0) >= 3:
+                    def_bytes.append(len(p['tab']))
+
+            conns = {}
+            ca = ResilientConnection(
+                src, lambda env: conns['b'].receive_msg(env),
+                wire=True, peer_id='b')
+            cb = ResilientConnection(
+                dst, lambda env: tap(env) or
+                conns['a'].receive_msg(env),
+                wire=True, peer_id='a')
+            conns['a'], conns['b'] = ca, cb
+            ca.open()
+            cb.open()
+            drive(ca, cb, 10)          # cold bootstrap via 'state'
+            assert len(dst.doc_ids) == 64, \
+                'warm-up lane bootstrap incomplete'
+            def_bytes.clear()
+            dst.apply_changes_batch(
+                {f'doc{d}': [
+                    {'actor': actors[d], 'seq': 2,
+                     'deps': {actors[d]: 1},
+                     'ops': [{'action': 'set', 'obj': ROOT_ID,
+                              'key': f'k{d % 7}', 'value': -d}]}]
+                 for d in range(64)})
+            drive(ca, cb, 6)
+            ca.close()
+            cb.close()
+            assert src.materialize('doc0') == dst.materialize('doc0')
+            return sum(def_bytes)
+        finally:
+            _conn.SESSION_WARMUP = prev
+
+    nowarm_def_bytes = bootstrap_def_bytes(False)
+    warm_def_bytes = bootstrap_def_bytes(True)
+    warm_ratio = nowarm_def_bytes / max(warm_def_bytes, 1)
+    log(f'wire-v3 session warm-up: post-bootstrap defs '
+        f'{nowarm_def_bytes} B cold-table vs {warm_def_bytes} B '
+        f'warmed -> {warm_ratio:.1f}x fewer definition bytes')
+
     return {
         'reconnect_bytes': reconnect_bytes,
         'reconnect_ms': reconnect_ms,
@@ -1971,6 +2039,9 @@ def bench_reconnect(n_docs=10000, divergent=200):
         'wire_v3_warm_bytes': v3_bytes,
         'wire_v2_warm_bytes': v2_bytes,
         'wire_v3_compression_ratio': ratio,
+        'reconnect_warmup_nowarm_def_bytes': nowarm_def_bytes,
+        'reconnect_warmup_warm_def_bytes': warm_def_bytes,
+        'reconnect_warmup_def_ratio': round(warm_ratio, 2),
     }
 
 
@@ -1996,14 +2067,31 @@ def reconnect_cli(argv):
 
 def bench_transport(n_docsets=8, beats=24, n_docs=1200,
                     divergent=50, link_samples=30):
-    """Real-socket transport lane (PR 19).
+    """Real-socket transport lane (PR 19, eager fast path PR 20).
 
-    Three figures, all over actual loopback TCP through
+    All figures over actual loopback TCP through
     :class:`~automerge_tpu.sync.transport.TransportEndpoint`:
 
     * ``transport_link_floor_ms_p50/_p99`` — single-change write ->
-      replicated-and-acked round trips over one socket (recorded,
-      not banded: wall-clock floors are hardware-dependent);
+      converged round trips over one socket with the EAGER path
+      (event-driven ``settle``, no tick quantum), vs
+      ``transport_quantized_link_floor_ms_*`` with ``eager=False``
+      driven by the PR 19 tick loop (``run``); their p50 ratio is
+      ``transport_eager_speedup_x`` (banded). Absolute floors are
+      recorded, not banded (hardware-dependent). Both arms pay the
+      same envelope-layer fused applies, so this ratio isolates the
+      tick-schedule overhead only — see the PERF_BUDGETS note;
+    * ``transport_wire_latency_ms_p50/_p99`` — the transport's OWN
+      latency: staged -> delivered, from staging a change on A until
+      B's framer receives the envelope bytes, over a direct socket
+      pair with no CRDT apply inside the timed window. Eager is the
+      sub-millisecond acceptance number (banded <= 1.5 ms);
+      ``transport_quantized_wire_latency_ms_*`` is the tick-driven
+      baseline;
+    * ``transport_frames_per_syscall`` — mean frames drained per
+      writelines/drain cycle over the eager link-floor arm (the
+      micro-coalescing win: conversation legs batch while a drain is
+      in flight);
     * ``transport_mux_overhead_x`` — per-beat drain time of
       ``n_docsets`` doc sets multiplexed over ONE socket vs the same
       schedule over ``n_docsets`` separate socket pairs. The mux must
@@ -2035,26 +2123,114 @@ def bench_transport(n_docsets=8, beats=24, n_docs=1200,
         return sum(v for k, v in metrics.counters.items()
                    if k.endswith(name))
 
-    # -- link floor: replicated-and-acked round trips --------------------
-    sets = [GeneralDocSet(64), GeneralDocSet(64)]
-    fleet = SocketChaosFleet(sets, seed=1)
-    for r in range(1, 4):              # warm the socket + sessions
-        sets[0].apply_changes_batch(
-            {'warm': [change('w', seq=r, value=r,
-                             deps={'w': r - 1} if r > 1 else None)]})
-        fleet.run(max_ticks=200)
-    samples = []
-    for r in range(link_samples):
-        sets[0].apply_changes_batch(
-            {f'd{r}': [change(f'a{r}', value=r)]})
-        t0 = time.perf_counter()
-        fleet.run(max_ticks=200)
-        samples.append((time.perf_counter() - t0) * 1e3)
-    fleet.close()
-    link_p50 = float(np.percentile(samples, 50))
-    link_p99 = float(np.percentile(samples, 99))
-    log(f'transport[link floor]: {link_p50:.2f} ms p50, '
-        f'{link_p99:.2f} ms p99 over {link_samples} round trips')
+    # -- link floor A/B: eager settle vs tick-quantized run --------------
+    def link_floor_arm(eager):
+        sets = [GeneralDocSet(64), GeneralDocSet(64)]
+        fleet = SocketChaosFleet(sets, seed=1, eager=eager)
+        drain = (lambda: fleet.settle(max_rounds=800)) if eager \
+            else (lambda: fleet.run(max_ticks=200))
+        for r in range(1, 4):          # warm the socket + sessions
+            sets[0].apply_changes_batch(
+                {'warm': [change('w', seq=r, value=r,
+                                 deps={'w': r - 1} if r > 1
+                                 else None)]})
+            drain()
+        samples = []
+        for r in range(link_samples):
+            sets[0].apply_changes_batch(
+                {f'd{r}': [change(f'a{r}', value=r)]})
+            t0 = time.perf_counter()
+            drain()
+            samples.append((time.perf_counter() - t0) * 1e3)
+        fleet.close()
+        return (float(np.percentile(samples, 50)),
+                float(np.percentile(samples, 99)))
+
+    quant_p50, quant_p99 = link_floor_arm(eager=False)
+    link_p50, link_p99 = link_floor_arm(eager=True)
+    eager_speedup = quant_p50 / max(link_p50, 1e-9)
+    log(f'transport[link floor]: eager {link_p50:.2f} ms p50 / '
+        f'{link_p99:.2f} ms p99 vs quantized {quant_p50:.2f} / '
+        f'{quant_p99:.2f} -> {eager_speedup:.2f}x '
+        f'({link_samples} round trips)')
+
+    # -- wire latency: staged -> delivered, no CRDT apply in window ------
+    def wire_latency_arm(eager):
+        a, b = GeneralDocSet(64), GeneralDocSet(64)
+        loop = asyncio.new_event_loop()
+        ea = TransportEndpoint('wa', {'s': a}, eager=eager)
+        eb = TransportEndpoint('wb', {'s': b}, eager=eager)
+        eps = [ea, eb]
+        key = 'node/wb/transport_frames_received'
+
+        async def tick_cycle():
+            for ep in eps:
+                await ep.tick()
+            for _ in range(6):
+                await asyncio.sleep(0)
+
+        async def drain():               # untimed inter-sample drain
+            for _ in range(400):
+                await tick_cycle()
+                if not any(ep.pending() for ep in eps):
+                    return
+            raise RuntimeError('wire arm failed to drain')
+
+        async def setup():
+            for ep in eps:
+                await ep.start()
+            await ea.connect('wb', '127.0.0.1', eb.port)
+            await drain()
+
+        async def deliver():
+            base = metrics.counters.get(key, 0)
+            t0 = time.perf_counter()
+            if eager:
+                await ea.poke()          # out-of-loop staging entry
+                for _ in range(8000):
+                    if metrics.counters.get(key, 0) > base:
+                        return (time.perf_counter() - t0) * 1e3
+                    await asyncio.sleep(0)
+            else:
+                for _ in range(400):
+                    if metrics.counters.get(key, 0) > base:
+                        return (time.perf_counter() - t0) * 1e3
+                    await tick_cycle()
+            raise RuntimeError('wire arm: envelope never delivered')
+
+        loop.run_until_complete(setup())
+        samples = []
+        try:
+            for r in range(1, 4):
+                a.apply_changes_batch(
+                    {'warm': [change('w', seq=r, value=r,
+                                     deps={'w': r - 1} if r > 1
+                                     else None)]})
+                loop.run_until_complete(drain())
+            for r in range(link_samples):
+                a.apply_changes_batch(
+                    {f'd{r}': [change(f'a{r}', value=r)]})
+                samples.append(loop.run_until_complete(deliver()))
+                loop.run_until_complete(drain())
+            assert canonical(doc_set_view(a)) == \
+                canonical(doc_set_view(b)), \
+                'wire arm did not converge'
+
+            async def down():
+                for ep in eps:
+                    await ep.close()
+            loop.run_until_complete(down())
+            loop.run_until_complete(asyncio.sleep(0.01))
+        finally:
+            loop.close()
+        return (float(np.percentile(samples, 50)),
+                float(np.percentile(samples, 99)))
+
+    qwire_p50, qwire_p99 = wire_latency_arm(eager=False)
+    wire_p50, wire_p99 = wire_latency_arm(eager=True)
+    log(f'transport[wire latency]: eager staged->delivered '
+        f'{wire_p50:.3f} ms p50 / {wire_p99:.3f} ms p99 vs quantized '
+        f'{qwire_p50:.3f} / {qwire_p99:.3f}')
 
     # -- mux fan-in: one socket vs n_docsets sockets ---------------------
     def mux_arm(shared):
@@ -2119,12 +2295,19 @@ def bench_transport(n_docsets=8, beats=24, n_docs=1200,
                 'mux arm did not converge'
         return float(np.percentile(per_beat, 50))
 
+    # frames/syscall is measured where coalescing matters: the shared
+    # mux arm keeps one link loaded with n_docsets of traffic, so
+    # conversation legs batch into each writelines/drain cycle (the
+    # idle link-floor arm correctly flushes ~1 frame/syscall)
+    metrics.reset_series('transport_frames_per_syscall')
     mux_ms = mux_arm(shared=True)
+    frames_per_syscall = metrics.mean('transport_frames_per_syscall')
     sep_ms = mux_arm(shared=False)
     mux_overhead = mux_ms / max(sep_ms, 1e-9)
     log(f'transport[mux fan-in]: {n_docsets} doc sets over 1 socket '
         f'{mux_ms:.2f} ms/beat vs {n_docsets} sockets '
-        f'{sep_ms:.2f} ms/beat -> {mux_overhead:.2f}x')
+        f'{sep_ms:.2f} ms/beat -> {mux_overhead:.2f}x, '
+        f'{frames_per_syscall:.2f} frames/syscall under load')
 
     # -- reconnect over a real re-dial: resumed vs cold ------------------
     def socket_reconnect_bytes(resume):
@@ -2162,6 +2345,14 @@ def bench_transport(n_docsets=8, beats=24, n_docs=1200,
     return {
         'transport_link_floor_ms_p50': round(link_p50, 3),
         'transport_link_floor_ms_p99': round(link_p99, 3),
+        'transport_quantized_link_floor_ms_p50': round(quant_p50, 3),
+        'transport_quantized_link_floor_ms_p99': round(quant_p99, 3),
+        'transport_eager_speedup_x': round(eager_speedup, 3),
+        'transport_wire_latency_ms_p50': round(wire_p50, 3),
+        'transport_wire_latency_ms_p99': round(wire_p99, 3),
+        'transport_quantized_wire_latency_ms_p50': round(qwire_p50, 3),
+        'transport_quantized_wire_latency_ms_p99': round(qwire_p99, 3),
+        'transport_frames_per_syscall': round(frames_per_syscall, 3),
         'transport_mux_docsets': n_docsets,
         'transport_mux_ms_per_beat': round(mux_ms, 3),
         'transport_separate_ms_per_beat': round(sep_ms, 3),

@@ -183,6 +183,32 @@ class TestChromeTrace:
         n_spans, n_instants, _ = validate_chrome_trace(obj)
         assert (n_spans, n_instants) == (1, 1)
 
+    def test_transport_summary_figures(self):
+        """trace_report's transport rollup: write spans fold into
+        syscall-batch count, frames/syscall and link-floor p50/p99;
+        read spans count bytes only."""
+        import sys
+        sys.path.insert(0, 'tools')
+        try:
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        events = [
+            {'event': 'span', 'name': 'transport.write', 'ts': i,
+             'dur_ms': float(i % 5), 'frames': 4, 'bytes': 1024}
+            for i in range(100)]
+        events.append({'event': 'span', 'name': 'transport.read',
+                       'ts': 100.0, 'dur_ms': 0.2, 'bytes': 4096})
+        events.append({'event': 'span', 'name': 'transport.write',
+                       'ts': 101.0})       # no dur: skipped
+        out = trace_report.transport_summary(events)
+        n, frames, nbytes, p50, p99 = out['transport.write']
+        assert (n, frames, nbytes) == (100, 400, 102400)
+        assert p50 == 2.0 and p99 == 4.0
+        n, frames, nbytes, p50, p99 = out['transport.read']
+        assert (n, frames, nbytes) == (1, 0, 4096)
+        assert p50 == p99 == 0.2
+
     def test_incident_file_to_trace_report(self, tmp_path):
         """The operator pipeline: incident JSONL (flight-recorder
         dump) -> tools/trace_report.py -> loadable Chrome trace."""

@@ -11,7 +11,9 @@ incident, and a session resume that serves only the divergence
 window.
 """
 
+import json
 import random
+import zlib
 
 import pytest
 
@@ -22,7 +24,9 @@ from automerge_tpu.sync import (FrameDecoder, FrameError,
                                 ServingDocSet, WireConnection)
 from automerge_tpu.sync.chaos import (SocketChaosFleet, canonical,
                                       doc_set_view)
-from automerge_tpu.sync.transport import (CHANNELS, encode_ctl_frame,
+from automerge_tpu.sync.transport import (CHANNELS, FRAME_MAGIC,
+                                          MAX_FRAME_BYTES, _HEADER,
+                                          encode_ctl_frame,
                                           encode_frame)
 from automerge_tpu.utils.metrics import FlightRecorder, metrics
 
@@ -204,6 +208,216 @@ class TestFramingFuzz:
                 assert item in originals
 
 
+class _OracleDecoder:
+    """Plain-copy reference decoder: the same frame grammar as
+    :class:`FrameDecoder`, implemented the naive way — an immutable
+    ``bytes`` buffer re-sliced per feed, a fresh copy per field, no
+    ring, no memoryviews. The differential fuzz below holds the
+    zero-copy ring decoder to this oracle's exact accept/reject/
+    counter behavior, so any divergence introduced by view slicing
+    or compaction shows up as a mismatch, not a silent protocol
+    drift."""
+
+    def __init__(self, max_frame_bytes=MAX_FRAME_BYTES):
+        self.max_frame_bytes = max_frame_bytes
+        self._buf = b''
+        self.frames_received = 0
+        self.frame_errors = 0
+        self.partial_frames = 0
+
+    def _error(self, reason):
+        self.frame_errors += 1
+        self._buf = b''
+        raise FrameError(reason)
+
+    def feed(self, data):
+        self._buf += bytes(data)
+        out = []
+        while len(self._buf) >= _HEADER.size:
+            magic, _chan, hlen, blen, crc = \
+                _HEADER.unpack_from(self._buf, 0)
+            if magic != FRAME_MAGIC:
+                self._error('bad frame magic')
+            if hlen == 0 or hlen + blen > self.max_frame_bytes:
+                self._error('frame length out of bounds')
+            frame_len = _HEADER.size + hlen + blen
+            if len(self._buf) < frame_len:
+                break
+            head = self._buf[_HEADER.size:_HEADER.size + hlen]
+            body = self._buf[_HEADER.size + hlen:frame_len]
+            if zlib.crc32(body, zlib.crc32(head)) != crc:
+                self._error('frame crc mismatch')
+            self._buf = self._buf[frame_len:]
+            try:
+                obj = json.loads(head.decode('utf-8'))
+            except (UnicodeDecodeError, ValueError):
+                self._error('frame header is not valid json')
+            if not isinstance(obj, dict):
+                self._error('frame header is not an object')
+            ctl = obj.get('ctl')
+            if ctl is not None:
+                if not isinstance(ctl, dict):
+                    self._error('ctl frame is not an object')
+                self.frames_received += 1
+                out.append(('ctl', None, ctl))
+                continue
+            dset = obj.get('d')
+            env = obj.get('e')
+            if not isinstance(dset, str) or not isinstance(env, dict):
+                self._error('frame header missing docset/envelope')
+            binfields = obj.get('b')
+            if binfields:
+                payload = env.get('payload')
+                if not isinstance(payload, dict) \
+                        or not isinstance(binfields, list):
+                    self._error('binary fields without a payload')
+                bpos = 0
+                for entry in binfields:
+                    if not (isinstance(entry, list)
+                            and len(entry) == 2
+                            and isinstance(entry[0], str)
+                            and isinstance(entry[1], int)
+                            and entry[1] >= 0):
+                        self._error('malformed binary field entry')
+                    field, n = entry
+                    payload[field] = body[bpos:bpos + n]
+                    bpos += n
+                if bpos != blen:
+                    self._error('binary fields disagree with body')
+            self.frames_received += 1
+            out.append(('env', dset, env))
+        return out
+
+    def eof(self):
+        if self._buf:
+            self.partial_frames += 1
+        self._buf = b''
+
+    @property
+    def buffered(self):
+        return len(self._buf)
+
+
+class TestFramingDifferential:
+    """Ring decoder vs plain-copy oracle, byte for byte: the seeded
+    corpus from TestFramingFuzz runs through both side by side with
+    identical chunk boundaries, and every rep must agree on decoded
+    frames, raise/no-raise, AND counter deltas. The ring arm runs
+    with a tiny compact_at so nearly every consumed frame triggers
+    a compaction — the exact machinery the oracle doesn't have."""
+
+    def _corpus(self):
+        rng = random.Random(0xF7A)
+        envs = [env_data(seq=i, payload={
+            'docs': [f'd{i}'], 'clocks': [{'a': i + 1}],
+            'blob': bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(64)))})
+            for i in range(6)]
+        stream = b''.join(encode_frame('f', e) for e in envs)
+        return rng, stream
+
+    def test_ring_and_oracle_agree_on_fuzzed_streams(self):
+        rng, stream = self._corpus()
+        for rep in range(300):
+            data = bytearray(stream)
+            mode = rep % 3
+            if mode == 0:              # flip 1-4 bytes
+                for _ in range(rng.randrange(1, 5)):
+                    data[rng.randrange(len(data))] ^= \
+                        1 << rng.randrange(8)
+            elif mode == 1:            # truncate
+                del data[rng.randrange(len(data)):]
+            else:                      # splice garbage mid-stream
+                at = rng.randrange(len(data))
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 40)))
+                data[at:at] = junk
+            chunks = []
+            at = 0
+            while at < len(data):
+                n = rng.randrange(1, 512)
+                chunks.append(bytes(data[at:at + n]))
+                at += n
+            ring = FrameDecoder(compact_at=97)
+            oracle = _OracleDecoder()
+            received = total('transport_frames_received')
+            errors = total('transport_frame_errors')
+            partials = total('transport_partial_frames')
+            ring_out, ring_err = [], False
+            oracle_out, oracle_err = [], False
+            try:
+                for chunk in chunks:
+                    ring_out += ring.feed(chunk)
+                ring.eof()
+            except FrameError:
+                ring_err = True
+            try:
+                for chunk in chunks:
+                    oracle_out += oracle.feed(chunk)
+                oracle.eof()
+            except FrameError:
+                oracle_err = True
+            assert ring_err == oracle_err, f'rep {rep}'
+            assert ring_out == oracle_out, f'rep {rep}'
+            assert total('transport_frames_received') - received \
+                == oracle.frames_received, f'rep {rep}'
+            assert total('transport_frame_errors') - errors \
+                == oracle.frame_errors, f'rep {rep}'
+            assert total('transport_partial_frames') - partials \
+                == oracle.partial_frames, f'rep {rep}'
+
+    def test_frame_straddling_the_compaction_point(self):
+        """The second frame's head arrives split across a compaction:
+        the first frame's consumed bytes pass compact_at with a torn
+        tail behind them, so the `del buf[:pos]` slides that tail to
+        offset zero mid-frame."""
+        first = encode_frame('f', env_data(seq=0, payload={
+            'docs': ['d0'], 'blob': b'x' * 200}))
+        second = encode_frame('f', env_data(seq=1))
+        dec = FrameDecoder(compact_at=len(first) - 8)
+        out = dec.feed(first + second[:7])
+        assert [e['seq'] for _k, _d, e in out] == [0]
+        assert dec.buffered == 7
+        out = dec.feed(second[7:])
+        assert out == [('env', 'f', env_data(seq=1))]
+        assert dec.buffered == 0
+
+    def test_byte_at_a_time_with_constant_compaction(self):
+        """compact_at=1 forces a compaction after every consumed
+        frame; single-byte feeds make every offset a chunk boundary.
+        All frames must still decode intact and in order."""
+        frames = [encode_frame('f', env_data(seq=i, payload={
+            'docs': ['d0'], 'blob': bytes([i]) * (i * 37 % 64)}))
+            for i in range(5)]
+        dec = FrameDecoder(compact_at=1)
+        out = []
+        for b in b''.join(frames):
+            out += dec.feed(bytes([b]))
+        assert [e['seq'] for _k, _d, e in out] == [0, 1, 2, 3, 4]
+        assert dec.buffered == 0
+
+    def test_max_size_frame_at_the_wrap(self):
+        """A frame of exactly max_frame_bytes whose bytes land right
+        after a compaction decodes; one byte over the cap is rejected
+        as a counted error, never buffered."""
+        small = encode_frame('f', env_data(seq=0))
+        big = encode_frame('f', env_data(seq=1, payload={
+            'docs': ['d0'], 'blob': bytes(range(256)) * 4}))
+        _m, _c, hlen, blen, _crc = _HEADER.unpack_from(big, 0)
+        dec = FrameDecoder(max_frame_bytes=hlen + blen,
+                           compact_at=len(small))
+        out = dec.feed(small + big[:20])   # compaction fires here
+        out += dec.feed(big[20:])
+        assert [e['seq'] for _k, _d, e in out] == [0, 1]
+        assert dec.buffered == 0
+        tight = FrameDecoder(max_frame_bytes=hlen + blen - 1)
+        before = total('transport_frame_errors')
+        with pytest.raises(FrameError):
+            tight.feed(big)
+        assert total('transport_frame_errors') == before + 1
+        assert tight.buffered == 0
+
+
 # ---------------------------------------------------------------------------
 # delta-encoded clock adverts (satellite 1)
 
@@ -360,6 +574,125 @@ class TestMembershipPark:
         assert conn.connection_status()['state'] == 'up'
         conn.set_link_state('down')
         assert conn.connection_status()['state'] == 'down'
+
+
+# ---------------------------------------------------------------------------
+# observability: the fast path must be measurable in production
+
+
+class TestTransportObservability:
+    def test_write_read_spans_and_coalescing_counters(self):
+        """A traced fleet run leaves transport.write spans (frames +
+        bytes per writelines batch), transport.read spans (bytes per
+        feed), a frames-per-syscall series and eager-flush counters —
+        the figures trace_report prints next to wire MB/s."""
+        rec = FlightRecorder(8192)
+        metrics.subscribe(rec)
+        flushes = total('transport_eager_flushes')
+        fps_n = total('transport_frames_per_syscall.count')
+        try:
+            sets = [GeneralDocSet(8) for _ in range(2)]
+            fleet = SocketChaosFleet(sets, seed=5)
+            try:
+                for t in range(4):
+                    write(sets[t % 2], f'doc{t}', f'a{t}', t)
+                    fleet.tick()
+                fleet.run(max_ticks=200)
+            finally:
+                fleet.close()
+        finally:
+            metrics.unsubscribe(rec)
+        assert total('transport_eager_flushes') > flushes
+        assert total('transport_frames_per_syscall.count') > fps_n
+        spans = [e for e in rec.events()
+                 if e.get('event') == 'span']
+        writes = [e for e in spans
+                  if e.get('name') == 'transport.write']
+        reads = [e for e in spans
+                 if e.get('name') == 'transport.read']
+        assert writes, 'no transport.write spans recorded'
+        assert reads, 'no transport.read spans recorded'
+        assert all(e.get('frames', 0) >= 1 and e.get('bytes', 0) > 0
+                   for e in writes)
+        assert all(e.get('bytes', 0) > 0 for e in reads)
+
+
+# ---------------------------------------------------------------------------
+# liveness fast path (eager satellite: HELLO / pings / busy replies
+# bypass coalescing, and the failure-detector deadlines don't move
+# when the eager path is on and the data queue is saturated)
+
+
+class TestLivenessFastPath:
+    def test_liveness_frames_jump_the_data_backlog(self):
+        """Keepalive pings and busy replies insert ahead of every
+        queued data frame but BEHIND leading ctl frames, so a pending
+        HELLO stays first on its socket."""
+        from automerge_tpu.sync.transport import (TransportEndpoint,
+                                                  _PeerLink)
+        ep = TransportEndpoint('n0', {})
+        link = _PeerLink('p0')
+        hello = encode_ctl_frame({'hello': 1, 'node': 'n0'})
+        link.outq.append((CHANNELS['ctl'], [hello], len(hello)))
+        for i in range(4):
+            f = encode_frame('f', env_data(seq=i))
+            link.outq.append((CHANNELS['data'], [f], len(f)))
+        ep._enqueue_ctl(link, {'ping': 1}, liveness=True)
+        busy = dict(env_data(seq=9))
+        busy['kind'] = 'busy'
+        ep._enqueue(link, 'f', busy)
+        chans = [e[0] for e in link.outq]
+        assert chans[0] == CHANNELS['ctl']     # the HELLO stays first
+        assert chans[1] == CHANNELS['ctl']     # ping right behind it
+        assert chans[2] == CHANNELS['busy']    # busy reply next
+        assert all(c == CHANNELS['data'] for c in chans[3:])
+
+    def _detection_ticks(self, saturate):
+        """Kill node1, then (optionally) pile writes onto node0 every
+        tick so its outgoing data path to the dead peer saturates.
+        Returns (ticks-to-suspect, ticks-to-down, frames pushed at
+        the dead link during the detection window)."""
+        sets = [GeneralDocSet(16) for _ in range(2)]
+        fleet = SocketChaosFleet(sets, seed=7, suspect_after=6,
+                                 dead_after=12)
+        try:
+            write(sets[0], 'doc0', 'a0', 1)
+            fleet.run(max_ticks=300)
+            fleet.kill(1)
+            sent0 = total('transport_frames_sent')
+            t0 = fleet.now
+            ep0 = fleet.endpoints[0]
+            suspect_at = down_at = None
+            n = 0
+            while fleet.now < t0 + 40 and down_at is None:
+                if saturate:
+                    for _ in range(4):
+                        write(sets[0], f'sat{n}', f's{n:02d}', n)
+                        n += 1
+                fleet.tick()
+                state = ep0.membership().get('node1')
+                if suspect_at is None and state in ('suspect', 'down'):
+                    suspect_at = fleet.now - t0
+                if down_at is None and state == 'down':
+                    down_at = fleet.now - t0
+            pushed = total('transport_frames_sent') - sent0
+            return suspect_at, down_at, pushed
+        finally:
+            fleet.close()
+
+    def test_deadlines_unchanged_under_saturated_eager_queue(self):
+        """Regression for the eager fast path: suspect/dead are
+        judged on logical ticks and last_seen only — a saturated
+        data queue (eager flushes landing every tick) must not move
+        either deadline by a single tick."""
+        idle = self._detection_ticks(False)
+        loaded = self._detection_ticks(True)
+        assert idle[1] is not None, 'idle run never detected death'
+        assert loaded[1] is not None, 'loaded run never detected death'
+        assert loaded[:2] == idle[:2], \
+            f'deadlines moved under load: {idle[:2]} -> {loaded[:2]}'
+        assert loaded[2] > idle[2] + 20, \
+            'saturation arm never actually pushed a data backlog'
 
 
 # ---------------------------------------------------------------------------

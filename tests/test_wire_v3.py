@@ -791,3 +791,138 @@ class TestV3Durability:
         got = {d: rec.doc_set.materialize(d) for d in doc_ids}
         assert got == want
         rec.close()
+
+
+class TestSessionWarmup:
+    """Wire-v3 session-table warm-up from 'state' bootstraps (ISSUE
+    20): both ends derive the same literal list from the same snapshot
+    payloads, the bootstrapper pre-seeds its tx table (refs 0..n-1,
+    acked), and the serving peer seeds its rx map from the list it
+    recorded — so the first warm flush ships bare refs with no
+    definitions."""
+
+    def test_warm_assigns_sequential_acked_refs(self):
+        t = wire.SessionStringTable()
+        lits = [b'\x00alice', b'\x00bob', b'\x00title']
+        assert t.warm(lits) == 3
+        for i, lit in enumerate(lits):
+            assert t.by_ref[i] == lit
+            ref, needs_def = t.intern(lit)
+            assert ref == i and not needs_def   # acked from birth
+        assert t.hits == 3 and t.misses == 0
+
+    def test_warm_noop_on_used_table(self):
+        t = wire.SessionStringTable()
+        t.intern(b'\x00organic')
+        assert t.warm([b'\x00late']) == 0
+        assert b'\x00late' not in t.entries
+
+    def test_warm_duplicate_burns_ref_for_parity(self):
+        # a duplicate literal consumes its ref number, so sender refs
+        # stay positionally aligned with the receiver's enumerate seed
+        t = wire.SessionStringTable()
+        lits = [b'\x00a', b'\x00dup', b'\x00dup', b'\x00b']
+        assert t.warm(lits) == 3
+        assert t.by_ref[3] == b'\x00b' and 2 not in t.by_ref
+        assert t.next_ref == 4
+
+    def test_state_warm_literals_deterministic_and_capped(self):
+        from automerge_tpu import compaction as C
+        src = GeneralDocSet(8)
+        src.apply_changes_batch(
+            {f'doc{i}': [
+                {'actor': f'{i:032x}', 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': f'key{i}', 'value': i}]}]
+             for i in range(4)})
+        C.compact_docset(src)
+        chunks = [src.store.horizon[src.id_of[f'doc{i}']]['state']
+                  for i in range(4)]
+        lits = C.state_warm_literals(chunks)
+        assert lits == C.state_warm_literals(chunks)  # deterministic
+        assert b'\x00' + b'0' * 31 + b'0' in lits     # actor of doc0
+        assert b'\x00key3' in lits
+        assert len(lits) == len(set(lits))            # deduped
+        # a corrupt chunk contributes nothing and never raises
+        assert C.state_warm_literals([b'garbage'] + chunks) == lits
+        # the byte budget caps the list deterministically
+        capped = C.state_warm_literals(chunks, budget=40)
+        assert capped == lits[:len(capped)] and len(capped) < len(lits)
+
+    def _bootstrap(self, warmup, monkeypatch):
+        from automerge_tpu import compaction as C
+        from automerge_tpu.sync import connection as conn_mod
+        monkeypatch.setattr(conn_mod, 'SESSION_WARMUP', warmup)
+        src = GeneralDocSet(8)
+        actors = [f'{i:032x}' for i in range(4)]
+        src.apply_changes_batch(
+            {f'doc{i}': [
+                {'actor': actors[i], 'seq': 1, 'deps': {},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': f'key{i}', 'value': i}]}]
+             for i in range(4)})
+        C.compact_docset(src)
+        dst = GeneralDocSet(8)
+        msgs_a, msgs_b = [], []
+        taps = []
+
+        def send_b(m):
+            if isinstance(m, dict) and m.get('wire', 0) >= 3:
+                taps.append(m)
+            msgs_b.append(m)
+
+        ca = WireConnection(src, msgs_a.append)
+        cb = WireConnection(dst, send_b)
+        ca.open()
+        cb.open()
+        for _ in range(12):
+            ca.flush()
+            cb.flush()
+            if not (msgs_a or msgs_b):
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        assert len(dst.doc_ids) == 4
+        taps.clear()
+        # post-bootstrap: dst writes with the snapshot's own literals
+        dst.apply_changes_batch(
+            {f'doc{i}': [
+                {'actor': actors[i], 'seq': 2,
+                 'deps': {actors[i]: 1},
+                 'ops': [{'action': 'set', 'obj': ROOT_ID,
+                          'key': f'key{i}', 'value': -i}]}]
+             for i in range(4)})
+        for _ in range(12):
+            ca.flush()
+            cb.flush()
+            if not (msgs_a or msgs_b):
+                break
+            for m in msgs_a[:]:
+                msgs_a.remove(m)
+                cb.receive_msg(m)
+            cb.flush()
+            for m in msgs_b[:]:
+                msgs_b.remove(m)
+                ca.receive_msg(m)
+        assert src.materialize('doc0') == dst.materialize('doc0') \
+            == {'key0': 0}
+        return sum(len(m['tab']) for m in taps)
+
+    def test_bootstrap_warm_flush_ships_bare_refs(self, monkeypatch):
+        before = dict(metrics.counters)
+        warm_tab = self._bootstrap(True, monkeypatch)
+        assert metrics.counters.get('sync_wire_session_warmups', 0) \
+            >= before.get('sync_wire_session_warmups', 0) + 2
+        assert metrics.counters.get('sync_wire_warm_literals', 0) \
+            > before.get('sync_wire_warm_literals', 0)
+        assert metrics.counters.get('sync_wire_table_stale_refs', 0) \
+            == before.get('sync_wire_table_stale_refs', 0)
+        cold_tab = self._bootstrap(False, monkeypatch)
+        # the warmed session redefines none of the snapshot's uuid
+        # actors/keys; the cold table defines them all
+        assert warm_tab < cold_tab

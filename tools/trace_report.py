@@ -129,6 +129,42 @@ def wire_throughput(events):
     return out
 
 
+def transport_summary(events):
+    """Transport fast-path figures from ``transport.write`` /
+    ``transport.read`` spans: each write span is one writelines/drain
+    batch stamped with its frame and byte counts, so a trace shows the
+    link-floor latency distribution (p50/p99 of the syscall batch) and
+    the frames-per-syscall coalescing ratio next to the wire MB/s.
+    Returns ``{span_name: (n, frames, total_bytes, p50_ms, p99_ms)}``
+    with ``frames`` 0 for the read side (read spans count bytes
+    only — frames are decoded after the span closes)."""
+    rows = {}
+    for e in events:
+        if e.get('event') != 'span':
+            continue
+        name = e.get('name')
+        if name not in ('transport.write', 'transport.read'):
+            continue
+        dur = e.get('dur_ms')
+        if not isinstance(dur, (int, float)):
+            continue
+        row = rows.setdefault(name, [0, 0, []])
+        frames = e.get('frames')
+        nbytes = e.get('bytes')
+        if isinstance(frames, (int, float)):
+            row[0] += int(frames)
+        if isinstance(nbytes, (int, float)):
+            row[1] += int(nbytes)
+        row[2].append(float(dur))
+    out = {}
+    for name, (frames, nbytes, durs) in rows.items():
+        durs.sort()
+        p50 = durs[len(durs) // 2]
+        p99 = durs[min(len(durs) - 1, int(len(durs) * 0.99))]
+        out[name] = (len(durs), frames, nbytes, p50, p99)
+    return out
+
+
 def session_table_summary(events):
     """Wire-v3 session string-table efficiency from ``sync_wire_send``
     instants: each v3 send stamps how many literal occurrences rode as
@@ -290,6 +326,12 @@ def main(argv=None):
             rate = total / (ms / 1e3) / 1e6 if ms else 0.0
             print(f'  {name}: {n} spans, {int(total) >> 10} KiB in '
                   f'{ms:.1f} ms -> {rate:.0f} MB/s')
+        for name, (n, frames, nbytes, p50, p99) in sorted(
+                transport_summary(events).items()):
+            per = f', {frames / n:.1f} frames/syscall' if frames else ''
+            print(f'  {name}: {n} syscall batches, '
+                  f'{int(nbytes) >> 10} KiB{per}, link floor '
+                  f'p50 {p50:.3f} ms p99 {p99:.3f} ms')
         sends, hits, misses = session_table_summary(events)
         if sends:
             lookups = hits + misses
